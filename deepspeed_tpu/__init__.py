@@ -1,0 +1,9 @@
+"""deepspeed_tpu: a TPU-native large-scale training & inference framework.
+
+Provides the capabilities of the DeepSpeed reference framework, re-designed for
+JAX/XLA/Pallas on TPU device meshes.
+"""
+__version__ = "0.1.0"
+
+from . import comm  # noqa: F401
+from .accelerator import get_accelerator  # noqa: F401

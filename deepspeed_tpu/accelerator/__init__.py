@@ -1,0 +1,13 @@
+from .abstract_accelerator import Accelerator, DeepSpeedAccelerator
+from .cpu_accelerator import CPUAccelerator
+from .real_accelerator import get_accelerator, set_accelerator
+from .tpu_accelerator import TPUAccelerator
+
+__all__ = [
+    "Accelerator",
+    "DeepSpeedAccelerator",
+    "CPUAccelerator",
+    "TPUAccelerator",
+    "get_accelerator",
+    "set_accelerator",
+]

@@ -1,0 +1,139 @@
+"""Accelerator abstraction for the TPU-native framework.
+
+Mirrors the role of DeepSpeed's ``DeepSpeedAccelerator`` ABC
+(reference: accelerator/abstract_accelerator.py:12-305) but is designed for
+JAX/XLA backends: there are no CUDA streams/events to expose, so the surface
+covers device enumeration, memory statistics, dtype support, RNG, and the
+communication-backend name used by ``deepspeed_tpu.comm``.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Any, Dict, List, Optional
+
+
+class Accelerator(abc.ABC):
+    """Abstract device runtime.
+
+    Concrete subclasses: :class:`~deepspeed_tpu.accelerator.tpu_accelerator.TPUAccelerator`
+    and :class:`~deepspeed_tpu.accelerator.cpu_accelerator.CPUAccelerator`.
+    """
+
+    _name: str = "abstract"
+    _communication_backend_name: str = "xla"
+
+    # ------------------------------------------------------------------ #
+    # Identity
+    # ------------------------------------------------------------------ #
+    def device_name(self) -> str:
+        return self._name
+
+    def communication_backend_name(self) -> str:
+        """Backend string handed to ``comm.init_distributed``."""
+        return self._communication_backend_name
+
+    @abc.abstractmethod
+    def is_available(self) -> bool:
+        ...
+
+    # ------------------------------------------------------------------ #
+    # Devices
+    # ------------------------------------------------------------------ #
+    @abc.abstractmethod
+    def devices(self) -> List[Any]:
+        """All addressable + non-addressable devices (global view)."""
+
+    @abc.abstractmethod
+    def local_devices(self) -> List[Any]:
+        """Devices attached to this process."""
+
+    def device_count(self) -> int:
+        return len(self.devices())
+
+    def local_device_count(self) -> int:
+        return len(self.local_devices())
+
+    def current_device(self) -> Any:
+        return self.local_devices()[0]
+
+    def synchronize(self, x: Any = None) -> None:
+        """Block until all pending work (or ``x``) is done."""
+        import jax
+
+        if x is not None:
+            jax.block_until_ready(x)
+        else:
+            jax.effects_barrier()
+
+    # ------------------------------------------------------------------ #
+    # Memory
+    # ------------------------------------------------------------------ #
+    def memory_stats(self, device: Any = None) -> Dict[str, int]:
+        dev = device if device is not None else self.current_device()
+        stats = getattr(dev, "memory_stats", lambda: None)()
+        return stats or {}
+
+    def memory_allocated(self, device: Any = None) -> int:
+        return int(self.memory_stats(device).get("bytes_in_use", 0))
+
+    def max_memory_allocated(self, device: Any = None) -> int:
+        return int(self.memory_stats(device).get("peak_bytes_in_use", 0))
+
+    def total_memory(self, device: Any = None) -> int:
+        return int(self.memory_stats(device).get("bytes_limit", 0))
+
+    def available_memory(self, device: Any = None) -> int:
+        stats = self.memory_stats(device)
+        return int(stats.get("bytes_limit", 0)) - int(stats.get("bytes_in_use", 0))
+
+    # ------------------------------------------------------------------ #
+    # Dtypes
+    # ------------------------------------------------------------------ #
+    def is_bf16_supported(self) -> bool:
+        return True
+
+    def is_fp16_supported(self) -> bool:
+        return True
+
+    def supported_dtypes(self) -> List[Any]:
+        import jax.numpy as jnp
+
+        out = [jnp.float32]
+        if self.is_bf16_supported():
+            out.append(jnp.bfloat16)
+        if self.is_fp16_supported():
+            out.append(jnp.float16)
+        return out
+
+    def preferred_dtype(self) -> Any:
+        import jax.numpy as jnp
+
+        return jnp.bfloat16 if self.is_bf16_supported() else jnp.float32
+
+    # ------------------------------------------------------------------ #
+    # RNG
+    # ------------------------------------------------------------------ #
+    def rng_key(self, seed: int = 0) -> Any:
+        import jax
+
+        return jax.random.PRNGKey(seed)
+
+    # ------------------------------------------------------------------ #
+    # Kernel/op support
+    # ------------------------------------------------------------------ #
+    def supports_pallas(self) -> bool:
+        return False
+
+    def op_builder_dir(self) -> str:
+        return "deepspeed_tpu.ops"
+
+    def platform(self) -> str:
+        """JAX platform string ('tpu'/'cpu'/'gpu')."""
+        return self._name
+
+    def __repr__(self) -> str:  # pragma: no cover
+        return f"<{type(self).__name__} devices={self.device_count()}>"
+
+
+# Backwards-compat alias matching the reference class name.
+DeepSpeedAccelerator = Accelerator

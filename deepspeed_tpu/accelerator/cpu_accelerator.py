@@ -1,0 +1,47 @@
+"""CPU accelerator runtime — used for tests and host-offloaded compute.
+
+Reference analogue: accelerator/cpu_accelerator.py. With
+``--xla_force_host_platform_device_count=N`` the CPU backend exposes N virtual
+devices, which is how the test harness simulates multi-chip meshes.
+"""
+from __future__ import annotations
+
+from typing import Any, List
+
+from .abstract_accelerator import Accelerator
+
+
+class CPUAccelerator(Accelerator):
+    _name = "cpu"
+    _communication_backend_name = "xla"
+
+    def is_available(self) -> bool:
+        return True
+
+    def devices(self) -> List[Any]:
+        import jax
+
+        return jax.devices("cpu")
+
+    def local_devices(self) -> List[Any]:
+        import jax
+
+        return [d for d in jax.local_devices(backend="cpu")]
+
+    def memory_stats(self, device: Any = None):
+        # CPU backend does not report allocator stats; use psutil-free /proc.
+        try:
+            with open("/proc/meminfo") as f:
+                info = {}
+                for line in f:
+                    parts = line.split()
+                    info[parts[0].rstrip(":")] = int(parts[1]) * 1024
+            total = info.get("MemTotal", 0)
+            avail = info.get("MemAvailable", 0)
+            return {
+                "bytes_limit": total,
+                "bytes_in_use": total - avail,
+                "peak_bytes_in_use": total - avail,
+            }
+        except OSError:
+            return {}

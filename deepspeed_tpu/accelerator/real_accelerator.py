@@ -1,0 +1,43 @@
+"""Accelerator selection (reference analogue: accelerator/real_accelerator.py:51-240).
+
+Selection order:
+1. ``DS_ACCELERATOR`` env var ("tpu" | "cpu"), matching the reference's
+   explicit-override semantics.
+2. Probe the JAX default backend: tpu if any TPU device exists, else cpu.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from .abstract_accelerator import Accelerator
+from .cpu_accelerator import CPUAccelerator
+from .tpu_accelerator import TPUAccelerator
+
+_ACCELERATOR: Optional[Accelerator] = None
+
+
+def _probe() -> Accelerator:
+    name = os.environ.get("DS_ACCELERATOR", "").lower()
+    if name == "cpu":
+        return CPUAccelerator()
+    if name == "tpu":
+        return TPUAccelerator()
+    if name:
+        raise ValueError(f"DS_ACCELERATOR={name!r} is not supported (tpu|cpu)")
+    tpu = TPUAccelerator()
+    if tpu.is_available():
+        return tpu
+    return CPUAccelerator()
+
+
+def get_accelerator() -> Accelerator:
+    global _ACCELERATOR
+    if _ACCELERATOR is None:
+        _ACCELERATOR = _probe()
+    return _ACCELERATOR
+
+
+def set_accelerator(accel: Accelerator) -> None:
+    global _ACCELERATOR
+    _ACCELERATOR = accel

@@ -1,0 +1,36 @@
+"""TPU accelerator runtime (reference analogue: accelerator/cuda_accelerator.py)."""
+from __future__ import annotations
+
+from typing import Any, List
+
+from .abstract_accelerator import Accelerator
+
+
+class TPUAccelerator(Accelerator):
+    _name = "tpu"
+    _communication_backend_name = "xla"
+
+    def is_available(self) -> bool:
+        try:
+            import jax
+
+            return any(d.platform == "tpu" for d in jax.devices())
+        except Exception:
+            return False
+
+    def devices(self) -> List[Any]:
+        import jax
+
+        return [d for d in jax.devices() if d.platform == "tpu"]
+
+    def local_devices(self) -> List[Any]:
+        import jax
+
+        return [d for d in jax.local_devices() if d.platform == "tpu"]
+
+    def is_fp16_supported(self) -> bool:
+        # TPUs compute in bf16; fp16 storage is supported but bf16 preferred.
+        return False
+
+    def supports_pallas(self) -> bool:
+        return True

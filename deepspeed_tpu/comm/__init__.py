@@ -1,0 +1,29 @@
+from .backend import Backend, XlaBackend
+from .comm import (
+    ReduceOp,
+    all_gather,
+    all_gather_into_tensor,
+    all_reduce,
+    all_to_all,
+    all_to_all_single,
+    barrier,
+    broadcast,
+    configure,
+    destroy_process_group,
+    get_axis_index,
+    get_local_rank,
+    get_rank,
+    get_world_size,
+    host_broadcast,
+    inference_all_reduce,
+    init_distributed,
+    is_initialized,
+    log_summary,
+    monitored_barrier,
+    ppermute,
+    reduce_scatter,
+    reduce_scatter_tensor,
+    send_recv_shift,
+)
+
+__all__ = [n for n in dir() if not n.startswith("_")]

@@ -1,0 +1,83 @@
+"""Communication backend ABC (reference analogue: deepspeed/comm/backend.py:25).
+
+On TPU there is exactly one real backend — XLA collectives over ICI/DCN — but
+the ABC is kept so the comm facade, comms logger, and tests are backend-neutral
+(the CPU-simulated mesh uses the same backend over the host platform).
+"""
+from __future__ import annotations
+
+import abc
+
+
+class Backend(abc.ABC):
+    def __init__(self, name: str):
+        self.name = name
+        self.initialized = False
+
+    def is_initialized(self) -> bool:
+        return self.initialized
+
+    @abc.abstractmethod
+    def init_process_group(self, **kwargs) -> None:
+        ...
+
+    @abc.abstractmethod
+    def get_rank(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def get_world_size(self) -> int:
+        ...
+
+    @abc.abstractmethod
+    def destroy_process_group(self) -> None:
+        ...
+
+
+class XlaBackend(Backend):
+    """Multi-host process bootstrap via ``jax.distributed`` plus XLA collectives.
+
+    Unlike the reference's ``TorchBackend`` (deepspeed/comm/torch.py:96), the
+    collectives themselves are not methods here: inside ``jit``/``shard_map``
+    they are ``jax.lax`` primitives over named mesh axes (see
+    ``deepspeed_tpu.comm.comm``).  This class owns only process-level state.
+    """
+
+    def __init__(self):
+        super().__init__("xla")
+
+    def init_process_group(
+        self,
+        coordinator_address: str | None = None,
+        num_processes: int | None = None,
+        process_id: int | None = None,
+        **kwargs,
+    ) -> None:
+        import jax
+
+        if num_processes is not None and num_processes > 1:
+            jax.distributed.initialize(
+                coordinator_address=coordinator_address,
+                num_processes=num_processes,
+                process_id=process_id,
+            )
+        self.initialized = True
+
+    def get_rank(self) -> int:
+        import jax
+
+        return jax.process_index()
+
+    def get_world_size(self) -> int:
+        import jax
+
+        return jax.process_count()
+
+    def destroy_process_group(self) -> None:
+        import jax
+
+        try:
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+        self.initialized = False

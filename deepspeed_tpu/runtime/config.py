@@ -1,0 +1,328 @@
+"""Top-level training config (reference analogue: deepspeed/runtime/config.py:707).
+
+``DeepSpeedConfig`` accepts a dict or a JSON file path with the reference
+framework's key names, so existing DeepSpeed JSON configs load unchanged.
+Batch-size resolution follows the reference invariant:
+
+    train_batch_size == micro_batch_per_device * gradient_accumulation_steps
+                        * data_parallel_world_size
+"""
+from __future__ import annotations
+
+import json
+from enum import Enum
+from typing import Any, Dict, List, Optional, Union
+
+from pydantic import Field
+
+from ..utils.logging import logger
+from .config_utils import DeepSpeedConfigModel
+from .zero.config import DeepSpeedZeroConfig
+
+
+class FP16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    auto_cast: bool = False
+    loss_scale: float = 0.0  # 0 = dynamic
+    initial_scale_power: int = 16
+    loss_scale_window: int = 1000
+    hysteresis: int = 2
+    consecutive_hysteresis: bool = False
+    min_loss_scale: float = 1.0
+
+
+class BF16Config(DeepSpeedConfigModel):
+    enabled: bool = False
+    immediate_grad_update: bool = True
+
+
+class OptimizerConfig(DeepSpeedConfigModel):
+    type: str = "Adam"
+    params: Dict[str, Any] = Field(default_factory=dict)
+    legacy_fusion: bool = False
+
+
+class SchedulerConfig(DeepSpeedConfigModel):
+    type: Optional[str] = None
+    params: Dict[str, Any] = Field(default_factory=dict)
+
+
+class ActivationCheckpointingConfig(DeepSpeedConfigModel):
+    """Reference: runtime/activation_checkpointing/config.py.
+
+    On TPU these map onto ``jax.checkpoint`` policies: ``partition_activations``
+    → save sharded residuals, ``cpu_checkpointing`` → offload-to-host remat.
+    """
+
+    partition_activations: bool = False
+    cpu_checkpointing: bool = False
+    contiguous_memory_optimization: bool = False
+    number_checkpoints: Optional[int] = None
+    synchronize_checkpoint_boundary: bool = False
+    profile: bool = False
+
+
+class CommsLoggerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    verbose: bool = False
+    prof_all: bool = True
+    debug: bool = False
+    prof_ops: List[str] = Field(default_factory=list)
+
+
+class FlopsProfilerConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    recompute_fwd_factor: float = 0.0
+    profile_step: int = 1
+    module_depth: int = -1
+    top_modules: int = 1
+    detailed: bool = True
+    output_file: Optional[str] = None
+
+
+class MonitorWriterConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    output_path: str = ""
+    job_name: str = "DeepSpeedTPUJob"
+    # wandb extras
+    team: Optional[str] = None
+    group: Optional[str] = None
+    project: Optional[str] = None
+
+
+class TensorParallelConfig(DeepSpeedConfigModel):
+    autotp_size: int = 1
+    tp_size: Optional[int] = None
+    tp_grain_size: int = 1
+
+    @property
+    def size(self) -> int:
+        return self.tp_size or self.autotp_size
+
+
+class PipelineConfig(DeepSpeedConfigModel):
+    stages: int = 1
+    partition_method: str = "parameters"
+    seed_layers: bool = False
+    activation_checkpoint_interval: int = 0
+    pipe_partitioned: bool = True
+    grad_partitioned: bool = True
+
+
+class CheckpointConfig(DeepSpeedConfigModel):
+    tag_validation: str = "Warn"  # Ignore | Warn | Fail
+    load_universal: bool = False
+    use_node_local_storage: bool = False
+    parallel_write: Dict[str, Any] = Field(default_factory=dict)
+    # TPU build: orbax-backed async save
+    async_save: bool = True
+
+
+class AioConfig(DeepSpeedConfigModel):
+    """Host async-IO tuning (reference csrc/aio; TPU build uses the C++ aio engine)."""
+
+    block_size: int = 1048576
+    queue_depth: int = 8
+    thread_count: int = 1
+    single_submit: bool = False
+    overlap_events: bool = True
+    use_gds: bool = False
+
+
+class DataEfficiencyConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    seed: int = 1234
+    data_sampling: Dict[str, Any] = Field(default_factory=dict)
+    data_routing: Dict[str, Any] = Field(default_factory=dict)
+
+
+class CompressionConfig(DeepSpeedConfigModel):
+    weight_quantization: Dict[str, Any] = Field(default_factory=dict)
+    activation_quantization: Dict[str, Any] = Field(default_factory=dict)
+    sparse_pruning: Dict[str, Any] = Field(default_factory=dict)
+    row_pruning: Dict[str, Any] = Field(default_factory=dict)
+    head_pruning: Dict[str, Any] = Field(default_factory=dict)
+    channel_pruning: Dict[str, Any] = Field(default_factory=dict)
+    layer_reduction: Dict[str, Any] = Field(default_factory=dict)
+
+
+class ElasticityConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    max_train_batch_size: int = 2000
+    micro_batch_sizes: List[int] = Field(default_factory=lambda: [2, 4, 6])
+    min_gpus: int = 1
+    max_gpus: int = 10000
+    min_time: int = 0
+    version: float = 0.2
+    ignore_non_elastic_batch_info: bool = False
+    prefer_larger_batch: bool = True
+
+
+class AutotuningConfig(DeepSpeedConfigModel):
+    enabled: bool = False
+    fast: bool = True
+    results_dir: str = "autotuning_results"
+    exps_dir: str = "autotuning_exps"
+    overwrite: bool = True
+    metric: str = "throughput"
+    start_profile_step: int = 3
+    end_profile_step: int = 5
+    tuner_type: str = "gridsearch"
+    tuner_early_stopping: int = 5
+    tuner_num_trials: int = 50
+    max_train_batch_size: Optional[int] = None
+    mp_size: int = 1
+
+
+class ValidationMode(str, Enum):
+    WARN = "Warn"
+    IGNORE = "Ignore"
+    FAIL = "Fail"
+
+
+class DeepSpeedConfig:
+    """Aggregates every sub-config; the engine reads everything from here.
+
+    Parameters
+    ----------
+    config: dict | str — config dict or path to a JSON file.
+    topology: optional MeshTopology, needed to resolve batch sizes.
+    """
+
+    def __init__(self, config: Union[str, Dict[str, Any], None] = None,
+                 topology=None, mpu=None):
+        if config is None:
+            config = {}
+        if isinstance(config, str):
+            with open(config) as f:
+                config = json.load(f)
+        if not isinstance(config, dict):
+            raise TypeError(f"config must be dict or path, got {type(config)}")
+        self._raw: Dict[str, Any] = dict(config)
+        self._topology = topology
+
+        # Batch sizing (resolved lazily against the topology in _resolve_batch).
+        self.train_batch_size: Optional[int] = config.get("train_batch_size")
+        self.train_micro_batch_size_per_gpu: Optional[int] = config.get(
+            "train_micro_batch_size_per_gpu")
+        self.gradient_accumulation_steps: Optional[int] = config.get(
+            "gradient_accumulation_steps")
+
+        self.steps_per_print: int = config.get("steps_per_print", 10)
+        self.wall_clock_breakdown: bool = config.get("wall_clock_breakdown", False)
+        self.memory_breakdown: bool = config.get("memory_breakdown", False)
+        self.dump_state: bool = config.get("dump_state", False)
+        self.prescale_gradients: bool = config.get("prescale_gradients", False)
+        self.gradient_predivide_factor: float = config.get("gradient_predivide_factor", 1.0)
+        self.sparse_gradients_enabled: bool = config.get("sparse_gradients", False)
+        self.gradient_clipping: float = config.get("gradient_clipping", 0.0)
+        self.graph_harvesting: bool = config.get("graph_harvesting", False)
+        self.seq_parallel_communication_data_type: str = config.get(
+            "seq_parallel_communication_data_type", "fp32")
+        self.disable_allgather: bool = config.get("disable_allgather", False)
+        self.communication_data_type: Optional[str] = config.get("communication_data_type")
+
+        self.fp16 = FP16Config(**config.get("fp16", {}))
+        self.bf16 = BF16Config(**config.get("bf16", config.get("bfloat16", {})))
+        self.zero_config = DeepSpeedZeroConfig(**config.get("zero_optimization", {}))
+        self.optimizer = OptimizerConfig(**config["optimizer"]) if "optimizer" in config else None
+        self.scheduler = SchedulerConfig(**config["scheduler"]) if "scheduler" in config else None
+        self.activation_checkpointing = ActivationCheckpointingConfig(
+            **config.get("activation_checkpointing", {}))
+        self.comms_logger = CommsLoggerConfig(**config.get("comms_logger", {}))
+        self.flops_profiler = FlopsProfilerConfig(**config.get("flops_profiler", {}))
+        self.tensorboard = MonitorWriterConfig(**config.get("tensorboard", {}))
+        self.csv_monitor = MonitorWriterConfig(**config.get("csv_monitor", {}))
+        self.wandb = MonitorWriterConfig(**config.get("wandb", {}))
+        self.tensor_parallel = TensorParallelConfig(**config.get(
+            "tensor_parallel", config.get("autotp", {})))
+        self.pipeline = PipelineConfig(**config.get("pipeline", {}))
+        self.checkpoint_config = CheckpointConfig(**config.get("checkpoint", {}))
+        self.aio_config = AioConfig(**config.get("aio", {}))
+        self.data_efficiency = DataEfficiencyConfig(**config.get("data_efficiency", {}))
+        self.curriculum_learning = config.get("curriculum_learning", {})
+        self.compression_config = CompressionConfig(**config.get("compression_training", {}))
+        self.elasticity = ElasticityConfig(**config.get("elasticity", {}))
+        self.autotuning_config = AutotuningConfig(**config.get("autotuning", {}))
+
+        self.sequence_parallel_size: int = config.get("sequence_parallel_size", 1)
+        self.moe_config: Dict[str, Any] = config.get("moe", {})
+        self.optimizer_offload_config = self.zero_config.offload_optimizer
+
+        self._resolve_batch()
+        self._sanity_check()
+
+    # ------------------------------------------------------------------ #
+    @property
+    def zero_enabled(self) -> bool:
+        return self.zero_config.stage > 0
+
+    @property
+    def zero_optimization_stage(self) -> int:
+        return self.zero_config.stage
+
+    @property
+    def dtype(self):
+        import jax.numpy as jnp
+
+        if self.bf16.enabled:
+            return jnp.bfloat16
+        if self.fp16.enabled:
+            return jnp.float16
+        return jnp.float32
+
+    @property
+    def loss_scale(self) -> float:
+        return self.fp16.loss_scale if self.fp16.enabled else 1.0
+
+    def data_parallel_size(self) -> int:
+        if self._topology is not None:
+            return self._topology.get_data_parallel_world_size()
+        return 1
+
+    def _resolve_batch(self) -> None:
+        """Solve train = micro * gas * dp for whichever terms are missing
+        (reference: runtime/config.py `_configure_train_batch_size`)."""
+        dp = self.data_parallel_size()
+        train, micro, gas = (self.train_batch_size,
+                             self.train_micro_batch_size_per_gpu,
+                             self.gradient_accumulation_steps)
+        if train is not None and micro is not None and gas is not None:
+            pass
+        elif train is not None and micro is not None:
+            gas = train // (micro * dp)
+        elif train is not None and gas is not None:
+            micro = train // (gas * dp)
+        elif micro is not None and gas is not None:
+            train = micro * gas * dp
+        elif train is not None:
+            gas = 1
+            micro = train // dp
+        elif micro is not None:
+            gas = 1
+            train = micro * dp
+        else:
+            micro, gas = 1, 1
+            train = dp
+        self.train_batch_size = train
+        self.train_micro_batch_size_per_gpu = micro
+        self.gradient_accumulation_steps = gas
+
+    def _sanity_check(self) -> None:
+        dp = self.data_parallel_size()
+        t, m, g = (self.train_batch_size, self.train_micro_batch_size_per_gpu,
+                   self.gradient_accumulation_steps)
+        if t != m * g * dp:
+            raise ValueError(
+                f"batch config invalid: train_batch_size={t} != micro({m}) * gas({g}) * dp({dp})")
+        if self.fp16.enabled and self.bf16.enabled:
+            raise ValueError("fp16 and bf16 cannot both be enabled")
+        if self.zero_config.stage not in (0, 1, 2, 3):
+            raise ValueError(f"zero stage must be 0-3, got {self.zero_config.stage}")
+
+    def print_config(self) -> None:
+        logger.info(json.dumps(self._raw, indent=2, sort_keys=True, default=str))
+
+    @property
+    def raw(self) -> Dict[str, Any]:
+        return self._raw

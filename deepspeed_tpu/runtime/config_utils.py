@@ -1,0 +1,39 @@
+"""Config model base (reference analogue: deepspeed/runtime/config_utils.py).
+
+All sub-configs derive from :class:`DeepSpeedConfigModel`, a pydantic model that
+keeps the reference's conventions: an ``enabled`` gate on optional features,
+tolerance of unknown keys (warn, don't fail — configs written for the reference
+framework should load here), and support for deprecated aliases.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from pydantic import BaseModel, ConfigDict
+
+from ..utils.logging import logger
+
+
+class DeepSpeedConfigModel(BaseModel):
+    model_config = ConfigDict(
+        extra="allow",
+        populate_by_name=True,
+        arbitrary_types_allowed=True,
+        protected_namespaces=(),
+    )
+
+    def __init__(self, strict: bool = False, **data):
+        super().__init__(**data)
+        if self.model_extra:
+            msg = f"{type(self).__name__}: unknown config keys ignored: {sorted(self.model_extra)}"
+            if strict:
+                raise ValueError(msg)
+            logger.warning(msg)
+
+    def dict(self, **kwargs) -> Dict[str, Any]:  # legacy accessor
+        return self.model_dump(**kwargs)
+
+
+def get_scalar_param(config: Dict[str, Any], name: str, default: Any) -> Any:
+    """Legacy dict accessor (reference: runtime/config.py:803-917 helpers)."""
+    return config.get(name, default)

@@ -1,0 +1,4 @@
+from .comms_logging import CommsLogger
+from .logging import log_dist, logger, warning_once
+
+__all__ = ["CommsLogger", "log_dist", "logger", "warning_once"]

@@ -1,0 +1,118 @@
+"""Communication operation logging (reference: deepspeed/utils/comms_logging.py:67).
+
+Collectives under ``jit`` are compiled, so per-call device latency is not
+observable from Python the way CUDA events make it on GPU.  We therefore log
+what IS knowable and useful on TPU:
+
+  * trace-time records: op name, message size, mesh axes, dtype — every time a
+    facade collective is *traced* (i.e., per compiled program, not per step);
+  * wall-clock records for host-blocking ops (barrier, multihost broadcast);
+  * algorithmic/bus bandwidth estimates from message size and link count,
+    reported by ``log_summary`` like the reference.
+
+Enable via config ``comms_logger`` (see comm/config.py) or
+``comm.configure(enabled=True)``.
+"""
+from __future__ import annotations
+
+import math
+from collections import defaultdict
+from typing import Dict, List, Optional
+
+
+def get_caller_func(frame_depth: int = 3) -> str:
+    import sys
+
+    frame = sys._getframe(frame_depth)
+    return frame.f_code.co_name
+
+
+def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float, n_ranks: int):
+    """Algorithmic and bus bandwidth in GB/s (mirrors reference formulas)."""
+    duration_s = max(duration_s, 1e-9)
+    n = max(n_ranks, 1)
+    if comm_op in ("all_to_all", "all_to_all_single"):
+        # Each rank sends (n-1)/n of its buffer.
+        algbw = size_bytes / duration_s
+        busbw = algbw * ((n - 1) / n)
+    elif comm_op in ("all_gather", "all_gather_into_tensor", "reduce_scatter",
+                     "reduce_scatter_tensor"):
+        algbw = size_bytes / duration_s
+        busbw = algbw * ((n - 1) / n)
+    elif comm_op in ("all_reduce", "inference_all_reduce"):
+        algbw = size_bytes / duration_s
+        busbw = algbw * (2 * (n - 1) / n)
+    else:  # send/recv/broadcast/ppermute
+        algbw = size_bytes / duration_s
+        busbw = algbw
+    return algbw / 1e9, busbw / 1e9
+
+
+class CommsLogger:
+    def __init__(self, enabled: bool = False, verbose: bool = False,
+                 prof_all: bool = True, prof_ops: Optional[List[str]] = None,
+                 debug: bool = False):
+        self.enabled = enabled
+        self.verbose = verbose
+        self.prof_all = prof_all
+        self.prof_ops = prof_ops or []
+        self.debug = debug
+        # op name -> size -> [count, total_latency_s, algbw_sum, busbw_sum]
+        self.comms_dict: Dict[str, Dict[int, List[float]]] = defaultdict(dict)
+
+    def configure(self, enabled=None, verbose=None, prof_all=None, prof_ops=None):
+        if enabled is not None:
+            self.enabled = enabled
+        if verbose is not None:
+            self.verbose = verbose
+        if prof_all is not None:
+            self.prof_all = prof_all
+        if prof_ops is not None:
+            self.prof_ops = prof_ops
+
+    def should_log(self, op_name: str) -> bool:
+        if not self.enabled:
+            return False
+        return self.prof_all or op_name in self.prof_ops
+
+    def append(self, op_name: str, raw_name: str, size_bytes: int,
+               duration_s: float, n_ranks: int) -> None:
+        algbw, busbw = calc_bw_log(op_name, size_bytes, duration_s, n_ranks)
+        per_size = self.comms_dict[op_name].setdefault(size_bytes, [0, 0.0, 0.0, 0.0])
+        per_size[0] += 1
+        per_size[1] += duration_s
+        per_size[2] += algbw
+        per_size[3] += busbw
+        if self.verbose:
+            from .logging import logger
+
+            logger.info(
+                f"comm op: {op_name} ({raw_name}) | size: {size_bytes} B | "
+                f"time: {duration_s*1e3:.3f} ms | algbw: {algbw:.2f} GB/s | busbw: {busbw:.2f} GB/s")
+
+    def log_summary(self, show_straggler: bool = False) -> str:
+        """Render the per-op/per-size summary table (reference: comm/comm.py:428)."""
+        lines = []
+        header = f"{'Comm. Op':<22}{'Message Size':>14}{'Count':>8}{'Total Lat(ms)':>15}{'Avg Lat(ms)':>13}{'algbw(GB/s)':>13}{'busbw(GB/s)':>13}"
+        lines.append(header)
+        for op_name, sizes in sorted(self.comms_dict.items()):
+            lines.append(op_name)
+            for size, (count, lat, algbw, busbw) in sorted(sizes.items()):
+                count = int(count)
+                avg_lat = lat / count * 1e3 if count else 0.0
+                lines.append(
+                    f"{'':<22}{_fmt_size(size):>14}{count:>8}{lat*1e3:>15.2f}{avg_lat:>13.2f}"
+                    f"{algbw / max(count,1):>13.2f}{busbw / max(count,1):>13.2f}")
+        out = "\n".join(lines)
+        from .logging import logger
+
+        logger.info("\n" + out)
+        return out
+
+
+def _fmt_size(num_bytes: int) -> str:
+    if num_bytes == 0:
+        return "0 B"
+    units = ["B", "KB", "MB", "GB", "TB"]
+    k = min(int(math.log(num_bytes, 1024)), len(units) - 1)
+    return f"{num_bytes / 1024**k:.2f} {units[k]}"

@@ -1,0 +1,57 @@
+"""Rank-filtered logging (reference analogue: deepspeed/utils/logging.py)."""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+from typing import Iterable, Optional
+
+LOG_LEVELS = {
+    "debug": logging.DEBUG,
+    "info": logging.INFO,
+    "warning": logging.WARNING,
+    "error": logging.ERROR,
+    "critical": logging.CRITICAL,
+}
+
+
+def _create_logger(name: str = "deepspeed_tpu", level: int = logging.INFO) -> logging.Logger:
+    lg = logging.getLogger(name)
+    lg.setLevel(level)
+    lg.propagate = False
+    if not lg.handlers:
+        handler = logging.StreamHandler(stream=sys.stdout)
+        handler.setFormatter(logging.Formatter(
+            "[%(asctime)s] [%(levelname)s] [%(name)s] %(message)s"))
+        lg.addHandler(handler)
+    env_level = os.environ.get("DSTPU_LOG_LEVEL")
+    if env_level:
+        lg.setLevel(LOG_LEVELS.get(env_level.lower(), logging.INFO))
+    return lg
+
+
+logger = _create_logger()
+
+
+def _process_rank() -> int:
+    try:
+        import jax
+
+        return jax.process_index()
+    except Exception:
+        return 0
+
+
+def log_dist(message: str, ranks: Optional[Iterable[int]] = None,
+             level: int = logging.INFO) -> None:
+    """Log only on the given process ranks (default: rank 0)."""
+    my_rank = _process_rank()
+    ranks = list(ranks) if ranks is not None else [0]
+    if my_rank in ranks or -1 in ranks:
+        logger.log(level, f"[Rank {my_rank}] {message}")
+
+
+def warning_once(message: str, _seen=set()) -> None:
+    if message not in _seen:
+        _seen.add(message)
+        logger.warning(message)

@@ -1,0 +1,46 @@
+"""Test harness (reference analogue: tests/unit/common.py).
+
+The reference forks world_size processes with a file-store rendezvous; the
+TPU-native equivalent is a single process with an 8-virtual-device CPU mesh
+(``--xla_force_host_platform_device_count=8``), which exercises real XLA
+collectives/shardings without TPU hardware.  Must run before jax is imported.
+"""
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ["DS_ACCELERATOR"] = "cpu"
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# The image's sitecustomize registers the TPU plugin and captures JAX_PLATFORMS
+# before conftest runs; the config update below is the authoritative override.
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", False)
+
+
+@pytest.fixture(autouse=True)
+def _reset_global_state():
+    """Each test gets a fresh global topology."""
+    from deepspeed_tpu.runtime import topology
+
+    topology.reset_topology()
+    yield
+    topology.reset_topology()
+
+
+@pytest.fixture
+def mesh8():
+    """Default 8-device pure-DP mesh."""
+    from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+    return initialize_mesh(TopologyConfig(), force=True)
+
+
+def world_size_guard(n: int):
+    """Skip when fewer than n devices exist (reference: common.py:262)."""
+    return pytest.mark.skipif(
+        len(jax.devices()) < n, reason=f"requires {n} devices")

@@ -1,0 +1,98 @@
+"""Config system tests (reference: tests/unit/runtime/test_ds_config_dict.py)."""
+import json
+
+import pytest
+
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+
+@pytest.fixture
+def topo():
+    return initialize_mesh(TopologyConfig(), force=True)  # dp=8
+
+
+class TestBatchResolution:
+    def test_all_given(self, topo):
+        c = DeepSpeedConfig({"train_batch_size": 32,
+                             "train_micro_batch_size_per_gpu": 2,
+                             "gradient_accumulation_steps": 2}, topology=topo)
+        assert (c.train_batch_size, c.train_micro_batch_size_per_gpu,
+                c.gradient_accumulation_steps) == (32, 2, 2)
+
+    def test_infer_gas(self, topo):
+        c = DeepSpeedConfig({"train_batch_size": 64,
+                             "train_micro_batch_size_per_gpu": 2}, topology=topo)
+        assert c.gradient_accumulation_steps == 4
+
+    def test_infer_train(self, topo):
+        c = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 4,
+                             "gradient_accumulation_steps": 2}, topology=topo)
+        assert c.train_batch_size == 64
+
+    def test_inconsistent_raises(self, topo):
+        with pytest.raises(ValueError):
+            DeepSpeedConfig({"train_batch_size": 33,
+                             "train_micro_batch_size_per_gpu": 2,
+                             "gradient_accumulation_steps": 2}, topology=topo)
+
+
+class TestDeepSpeedJsonCompat:
+    def test_reference_style_config(self, topo, tmp_path):
+        """A config written for the reference framework parses unchanged."""
+        ds_config = {
+            "train_batch_size": 16,
+            "steps_per_print": 2000,
+            "optimizer": {"type": "Adam",
+                          "params": {"lr": 0.001, "betas": [0.8, 0.999],
+                                     "eps": 1e-8, "weight_decay": 3e-7}},
+            "scheduler": {"type": "WarmupLR",
+                          "params": {"warmup_min_lr": 0, "warmup_max_lr": 0.001,
+                                     "warmup_num_steps": 1000}},
+            "gradient_clipping": 1.0,
+            "prescale_gradients": False,
+            "bf16": {"enabled": True},
+            "zero_optimization": {
+                "stage": 3,
+                "stage3_prefetch_bucket_size": 5e7,
+                "stage3_param_persistence_threshold": 1e5,
+                "offload_optimizer": {"device": "cpu", "pin_memory": True},
+                "overlap_comm": True,
+                "contiguous_gradients": True,
+            },
+            "wall_clock_breakdown": False,
+        }
+        path = tmp_path / "ds_config.json"
+        path.write_text(json.dumps(ds_config))
+        c = DeepSpeedConfig(str(path), topology=topo)
+        assert c.zero_config.stage == 3
+        assert c.zero_config.param_persistence_threshold == 1e5
+        assert c.zero_config.offload_optimizer_device() == "cpu"
+        assert c.optimizer.type == "Adam"
+        assert c.optimizer.params["lr"] == 0.001
+        assert c.scheduler.type == "WarmupLR"
+        assert c.gradient_clipping == 1.0
+        assert c.bf16.enabled
+        import jax.numpy as jnp
+
+        assert c.dtype == jnp.bfloat16
+
+    def test_fp16_bf16_conflict(self, topo):
+        with pytest.raises(ValueError):
+            DeepSpeedConfig({"fp16": {"enabled": True}, "bf16": {"enabled": True}},
+                            topology=topo)
+
+    def test_unknown_keys_warn_not_fail(self, topo):
+        c = DeepSpeedConfig({"zero_optimization": {"stage": 1, "bogus_knob": True}},
+                            topology=topo)
+        assert c.zero_config.stage == 1
+
+
+def test_accelerator_selection():
+    from deepspeed_tpu.accelerator import get_accelerator
+
+    acc = get_accelerator()
+    assert acc.device_name() in ("cpu", "tpu")
+    assert acc.communication_backend_name() == "xla"
+    assert acc.device_count() >= 1
+    assert acc.preferred_dtype() is not None

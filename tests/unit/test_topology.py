@@ -1,0 +1,85 @@
+"""Tests for the mesh topology layer (reference: tests/unit/runtime/pipe/test_topology.py)."""
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.topology import (
+    DATA,
+    EXPERT,
+    PIPE,
+    SEQ,
+    TENSOR,
+    MeshTopology,
+    PipeModelDataParallelTopology,
+    ProcessTopology,
+    TopologyConfig,
+    initialize_mesh,
+)
+
+
+class TestProcessTopology:
+    def test_world_size(self):
+        topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+        assert topo.world_size() == 8
+
+    def test_rank_coord_roundtrip(self):
+        topo = ProcessTopology(axes=["pipe", "data", "model"], dims=[2, 2, 2])
+        for rank in range(topo.world_size()):
+            c = topo.get_coord(rank)
+            assert topo.get_rank(pipe=c.pipe, data=c.data, model=c.model) == rank
+
+    def test_axis_comm_lists(self):
+        topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+        data_lists = topo.get_axis_comm_lists("data")
+        assert data_lists == [[0, 1, 2, 3], [4, 5, 6, 7]]
+        pipe_lists = topo.get_axis_comm_lists("pipe")
+        assert pipe_lists == [[0, 4], [1, 5], [2, 6], [3, 7]]
+
+    def test_filter_match(self):
+        topo = ProcessTopology(axes=["pipe", "data"], dims=[2, 4])
+        assert topo.filter_match(pipe=1) == [4, 5, 6, 7]
+
+    def test_pmd_topology(self):
+        topo = PipeModelDataParallelTopology(num_pp=2, num_mp=2, num_dp=2)
+        assert topo.world_size() == 8
+        assert topo.get_dim("pipe") == 2
+
+
+class TestMeshTopology:
+    def test_default_all_data(self):
+        topo = MeshTopology(TopologyConfig())
+        assert topo.dims[DATA] == 8
+        assert topo.world_size() == 8
+        assert topo.get_data_parallel_world_size() == 8
+
+    def test_mixed_axes(self):
+        topo = MeshTopology(TopologyConfig(tensor=2, seq=2))
+        assert topo.dims == {PIPE: 1, DATA: 2, EXPERT: 1, SEQ: 2, TENSOR: 2}
+        assert topo.get_tensor_parallel_world_size() == 2
+        assert topo.get_data_parallel_world_size() == 2
+
+    def test_expert_subaxis(self):
+        topo = MeshTopology(TopologyConfig(expert=4))
+        assert topo.get_expert_parallel_world_size() == 4
+        # DP spans data × expert for non-expert params
+        assert topo.get_data_parallel_world_size() == 8
+
+    def test_invalid_dims(self):
+        with pytest.raises(ValueError):
+            MeshTopology(TopologyConfig(tensor=3))  # 8 % 3 != 0
+
+    def test_zero_axes(self):
+        topo = MeshTopology(TopologyConfig(tensor=2))
+        assert topo.zero_axes() == (DATA,)
+
+    def test_sharding_helpers(self):
+        topo = MeshTopology(TopologyConfig(tensor=2))
+        s = topo.named_sharding(None, TENSOR)
+        assert s.mesh.shape[TENSOR] == 2
+        assert topo.replicated().is_fully_replicated
+
+
+def test_global_singleton():
+    t1 = initialize_mesh(TopologyConfig(tensor=2), force=True)
+    from deepspeed_tpu.runtime.topology import get_topology
+
+    assert get_topology() is t1
