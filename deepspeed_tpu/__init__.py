@@ -1,9 +1,139 @@
 """deepspeed_tpu: a TPU-native large-scale training & inference framework.
 
-Provides the capabilities of the DeepSpeed reference framework, re-designed for
-JAX/XLA/Pallas on TPU device meshes.
+Provides the capabilities of the DeepSpeed reference framework
+(`deepspeed/__init__.py:69,268,291,369`), re-designed for JAX/XLA/Pallas on
+TPU device meshes: ZeRO via sharding, pipeline/tensor/expert/sequence
+parallelism over a named mesh, Pallas kernels for the hot ops, and a
+ragged-batching inference engine.
 """
+from __future__ import annotations
+
+from typing import Any, Optional
+
 __version__ = "0.1.0"
 
 from . import comm  # noqa: F401
 from .accelerator import get_accelerator  # noqa: F401
+from .runtime.config import DeepSpeedConfig  # noqa: F401
+from .runtime.topology import TopologyConfig, initialize_mesh  # noqa: F401
+
+
+def initialize(
+    args: Any = None,
+    model: Any = None,
+    optimizer: Any = None,
+    model_parameters: Any = None,
+    training_data: Any = None,
+    lr_scheduler: Any = None,
+    distributed_port: Optional[int] = None,
+    mpu: Any = None,
+    dist_init_required: Optional[bool] = None,
+    collate_fn: Any = None,
+    config: Any = None,
+    config_params: Any = None,
+    topology: Any = None,
+    mesh_config: Optional["TopologyConfig"] = None,
+    seed: int = 0,
+):
+    """Create a training engine (reference: ``deepspeed.initialize``,
+    deepspeed/__init__.py:69).
+
+    Returns ``(engine, optimizer, dataloader, lr_scheduler)`` like the
+    reference.  ``model`` is a loss callable ``f(params, batch, rng) -> loss``
+    or a flax module; ``model_parameters`` is the initial parameter pytree.
+    """
+    import importlib.util
+    import json
+
+    from .runtime.engine import DeepSpeedEngine
+
+    if dist_init_required is None or dist_init_required:
+        comm.init_distributed(distributed_port=distributed_port)
+
+    config = config if config is not None else config_params
+    if args is not None and getattr(args, "deepspeed_config", None):
+        if config is not None:
+            raise ValueError(
+                "Not sure how to proceed: both args.deepspeed_config and the "
+                "config argument were given (reference semantics: pass one)")
+        config = args.deepspeed_config
+
+    # Normalize to a dict once (DeepSpeedConfig instances keep their raw dict).
+    if isinstance(config, str):
+        with open(config) as f:
+            config = json.load(f)
+    raw_cfg = config.raw if isinstance(config, DeepSpeedConfig) else (config or {})
+
+    if topology is None:
+        if mesh_config is not None:
+            topology = initialize_mesh(mesh_config, force=True)
+        else:
+            topology = _topology_from_config(raw_cfg)
+
+    if isinstance(config, DeepSpeedConfig):
+        ds_config = config
+        if ds_config._topology is not topology:
+            # Re-resolve batch sizes against the actual mesh.
+            ds_config = DeepSpeedConfig(ds_config.raw, topology=topology)
+    else:
+        ds_config = DeepSpeedConfig(config, topology=topology)
+
+    engine_cls = DeepSpeedEngine
+    if importlib.util.find_spec("deepspeed_tpu.runtime.pipe.module") is not None:
+        from .runtime.pipe.module import PipelineModule
+
+        if isinstance(model, PipelineModule):
+            from .runtime.pipe.engine import PipelineEngine
+
+            engine_cls = PipelineEngine
+
+    engine = engine_cls(
+        model=model, config=ds_config, topology=topology,
+        model_parameters=model_parameters, optimizer=optimizer,
+        lr_scheduler=lr_scheduler, training_data=training_data,
+        collate_fn=collate_fn, seed=seed)
+
+    return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def _topology_from_config(cfg: dict):
+    """Derive mesh degrees from DeepSpeed config keys (sequence_parallel_size,
+    tensor_parallel.autotp_size, pipeline.stages, moe ep_size)."""
+    from .runtime.topology import get_topology
+
+    tp = cfg.get("tensor_parallel", {}).get("autotp_size") or \
+        cfg.get("tensor_parallel", {}).get("tp_size") or 1
+    sp = cfg.get("sequence_parallel_size", 1)
+    pp = cfg.get("pipeline", {}).get("stages", 1)
+    ep = cfg.get("moe", {}).get("ep_size", 1)
+    if tp == 1 and sp == 1 and pp == 1 and ep == 1:
+        return get_topology()
+    return initialize_mesh(
+        TopologyConfig(pipe=pp, tensor=tp, seq=sp, expert=ep), force=True)
+
+
+def init_distributed(dist_backend: str = "xla", **kwargs) -> None:
+    """Reference: deepspeed/__init__.py:268 → comm.init_distributed."""
+    comm.init_distributed(dist_backend=dist_backend, **kwargs)
+
+
+def init_inference(model: Any = None, config: Any = None, **kwargs):
+    """Create an inference engine (reference: deepspeed/__init__.py:291)."""
+    import importlib.util
+
+    if importlib.util.find_spec("deepspeed_tpu.inference.engine") is None:
+        raise NotImplementedError(
+            "deepspeed_tpu.inference is not available in this build")
+    from .inference.engine import InferenceEngine
+
+    return InferenceEngine(model=model, config=config, **kwargs)
+
+
+def add_config_arguments(parser):
+    """Reference: deepspeed/__init__.py:268 — CLI arg group."""
+    group = parser.add_argument_group("DeepSpeed-TPU", "DeepSpeed-TPU configurations")
+    group.add_argument("--deepspeed", default=False, action="store_true",
+                       help="Enable DeepSpeed-TPU (helper flag)")
+    group.add_argument("--deepspeed_config", default=None, type=str,
+                       help="Path to DeepSpeed-TPU json configuration")
+    return parser

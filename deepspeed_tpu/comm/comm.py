@@ -69,7 +69,11 @@ def init_distributed(
     if dist_backend != "xla":
         logger.warning(f"dist_backend={dist_backend!r} requested; TPU build always uses 'xla'")
 
+    distributed_port = kwargs.pop("distributed_port", None)
     coordinator_address = coordinator_address or os.environ.get("COORDINATOR_ADDRESS")
+    if coordinator_address is None and os.environ.get("MASTER_ADDR"):
+        port = distributed_port or os.environ.get("MASTER_PORT", "29500")
+        coordinator_address = f"{os.environ['MASTER_ADDR']}:{port}"
     if world_size is None:
         for var in ("DSTPU_WORLD_SIZE", "WORLD_SIZE", "OMPI_COMM_WORLD_SIZE"):
             if os.environ.get(var):

@@ -1,0 +1,105 @@
+"""Monitoring fan-out (reference: deepspeed/monitor/monitor.py:30).
+
+``MonitorMaster`` dispatches scalar events to every enabled writer
+(TensorBoard / W&B / CSV).  Writers degrade gracefully when their backing
+library is absent (this image has no tensorboard/wandb — CSV always works).
+Event tuples: ``(label, value, step)``.
+"""
+from __future__ import annotations
+
+import csv
+import os
+from typing import List, Optional, Tuple
+
+from ..utils.logging import logger
+
+Event = Tuple[str, float, int]
+
+
+class Monitor:
+    def __init__(self, config):
+        self.enabled = bool(getattr(config, "enabled", False))
+
+    def write_events(self, event_list: List[Event]) -> None:  # pragma: no cover
+        raise NotImplementedError
+
+
+class TensorBoardMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        self.summary_writer = None
+        if not self.enabled:
+            return
+        try:
+            from torch.utils.tensorboard import SummaryWriter
+
+            path = os.path.join(config.output_path or "runs", config.job_name)
+            self.summary_writer = SummaryWriter(log_dir=path)
+        except Exception as e:
+            logger.warning(f"tensorboard writer unavailable: {e}")
+            self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if self.summary_writer is None:
+            return
+        for label, value, step in event_list:
+            self.summary_writer.add_scalar(label, value, step)
+        self.summary_writer.flush()
+
+
+class WandbMonitor(Monitor):
+    def __init__(self, config):
+        super().__init__(config)
+        if not self.enabled:
+            return
+        try:
+            import wandb
+
+            wandb.init(team=config.team, project=config.project, group=config.group)
+            self._wandb = wandb
+        except Exception as e:
+            logger.warning(f"wandb unavailable: {e}")
+            self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for label, value, step in event_list:
+            self._wandb.log({label: value}, step=step)
+
+
+class csvMonitor(Monitor):  # reference class name
+    def __init__(self, config):
+        super().__init__(config)
+        self.filenames = {}
+        if self.enabled:
+            self.output_path = os.path.join(config.output_path or "csv_logs",
+                                            config.job_name)
+            os.makedirs(self.output_path, exist_ok=True)
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for label, value, step in event_list:
+            fname = os.path.join(self.output_path,
+                                 label.replace("/", "_") + ".csv")
+            new = not os.path.exists(fname)
+            with open(fname, "a", newline="") as f:
+                w = csv.writer(f)
+                if new:
+                    w.writerow(["step", label])
+                w.writerow([step, value])
+
+
+class MonitorMaster(Monitor):
+    def __init__(self, ds_config):
+        self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
+        self.wandb_monitor = WandbMonitor(ds_config.wandb)
+        self.csv_monitor = csvMonitor(ds_config.csv_monitor)
+        self.enabled = any(m.enabled for m in
+                           (self.tb_monitor, self.wandb_monitor, self.csv_monitor))
+
+    def write_events(self, event_list: List[Event]) -> None:
+        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+            if m.enabled:
+                m.write_events(event_list)

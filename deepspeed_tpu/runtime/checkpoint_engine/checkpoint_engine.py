@@ -1,0 +1,31 @@
+"""Checkpoint engine ABC (reference: runtime/checkpoint_engine/checkpoint_engine.py:9).
+
+Pluggable persistence backend for the engine's save/load.  Implementations:
+:class:`OrbaxCheckpointEngine` (async, sharded, reshardable — the default) and
+a simple numpy/pickle engine for host-only artifacts.
+"""
+from __future__ import annotations
+
+import abc
+from typing import Any, Optional
+
+
+class CheckpointEngine(abc.ABC):
+    def __init__(self, ckpt_dir: str):
+        self.ckpt_dir = ckpt_dir
+
+    @abc.abstractmethod
+    def save(self, payload: Any, tag: str) -> None:
+        ...
+
+    @abc.abstractmethod
+    def load(self, template: Any, tag: str) -> Any:
+        ...
+
+    @abc.abstractmethod
+    def commit(self, tag: str) -> None:
+        """Mark ``tag`` durable + update the ``latest`` pointer."""
+
+    @abc.abstractmethod
+    def latest_tag(self) -> Optional[str]:
+        ...

@@ -1,0 +1,82 @@
+"""Orbax/tensorstore checkpoint engine — the default persistence backend.
+
+Reference analogues: ``torch_checkpoint_engine.py:12`` (sync torch.save) and
+``nebula_checkpoint_engine.py:20`` (async tiered persistence).  Orbax gives
+both behaviors natively: per-shard parallel tensorstore writes, async commit,
+and — because arrays are stored with global shape + shard metadata — every
+checkpoint is "universal" (reshardable across world sizes) by construction,
+which is the key property of the reference's universal checkpoint format
+(``deepspeed/checkpoint/ds_to_universal.py``).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Optional
+
+from .checkpoint_engine import CheckpointEngine
+
+LATEST_FILE = "latest"  # same pointer-file convention as the reference
+
+
+class OrbaxCheckpointEngine(CheckpointEngine):
+    def __init__(self, ckpt_dir: str):
+        super().__init__(os.path.abspath(ckpt_dir))
+        os.makedirs(self.ckpt_dir, exist_ok=True)
+
+    def _path(self, tag: str) -> str:
+        return os.path.join(self.ckpt_dir, str(tag))
+
+    def save(self, payload: Any, tag: str) -> None:
+        import orbax.checkpoint as ocp
+
+        state = payload.pop("state") if isinstance(payload, dict) else payload
+        path = self._path(tag)
+        with ocp.PyTreeCheckpointer() as ckptr:
+            ckptr.save(os.path.join(path, "state"), state, force=True)
+        if isinstance(payload, dict):
+            meta = {k: v for k, v in payload.items()}
+            with open(os.path.join(path, "meta.json"), "w") as f:
+                json.dump(meta, f, default=_jsonable)
+            payload["state"] = state  # restore caller's dict
+
+    def load(self, template: Any, tag: str) -> Any:
+        import orbax.checkpoint as ocp
+
+        path = self._path(tag)
+        state_t = template.pop("state") if isinstance(template, dict) else template
+        with ocp.PyTreeCheckpointer() as ckptr:
+            restore_args = ocp.checkpoint_utils.construct_restore_args(state_t)
+            state = ckptr.restore(
+                os.path.join(path, "state"), item=state_t,
+                restore_args=restore_args)
+        if isinstance(template, dict):
+            template["state"] = state_t
+            out = {"state": state}
+            meta_path = os.path.join(path, "meta.json")
+            if os.path.exists(meta_path):
+                with open(meta_path) as f:
+                    out.update(json.load(f))
+            return out
+        return state
+
+    def commit(self, tag: str) -> None:
+        with open(os.path.join(self.ckpt_dir, LATEST_FILE), "w") as f:
+            f.write(str(tag))
+
+    def latest_tag(self) -> Optional[str]:
+        p = os.path.join(self.ckpt_dir, LATEST_FILE)
+        if not os.path.exists(p):
+            return None
+        with open(p) as f:
+            return f.read().strip()
+
+
+def _jsonable(obj):
+    import numpy as np
+
+    if hasattr(obj, "item"):
+        return obj.item()
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    return str(obj)

@@ -1,0 +1,528 @@
+"""DeepSpeedEngine — the central training wrapper, TPU-native.
+
+Reference analogue: ``deepspeed/runtime/engine.py:184`` (forward :1926,
+backward :2085, step :2282, save/load checkpoint :2872-3756).
+
+Architecture: the engine owns a functional :class:`EngineState` (params,
+optimizer state, loss-scaler state, grad-accumulation buffer, RNG) laid out on
+the device mesh according to the ZeRO stage's sharding plan
+(:mod:`deepspeed_tpu.runtime.zero.sharding`).  Two execution paths:
+
+  * **Fused path** — ``train_batch(batch)``: one jitted update covering all
+    gradient-accumulation micro-steps via ``lax.scan``, loss scaling, global
+    clipping, optimizer update, scheduler.  This is the fast path: XLA overlaps
+    the ZeRO collectives (param allgather / grad reduce-scatter) with compute,
+    which is what the reference's overlap_comm/prefetch machinery does by hand.
+  * **Imperative path** — ``forward``/``backward``/``step`` matching the
+    reference's micro-batch loop API: ``backward(batch)`` accumulates grads
+    into the state buffer; ``step()`` applies the update only at the
+    grad-accumulation boundary.
+
+Mixed precision follows the bf16-optimizer design (runtime/bf16_optimizer.py):
+fp32 master params in optimizer space, compute in ``config.dtype`` via cast at
+forward entry, grads accumulated in fp32.
+"""
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Any, Callable, Dict, Iterable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..accelerator import get_accelerator
+from ..utils.logging import log_dist, logger
+from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
+from .config import DeepSpeedConfig
+from .fp16.loss_scaler import LossScaler, LossScalerState, create_loss_scaler
+from .lr_schedules import build_scheduler, get_schedule_fn
+from .optimizer import build_optimizer
+from .topology import MeshTopology, get_topology
+from .zero.sharding import ZeroShardingPlan
+
+
+@struct.dataclass
+class EngineState:
+    """All mutable training state, as one sharded pytree."""
+
+    global_step: jnp.ndarray       # optimizer steps taken
+    micro_step: jnp.ndarray        # micro batches seen
+    skipped_steps: jnp.ndarray     # overflow-skipped optimizer steps
+    params: Any                    # fp32 master params (sharded per plan)
+    opt_state: Any
+    scaler: LossScalerState
+    grad_acc: Any                  # fp32 grad accumulation buffer (or None)
+    rng: jax.Array
+
+
+def _tree_zeros_like(tree, dtype=jnp.float32):
+    return jax.tree.map(lambda x: jnp.zeros(x.shape, dtype), tree)
+
+
+def _global_norm(tree):
+    leaves = [jnp.sum(jnp.square(g.astype(jnp.float32))) for g in jax.tree.leaves(tree)]
+    return jnp.sqrt(jnp.sum(jnp.stack(leaves))) if leaves else jnp.zeros((), jnp.float32)
+
+
+class DeepSpeedEngine:
+    def __init__(
+        self,
+        model: Any,
+        config: DeepSpeedConfig,
+        topology: Optional[MeshTopology] = None,
+        model_parameters: Any = None,
+        optimizer: Any = None,
+        lr_scheduler: Any = None,
+        training_data: Any = None,
+        collate_fn: Optional[Callable] = None,
+        seed: int = 0,
+        dont_change_device: bool = False,
+    ):
+        self.config = config
+        self.topology = topology or get_topology()
+        self.mesh = self.topology.mesh
+        self.module = model
+        self._timers = SynchronizedWallClockTimer()
+        self.tput_timer = ThroughputTimer(
+            batch_size=config.train_batch_size or 1,
+            steps_per_output=config.steps_per_print,
+            logging_fn=lambda m: log_dist(m, ranks=[0]))
+
+        self.loss_fn = self._resolve_loss_fn(model)
+        self.compute_dtype = config.dtype
+        self.zero_stage = config.zero_config.stage
+        self.plan = ZeroShardingPlan(
+            self.topology, self.zero_stage,
+            param_persistence_threshold=config.zero_config.param_persistence_threshold,
+            base_specs=getattr(model, "partition_specs", None))
+
+        # ---- params ------------------------------------------------- #
+        params = model_parameters
+        if params is None:
+            params = getattr(model, "params", None)
+        if params is None:
+            raise ValueError("model_parameters (a pytree) is required")
+        params = jax.tree.map(lambda x: jnp.asarray(x, jnp.float32), params)
+
+        # ---- optimizer + schedule ----------------------------------- #
+        self.client_optimizer = optimizer
+        self.lr_scheduler = lr_scheduler
+        self._schedule_fn = self._resolve_schedule()
+        self.optimizer = self._resolve_optimizer(optimizer)
+
+        # ---- loss scaling ------------------------------------------- #
+        self.loss_scaler: LossScaler = create_loss_scaler(config.fp16, self.compute_dtype)
+
+        # ---- state layout + placement -------------------------------- #
+        self.param_shardings = self.plan.param_shardings(params)
+        params = jax.device_put(params, self.param_shardings)
+        opt_state = jax.jit(
+            self.optimizer.init,
+            out_shardings=self.plan.opt_state_shardings(
+                jax.eval_shape(self.optimizer.init, params), params),
+        )(params)
+
+        gas = config.gradient_accumulation_steps
+        grad_acc = None
+        if gas > 1:
+            grad_acc = jax.jit(
+                partial(_tree_zeros_like, dtype=jnp.float32),
+                out_shardings=jax.tree.map(
+                    lambda s: NamedSharding(self.mesh, s), self.plan.grad_specs(params),
+                    is_leaf=lambda x: isinstance(x, PartitionSpec)),
+            )(params)
+
+        self.state = EngineState(
+            global_step=jnp.zeros((), jnp.int32),
+            micro_step=jnp.zeros((), jnp.int32),
+            skipped_steps=jnp.zeros((), jnp.int32),
+            params=params,
+            opt_state=opt_state,
+            scaler=self.loss_scaler.init(),
+            grad_acc=grad_acc,
+            rng=jax.random.PRNGKey(seed),
+        )
+
+        # ---- data ---------------------------------------------------- #
+        self.training_dataloader = None
+        if training_data is not None:
+            self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
+
+        # ---- compiled steps ------------------------------------------ #
+        self._compiled: Dict[str, Any] = {}
+        self._losses: list = []
+        self.monitor = self._configure_monitor()
+
+        log_dist(
+            f"engine ready: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
+            f"mesh={self.topology.dims} batch={config.train_batch_size} "
+            f"micro={config.train_micro_batch_size_per_gpu} gas={gas}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    # Resolution helpers
+    # ------------------------------------------------------------------ #
+    def _resolve_loss_fn(self, model) -> Callable:
+        """Accept a loss callable, or a flax-like module with .apply.
+
+        Convention (mirrors the reference's "module forward returns loss"):
+        ``loss_fn(params, batch, rng) -> loss`` or ``(loss, aux)``.
+        """
+        if hasattr(model, "loss_fn"):
+            return model.loss_fn
+        if callable(model) and not hasattr(model, "apply"):
+            return model
+        if hasattr(model, "apply"):
+            def fn(params, batch, rng):
+                return model.apply({"params": params}, batch, rngs={"dropout": rng})
+
+            return fn
+        raise TypeError(f"cannot derive loss fn from model {type(model)}")
+
+    def _resolve_schedule(self):
+        cfg = self.config
+        base_lr = (cfg.optimizer.params.get("lr", 1e-3) if cfg.optimizer else 1e-3)
+        if cfg.scheduler and cfg.scheduler.type:
+            return get_schedule_fn(cfg.scheduler.type, cfg.scheduler.params, base_lr=base_lr)
+        return lambda step: jnp.asarray(base_lr, jnp.float32)
+
+    def _resolve_optimizer(self, optimizer):
+        import optax
+
+        if optimizer is not None and not isinstance(optimizer, optax.GradientTransformation):
+            raise TypeError("client optimizer must be an optax.GradientTransformation")
+        if optimizer is not None:
+            return optimizer
+        cfg = self.config.optimizer
+        if cfg is None:
+            return build_optimizer("adam", {}, learning_rate=self._schedule_fn)
+        return build_optimizer(cfg.type, cfg.params, learning_rate=self._schedule_fn)
+
+    def _configure_monitor(self):
+        try:
+            from ..monitor.monitor import MonitorMaster
+
+            return MonitorMaster(self.config)
+        except Exception:
+            return None
+
+    # ------------------------------------------------------------------ #
+    # Introspection API (reference names)
+    # ------------------------------------------------------------------ #
+    @property
+    def global_steps(self) -> int:
+        return int(self.state.global_step)
+
+    @property
+    def skipped_steps(self) -> int:
+        return int(self.state.skipped_steps)
+
+    @property
+    def micro_steps(self) -> int:
+        return int(self.state.micro_step)
+
+    @property
+    def global_samples(self) -> int:
+        return self.micro_steps * self.train_micro_batch_size_per_gpu() * \
+            self.topology.get_data_parallel_world_size()
+
+    def train_batch_size(self) -> int:
+        return self.config.train_batch_size
+
+    def train_micro_batch_size_per_gpu(self) -> int:
+        return self.config.train_micro_batch_size_per_gpu
+
+    def gradient_accumulation_steps(self) -> int:
+        return self.config.gradient_accumulation_steps
+
+    def zero_optimization_stage(self) -> int:
+        return self.zero_stage
+
+    def get_lr(self):
+        return [float(self._schedule_fn(self.state.global_step))]
+
+    def get_loss_scale(self) -> float:
+        return float(self.state.scaler.scale)
+
+    def is_gradient_accumulation_boundary(self) -> bool:
+        gas = self.gradient_accumulation_steps()
+        return (self.micro_steps % gas) == 0 and self.micro_steps > 0
+
+    def timers(self, name):
+        return self._timers(name)
+
+    # ------------------------------------------------------------------ #
+    # Data
+    # ------------------------------------------------------------------ #
+    def deepspeed_io(self, dataset, batch_size=None, collate_fn=None, num_local_io_workers=None,
+                     data_sampler=None, route=None):
+        from .dataloader import DeepSpeedDataLoader
+
+        return DeepSpeedDataLoader(
+            dataset,
+            batch_size=batch_size or self.train_micro_batch_size_per_gpu(),
+            collate_fn=collate_fn,
+            topology=self.topology)
+
+    # ------------------------------------------------------------------ #
+    # Core math (shared by both paths)
+    # ------------------------------------------------------------------ #
+    def _loss_and_grads(self, params, batch, rng, scaler_state):
+        """One micro-batch: cast → forward → scaled backward → fp32 grads."""
+
+        def scaled_loss(p32):
+            p = jax.tree.map(lambda x: x.astype(self.compute_dtype), p32)
+            out = self.loss_fn(p, batch, rng)
+            loss = out[0] if isinstance(out, tuple) else out
+            return self.loss_scaler.scale_loss(loss.astype(jnp.float32), scaler_state), loss
+
+        grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        grads = self._constrain_grads(grads)
+        return loss, grads
+
+    def _constrain_grads(self, grads):
+        """Apply ZeRO-2/3 grad sharding (XLA lowers the psum into reduce-scatter)."""
+        if self.zero_stage >= 2:
+            specs = self.plan.grad_specs(grads)
+            grads = jax.tree.map(
+                lambda g, s: jax.lax.with_sharding_constraint(g, NamedSharding(self.mesh, s)),
+                grads, specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
+        return grads
+
+    def _apply_update(self, state: EngineState, grads, grad_norm_scale=None):
+        """Unscale, clip, optimizer update, loss-scale update, skip-on-overflow."""
+        grads = self.loss_scaler.unscale_grads(grads, state.scaler)
+        if grad_norm_scale is not None:
+            grads = jax.tree.map(lambda g: g * grad_norm_scale, grads)
+        overflow = self.loss_scaler.check_overflow(grads) \
+            if self.loss_scaler.dynamic else jnp.zeros((), bool)
+
+        clip = self.config.gradient_clipping
+        if clip and clip > 0:
+            gnorm = _global_norm(grads)
+            scale = jnp.minimum(1.0, clip / (gnorm + 1e-6))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        safe_grads = jax.tree.map(lambda g: jnp.where(jnp.isfinite(g), g, 0.0), grads)
+        updates, new_opt = self.optimizer.update(safe_grads, state.opt_state, state.params)
+        import optax
+
+        new_params = optax.apply_updates(state.params, updates)
+        # On overflow: keep old params/opt state, bump skipped counter.
+        keep = lambda new, old: jax.tree.map(
+            lambda n, o: jnp.where(overflow, o, n), new, old)
+        new_params = keep(new_params, state.params)
+        new_opt = keep(new_opt, state.opt_state)
+        new_scaler = self.loss_scaler.update(state.scaler, overflow)
+        return state.replace(
+            params=new_params,
+            opt_state=new_opt,
+            scaler=new_scaler,
+            global_step=state.global_step + jnp.where(overflow, 0, 1),
+            skipped_steps=state.skipped_steps + jnp.where(overflow, 1, 0),
+        )
+
+    # ------------------------------------------------------------------ #
+    # Fused path
+    # ------------------------------------------------------------------ #
+    def _build_train_batch_fn(self):
+        gas = self.gradient_accumulation_steps()
+
+        def step_fn(state: EngineState, batch):
+            rng, sub = jax.random.split(state.rng)
+
+            if gas == 1:
+                loss, grads = self._loss_and_grads(state.params, batch, sub, state.scaler)
+                mean_loss = loss
+            else:
+                # batch leaves: [gas, micro_global, ...]
+                def micro(carry, mb):
+                    acc, r = carry
+                    r, r2 = jax.random.split(r)
+                    loss, grads = self._loss_and_grads(state.params, mb, r2, state.scaler)
+                    acc = jax.tree.map(jnp.add, acc, grads)
+                    return (acc, r), loss
+
+                zeros = _tree_zeros_like(state.params)
+                zeros = self._constrain_grads(zeros)
+                (grads, _), losses = jax.lax.scan(micro, (zeros, sub), batch)
+                grads = jax.tree.map(lambda g: g / gas, grads)
+                mean_loss = losses.mean()
+
+            new_state = self._apply_update(state, grads)
+            new_state = new_state.replace(micro_step=state.micro_step + gas, rng=rng)
+            return new_state, mean_loss
+
+        donate = jax.jit(step_fn, donate_argnums=(0,))
+        return donate
+
+    def train_batch(self, batch) -> jnp.ndarray:
+        """One full optimizer step over a global batch.
+
+        ``batch`` leaves have leading dim ``train_batch_size`` (global);
+        with gradient accumulation the engine reshapes to [gas, micro].
+        """
+        gas = self.gradient_accumulation_steps()
+        if gas > 1:
+            batch = jax.tree.map(
+                lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch)
+        if "train_batch" not in self._compiled:
+            self._compiled["train_batch"] = self._build_train_batch_fn()
+        self.tput_timer.start()
+        self.state, loss = self._compiled["train_batch"](self.state, batch)
+        self.tput_timer.stop(sync=loss)
+        self._write_monitor_events(loss)
+        return loss
+
+    def _write_monitor_events(self, loss):
+        if self.monitor is None or not getattr(self.monitor, "enabled", False):
+            return
+        step = self.global_steps
+        events = [("Train/Samples/train_loss", float(loss), self.global_samples),
+                  ("Train/Samples/lr", self.get_lr()[0], self.global_samples)]
+        if self.loss_scaler.dynamic:
+            events.append(("Train/Samples/loss_scale", self.get_loss_scale(), self.global_samples))
+        self.monitor.write_events(events)
+
+    # ------------------------------------------------------------------ #
+    # Imperative path (reference API shape)
+    # ------------------------------------------------------------------ #
+    def _build_micro_fn(self):
+        def micro_fn(state: EngineState, batch):
+            rng, sub = jax.random.split(state.rng)
+            loss, grads = self._loss_and_grads(state.params, batch, sub, state.scaler)
+            if state.grad_acc is not None:
+                acc = jax.tree.map(jnp.add, state.grad_acc, grads)
+            else:
+                acc = grads
+            return state.replace(grad_acc=acc, micro_step=state.micro_step + 1, rng=rng), loss
+
+        return jax.jit(micro_fn, donate_argnums=(0,))
+
+    def _build_step_fn(self):
+        gas = self.gradient_accumulation_steps()
+
+        def step_fn(state: EngineState):
+            grads = state.grad_acc
+            new_state = self._apply_update(state, grads, grad_norm_scale=1.0 / gas)
+            zeros = jax.tree.map(jnp.zeros_like, grads)
+            return new_state.replace(grad_acc=zeros)
+
+        return jax.jit(step_fn, donate_argnums=(0,))
+
+    def forward(self, batch, rng: Optional[jax.Array] = None):
+        """Loss-only forward (eval). For the training loop use backward()/step()."""
+        if "forward" not in self._compiled:
+            def fwd(params, batch, rng, scaler):
+                p = jax.tree.map(lambda x: x.astype(self.compute_dtype), params)
+                out = self.loss_fn(p, batch, rng)
+                return out
+
+            self._compiled["forward"] = jax.jit(fwd)
+        rng = rng if rng is not None else jax.random.PRNGKey(0)
+        return self._compiled["forward"](self.state.params, batch, rng, self.state.scaler)
+
+    __call__ = forward
+
+    def backward(self, batch, loss=None):
+        """Compute+accumulate grads for one micro batch (fwd+bwd fused).
+
+        Note: unlike the reference (which takes the loss tensor from a prior
+        ``forward``), JAX differentiates the loss *function*, so backward takes
+        the micro-batch. Returns the micro-batch loss.
+        """
+        if self.state.grad_acc is None and self.gradient_accumulation_steps() > 1:
+            raise RuntimeError("grad accumulation buffer missing")
+        if self.state.grad_acc is None:
+            # allocate lazily for gas==1 imperative use
+            self.state = self.state.replace(
+                grad_acc=_tree_zeros_like(self.state.params))
+            self._compiled.pop("micro", None)
+        if "micro" not in self._compiled:
+            self._compiled["micro"] = self._build_micro_fn()
+        self.state, loss = self._compiled["micro"](self.state, batch)
+        self._losses.append(loss)
+        return loss
+
+    def step(self):
+        """Apply the optimizer at the grad-accumulation boundary (else no-op),
+        mirroring reference step() semantics (engine.py:2282)."""
+        if not self.is_gradient_accumulation_boundary():
+            return
+        if "step" not in self._compiled:
+            self._compiled["step"] = self._build_step_fn()
+        self.state = self._compiled["step"](self.state)
+        if self._losses:
+            self._write_monitor_events(self._losses[-1])
+            self._losses.clear()
+
+    def eval_batch(self, batch):
+        out = self.forward(batch)
+        return out[0] if isinstance(out, tuple) else out
+
+    # ------------------------------------------------------------------ #
+    # Checkpointing (orbax-backed; universal/reshardable by construction)
+    # ------------------------------------------------------------------ #
+    def save_checkpoint(self, save_dir: str, tag: Optional[str] = None,
+                        client_state: Optional[dict] = None, save_latest: bool = True,
+                        exclude_frozen_parameters: bool = False):
+        from .checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
+
+        tag = tag or f"global_step{self.global_steps}"
+        engine = OrbaxCheckpointEngine(save_dir)
+        payload = {
+            "state": self.state,
+            "client_state": client_state or {},
+            "lr_scheduler": (self.lr_scheduler.state_dict()
+                             if hasattr(self.lr_scheduler, "state_dict") else None),
+            "config": {"zero_stage": self.zero_stage,
+                       "world_size": self.topology.world_size()},
+        }
+        engine.save(payload, tag)
+        if save_latest:
+            engine.commit(tag)
+        log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
+        return True
+
+    def load_checkpoint(self, load_dir: str, tag: Optional[str] = None,
+                        load_module_strict: bool = True, load_optimizer_states: bool = True,
+                        load_lr_scheduler_states: bool = True,
+                        load_module_only: bool = False):
+        from .checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
+
+        engine = OrbaxCheckpointEngine(load_dir)
+        if tag is None:
+            tag = engine.latest_tag()
+            if tag is None:
+                logger.warning(f"no checkpoint found under {load_dir}")
+                return None, {}
+        payload = engine.load({"state": self.state, "client_state": None,
+                               "lr_scheduler": None, "config": None}, tag)
+        restored = payload["state"]
+        # Re-place on this engine's target shardings (restore may commit
+        # scalar leaves to a single device, which conflicts under jit).
+        target = jax.tree.map(
+            lambda cur: cur.sharding if isinstance(cur.sharding, NamedSharding)
+            else self.topology.replicated(), self.state)
+        restored = jax.device_put(restored, target)
+        if load_module_only or not load_optimizer_states:
+            self.state = self.state.replace(params=restored.params)
+        else:
+            self.state = restored
+        if load_lr_scheduler_states and payload.get("lr_scheduler") and \
+                hasattr(self.lr_scheduler, "load_state_dict"):
+            self.lr_scheduler.load_state_dict(payload["lr_scheduler"])
+        log_dist(f"loaded checkpoint {load_dir}/{tag}", ranks=[0])
+        return os.path.join(load_dir, str(tag)), payload.get("client_state", {})
+
+    # ------------------------------------------------------------------ #
+    def get_fp32_state_dict(self):
+        """Gather full (unsharded) fp32 params on host — the
+        ``_zero3_consolidated_16bit_state_dict`` analogue (engine.py:3693)."""
+        rep = jax.device_put(self.state.params,
+                             jax.tree.map(lambda _: self.topology.replicated(),
+                                          self.state.params))
+        return jax.tree.map(np.asarray, rep)

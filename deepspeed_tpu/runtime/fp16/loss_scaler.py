@@ -1,0 +1,114 @@
+"""Loss scaling (reference analogue: deepspeed/runtime/fp16/loss_scaler.py:67,91).
+
+Functional formulation: scaler state is a small pytree carried through the
+jitted train step; ``update`` implements the reference's dynamic-scale policy
+(halve + hysteresis on overflow, double after ``scale_window`` clean steps).
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+class LossScalerState(NamedTuple):
+    scale: jnp.ndarray          # f32 scalar
+    good_steps: jnp.ndarray     # i32 scalar
+    hysteresis: jnp.ndarray     # i32 scalar
+
+
+class LossScaler:
+    """Static (or disabled) loss scaling."""
+
+    dynamic = False
+
+    def __init__(self, scale: float = 1.0):
+        self.initial_scale = float(scale)
+
+    def init(self) -> LossScalerState:
+        return LossScalerState(
+            scale=jnp.asarray(self.initial_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.ones((), jnp.int32),
+        )
+
+    def scale_loss(self, loss, state: LossScalerState):
+        return loss * state.scale.astype(loss.dtype)
+
+    def unscale_grads(self, grads, state: LossScalerState):
+        inv = 1.0 / state.scale
+        return jax.tree.map(lambda g: g * inv.astype(g.dtype), grads)
+
+    def check_overflow(self, grads) -> jnp.ndarray:
+        leaves = jax.tree.leaves(grads)
+        if not leaves:
+            return jnp.zeros((), bool)
+        finite = [jnp.all(jnp.isfinite(g)) for g in leaves]
+        return ~jnp.stack(finite).all()
+
+    def update(self, state: LossScalerState, overflow) -> LossScalerState:
+        return state  # static scale never changes
+
+
+class DynamicLossScaler(LossScaler):
+    """Reference: loss_scaler.py:91 — scale 2x after a clean window, 0.5x on
+    overflow once hysteresis is exhausted."""
+
+    dynamic = True
+
+    def __init__(self, init_scale: float = 2 ** 16, scale_factor: float = 2.0,
+                 scale_window: int = 1000, min_scale: float = 1.0,
+                 delayed_shift: int = 1, consecutive_hysteresis: bool = False):
+        super().__init__(init_scale)
+        self.scale_factor = float(scale_factor)
+        self.scale_window = int(scale_window)
+        self.min_scale = float(min_scale)
+        self.delayed_shift = int(delayed_shift)
+        self.consecutive_hysteresis = consecutive_hysteresis
+
+    def init(self) -> LossScalerState:
+        return LossScalerState(
+            scale=jnp.asarray(self.initial_scale, jnp.float32),
+            good_steps=jnp.zeros((), jnp.int32),
+            hysteresis=jnp.asarray(self.delayed_shift, jnp.int32),
+        )
+
+    def update(self, state: LossScalerState, overflow) -> LossScalerState:
+        overflow = jnp.asarray(overflow)
+
+        def on_overflow(s: LossScalerState) -> LossScalerState:
+            hyst = s.hysteresis - 1
+            new_scale = jnp.where(
+                hyst <= 0, jnp.maximum(s.scale / self.scale_factor, self.min_scale), s.scale)
+            return LossScalerState(scale=new_scale, good_steps=jnp.zeros((), jnp.int32),
+                                   hysteresis=jnp.maximum(hyst, 0))
+
+        def on_clean(s: LossScalerState) -> LossScalerState:
+            good = s.good_steps + 1
+            grow = good >= self.scale_window
+            hyst = (jnp.asarray(self.delayed_shift, jnp.int32)
+                    if self.consecutive_hysteresis else s.hysteresis)
+            return LossScalerState(
+                scale=jnp.where(grow, s.scale * self.scale_factor, s.scale),
+                good_steps=jnp.where(grow, 0, good),
+                hysteresis=hyst)
+
+        return jax.lax.cond(overflow, on_overflow, on_clean, state)
+
+
+def create_loss_scaler(fp16_config=None, dtype=None) -> LossScaler:
+    """Build from FP16Config (reference: fused_optimizer.py loss-scale setup)."""
+    import jax.numpy as jnp
+
+    if fp16_config is None or not getattr(fp16_config, "enabled", False) or dtype == jnp.bfloat16:
+        return LossScaler(1.0)
+    if fp16_config.loss_scale and fp16_config.loss_scale > 0:
+        return LossScaler(fp16_config.loss_scale)
+    return DynamicLossScaler(
+        init_scale=2.0 ** fp16_config.initial_scale_power,
+        scale_window=fp16_config.loss_scale_window,
+        min_scale=fp16_config.min_loss_scale,
+        delayed_shift=fp16_config.hysteresis,
+        consecutive_hysteresis=fp16_config.consecutive_hysteresis,
+    )

@@ -1,0 +1,84 @@
+"""Optimizer factory (reference analogue: engine._configure_basic_optimizer,
+deepspeed/runtime/engine.py:1405).
+
+Maps DeepSpeed optimizer config names onto optax gradient transforms.  The
+"fused" variants the reference implements as CUDA multi-tensor kernels
+(csrc/adam/multi_tensor_adam.cu etc.) are XLA-fused automatically here; a
+Pallas fused-update path for the flat-buffer case lives in
+``deepspeed_tpu.ops.adam``.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional, Union
+
+import optax
+
+ADAM_OPTIMIZER = "adam"
+ADAMW_OPTIMIZER = "adamw"
+LAMB_OPTIMIZER = "lamb"
+ONEBIT_ADAM_OPTIMIZER = "onebitadam"
+ZERO_ONE_ADAM_OPTIMIZER = "zerooneadam"
+ONEBIT_LAMB_OPTIMIZER = "onebitlamb"
+LION_OPTIMIZER = "lion"
+MUON_OPTIMIZER = "muon"
+SGD_OPTIMIZER = "sgd"
+ADAGRAD_OPTIMIZER = "adagrad"
+
+SUPPORTED_OPTIMIZERS = [
+    ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, LION_OPTIMIZER,
+    SGD_OPTIMIZER, ADAGRAD_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
+    ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER, MUON_OPTIMIZER,
+]
+
+ScheduleOrFloat = Union[float, Callable]
+
+
+def _common(params: Dict[str, Any]):
+    lr = params.get("lr", 1e-3)
+    betas = params.get("betas", (0.9, 0.999))
+    eps = params.get("eps", 1e-8)
+    wd = params.get("weight_decay", 0.0)
+    return lr, tuple(betas), eps, wd
+
+
+def build_optimizer(opt_type: str, params: Dict[str, Any],
+                    learning_rate: Optional[ScheduleOrFloat] = None) -> optax.GradientTransformation:
+    """Create the optax transform for a DeepSpeed optimizer config.
+
+    ``learning_rate`` overrides ``params["lr"]`` (used to inject the jit-pure
+    LR schedule so lr lives inside the compiled step).
+    """
+    name = opt_type.lower()
+    lr, betas, eps, wd = _common(params)
+    if learning_rate is not None:
+        lr = learning_rate
+
+    if name in (ADAM_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
+        adam_w_mode = params.get("adam_w_mode", True)
+        if wd and adam_w_mode:
+            return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+        tx = optax.adam(lr, b1=betas[0], b2=betas[1], eps=eps)
+        if wd:
+            tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        return tx
+    if name == ADAMW_OPTIMIZER:
+        return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    if name in (LAMB_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
+        return optax.lamb(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
+    if name == LION_OPTIMIZER:
+        b1, b2 = (betas if len(betas) == 2 else (0.9, 0.99))
+        return optax.lion(lr, b1=b1, b2=b2, weight_decay=wd)
+    if name == SGD_OPTIMIZER:
+        momentum = params.get("momentum", 0.0)
+        tx = optax.sgd(lr, momentum=momentum or None, nesterov=params.get("nesterov", False))
+        if wd:
+            tx = optax.chain(optax.add_decayed_weights(wd), tx)
+        return tx
+    if name == ADAGRAD_OPTIMIZER:
+        return optax.adagrad(lr, eps=eps)
+    if name == MUON_OPTIMIZER:
+        try:
+            return optax.contrib.muon(lr)
+        except AttributeError as e:
+            raise NotImplementedError("muon requires newer optax") from e
+    raise ValueError(f"unknown optimizer {opt_type!r}; supported: {SUPPORTED_OPTIMIZERS}")

@@ -1,0 +1,159 @@
+"""ZeRO stage semantics as sharding rules.
+
+Reference analogues: ``runtime/zero/stage_1_and_2.py`` (optimizer/grad
+partitioning), ``runtime/zero/stage3.py`` + ``partition_parameters.py``
+(parameter partitioning with gather-on-use).
+
+On TPU, ZeRO is not a hand-written partition/gather engine: each stage is a
+*sharding assignment* over the mesh's ZeRO axes, and XLA inserts the
+allgather/reduce-scatter collectives plus prefetch/overlap scheduling that the
+reference implements manually (stage3 prefetching, overlap_comm side streams).
+
+  stage 0: params R, grads R (psum), opt R            — plain DP
+  stage 1: params R, grads R, opt SHARDED             — optimizer partitioning
+  stage 2: params R, grads SHARDED (reduce-scatter), opt SHARDED
+  stage 3: params SHARDED (allgather-on-use), grads SHARDED, opt SHARDED
+
+``param_persistence_threshold`` maps directly: params smaller than the
+threshold stay replicated ("persistent" in the reference's sense —
+stage3.py:214 persistence filtering) since gathering tiny arrays costs more
+latency than the memory saved.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec
+
+from ..topology import MeshTopology
+
+
+def _spec_axes(spec: Optional[PartitionSpec]) -> set:
+    used = set()
+    if spec is None:
+        return used
+    for entry in spec:
+        if entry is None:
+            continue
+        if isinstance(entry, (tuple, list)):
+            used.update(entry)
+        else:
+            used.add(entry)
+    return used
+
+
+def shard_param_spec(
+    shape: Tuple[int, ...],
+    zero_axes: Tuple[str, ...],
+    zero_size: int,
+    base_spec: Optional[PartitionSpec] = None,
+) -> PartitionSpec:
+    """Assign ``zero_axes`` to the best dimension of an array.
+
+    ``base_spec`` carries pre-existing model-parallel sharding (e.g. a TP axis
+    on a Megatron-style Linear); ZeRO axes are added on a *different* dim.
+    Picks the largest dim divisible by ``zero_size``; returns ``base_spec``
+    unchanged (replicated over ZeRO axes) if none divides.
+    """
+    if zero_size <= 1 or not zero_axes:
+        return base_spec if base_spec is not None else PartitionSpec()
+    ndim = len(shape)
+    base = list(base_spec) if base_spec is not None else []
+    base = base + [None] * (ndim - len(base))
+    taken = _spec_axes(base_spec)
+    if any(a in taken for a in zero_axes):
+        return PartitionSpec(*base)  # already sharded over zero axes
+
+    candidates = [d for d in range(ndim)
+                  if base[d] is None and shape[d] % zero_size == 0]
+    if not candidates:
+        return PartitionSpec(*base)
+    dim = max(candidates, key=lambda d: shape[d])
+    base[dim] = zero_axes if len(zero_axes) > 1 else zero_axes[0]
+    return PartitionSpec(*base)
+
+
+class ZeroShardingPlan:
+    """Per-stage sharding assignment for params / grads / optimizer state."""
+
+    def __init__(self, topology: MeshTopology, stage: int,
+                 param_persistence_threshold: int = 100_000,
+                 base_specs: Any = None):
+        self.topology = topology
+        self.stage = int(stage)
+        self.threshold = int(param_persistence_threshold)
+        self.zero_axes = topology.zero_axes()
+        self.zero_size = int(np.prod([topology.dims[a] for a in self.zero_axes])) \
+            if self.zero_axes else 1
+        self.base_specs = base_specs
+
+    # -------------------------------------------------------------- #
+    def _base_spec_for(self, path) -> Optional[PartitionSpec]:
+        if self.base_specs is None:
+            return None
+        node = self.base_specs
+        try:
+            for key in path:
+                k = getattr(key, "key", getattr(key, "idx", None))
+                node = node[k]
+            return node if isinstance(node, PartitionSpec) else None
+        except (KeyError, IndexError, TypeError):
+            return None
+
+    def _sharded_spec(self, path, leaf) -> PartitionSpec:
+        shape = tuple(leaf.shape)
+        base = self._base_spec_for(path)
+        size = int(np.prod(shape)) if shape else 1
+        if size < self.threshold or not shape:
+            return base if base is not None else PartitionSpec()
+        return shard_param_spec(shape, self.zero_axes, self.zero_size, base)
+
+    def _replicated_spec(self, path, leaf) -> PartitionSpec:
+        base = self._base_spec_for(path)
+        return base if base is not None else PartitionSpec()
+
+    # -------------------------------------------------------------- #
+    def param_specs(self, params: Any) -> Any:
+        """PartitionSpec pytree for model parameters (persistent storage)."""
+        fn = self._sharded_spec if self.stage >= 3 else self._replicated_spec
+        return jax.tree_util.tree_map_with_path(fn, params)
+
+    def grad_specs(self, params: Any) -> Any:
+        """Sharding constraint applied to grads inside the train step."""
+        fn = self._sharded_spec if self.stage >= 2 else self._replicated_spec
+        return jax.tree_util.tree_map_with_path(fn, params)
+
+    def opt_state_specs_for_param(self, params: Any) -> Any:
+        """Spec pytree used for optimizer moments (same layout as params)."""
+        fn = self._sharded_spec if self.stage >= 1 else self._replicated_spec
+        return jax.tree_util.tree_map_with_path(fn, params)
+
+    # -------------------------------------------------------------- #
+    def param_shardings(self, params: Any) -> Any:
+        mesh = self.topology.mesh
+        return jax.tree.map(lambda s: NamedSharding(mesh, s), self.param_specs(params),
+                            is_leaf=lambda x: isinstance(x, PartitionSpec))
+
+    def opt_state_shardings(self, opt_state: Any, params: Any) -> Any:
+        """Match optimizer-state leaves to their parameter's sharding.
+
+        Optax states mirror the param pytree inside each moment container;
+        scalar leaves (counts) stay replicated.  We key by shape: a state leaf
+        with the same shape as some param follows that param's moment spec.
+        """
+        mesh = self.topology.mesh
+        spec_tree = self.opt_state_specs_for_param(params)
+        param_leaves = jax.tree.leaves(params)
+        spec_leaves = jax.tree.leaves(
+            spec_tree, is_leaf=lambda x: isinstance(x, PartitionSpec))
+        shape_to_spec = {}
+        for p, s in zip(param_leaves, spec_leaves):
+            shape_to_spec.setdefault(tuple(p.shape), s)
+
+        def assign(leaf):
+            spec = shape_to_spec.get(tuple(leaf.shape), PartitionSpec())
+            return NamedSharding(mesh, spec)
+
+        return jax.tree.map(assign, opt_state)

@@ -1,0 +1,132 @@
+"""Wall-clock + throughput timers (reference: deepspeed/utils/timer.py:44,199).
+
+CUDA-event timing maps to ``jax.block_until_ready`` fences; under jit the
+per-phase breakdown (fwd/bwd/step) is only meaningful for the imperative API —
+the fused train step reports whole-step time.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+FORWARD_MICRO_TIMER = "fwd_microstep"
+FORWARD_GLOBAL_TIMER = "fwd"
+BACKWARD_MICRO_TIMER = "bwd_microstep"
+BACKWARD_GLOBAL_TIMER = "bwd"
+STEP_MICRO_TIMER = "step_microstep"
+STEP_GLOBAL_TIMER = "step"
+
+
+class _Timer:
+    def __init__(self, name: str):
+        self.name = name
+        self.started = False
+        self._start = 0.0
+        self.elapsed_total = 0.0
+        self.count = 0
+
+    def start(self):
+        self.started = True
+        self._start = time.perf_counter()
+
+    def stop(self, sync=None, reset=False):
+        if not self.started:
+            return
+        if sync is not None:
+            import jax
+
+            jax.block_until_ready(sync)
+        self.elapsed_total += time.perf_counter() - self._start
+        self.count += 1
+        self.started = False
+
+    def elapsed(self, reset: bool = True) -> float:
+        out = self.elapsed_total
+        if reset:
+            self.reset()
+        return out
+
+    def mean(self) -> float:
+        return self.elapsed_total / max(self.count, 1)
+
+    def reset(self):
+        self.elapsed_total = 0.0
+        self.count = 0
+        self.started = False
+
+
+class SynchronizedWallClockTimer:
+    """Named-timer registry (reference: utils/timer.py:44)."""
+
+    def __init__(self):
+        self.timers: Dict[str, _Timer] = {}
+
+    def __call__(self, name: str) -> _Timer:
+        if name not in self.timers:
+            self.timers[name] = _Timer(name)
+        return self.timers[name]
+
+    def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
+            memory_breakdown: bool = False) -> str:
+        assert normalizer > 0.0
+        parts = []
+        for name in names:
+            if name in self.timers:
+                ms = self.timers[name].elapsed(reset=reset) * 1000.0 / normalizer
+                parts.append(f"{name}: {ms:.2f}")
+        msg = "time (ms) | " + " | ".join(parts)
+        from .logging import log_dist
+
+        log_dist(msg, ranks=[0])
+        return msg
+
+
+class ThroughputTimer:
+    """Samples/sec + TFLOPS estimate (reference: utils/timer.py:199)."""
+
+    def __init__(self, batch_size: int, start_step: int = 2,
+                 steps_per_output: int = 50, monitor_memory: bool = False,
+                 logging_fn=None):
+        self.batch_size = max(batch_size, 1)
+        self.start_step = start_step
+        self.steps_per_output = steps_per_output
+        self.logging = logging_fn
+        self.global_step_count = 0
+        self.total_elapsed_time = 0.0
+        self.step_elapsed_time = 0.0
+        self._start = 0.0
+        self.started = False
+
+    def start(self):
+        self.started = True
+        self._start = time.perf_counter()
+
+    def stop(self, global_step: bool = True, report_speed: bool = True, sync=None):
+        if not self.started:
+            return
+        self.started = False
+        if sync is not None:
+            import jax
+
+            jax.block_until_ready(sync)
+        duration = time.perf_counter() - self._start
+        if not global_step:
+            return
+        self.global_step_count += 1
+        if self.global_step_count <= self.start_step:
+            return  # skip warmup/compile steps
+        self.total_elapsed_time += duration
+        self.step_elapsed_time += duration
+        if report_speed and self.logging and \
+                self.global_step_count % self.steps_per_output == 0:
+            self.logging(
+                f"step={self.global_step_count} "
+                f"samples/sec={self.avg_samples_per_sec():.2f} "
+                f"ms/step={self.step_elapsed_time / self.steps_per_output * 1000:.1f}")
+            self.step_elapsed_time = 0.0
+
+    def avg_samples_per_sec(self) -> float:
+        measured = self.global_step_count - self.start_step
+        if measured <= 0 or self.total_elapsed_time == 0:
+            return 0.0
+        return self.batch_size / (self.total_elapsed_time / measured)

@@ -1,0 +1,57 @@
+"""Tiny model fixtures (reference analogue: tests/unit/simple_model.py)."""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_mlp_params(key, hidden=16, layers=2, out=8):
+    params = {}
+    for i in range(layers):
+        key, k1, k2 = jax.random.split(key, 3)
+        params[f"layer_{i}"] = {
+            "kernel": jax.random.normal(k1, (hidden, hidden)) * 0.1,
+            "bias": jnp.zeros((hidden,)),
+        }
+    key, k1 = jax.random.split(key)
+    params["head"] = {"kernel": jax.random.normal(k1, (hidden, out)) * 0.1,
+                      "bias": jnp.zeros((out,))}
+    return params
+
+
+def mlp_loss_fn(params, batch, rng):
+    """SimpleModel equivalent: MLP + cross-entropy on random labels."""
+    x, y = batch["x"], batch["y"]
+    h = x
+    i = 0
+    while f"layer_{i}" in params:
+        p = params[f"layer_{i}"]
+        h = jnp.tanh(h @ p["kernel"] + p["bias"])
+        i += 1
+    logits = h @ params["head"]["kernel"] + params["head"]["bias"]
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32))
+    return -jnp.mean(jnp.take_along_axis(logp, y[:, None], axis=1))
+
+
+class RandomClsDataset:
+    """Indexable dataset of (x, y) dicts."""
+
+    def __init__(self, n=256, hidden=16, classes=8, seed=0):
+        rng = np.random.default_rng(seed)
+        self.x = rng.normal(size=(n, hidden)).astype(np.float32)
+        self.y = rng.integers(0, classes, size=(n,)).astype(np.int32)
+
+    def __len__(self):
+        return len(self.x)
+
+    def __getitem__(self, i):
+        return {"x": self.x[i], "y": self.y[i]}
+
+
+def random_batch(global_batch=32, hidden=16, classes=8, seed=0):
+    rng = np.random.default_rng(seed)
+    return {
+        "x": jnp.asarray(rng.normal(size=(global_batch, hidden)), jnp.float32),
+        "y": jnp.asarray(rng.integers(0, classes, size=(global_batch,)), jnp.int32),
+    }

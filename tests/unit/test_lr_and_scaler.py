@@ -1,0 +1,129 @@
+"""LR schedule + loss scaler tests (reference: tests/unit/runtime/test_lr_schedulers.py,
+tests/unit/runtime/half_precision/test_dynamic_loss_scale.py)."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.fp16.loss_scaler import DynamicLossScaler, LossScaler
+from deepspeed_tpu.runtime.lr_schedules import (
+    VALID_LR_SCHEDULES,
+    build_scheduler,
+    get_schedule_fn,
+)
+
+
+class TestSchedules:
+    def test_warmup_lr_endpoints(self):
+        fn = get_schedule_fn("WarmupLR", {"warmup_min_lr": 0.0, "warmup_max_lr": 0.1,
+                                          "warmup_num_steps": 100})
+        assert float(fn(0)) == pytest.approx(0.0, abs=1e-6)
+        assert float(fn(100)) == pytest.approx(0.1, rel=1e-5)
+        assert float(fn(1000)) == pytest.approx(0.1, rel=1e-5)  # holds after warmup
+
+    def test_warmup_decay_hits_zero(self):
+        fn = get_schedule_fn("WarmupDecayLR", {"warmup_max_lr": 0.1,
+                                               "warmup_num_steps": 10,
+                                               "total_num_steps": 100})
+        assert float(fn(10)) == pytest.approx(0.1, rel=1e-4)
+        assert float(fn(100)) == pytest.approx(0.0, abs=1e-6)
+
+    def test_warmup_cosine(self):
+        fn = get_schedule_fn("WarmupCosineLR", {"warmup_num_steps": 10,
+                                                "total_num_steps": 110,
+                                                "cos_min_ratio": 0.1},
+                             base_lr=1.0)
+        assert float(fn(10)) == pytest.approx(1.0, rel=1e-4)
+        mid = float(fn(60))
+        assert 0.1 < mid < 1.0
+        assert float(fn(110)) == pytest.approx(0.1, rel=1e-3)
+
+    def test_one_cycle_shape(self):
+        fn = get_schedule_fn("OneCycle", {"cycle_min_lr": 0.01, "cycle_max_lr": 0.1,
+                                          "cycle_first_step_size": 10})
+        assert float(fn(0)) == pytest.approx(0.01, rel=1e-5)
+        assert float(fn(10)) == pytest.approx(0.1, rel=1e-5)
+        assert float(fn(20)) == pytest.approx(0.01, rel=1e-5)
+
+    def test_lr_range_test(self):
+        fn = get_schedule_fn("LRRangeTest", {"lr_range_test_min_lr": 0.01,
+                                             "lr_range_test_step_size": 10,
+                                             "lr_range_test_step_rate": 1.0})
+        assert float(fn(0)) == pytest.approx(0.01)
+        assert float(fn(10)) == pytest.approx(0.02, rel=1e-5)
+
+    def test_stateful_wrappers(self):
+        for name in VALID_LR_SCHEDULES:
+            params = {}
+            if name in ("WarmupDecayLR", "WarmupCosineLR"):
+                params["total_num_steps"] = 100
+            sched = build_scheduler(name, params)
+            sched.step()
+            lr = sched.get_last_lr()[0]
+            assert np.isfinite(lr)
+            sd = sched.state_dict()
+            sched2 = build_scheduler(name, params)
+            sched2.load_state_dict(sd)
+            assert sched2.get_last_lr() == sched.get_last_lr()
+
+
+class TestLossScaler:
+    def test_static_scaler(self):
+        s = LossScaler(128.0)
+        st = s.init()
+        assert float(s.scale_loss(jnp.asarray(2.0), st)) == 256.0
+        grads = {"w": jnp.ones(4) * 128.0}
+        un = s.unscale_grads(grads, st)
+        np.testing.assert_allclose(np.asarray(un["w"]), 1.0)
+        st2 = s.update(st, jnp.asarray(True))
+        assert float(st2.scale) == 128.0  # static never changes
+
+    def test_dynamic_decrease_on_overflow(self):
+        s = DynamicLossScaler(init_scale=1024.0, delayed_shift=1)
+        st = s.init()
+        st = s.update(st, jnp.asarray(True))
+        assert float(st.scale) == 512.0
+
+    def test_dynamic_hysteresis(self):
+        s = DynamicLossScaler(init_scale=1024.0, delayed_shift=2)
+        st = s.init()
+        st = s.update(st, jnp.asarray(True))
+        assert float(st.scale) == 1024.0  # first overflow absorbed
+        st = s.update(st, jnp.asarray(True))
+        assert float(st.scale) == 512.0
+
+    def test_dynamic_growth_after_window(self):
+        s = DynamicLossScaler(init_scale=2.0, scale_window=3)
+        st = s.init()
+        for _ in range(3):
+            st = s.update(st, jnp.asarray(False))
+        assert float(st.scale) == 4.0
+
+    def test_overflow_detection(self):
+        s = DynamicLossScaler()
+        grads = {"w": jnp.asarray([1.0, jnp.inf])}
+        assert bool(s.check_overflow(grads))
+        assert not bool(s.check_overflow({"w": jnp.ones(3)}))
+
+
+class TestFp16Engine:
+    def test_fp16_dynamic_scaling_train(self):
+        import jax
+
+        import deepspeed_tpu
+        from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+        from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn,
+            model_parameters=init_mlp_params(jax.random.PRNGKey(0)),
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                    "fp16": {"enabled": True, "initial_scale_power": 8}},
+            topology=topo)
+        assert engine.get_loss_scale() == 256.0
+        batch = random_batch(engine.train_batch_size())
+        losses = [float(engine.train_batch(batch)) for _ in range(10)]
+        assert losses[-1] < losses[0]
+        assert engine.global_steps + engine.skipped_steps == 10
