@@ -1,0 +1,109 @@
+"""Benchmark: ZeRO training throughput on the available chip(s).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+Metric: training tokens/sec/chip on a Llama-family model (bf16, flash
+attention, remat) via the deepspeed_tpu.initialize() engine.  vs_baseline is
+MFU / 0.50 — the reference's north-star target (BASELINE.md: Llama-3-8B ZeRO-3
+at >50% MFU on v5p; scaled here to the single-chip model that fits).
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+PEAK_FLOPS = {
+    "TPU v5 lite": 197e12,   # v5e bf16
+    "TPU v5e": 197e12,
+    "TPU v5p": 459e12,
+    "TPU v4": 275e12,
+    "cpu": 1e12,
+}
+
+
+def peak_flops_per_chip() -> float:
+    d = jax.devices()[0]
+    kind = getattr(d, "device_kind", "cpu")
+    for key, val in PEAK_FLOPS.items():
+        if key.lower() in str(kind).lower():
+            return val
+    return 197e12 if d.platform == "tpu" else 1e12
+
+
+def main():
+    on_tpu = jax.default_backend() == "tpu"
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+    from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=16, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+            remat=True, use_flash=True)
+        batch_size, seq, steps, warmup = 8, 2048, 20, 3
+    else:  # CPU smoke mode
+        cfg = TransformerConfig.tiny(use_flash=False)
+        batch_size, seq, steps, warmup = 4, 128, 3, 1
+
+    topo = initialize_mesh(TopologyConfig(), force=True)
+    n_chips = topo.world_size()
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={
+            "train_micro_batch_size_per_gpu": batch_size // n_chips or 1,
+            "optimizer": {"type": "AdamW",
+                          "params": {"lr": 3e-4, "weight_decay": 0.1}},
+            "gradient_clipping": 1.0,
+            "zero_optimization": {"stage": 3 if n_chips > 1 else 0},
+            "bf16": {"enabled": True},
+        },
+        topology=topo)
+
+    rng = np.random.default_rng(0)
+    batch = {"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(engine.train_batch_size(), seq)),
+        jnp.int32)}
+
+    for _ in range(warmup):
+        loss = engine.train_batch(batch)
+    jax.block_until_ready(loss)
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        loss = engine.train_batch(batch)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+
+    tokens = engine.train_batch_size() * seq * steps
+    tok_per_sec_chip = tokens / dt / n_chips
+    flops_per_token = model.flops_per_token() + \
+        3 * 2 * 2 * cfg.num_layers * cfg.hidden_size * seq  # attention term
+    mfu = tok_per_sec_chip * flops_per_token / peak_flops_per_chip()
+
+    print(json.dumps({
+        "metric": "zero_train_tokens_per_sec_per_chip",
+        "value": round(tok_per_sec_chip, 1),
+        "unit": "tokens/s/chip",
+        "vs_baseline": round(mfu / 0.50, 4),
+        "extra": {
+            "mfu": round(mfu, 4),
+            "model_params": model.num_params(),
+            "loss": float(loss),
+            "chips": n_chips,
+            "seq_len": seq,
+            "device": str(jax.devices()[0].device_kind),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,302 @@
+"""TPU-native causal-LM transformer — the framework's flagship model family.
+
+Reference analogue: the model containers DeepSpeed injects/serves
+(``module_inject/containers/llama.py``, ``inference/v2/model_implementations/
+llama_v2``) — but built as a first-class JAX model rather than a wrapper over
+HF torch modules.
+
+Design points (TPU-first):
+  * stacked layer parameters + ``lax.scan`` over layers → O(1) compile time,
+    XLA-friendly static control flow;
+  * bf16 compute / fp32 master handled by the engine; this module computes in
+    the dtype of the incoming params;
+  * Megatron-style tensor-parallel sharding expressed as PartitionSpecs
+    (``partition_specs``): qkv/gate/up kernels column-sharded over "tensor",
+    o/down row-sharded; embeddings sharded over the hidden dim;
+  * activation sharding constraints at layer boundaries: [batch→data axes,
+    seq→"seq", hidden→None] so XLA lays out collectives over the right axes;
+  * GQA (num_kv_heads ≤ num_heads), RoPE, RMSNorm, SwiGLU — the Llama recipe;
+  * optional ``jax.checkpoint`` (remat) per layer for activation checkpointing.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from functools import partial
+from typing import Any, Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.topology import DATA, EXPERT, SEQ, TENSOR
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 512
+    intermediate_size: int = 1408
+    num_layers: int = 4
+    num_heads: int = 8
+    num_kv_heads: int = 8
+    max_seq_len: int = 2048
+    rope_theta: float = 10000.0
+    norm_eps: float = 1e-5
+    tie_embeddings: bool = False
+    remat: bool = False
+    use_flash: bool = True          # pallas flash attention on TPU
+    attn_impl: str = "auto"         # auto | flash | xla | ring | ulysses
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_heads
+
+    @staticmethod
+    def tiny(**kw):
+        return TransformerConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
+                                 num_layers=2, num_heads=4, num_kv_heads=2,
+                                 max_seq_len=128, **kw)
+
+    @staticmethod
+    def llama3_8b(**kw):
+        return TransformerConfig(vocab_size=128256, hidden_size=4096,
+                                 intermediate_size=14336, num_layers=32,
+                                 num_heads=32, num_kv_heads=8, max_seq_len=8192,
+                                 rope_theta=500000.0, **kw)
+
+    @staticmethod
+    def gpt2_small(**kw):
+        return TransformerConfig(vocab_size=50257, hidden_size=768,
+                                 intermediate_size=3072, num_layers=12,
+                                 num_heads=12, num_kv_heads=12, max_seq_len=1024, **kw)
+
+
+# --------------------------------------------------------------------- #
+# Parameter init + sharding specs
+# --------------------------------------------------------------------- #
+def init_params(cfg: TransformerConfig, key: jax.Array, dtype=jnp.float32) -> Dict:
+    """Stacked-layer parameter pytree. Layer arrays have leading dim L."""
+    k_embed, k_layers, k_head = jax.random.split(key, 3)
+    D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+
+    def norm_init(*shape):
+        return jnp.ones(shape, dtype)
+
+    def dense_init(k, shape, fan_in):
+        return (jax.random.normal(k, shape) / math.sqrt(fan_in)).astype(dtype)
+
+    ks = jax.random.split(k_layers, 7)
+    params = {
+        "embed": {"embedding": (jax.random.normal(k_embed, (cfg.vocab_size, D)) * 0.02).astype(dtype)},
+        "layers": {
+            "attn_norm": {"scale": norm_init(L, D)},
+            "q_proj": {"kernel": dense_init(ks[0], (L, D, H * hd), D)},
+            "k_proj": {"kernel": dense_init(ks[1], (L, D, KV * hd), D)},
+            "v_proj": {"kernel": dense_init(ks[2], (L, D, KV * hd), D)},
+            "o_proj": {"kernel": dense_init(ks[3], (L, H * hd, D), H * hd)},
+            "mlp_norm": {"scale": norm_init(L, D)},
+            "gate_proj": {"kernel": dense_init(ks[4], (L, D, F), D)},
+            "up_proj": {"kernel": dense_init(ks[5], (L, D, F), D)},
+            "down_proj": {"kernel": dense_init(ks[6], (L, F, D), F)},
+        },
+        "norm_f": {"scale": norm_init(D)},
+    }
+    if not cfg.tie_embeddings:
+        params["lm_head"] = {"kernel": dense_init(k_head, (D, cfg.vocab_size), D)}
+    return params
+
+
+def partition_specs(cfg: TransformerConfig) -> Dict:
+    """Megatron-style TP specs (reference: module_inject/auto_tp.py row/col split).
+
+    Column-parallel (output dim over "tensor"): q/k/v, gate, up.
+    Row-parallel (input dim over "tensor"): o, down.  Embedding + lm_head
+    sharded over the vocab/hidden as appropriate.
+    """
+    specs = {
+        "embed": {"embedding": P(TENSOR, None)},
+        "layers": {
+            "attn_norm": {"scale": P(None, None)},
+            "q_proj": {"kernel": P(None, None, TENSOR)},
+            "k_proj": {"kernel": P(None, None, TENSOR)},
+            "v_proj": {"kernel": P(None, None, TENSOR)},
+            "o_proj": {"kernel": P(None, TENSOR, None)},
+            "mlp_norm": {"scale": P(None, None)},
+            "gate_proj": {"kernel": P(None, None, TENSOR)},
+            "up_proj": {"kernel": P(None, None, TENSOR)},
+            "down_proj": {"kernel": P(None, TENSOR, None)},
+        },
+        "norm_f": {"scale": P(None)},
+    }
+    if not cfg.tie_embeddings:
+        specs["lm_head"] = {"kernel": P(None, TENSOR)}
+    return specs
+
+
+# --------------------------------------------------------------------- #
+# Building blocks
+# --------------------------------------------------------------------- #
+def rms_norm(x, scale, eps):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale
+
+
+def rope_tables(seq_len: int, head_dim: int, theta: float, offset=0):
+    pos = jnp.arange(seq_len, dtype=jnp.float32) + offset
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    freqs = jnp.outer(pos, inv)                      # [S, hd/2]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def apply_rope(x, cos, sin):
+    """x: [B, S, H, hd]; rotate pairs (even, odd stacked halves)."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    cos = cos[None, :, None, :].astype(x.dtype)
+    sin = sin[None, :, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+
+
+def _xla_attention(q, k, v, causal=True, seq_offset=0):
+    """Plain XLA attention [B,S,H,hd] — fallback + CPU-sim path."""
+    B, S, H, hd = q.shape
+    KV = k.shape[2]
+    if KV != H:
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / math.sqrt(hd)
+    if causal:
+        q_pos = jnp.arange(S)[:, None] + seq_offset
+        k_pos = jnp.arange(k.shape[1])[None, :]
+        mask = q_pos >= k_pos
+        scores = jnp.where(mask[None, None], scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
+def attention(q, k, v, cfg: TransformerConfig, causal=True):
+    """Dispatch to the Pallas flash kernel on TPU, XLA math elsewhere."""
+    impl = cfg.attn_impl
+    if impl == "auto":
+        from ..accelerator import get_accelerator
+
+        impl = "flash" if (cfg.use_flash and get_accelerator().supports_pallas()
+                           and q.shape[1] >= 128) else "xla"
+    if impl == "flash":
+        from ..ops.transformer.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, causal=causal)
+    return _xla_attention(q, k, v, causal=causal)
+
+
+# --------------------------------------------------------------------- #
+# Forward
+# --------------------------------------------------------------------- #
+def _activation_spec():
+    return P((DATA, EXPERT), SEQ, None)
+
+
+def _constrain(x, spec):
+    try:
+        return jax.lax.with_sharding_constraint(x, spec)
+    except (ValueError, RuntimeError):
+        return x  # outside jit/mesh context
+
+
+def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
+            dropout_rng: Optional[jax.Array] = None) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, V]."""
+    dtype = params["layers"]["q_proj"]["kernel"].dtype
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
+    x = _constrain(x, _activation_spec())
+    S = tokens.shape[1]
+    cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
+
+    def layer(x, lp):
+        B = x.shape[0]
+        h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
+        q = (h @ lp["q_proj"]["kernel"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
+        k = (h @ lp["k_proj"]["kernel"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        v = (h @ lp["v_proj"]["kernel"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        q = apply_rope(q, cos, sin)
+        k = apply_rope(k, cos, sin)
+        o = attention(q, k, v, cfg, causal=True)
+        x = x + (o.reshape(B, S, -1) @ lp["o_proj"]["kernel"])
+        x = _constrain(x, _activation_spec())
+        h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
+        up = h @ lp["up_proj"]["kernel"]
+        x = x + ((gate * up) @ lp["down_proj"]["kernel"])
+        x = _constrain(x, _activation_spec())
+        return x, None
+
+    layer_fn = layer
+    if cfg.remat:
+        layer_fn = jax.checkpoint(layer, policy=jax.checkpoint_policies.nothing_saveable)
+
+    def scan_body(carry, lp):
+        return layer_fn(carry, lp)
+
+    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    x = rms_norm(x, params["norm_f"]["scale"], cfg.norm_eps)
+    if cfg.tie_embeddings:
+        logits = x @ params["embed"]["embedding"].T
+    else:
+        logits = x @ params["lm_head"]["kernel"]
+    return logits
+
+
+def lm_loss(params: Dict, batch: Any, cfg: TransformerConfig,
+            rng: Optional[jax.Array] = None) -> jax.Array:
+    """Causal LM loss: predict batch['input_ids'] shifted by one.
+
+    Accepts {'input_ids': [B,S]} (+ optional 'labels' [B,S] with -100 ignore).
+    """
+    tokens = batch["input_ids"] if isinstance(batch, dict) else batch
+    labels = batch.get("labels") if isinstance(batch, dict) else None
+    logits = forward(params, tokens, cfg)
+    if labels is None:
+        labels = jnp.pad(tokens[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    valid = labels >= 0
+    safe_labels = jnp.where(valid, labels, 0)
+    token_logp = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    return -jnp.sum(token_logp * valid) / jnp.maximum(jnp.sum(valid), 1)
+
+
+class CausalLM:
+    """Model object consumable by ``deepspeed_tpu.initialize``.
+
+    Exposes ``loss_fn(params, batch, rng)``, ``partition_specs`` (read by the
+    engine's ZeRO plan as TP base specs), and ``init_params``.
+    """
+
+    def __init__(self, cfg: TransformerConfig):
+        self.config = cfg
+        self.partition_specs = partition_specs(cfg)
+
+    def init_params(self, key: jax.Array, dtype=jnp.float32):
+        return init_params(self.config, key, dtype)
+
+    def loss_fn(self, params, batch, rng):
+        return lm_loss(params, batch, self.config, rng)
+
+    def __call__(self, params, tokens):
+        return forward(params, tokens, self.config)
+
+    def num_params(self, params=None) -> int:
+        if params is None:
+            params = jax.eval_shape(lambda k: self.init_params(k), jax.random.PRNGKey(0))
+        import numpy as np
+
+        return int(sum(np.prod(l.shape) for l in jax.tree.leaves(params)))
+
+    def flops_per_token(self) -> float:
+        """~6N flops/token for training (fwd+bwd), N = non-embedding params."""
+        cfg = self.config
+        D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+        per_layer = 2 * D * (cfg.num_heads + 2 * cfg.num_kv_heads) * cfg.head_dim \
+            + 2 * cfg.num_heads * cfg.head_dim * D + 3 * 2 * D * F
+        return 3 * (L * per_layer + 2 * D * cfg.vocab_size)

@@ -1,0 +1,297 @@
+"""Pallas TPU flash attention (fwd + bwd), the framework's hot attention op.
+
+Reference analogues: the CUDA inference/training attention kernels
+(``csrc/transformer/inference/csrc/softmax.cu``, evoformer/cutlass attention
+``csrc/deepspeed4science/evoformer_attn``, FastGen ``blocked_flash``).  This is
+the TPU equivalent: blocked online-softmax attention tiled for the MXU, with a
+recompute-based backward (dq and dkv kernels), exposed through
+``jax.custom_vjp`` so it drops into any autodiff'd model.
+
+Layout: inputs [B, S, H, hd] (GQA allowed: KV heads = H // group).  The kernel
+operates per (batch, head, q-block) with kv-blocks as the innermost grid dim,
+accumulating in VMEM scratch (f32).  Causal masking skips fully-masked blocks.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+DEFAULT_BLOCK_Q = 256
+DEFAULT_BLOCK_K = 256
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    """Pallas TPU kernels run in interpreter mode on non-TPU backends
+    (CPU-simulated meshes in tests)."""
+    return jax.default_backend() != "tpu"
+
+
+def _cdiv(a, b):
+    return (a + b - 1) // b
+
+
+# ===================================================================== #
+# Forward kernel
+# ===================================================================== #
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
+                scale, causal, block_q, block_k, seq_len):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    q_first = iq * block_q
+    k_first = ik * block_k
+    # Causal: block fully above the diagonal contributes nothing.
+    needed = jnp.logical_or(not causal, q_first + block_q - 1 >= k_first)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [BQ, hd]
+        k = k_ref[0, 0].astype(jnp.float32)            # [BK, hd]
+        v = v_ref[0, 0].astype(jnp.float32)            # [BK, hd]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+        q_pos = q_first + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = k_first + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_len
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_prev = m_scr[:, :1]                           # [BQ, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        alpha = jnp.exp(m_prev - m_new)                 # rescale factor
+        p = jnp.exp(s - m_new)                          # [BQ, BK]
+        l_new = alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True)
+        acc[:] = acc[:] * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+        l_scr[:] = jnp.broadcast_to(l_new, l_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / l_safe).astype(o_ref.dtype)
+        lse_ref[0, 0] = (m_scr[:, :1] + jnp.log(l_safe))[:, 0]
+
+
+def _fwd(q, k, v, scale, causal, block_q, block_k):
+    B, H, S, hd = q.shape
+    nq, nk = _cdiv(S, block_q), _cdiv(S, block_k)
+    Sq, Sk = nq * block_q, nk * block_k
+    qp = jnp.pad(q, ((0, 0), (0, 0), (0, Sq - S), (0, 0)))
+    kp = jnp.pad(k, ((0, 0), (0, 0), (0, Sk - S), (0, 0)))
+    vp = jnp.pad(v, ((0, 0), (0, 0), (0, Sk - S), (0, 0)))
+
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal,
+        block_q=block_q, block_k=block_k, seq_len=S)
+    out, lse = pl.pallas_call(
+        kernel,
+        grid=(B, H, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
+            pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
+            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype),
+            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, hd), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+            pltpu.VMEM((block_q, 128), jnp.float32),
+        ],
+        interpret=_interpret(),
+    )(qp, kp, vp)
+    return out[:, :, :S], lse[:, :, :S]
+
+
+# ===================================================================== #
+# Backward kernels
+# ===================================================================== #
+def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
+                   dq_acc, *, scale, causal, block_q, block_k, seq_len):
+    iq, ik = pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    q_first = iq * block_q
+    k_first = ik * block_k
+    needed = jnp.logical_or(not causal, q_first + block_q - 1 >= k_first)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        q_pos = q_first + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = k_first + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_len
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dq_acc[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+    @pl.when(ik == nk - 1)
+    def _write():
+        dq_ref[0, 0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                    dk_ref, dv_ref, dk_acc, dv_acc, *,
+                    scale, causal, block_q, block_k, seq_len):
+    ik, iq = pl.program_id(2), pl.program_id(3)
+    nq = pl.num_programs(3)
+
+    @pl.when(iq == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    q_first = iq * block_q
+    k_first = ik * block_k
+    needed = jnp.logical_or(not causal, q_first + block_q - 1 >= k_first)
+
+    @pl.when(needed)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0][:, None]
+        delta = delta_ref[0, 0][:, None]
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        q_pos = q_first + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+        k_pos = k_first + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = k_pos < seq_len
+        if causal:
+            mask = jnp.logical_and(mask, q_pos >= k_pos)
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        dk_acc[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+    @pl.when(iq == nq - 1)
+    def _write():
+        dk_ref[0, 0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+def _bwd(scale, causal, block_q, block_k, res, g):
+    q, k, v, out, lse = res
+    do = g
+    B, H, S, hd = q.shape
+    delta = jnp.sum(do.astype(jnp.float32) * out.astype(jnp.float32), axis=-1)  # [B,H,S]
+
+    nq, nk = _cdiv(S, block_q), _cdiv(S, block_k)
+    Sq, Sk = nq * block_q, nk * block_k
+    pad_q = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, Sq - S), (0, 0)))
+    pad_k = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, Sk - S), (0, 0)))
+    qp, kp, vp, dop = pad_q(q), pad_k(k), pad_k(v), pad_q(do)
+    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, Sq - S)))
+    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, Sq - S)))
+
+    q_spec = pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0))
+    k_spec = pl.BlockSpec((1, 1, block_k, hd), lambda b, h, i, j: (b, h, j, 0))
+    r_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i))
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=S),
+        grid=(B, H, nq, nk),
+        in_specs=[q_spec, k_spec, k_spec, q_spec, r_spec, r_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, hd), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+
+    # dkv: kv-blocks outer, q-blocks inner
+    q_spec2 = pl.BlockSpec((1, 1, block_q, hd), lambda b, h, j, i: (b, h, i, 0))
+    k_spec2 = pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0))
+    r_spec2 = pl.BlockSpec((1, 1, block_q), lambda b, h, j, i: (b, h, i))
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k, seq_len=S),
+        grid=(B, H, nk, nq),
+        in_specs=[q_spec2, k_spec2, k_spec2, q_spec2, r_spec2, r_spec2],
+        out_specs=[k_spec2, k_spec2],
+        out_shape=[jax.ShapeDtypeStruct((B, H, Sk, hd), k.dtype),
+                   jax.ShapeDtypeStruct((B, H, Sk, hd), v.dtype)],
+        scratch_shapes=[pltpu.VMEM((block_k, hd), jnp.float32),
+                        pltpu.VMEM((block_k, hd), jnp.float32)],
+        interpret=_interpret(),
+    )(qp, kp, vp, dop, lsep, deltap)
+    return dq[:, :, :S], dk[:, :, :S], dv[:, :, :S]
+
+
+# ===================================================================== #
+# Public API
+# ===================================================================== #
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash_bhsd(q, k, v, scale, causal, block_q, block_k):
+    out, _ = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out
+
+
+def _flash_fwd_rule(q, k, v, scale, causal, block_q, block_k):
+    out, lse = _fwd(q, k, v, scale, causal, block_q, block_k)
+    return out, (q, k, v, out, lse)
+
+
+_flash_bhsd.defvjp(_flash_fwd_rule, _bwd)
+
+
+def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q, block_k: int = DEFAULT_BLOCK_K):
+    """Flash attention over [B, S, H, hd] inputs (GQA: kv may have fewer heads).
+
+    Returns [B, S, H, hd].  Falls back to padded head_dim for hd < 128 lanes
+    (Mosaic handles sub-128 minor dims; hd is kept as-is).
+    """
+    B, S, H, hd = q.shape
+    KV = k.shape[2]
+    if KV != H:
+        assert H % KV == 0, "query heads must be a multiple of kv heads"
+        rep = H // KV
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    bq = min(block_q, max(128, S))
+    bk = min(block_k, max(128, S))
+    # [B,S,H,hd] -> [B,H,S,hd]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    out = _flash_bhsd(qt, kt, vt, scale, causal, bq, bk)
+    return out.transpose(0, 2, 1, 3)
