@@ -1,0 +1,12 @@
+from .layer import MoE
+from .sharded_moe import (
+    init_moe_params,
+    moe_layer,
+    moe_partition_specs,
+    top1gating,
+    top2gating,
+    topkgating,
+)
+
+__all__ = ["MoE", "moe_layer", "init_moe_params", "moe_partition_specs",
+           "top1gating", "top2gating", "topkgating"]

@@ -1,0 +1,93 @@
+"""MoE module wrapper (reference: deepspeed/moe/layer.py:17 ``MoE``).
+
+Bundles gate + experts with DeepSpeed's constructor signature; functional
+like every layer in this framework: ``init_params`` returns the pytree,
+``__call__`` applies it.  ``partition_specs`` shards experts over the
+"expert" mesh axis (EP); data-parallel replication of the gate and
+expert-data-parallel gradient reduction fall out of the mesh shardings
+(reference handles this with dedicated process groups,
+utils/groups.py:236,376, and `_reduce_expert_gradients`, engine.py:2588).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..runtime.topology import get_topology
+from .sharded_moe import init_moe_params, moe_layer, moe_partition_specs
+
+
+class MoE:
+    def __init__(self, hidden_size: int, expert=None, num_experts: int = 1,
+                 ep_size: int = 1, k: int = 1, capacity_factor: float = 1.0,
+                 eval_capacity_factor: float = 1.0, min_capacity: int = 4,
+                 use_residual: bool = False, noisy_gate_policy: Optional[str] = None,
+                 drop_tokens: bool = True, use_rts: bool = True,
+                 ffn_hidden_size: Optional[int] = None, activation=jax.nn.gelu):
+        if num_experts % max(ep_size, 1) != 0:
+            raise ValueError(f"num_experts({num_experts}) must divide by ep_size({ep_size})")
+        self.hidden_size = hidden_size
+        self.num_experts = num_experts
+        self.ep_size = ep_size
+        self.k = k
+        self.capacity_factor = capacity_factor
+        self.eval_capacity_factor = eval_capacity_factor
+        self.min_capacity = min_capacity
+        self.use_residual = use_residual
+        self.noisy_gate_policy = noisy_gate_policy
+        self.drop_tokens = drop_tokens
+        self.ffn_hidden_size = ffn_hidden_size or 4 * hidden_size
+        self.activation = activation
+        self.partition_specs = moe_partition_specs()
+        if use_residual:
+            from jax.sharding import PartitionSpec as P
+
+            self.partition_specs = {
+                "moe": self.partition_specs,
+                "residual_mlp": {"w1": P(None, None), "b1": P(None),
+                                 "w2": P(None, None), "b2": P(None)},
+                "coefficient": {"kernel": P(None, None)},
+            }
+
+    def init_params(self, key: jax.Array, dtype=jnp.float32) -> Dict:
+        moe_p = init_moe_params(key, self.hidden_size, self.ffn_hidden_size,
+                                self.num_experts, dtype)
+        if not self.use_residual:
+            return moe_p
+        import math
+
+        k1, k2, k3 = jax.random.split(key, 3)
+        s1 = 1.0 / math.sqrt(self.hidden_size)
+        return {
+            "moe": moe_p,
+            "residual_mlp": {
+                "w1": (jax.random.normal(k1, (self.hidden_size, self.ffn_hidden_size)) * s1).astype(dtype),
+                "b1": jnp.zeros((self.ffn_hidden_size,), dtype),
+                "w2": (jax.random.normal(k2, (self.ffn_hidden_size, self.hidden_size)) *
+                       (1.0 / math.sqrt(self.ffn_hidden_size))).astype(dtype),
+                "b2": jnp.zeros((self.hidden_size,), dtype),
+            },
+            "coefficient": {"kernel": (jax.random.normal(k3, (self.hidden_size, 2)) * s1).astype(dtype)},
+        }
+
+    def __call__(self, params: Dict, hidden_states: jnp.ndarray,
+                 rng: Optional[jax.Array] = None, training: bool = True):
+        """Returns (output, l_aux, exp_counts) like the reference MoE.forward."""
+        moe_p = params["moe"] if self.use_residual else params
+        out, l_aux, counts = moe_layer(
+            moe_p, hidden_states, k=self.k,
+            capacity_factor=self.capacity_factor,
+            eval_capacity_factor=self.eval_capacity_factor,
+            min_capacity=self.min_capacity, drop_tokens=self.drop_tokens,
+            noisy_gate_policy=self.noisy_gate_policy, rng=rng,
+            training=training, activation=self.activation)
+        if self.use_residual:
+            # MoS residual (reference layer.py residual_mlp + coefficient mix)
+            h = self.activation(hidden_states @ params["residual_mlp"]["w1"] +
+                                params["residual_mlp"]["b1"])
+            res = h @ params["residual_mlp"]["w2"] + params["residual_mlp"]["b2"]
+            coef = jax.nn.softmax(hidden_states @ params["coefficient"]["kernel"], axis=-1)
+            out = out * coef[..., 0:1] + res * coef[..., 1:2]
+        return out, l_aux, counts

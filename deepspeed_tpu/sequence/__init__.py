@@ -1,0 +1,10 @@
+from .cross_entropy import vocab_sequence_parallel_cross_entropy
+from .layer import DistributedAttention, UlyssesAttention
+from .ring_attention import ring_attention
+
+__all__ = [
+    "DistributedAttention",
+    "UlyssesAttention",
+    "ring_attention",
+    "vocab_sequence_parallel_cross_entropy",
+]

@@ -1,0 +1,35 @@
+"""Sequence-parallel cross entropy (reference: deepspeed/sequence/cross_entropy.py:11).
+
+With tokens sharded over the "seq" axis, each shard computes its local
+token losses; the global mean reduces over (seq × data) with valid-token
+weighting.  Runs inside jit/shard_map; under pure GSPMD sharding the psum is
+inserted by XLA, so this explicit version is only needed in shard_map regions.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+from ..runtime.topology import SEQ, get_topology
+
+
+def vocab_sequence_parallel_cross_entropy(logits, labels, sp_axis: str = SEQ,
+                                          ignore_index: int = -100):
+    """logits [B, s_local, V] (f32 recommended), labels [B, s_local].
+
+    Returns the global mean NLL over valid tokens across the whole sequence
+    group.  Must run where ``sp_axis`` is bound (shard_map) — or with sp=1 it
+    degrades to plain masked cross entropy.
+    """
+    topo = get_topology()
+    sp = topo.dims.get(sp_axis, 1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    valid = labels != ignore_index
+    safe = jnp.where(valid, labels, 0)
+    tok = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    local_sum = -jnp.sum(tok * valid)
+    local_cnt = jnp.sum(valid).astype(jnp.float32)
+    if sp > 1:
+        local_sum = jax.lax.psum(local_sum, sp_axis)
+        local_cnt = jax.lax.psum(local_cnt, sp_axis)
+    return local_sum / jnp.maximum(local_cnt, 1.0)

@@ -1,0 +1,114 @@
+"""Ulysses sequence parallelism (reference: deepspeed/sequence/layer.py:257,311).
+
+``DistributedAttention`` runs any local attention function under sequence
+parallelism: tokens are sharded over the "seq" mesh axis; before attention,
+an all-to-all scatters *heads* and gathers *sequence* (each rank then holds
+full sequences for H/sp heads), attention runs locally, and the inverse
+all-to-all restores the [B, S/sp, H, hd] layout.
+
+The reference implements this with torch.distributed all_to_all_single +
+manual permutes (``_SeqAllToAll``); here it is a ``shard_map`` region over the
+mesh with ``jax.lax.all_to_all``, so it composes with jit/GSPMD and autodiff
+(all_to_all's transpose is the inverse all-to-all — no custom autograd fn
+needed, unlike the reference).
+"""
+from __future__ import annotations
+
+from functools import partial
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.topology import DATA, EXPERT, SEQ, get_topology
+
+
+def _attn_io_spec(x, topo, sp_axis: str):
+    """[B, S, H, hd] spec: shard batch over the data axes when divisible,
+    sequence over the SP axis.  Committed inputs keep their own spec."""
+    from jax.sharding import NamedSharding
+
+    sharding = getattr(x, "sharding", None)
+    if isinstance(sharding, NamedSharding) and sharding.spec and \
+            any(e is not None for e in sharding.spec):
+        spec = list(sharding.spec) + [None] * (x.ndim - len(sharding.spec))
+        spec[1] = sp_axis
+        return P(*spec)
+    batch_axes = tuple(a for a in (DATA, EXPERT) if topo.dims[a] > 1)
+    dp = 1
+    for a in batch_axes:
+        dp *= topo.dims[a]
+    if not batch_axes or x.shape[0] % dp != 0:
+        batch_axes = None
+    return P(batch_axes, sp_axis, None, None)
+
+
+def _seq_all_to_all(x, scatter_heads: bool):
+    """[B, s, H, hd] -> [B, S, H/sp, hd] (scatter_heads) or inverse."""
+    if scatter_heads:
+        # split head dim across seq group, concat along sequence dim
+        return jax.lax.all_to_all(x, SEQ, split_axis=2, concat_axis=1, tiled=True)
+    return jax.lax.all_to_all(x, SEQ, split_axis=1, concat_axis=2, tiled=True)
+
+
+class DistributedAttention:
+    """Reference: sequence/layer.py:311.
+
+    Parameters
+    ----------
+    local_attention: f(q, k, v, **kw) -> out over [B, S, H_local, hd].
+    sp_axis: mesh axis name carrying the sequence shards.
+    """
+
+    def __init__(self, local_attention: Callable, sp_axis: str = SEQ,
+                 scatter_idx: int = 2, gather_idx: int = 1):
+        self.local_attn = local_attention
+        self.sp_axis = sp_axis
+        self.scatter_idx = scatter_idx
+        self.gather_idx = gather_idx
+
+    def __call__(self, query, key, value, *args, **kwargs):
+        topo = get_topology()
+        sp = topo.dims.get(self.sp_axis, 1)
+        if sp <= 1:
+            return self.local_attn(query, key, value, *args, **kwargs)
+
+        n_heads = query.shape[2]
+        if n_heads % sp != 0:
+            raise ValueError(
+                f"Ulysses requires heads ({n_heads}) divisible by sp ({sp}); "
+                f"uneven-head support: pad heads or use ring attention")
+
+        mesh = topo.mesh
+        io_spec = _attn_io_spec(query, topo, self.sp_axis)
+
+        def body(q, k, v):
+            q = _seq_all_to_all(q, scatter_heads=True)
+            k = _seq_all_to_all(k, scatter_heads=True)
+            v = _seq_all_to_all(v, scatter_heads=True)
+            out = self.local_attn(q, k, v, *args, **kwargs)
+            return _seq_all_to_all(out, scatter_heads=False)
+
+        return jax.shard_map(
+            body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
+            out_specs=io_spec, check_vma=False)(query, key, value)
+
+
+class UlyssesAttention(DistributedAttention):
+    """Convenience: Ulysses over the framework's XLA/flash local attention."""
+
+    def __init__(self, cfg=None, sp_axis: str = SEQ):
+        from ..models.transformer import _xla_attention
+
+        def local(q, k, v, causal=True):
+            from ..accelerator import get_accelerator
+
+            if cfg is not None and getattr(cfg, "use_flash", False) and \
+                    get_accelerator().supports_pallas() and q.shape[1] >= 128:
+                from ..ops.transformer.flash_attention import flash_attention
+
+                return flash_attention(q, k, v, causal=causal)
+            return _xla_attention(q, k, v, causal=causal)
+
+        super().__init__(local, sp_axis)

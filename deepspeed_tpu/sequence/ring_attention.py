@@ -1,0 +1,126 @@
+"""Ring attention — the TPU-native context-parallel (CP) strategy.
+
+The reference has no ring attention (SURVEY §2.2: its long-context story is
+Ulysses all-to-all + FPDT chunk/offload, fpdt_layer.py:510,971).  On TPU, ICI
+neighbor links make a kv-rotation ring the natural long-context primitive, so
+this framework adds it as the CP path alongside Ulysses.
+
+Mechanics: sequence sharded over the "seq" axis.  Each rank keeps its query
+shard; key/value shards rotate around the ring via ``lax.ppermute``.  Per-step
+partial attention produces (out, lse) which are merged with the numerically
+stable online-softmax rule — the same merge FPDT uses for its chunks
+(reference fpdt_layer.py:40-78).  Causality at chunk granularity: a rank
+attends fully to earlier chunks, causally to its own, not at all to later
+ones (those steps are skipped via masking).
+
+Differentiable by construction (scan + ppermute transpose = reverse ring).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.topology import DATA, EXPERT, SEQ, get_topology
+
+_NEG_INF = -1e30
+
+
+def _chunk_attn(q, k, v, scale, mask):
+    """Partial attention over one kv chunk → (unnormalized out, m, l).
+
+    q [B,s,H,hd], k/v [B,c,H,hd], mask [s, c] or None.
+    Returns out [B,s,H,hd] (sum of exp(s - m) * v), m and l [B,s,H].
+    """
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, :, None, :], s, _NEG_INF)
+    m = jnp.max(s, axis=-1)                                   # [B,s,H]
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(jnp.isfinite(m)[..., None], p, 0.0)         # fully-masked rows
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out, m, l
+
+
+def _merge(acc, out, m_acc, m, l_acc, l):
+    """Online-softmax merge of two partial results (FPDT-style)."""
+    m_new = jnp.maximum(m_acc, m)
+    a1 = jnp.exp(m_acc - m_new)
+    a2 = jnp.exp(m - m_new)
+    acc = acc * a1[..., None] + out * a2[..., None]
+    l_new = l_acc * a1 + l * a2
+    return acc, m_new, l_new
+
+
+def ring_attention(query, key, value, causal: bool = True,
+                   scale: Optional[float] = None, sp_axis: str = SEQ):
+    """Context-parallel attention over [B, S, H, hd] with S sharded on sp_axis.
+
+    GQA is supported (kv heads broadcast before the ring).
+    """
+    topo = get_topology()
+    sp = topo.dims.get(sp_axis, 1)
+    hd = query.shape[-1]
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    H, KV = query.shape[2], key.shape[2]
+    if KV != H:
+        key = jnp.repeat(key, H // KV, axis=2)
+        value = jnp.repeat(value, H // KV, axis=2)
+    if sp <= 1:
+        out, m, l = _chunk_attn(query, key, value, scale,
+                                _local_causal_mask(query.shape[1], key.shape[1])
+                                if causal else None)
+        return (out / jnp.maximum(l, 1e-30)[..., None]).astype(query.dtype)
+
+    mesh = topo.mesh
+    from .layer import _attn_io_spec
+
+    io_spec = _attn_io_spec(query, topo, sp_axis)
+    perm = [(i, (i + 1) % sp) for i in range(sp)]  # kv moves to next rank
+
+    def body(q, k, v):
+        r = jax.lax.axis_index(sp_axis)
+        s_local = q.shape[1]
+        B, _, H_, hd_ = q.shape
+        acc = jnp.zeros((B, s_local, H_, hd_), jnp.float32)
+        m_acc = jnp.full((B, s_local, H_), _NEG_INF, jnp.float32)
+        l_acc = jnp.zeros((B, s_local, H_), jnp.float32)
+
+        def step(t, carry):
+            acc, m_acc, l_acc, k_t, v_t = carry
+            chunk = (r - t) % sp  # which sequence chunk we currently hold
+            if causal:
+                # chunk < r: attend fully; == r: local causal; > r: skip.
+                local_mask = _local_causal_mask(s_local, s_local)
+                full = jnp.ones((s_local, s_local), bool)
+                none = jnp.zeros((s_local, s_local), bool)
+                mask = jnp.where(chunk < r, full,
+                                 jnp.where(chunk == r, local_mask, none))
+            else:
+                mask = None
+            out, m, l = _chunk_attn(q, k_t, v_t, scale, mask)
+            acc, m_acc, l_acc = _merge(acc, out, m_acc, m, l_acc, l)
+            k_t = jax.lax.ppermute(k_t, sp_axis, perm)
+            v_t = jax.lax.ppermute(v_t, sp_axis, perm)
+            return acc, m_acc, l_acc, k_t, v_t
+
+        acc, m_acc, l_acc, _, _ = jax.lax.fori_loop(
+            0, sp, step, (acc, m_acc, l_acc, k, v))
+        out = acc / jnp.maximum(l_acc, 1e-30)[..., None]
+        return out.astype(q.dtype)
+
+    return jax.shard_map(body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
+                         out_specs=io_spec, check_vma=False)(query, key, value)
+
+
+def _local_causal_mask(sq, sk):
+    qi = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (sq, sk), 1)
+    return qi >= ki

@@ -1,0 +1,145 @@
+"""MoE tests (reference: tests/unit/moe/test_moe.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deepspeed_tpu.moe import (
+    MoE,
+    init_moe_params,
+    moe_layer,
+    moe_partition_specs,
+    top1gating,
+    top2gating,
+    topkgating,
+)
+from deepspeed_tpu.runtime.topology import EXPERT, TopologyConfig, initialize_mesh
+
+
+class TestGating:
+    def test_top1_shapes_and_capacity(self):
+        initialize_mesh(TopologyConfig(), force=True)
+        logits = jax.random.normal(jax.random.PRNGKey(0), (32, 4))
+        out = top1gating(logits, capacity_factor=1.0, min_capacity=4)
+        C = max(32 // 4, 4)
+        assert out.combine.shape == (32, 4, C)
+        assert out.dispatch.shape == (32, 4, C)
+        # every dispatched token has exactly one slot
+        assert np.asarray(out.dispatch.sum(axis=(1, 2))).max() <= 1
+        assert float(out.l_aux) > 0
+
+    def test_top1_capacity_drops(self):
+        # all tokens pick expert 0 → only C survive
+        logits = jnp.zeros((16, 4)).at[:, 0].set(10.0)
+        out = top1gating(logits, capacity_factor=1.0, min_capacity=1)
+        C = 4
+        kept = int(np.asarray(out.dispatch.sum()))
+        assert kept == C
+
+    def test_top2_two_slots(self):
+        logits = jax.random.normal(jax.random.PRNGKey(1), (32, 8))
+        out = top2gating(logits, capacity_factor=2.0)
+        per_token = np.asarray(out.dispatch.sum(axis=(1, 2)))
+        assert per_token.max() <= 2
+        # combine weights normalized over the two choices
+        cw = np.asarray(out.combine.sum(axis=(1, 2)))
+        np.testing.assert_allclose(cw[per_token == 2], 1.0, atol=1e-5)
+
+    def test_topk_matches_no_drop(self):
+        logits = jax.random.normal(jax.random.PRNGKey(2), (16, 4))
+        out = topkgating(logits, k=3, capacity_factor=10.0)
+        per_token = np.asarray(out.dispatch.sum(axis=(1, 2)))
+        np.testing.assert_array_equal(per_token, 3)
+
+
+class TestMoELayer:
+    def test_identity_routing_recovers_ffn(self):
+        """With capacity ample and k=1, MoE output equals the chosen expert's FFN."""
+        initialize_mesh(TopologyConfig(), force=True)
+        D, F, E = 8, 16, 4
+        params = init_moe_params(jax.random.PRNGKey(0), D, F, E)
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 8, D))
+        out, l_aux, counts = moe_layer(params, x, k=1, capacity_factor=E * 2.0)
+        assert out.shape == x.shape
+        assert int(np.asarray(counts).sum()) == 16
+        # manual: each token through its argmax expert, scaled by its gate prob
+        tokens = x.reshape(-1, D)
+        logits = tokens @ params["gate"]["kernel"]
+        gates = jax.nn.softmax(logits, axis=1)
+        idx = jnp.argmax(logits, axis=1)
+        w = params["experts"]
+        ref = []
+        for i, t in enumerate(tokens):
+            e = int(idx[i])
+            h = jax.nn.gelu(t @ w["w1"][e] + w["b1"][e])
+            ref.append((h @ w["w2"][e] + w["b2"][e]) * gates[i, e])
+        np.testing.assert_allclose(np.asarray(out).reshape(-1, D),
+                                   np.asarray(jnp.stack(ref)), atol=1e-5, rtol=1e-5)
+
+    @pytest.mark.parametrize("ep", [2, 4])
+    def test_expert_parallel_matches_single(self, ep):
+        """EP-sharded MoE == unsharded MoE (same math, all-to-all layout)."""
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        D, F, E = 8, 16, 4
+        params = init_moe_params(jax.random.PRNGKey(0), D, F, E)
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 8, D))
+        ref, ref_aux, _ = moe_layer(params, x, k=2, capacity_factor=4.0)
+
+        topo = initialize_mesh(TopologyConfig(expert=ep), force=True)
+        specs = moe_partition_specs()
+        sharded = jax.tree.map(
+            lambda p, s: jax.device_put(p, NamedSharding(topo.mesh, s)),
+            params, specs, is_leaf=lambda v: isinstance(v, P))
+        xs = jax.device_put(x, NamedSharding(topo.mesh, P(EXPERT, None, None)))
+        out, l_aux, _ = jax.jit(
+            lambda p, x: moe_layer(p, x, k=2, capacity_factor=4.0))(sharded, xs)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=1e-5, rtol=1e-5)
+        np.testing.assert_allclose(float(l_aux), float(ref_aux), rtol=1e-5)
+
+
+class TestMoEModule:
+    def test_moe_class(self):
+        initialize_mesh(TopologyConfig(), force=True)
+        moe = MoE(hidden_size=8, num_experts=4, k=2, capacity_factor=2.0,
+                  ffn_hidden_size=16)
+        params = moe.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 8))
+        out, l_aux, counts = moe(params, x)
+        assert out.shape == x.shape
+        assert np.isfinite(float(l_aux))
+
+    def test_residual_moe(self):
+        initialize_mesh(TopologyConfig(), force=True)
+        moe = MoE(hidden_size=8, num_experts=2, use_residual=True, ffn_hidden_size=16)
+        params = moe.init_params(jax.random.PRNGKey(0))
+        x = jax.random.normal(jax.random.PRNGKey(1), (2, 4, 8))
+        out, _, _ = moe(params, x)
+        assert out.shape == x.shape
+
+    def test_invalid_ep_size(self):
+        with pytest.raises(ValueError):
+            MoE(hidden_size=8, num_experts=3, ep_size=2)
+
+    def test_moe_trains_with_engine(self):
+        import deepspeed_tpu
+
+        topo = initialize_mesh(TopologyConfig(expert=4), force=True)
+        moe = MoE(hidden_size=8, num_experts=4, k=1, capacity_factor=2.0,
+                  ffn_hidden_size=16)
+        moe_params = moe.init_params(jax.random.PRNGKey(0))
+
+        def loss_fn(params, batch, rng):
+            out, l_aux, _ = moe(params, batch["x"], rng=rng)
+            return jnp.mean((out - batch["y"]) ** 2) + 0.01 * l_aux
+
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=loss_fn, model_parameters=moe_params,
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}},
+            topology=topo)
+        rng = np.random.default_rng(0)
+        batch = {"x": jnp.asarray(rng.normal(size=(32, 4, 8)), jnp.float32),
+                 "y": jnp.asarray(rng.normal(size=(32, 4, 8)), jnp.float32)}
+        losses = [float(engine.train_batch(batch)) for _ in range(10)]
+        assert losses[-1] < losses[0]
