@@ -79,13 +79,12 @@ def initialize(
         ds_config = DeepSpeedConfig(config, topology=topology)
 
     engine_cls = DeepSpeedEngine
-    if importlib.util.find_spec("deepspeed_tpu.runtime.pipe.module") is not None:
-        from .runtime.pipe.module import PipelineModule
+    from .runtime.pipe.module import PipelinedCausalLM, PipelineModule
 
-        if isinstance(model, PipelineModule):
-            from .runtime.pipe.engine import PipelineEngine
+    if isinstance(model, (PipelineModule, PipelinedCausalLM)):
+        from .runtime.pipe.engine import PipelineEngine
 
-            engine_cls = PipelineEngine
+        engine_cls = PipelineEngine
 
     engine = engine_cls(
         model=model, config=ds_config, topology=topology,

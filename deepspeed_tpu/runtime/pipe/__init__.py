@@ -1,0 +1,18 @@
+from .module import LayerSpec, PipelinedCausalLM, PipelineModule, TiedLayerSpec
+from .schedule import (
+    DataParallelSchedule,
+    InferenceSchedule,
+    PipeSchedule,
+    TrainSchedule,
+)
+
+__all__ = [
+    "LayerSpec",
+    "TiedLayerSpec",
+    "PipelineModule",
+    "PipelinedCausalLM",
+    "PipeSchedule",
+    "InferenceSchedule",
+    "TrainSchedule",
+    "DataParallelSchedule",
+]

@@ -1,0 +1,162 @@
+"""Pipeline-parallel model container.
+
+Reference analogues: ``LayerSpec``/``TiedLayerSpec``/``PipelineModule``
+(runtime/pipe/module.py:30,77,86) with partitioning by uniform/parameters
+(:393) and tied-layer handling (:446).
+
+TPU-native layout: stage parameters live in ONE pytree whose stacked-layer
+arrays carry the "pipe" mesh axis on dim 0 — each pipeline stage materializes
+only its own slice, exactly like each reference rank building only its
+partition.  Tied layers (embedding/head) are replicated over the pipe axis;
+the gradient allreduce the reference runs over the tied-weight group (:446)
+falls out of shard_map's transpose (replicated-in → psum of grads).
+
+The jitted GPipe/1F1B executor (engine.py) requires the *pipelined* middle
+layers to share one structure (true for transformer stacks — and the reference
+partitions at transformer-layer granularity too).  Heterogeneous LayerSpec
+lists still work with num_stages=1 (sequential execution).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class LayerSpec:
+    """Deferred layer construction (reference module.py:30).
+
+    ``init_fn(key) -> params``; ``apply_fn(params, x, *, rng) -> x``.
+    """
+
+    def __init__(self, init_fn: Callable, apply_fn: Callable, name: str = ""):
+        self.init_fn = init_fn
+        self.apply_fn = apply_fn
+        self.name = name
+
+    def param_count(self) -> int:
+        shapes = jax.eval_shape(self.init_fn, jax.random.PRNGKey(0))
+        return int(sum(np.prod(l.shape) for l in jax.tree.leaves(shapes)))
+
+
+class TiedLayerSpec(LayerSpec):
+    """Weight-shared layer (reference module.py:77): layers with the same
+    ``key`` share one parameter set, replicated across stages."""
+
+    def __init__(self, key: str, init_fn, apply_fn, name: str = "",
+                 forward_fn: Optional[Callable] = None):
+        super().__init__(init_fn, forward_fn or apply_fn, name)
+        self.key = key
+
+
+class PipelineModule:
+    def __init__(self, layers: Sequence[LayerSpec], num_stages: Optional[int] = None,
+                 topology=None, loss_fn: Optional[Callable] = None,
+                 partition_method: str = "parameters",
+                 activation_checkpoint_interval: int = 0):
+        from ..topology import get_topology
+
+        self.specs = list(layers)
+        self.topology = topology or get_topology()
+        self.num_stages = num_stages or self.topology.get_pipe_parallel_world_size()
+        self.loss_fn = loss_fn
+        self.partition_method = partition_method
+        self.activation_checkpoint_interval = activation_checkpoint_interval
+        self.parts = self._partition_layers()
+
+    # ------------------------------------------------------------------ #
+    def _partition_layers(self) -> List[int]:
+        """Stage boundaries (reference :393): parts[i] = first layer of stage i."""
+        n, P = len(self.specs), self.num_stages
+        method = self.partition_method.lower()
+        if method == "uniform":
+            return [round(i * n / P) for i in range(P + 1)]
+        if method == "parameters":
+            weights = np.array([max(s.param_count(), 1) for s in self.specs], dtype=np.float64)
+            cum = np.concatenate([[0.0], np.cumsum(weights)])
+            targets = np.linspace(0, cum[-1], P + 1)
+            parts = [int(np.searchsorted(cum, t)) for t in targets]
+            parts[0], parts[-1] = 0, n
+            for i in range(1, P + 1):  # monotone, non-empty where possible
+                parts[i] = max(parts[i], parts[i - 1])
+            return parts
+        raise NotImplementedError(f"partition_method={self.partition_method}")
+
+    def stage_layers(self, stage_id: int) -> List[LayerSpec]:
+        return self.specs[self.parts[stage_id]:self.parts[stage_id + 1]]
+
+    # ------------------------------------------------------------------ #
+    def init_params(self, key: jax.Array) -> Dict:
+        """Params for ALL layers (sharding assigns slices to stages)."""
+        params: Dict[str, Any] = {}
+        tied_done = set()
+        keys = jax.random.split(key, len(self.specs))
+        for i, (spec, k) in enumerate(zip(self.specs, keys)):
+            if isinstance(spec, TiedLayerSpec):
+                if spec.key in tied_done:
+                    continue
+                tied_done.add(spec.key)
+                params[f"tied_{spec.key}"] = spec.init_fn(k)
+            else:
+                params[f"layer_{i}"] = spec.init_fn(k)
+        return params
+
+    def apply_sequential(self, params: Dict, x, rng: Optional[jax.Array] = None):
+        """Reference PipelineModule.forward (:340) — single-stage execution."""
+        for i, spec in enumerate(self.specs):
+            p = params[f"tied_{spec.key}"] if isinstance(spec, TiedLayerSpec) \
+                else params[f"layer_{i}"]
+            fn = spec.apply_fn
+            if self.activation_checkpoint_interval and \
+                    i % self.activation_checkpoint_interval == 0:
+                fn = jax.checkpoint(fn)
+            x = fn(p, x, rng=rng)
+        return x
+
+
+# --------------------------------------------------------------------- #
+# Transformer pipeline factory — the homogeneous-stack fast path
+# --------------------------------------------------------------------- #
+class PipelinedCausalLM:
+    """Flagship-model pipeline container consumed by PipelineEngine.
+
+    Params: {"embed", "layers" (stacked [L, ...], pipe-sharded on dim 0),
+    "norm_f", "lm_head"} — embed/norm/head tied (pipe-replicated).
+    """
+
+    def __init__(self, cfg, topology=None):
+        from ...models.transformer import partition_specs as tp_specs
+        from ..topology import PIPE, get_topology
+
+        self.config = cfg
+        self.topology = topology or get_topology()
+        self.num_stages = self.topology.get_pipe_parallel_world_size()
+        if cfg.num_layers % max(self.num_stages, 1) != 0:
+            raise ValueError(
+                f"num_layers({cfg.num_layers}) must divide evenly into "
+                f"{self.num_stages} pipeline stages")
+        base = tp_specs(cfg)
+        # stack dim 0 of every layer array carries the pipe axis
+        from jax.sharding import PartitionSpec as P
+
+        def pipeify(spec):
+            entries = list(spec)
+            entries[0] = PIPE
+            return P(*entries)
+
+        base["layers"] = jax.tree.map(
+            pipeify, base["layers"], is_leaf=lambda s: isinstance(s, P))
+        self.partition_specs = base
+
+    def init_params(self, key, dtype=jnp.float32):
+        from ...models.transformer import init_params
+
+        return init_params(self.config, key, dtype)
+
+    def loss_fn(self, params, batch, rng):
+        from .engine import pipeline_lm_loss
+
+        return pipeline_lm_loss(params, batch, self.config, self.topology, rng)
