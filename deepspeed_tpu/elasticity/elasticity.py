@@ -1,0 +1,106 @@
+"""Elastic training config (reference: elasticity/elasticity.py:27-146,233).
+
+Computes batch-size schedules valid across a range of chip counts ahead of
+time, so a job restarted on a different slice size keeps the same global batch
+semantics.  The TPU runtime story differs from torchelastic: recovery is
+"resume from the (reshardable) universal checkpoint on the new mesh", so this
+module provides the *planning* math plus helpers the launcher uses.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Tuple
+
+ELASTICITY = "elasticity"
+MINIMUM_DEEPSPEED_VERSION = "0.1.0"
+LATEST_ELASTICITY_VERSION = 0.2
+
+
+class ElasticityError(Exception):
+    pass
+
+
+class ElasticityConfigError(ElasticityError):
+    pass
+
+
+class ElasticityIncompatibleWorldSize(ElasticityError):
+    pass
+
+
+def get_candidate_batch_sizes(base_list: List[int], max_acceptable_batch_size: int) -> List[int]:
+    """All batch sizes = lcm-combinations × powers of 2 under the cap
+    (reference :27)."""
+    candidate_batch_sizes = set()
+    for base in base_list:
+        if base <= 0:
+            raise ElasticityConfigError(f"micro batch {base} must be positive")
+        batch = base
+        while batch <= max_acceptable_batch_size:
+            candidate_batch_sizes.add(batch)
+            batch *= 2
+    return sorted(candidate_batch_sizes)
+
+
+def get_valid_gpus(batch_size: int, micro_batches: List[int],
+                   min_gpus: int, max_gpus: int) -> List[int]:
+    """Chip counts that evenly tile ``batch_size`` for some micro size (:59)."""
+    valid = set()
+    for mb in micro_batches:
+        if batch_size % mb:
+            continue
+        max_g = batch_size // mb
+        for g in range(1, max_g + 1):
+            if max_g % g == 0 and min_gpus <= g <= max_gpus:
+                valid.add(g)
+    return sorted(valid)
+
+
+def get_best_candidates(candidate_batch_sizes: List[int], micro_batches: List[int],
+                        min_gpus: int, max_gpus: int, prefer_larger: bool):
+    """Pick the batch size with the most valid chip counts (:86)."""
+    max_valid = -1
+    best_batch, best_gpus = None, []
+    for batch in candidate_batch_sizes:
+        gpus = get_valid_gpus(batch, micro_batches, min_gpus, max_gpus)
+        if len(gpus) > max_valid or (len(gpus) == max_valid and prefer_larger
+                                     and best_batch is not None and batch > best_batch):
+            max_valid = len(gpus)
+            best_batch, best_gpus = batch, gpus
+    return best_batch, best_gpus
+
+
+def compute_elastic_config(ds_config, target_deepspeed_version: str = "",
+                           world_size: int = 0, return_microbatch: bool = False):
+    """Reference :233 — resolve (final_batch, valid_gpus[, micro]) from config."""
+    ec = ds_config.get(ELASTICITY, {}) if isinstance(ds_config, dict) else \
+        ds_config.elasticity.model_dump()
+    if not ec.get("enabled", False):
+        raise ElasticityConfigError("elasticity not enabled in config")
+    micro_batches = ec.get("micro_batch_sizes", [2, 4, 6])
+    max_batch = ec.get("max_train_batch_size", 2000)
+    min_gpus = ec.get("min_gpus", 1)
+    max_gpus = ec.get("max_gpus", 10000)
+    prefer_larger = ec.get("prefer_larger_batch", True)
+
+    candidates = get_candidate_batch_sizes(micro_batches, max_batch)
+    final_batch, valid_gpus = get_best_candidates(
+        candidates, micro_batches, min_gpus, max_gpus, prefer_larger)
+    if final_batch is None:
+        raise ElasticityConfigError("no valid batch size found")
+
+    if world_size > 0 and world_size not in valid_gpus:
+        raise ElasticityIncompatibleWorldSize(
+            f"world size {world_size} not in valid set {valid_gpus}")
+
+    if return_microbatch:
+        micro = None
+        for mb in sorted(micro_batches, reverse=prefer_larger):
+            if world_size > 0 and final_batch % (mb * world_size) == 0:
+                micro = mb
+                break
+        return final_batch, valid_gpus, micro
+    return final_batch, valid_gpus
+
+
+def elasticity_enabled(ds_config: Dict) -> bool:
+    return ds_config.get(ELASTICITY, {}).get("enabled", False)

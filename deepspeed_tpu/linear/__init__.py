@@ -1,0 +1,11 @@
+from .optimized_linear import (
+    LoRAConfig,
+    LoRAOptimizedLinear,
+    OptimizedLinear,
+    QuantizationConfig,
+    dequantize_int8,
+    quantize_int8,
+)
+
+__all__ = ["OptimizedLinear", "LoRAOptimizedLinear", "LoRAConfig",
+           "QuantizationConfig", "quantize_int8", "dequantize_int8"]

@@ -1,0 +1,120 @@
+"""OptimizedLinear: quantized base weight + LoRA adapters, shard-aware.
+
+Reference: ``deepspeed/linear/optimized_linear.py:18`` with ``LoRAConfig`` /
+``QuantizationConfig`` (``linear/config.py:13,39``).  Functional JAX version:
+``init_params`` produces a frozen (optionally int8-quantized) base kernel plus
+trainable low-rank A/B factors; ``apply`` fuses dequant into the matmul epilog
+(XLA fuses the scale multiply).  The base weight can be sharded over the ZeRO
+axes like the reference's DP-sharded base weight.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class LoRAConfig:
+    """Reference: linear/config.py:13."""
+
+    lora_r: int = 64
+    lora_alpha: float = 16.0
+    base_weight_sharding: int = 1
+    offload: bool = False
+    offload_ratio: float = 0.0
+    delay_lora_init: bool = False
+    target_mods: Any = None
+
+
+@dataclasses.dataclass
+class QuantizationConfig:
+    """Reference: linear/config.py:39."""
+
+    q_bits: int = 8
+    rounding: str = "nearest"
+    mantissa_bits: int = 3
+    group_size: int = 512
+    group_dim: int = 0
+
+
+def quantize_int8(w: jnp.ndarray, group_size: int = 512):
+    """Groupwise symmetric int8 quantization along dim 0."""
+    in_dim, out_dim = w.shape
+    groups = max(in_dim // group_size, 1)
+    gsize = in_dim // groups
+    wg = w[:groups * gsize].reshape(groups, gsize, out_dim)
+    scale = jnp.max(jnp.abs(wg), axis=1, keepdims=True) / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(wg / scale), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+def dequantize_int8(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.float32):
+    groups, gsize, out_dim = q.shape
+    return (q.astype(jnp.float32) * scale).reshape(groups * gsize, out_dim).astype(dtype)
+
+
+class OptimizedLinear:
+    def __init__(self, input_dim: int, output_dim: int,
+                 lora_config: Optional[LoRAConfig] = None,
+                 quantization_config: Optional[QuantizationConfig] = None,
+                 bias: bool = False, dtype=jnp.bfloat16):
+        self.input_dim = input_dim
+        self.output_dim = output_dim
+        self.lora = lora_config or LoRAConfig()
+        self.quant = quantization_config
+        self.bias = bias
+        self.dtype = dtype
+
+    def init_params(self, key: jax.Array, base_weight: Optional[jnp.ndarray] = None) -> Dict:
+        k1, k2, k3 = jax.random.split(key, 3)
+        if base_weight is None:
+            base_weight = jax.random.normal(k1, (self.input_dim, self.output_dim)) \
+                / math.sqrt(self.input_dim)
+        params: Dict[str, Any] = {}
+        if self.quant is not None:
+            q, scale = quantize_int8(base_weight, self.quant.group_size)
+            params["base"] = {"q": q, "scale": scale}
+        else:
+            params["base"] = {"kernel": base_weight.astype(self.dtype)}
+        r = self.lora.lora_r
+        params["lora_A"] = (jax.random.normal(k2, (self.input_dim, r)) /
+                            math.sqrt(self.input_dim)).astype(jnp.float32)
+        params["lora_B"] = jnp.zeros((r, self.output_dim), jnp.float32)
+        if self.bias:
+            params["bias"] = jnp.zeros((self.output_dim,), jnp.float32)
+        return params
+
+    def apply(self, params: Dict, x: jnp.ndarray) -> jnp.ndarray:
+        if "q" in params["base"]:
+            w = dequantize_int8(params["base"]["q"], params["base"]["scale"], x.dtype)
+        else:
+            w = params["base"]["kernel"].astype(x.dtype)
+        out = x @ w
+        scaling = self.lora.lora_alpha / self.lora.lora_r
+        out = out + (x @ params["lora_A"].astype(x.dtype)) @ \
+            params["lora_B"].astype(x.dtype) * scaling
+        if self.bias:
+            out = out + params["bias"].astype(x.dtype)
+        return out
+
+    __call__ = apply
+
+    def trainable_filter(self, params: Dict) -> Dict:
+        """Mask pytree: True for trainable leaves (LoRA + bias), False for base.
+
+        Feed to ``optax.masked`` so the optimizer only touches adapters —
+        the reference freezes the base weight the same way.
+        """
+        return jax.tree.map(lambda _: False, params) | {
+            "lora_A": True, "lora_B": True,
+            **({"bias": True} if self.bias else {}),
+        }
+
+
+class LoRAOptimizedLinear(OptimizedLinear):
+    """Reference class name alias (linear/optimized_linear.py:87)."""

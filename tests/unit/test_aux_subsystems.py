@@ -1,0 +1,193 @@
+"""Aux subsystem tests: fused optimizers, activation ckpt, flops profiler,
+LoRA/OptimizedLinear, elasticity, curriculum, monitor.
+(reference: tests/unit/ops/adam, runtime/activation_checkpointing,
+profiling/flops_profiler, linear, elasticity, data_efficiency dirs)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+
+class TestFusedAdam:
+    def test_matches_optax_adamw(self):
+        from deepspeed_tpu.ops.adam.fused_adam import fused_adam
+
+        params = {"w": jax.random.normal(jax.random.PRNGKey(0), (33, 17)),
+                  "b": jnp.zeros((7,))}
+        grads = jax.tree.map(lambda p: jnp.ones_like(p) * 0.1, params)
+
+        tx_f = fused_adam(learning_rate=1e-2, weight_decay=0.01)
+        tx_r = optax.adamw(1e-2, weight_decay=0.01)
+        sf, sr = tx_f.init(params), tx_r.init(params)
+        pf = pr = params
+        for _ in range(3):
+            uf, sf = tx_f.update(grads, sf, pf)
+            pf = optax.apply_updates(pf, uf)
+            ur, sr = tx_r.update(grads, sr, pr)
+            pr = optax.apply_updates(pr, ur)
+        jax.tree.map(lambda a, b: np.testing.assert_allclose(a, b, atol=1e-5, rtol=1e-5),
+                     pf, pr)
+
+    def test_plain_adam_mode(self):
+        from deepspeed_tpu.ops.adam.fused_adam import fused_adam
+
+        params = {"w": jnp.ones((8, 128))}
+        grads = {"w": jnp.full((8, 128), 0.5)}
+        tx_f = fused_adam(learning_rate=1e-3, weight_decay=0.0, adam_w_mode=False)
+        tx_r = optax.adam(1e-3)
+        sf, sr = tx_f.init(params), tx_r.init(params)
+        uf, _ = tx_f.update(grads, sf, params)
+        ur, _ = tx_r.update(grads, sr, params)
+        np.testing.assert_allclose(np.asarray(uf["w"]), np.asarray(ur["w"]),
+                                   atol=1e-6, rtol=1e-5)
+
+    def test_fused_lion_matches_optax(self):
+        from deepspeed_tpu.ops.adam.fused_adam import fused_lion_update
+
+        p = jax.random.normal(jax.random.PRNGKey(1), (50,))
+        g = jax.random.normal(jax.random.PRNGKey(2), (50,))
+        m = jnp.zeros((50,))
+        p2, m2 = fused_lion_update(p, g, m, lr=1e-3, beta1=0.9, beta2=0.99)
+        tx = optax.lion(1e-3, b1=0.9, b2=0.99)
+        s = tx.init({"p": p})
+        u, s2 = tx.update({"p": g}, s, {"p": p})
+        p_ref = optax.apply_updates({"p": p}, u)["p"]
+        np.testing.assert_allclose(np.asarray(p2), np.asarray(p_ref), atol=1e-5)
+
+
+class TestActivationCheckpointing:
+    def test_checkpoint_preserves_values_and_grads(self):
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+        def f(x):
+            return jnp.sum(jnp.tanh(x) ** 2)
+
+        x = jax.random.normal(jax.random.PRNGKey(0), (16,))
+        assert float(checkpointing.checkpoint(f, x)) == pytest.approx(float(f(x)))
+        g1 = jax.grad(lambda x: checkpointing.checkpoint(f, x))(x)
+        g2 = jax.grad(f)(x)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), rtol=1e-6)
+
+    def test_configure_flags(self):
+        from deepspeed_tpu.runtime.activation_checkpointing import checkpointing
+
+        checkpointing.configure(partition_activations=True, checkpoint_in_cpu=True)
+        assert checkpointing.partition_activations_enabled()
+        checkpointing.reset()
+        assert not checkpointing.partition_activations_enabled()
+
+
+class TestFlopsProfiler:
+    def test_profile_fn_counts_matmul(self):
+        from deepspeed_tpu.profiling.flops_profiler.profiler import profile_fn
+
+        a = jnp.ones((128, 128))
+        stats = profile_fn(lambda a: a @ a, a)
+        # 2*M*N*K = 4.19M flops
+        assert stats["flops"] >= 2 * 128 ** 3 * 0.9
+
+    def test_get_model_profile(self):
+        from deepspeed_tpu.profiling.flops_profiler.profiler import get_model_profile
+
+        flops, macs, _ = get_model_profile(
+            lambda x: jnp.sum(x @ x), args=(jnp.ones((64, 64)),),
+            print_profile=False, as_string=False)
+        assert flops > 0
+
+
+class TestOptimizedLinear:
+    def test_lora_forward_and_quant(self):
+        from deepspeed_tpu.linear import LoRAConfig, OptimizedLinear, QuantizationConfig
+
+        lin = OptimizedLinear(64, 32, lora_config=LoRAConfig(lora_r=8),
+                              quantization_config=QuantizationConfig(group_size=32),
+                              dtype=jnp.float32)
+        params = lin.init_params(jax.random.PRNGKey(0))
+        assert params["base"]["q"].dtype == jnp.int8
+        x = jax.random.normal(jax.random.PRNGKey(1), (4, 64))
+        out = lin(params, x)
+        assert out.shape == (4, 32)
+        # LoRA B starts at zero → output equals (dequantized) base matmul
+        from deepspeed_tpu.linear import dequantize_int8
+
+        w = dequantize_int8(params["base"]["q"], params["base"]["scale"])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(x @ w), rtol=1e-4, atol=1e-4)
+
+    def test_quant_roundtrip_error_small(self):
+        from deepspeed_tpu.linear import dequantize_int8, quantize_int8
+
+        w = jax.random.normal(jax.random.PRNGKey(0), (128, 16))
+        q, s = quantize_int8(w, group_size=64)
+        w2 = dequantize_int8(q, s)
+        assert float(jnp.max(jnp.abs(w - w2))) < 0.05
+
+
+class TestElasticity:
+    def test_candidates_and_valid_gpus(self):
+        from deepspeed_tpu.elasticity.elasticity import (
+            get_candidate_batch_sizes,
+            get_valid_gpus,
+        )
+
+        cands = get_candidate_batch_sizes([2, 3], 12)
+        assert cands == [2, 3, 4, 6, 8, 12]
+        gpus = get_valid_gpus(12, [2, 3], min_gpus=1, max_gpus=100)
+        assert 6 in gpus and 4 in gpus
+
+    def test_compute_elastic_config(self):
+        from deepspeed_tpu.elasticity.elasticity import (
+            ElasticityIncompatibleWorldSize,
+            compute_elastic_config,
+        )
+
+        cfg = {"elasticity": {"enabled": True, "max_train_batch_size": 64,
+                              "micro_batch_sizes": [2, 4], "min_gpus": 1,
+                              "max_gpus": 64}}
+        batch, gpus = compute_elastic_config(cfg)
+        assert batch <= 64 and len(gpus) > 0
+        with pytest.raises(ElasticityIncompatibleWorldSize):
+            compute_elastic_config(cfg, world_size=7)
+
+
+class TestCurriculum:
+    def test_fixed_linear(self):
+        from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+            CurriculumScheduler,
+        )
+
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 8,
+            "max_difficulty": 64, "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100, "difficulty_step": 8}})
+        assert sched.get_difficulty(0) == 8
+        assert sched.get_difficulty(50) in (32, 40)
+        assert sched.get_difficulty(200) == 64
+
+    def test_fixed_discrete(self):
+        from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+            CurriculumScheduler,
+        )
+
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 2,
+            "max_difficulty": 10, "schedule_type": "fixed_discrete",
+            "schedule_config": {"difficulty": [2, 4, 10], "max_step": [5, 10]}})
+        assert sched.get_difficulty(3) == 2
+        assert sched.get_difficulty(7) == 4
+        assert sched.get_difficulty(100) == 10
+
+
+class TestMonitor:
+    def test_csv_monitor_writes(self, tmp_path):
+        from deepspeed_tpu.monitor.monitor import csvMonitor
+        from deepspeed_tpu.runtime.config import MonitorWriterConfig
+
+        mon = csvMonitor(MonitorWriterConfig(enabled=True, output_path=str(tmp_path),
+                                             job_name="job"))
+        mon.write_events([("Train/loss", 1.5, 10)])
+        files = list((tmp_path / "job").glob("*.csv"))
+        assert len(files) == 1
+        assert "1.5" in files[0].read_text()
