@@ -1,0 +1,106 @@
+"""Inference engine v1 (reference: deepspeed/inference/engine.py:40,
+entered via ``deepspeed.init_inference``, deepspeed/__init__.py:291).
+
+The reference's v1 engine swaps HF torch modules for fused CUDA kernels
+("kernel injection") and shards them over TP ranks.  The TPU equivalent needs
+no module surgery: the model is already a jit-compiled function, the "fused
+kernels" are XLA fusions + our Pallas attention, and TP is a parameter
+sharding (``replace_with_kernel_inject`` ≈ re-placing params on the mesh).
+Under the hood serving runs on the v2 ragged engine, so v1 users get paged KV
+and continuous batching for free.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import CausalLM, TransformerConfig
+from ..runtime.topology import TENSOR, get_topology
+from ..utils.logging import log_dist
+from .v2.engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+
+
+@dataclasses.dataclass
+class DeepSpeedInferenceConfig:
+    """Subset of reference inference/config.py knobs that exist on TPU."""
+
+    dtype: object = jnp.bfloat16
+    tensor_parallel: int = 1
+    max_tokens: int = 1024
+    max_out_tokens: int = 1024
+    min_out_tokens: int = 1
+    replace_with_kernel_inject: bool = False  # accepted; XLA always "injects"
+    max_seqs: int = 16
+    block_size: int = 64
+
+
+class InferenceEngine:
+    def __init__(self, model: Any = None, config: Any = None,
+                 model_parameters: Any = None, **kwargs):
+        if isinstance(config, dict):
+            known = {f.name for f in dataclasses.fields(DeepSpeedInferenceConfig)}
+            config = DeepSpeedInferenceConfig(
+                **{k: v for k, v in config.items() if k in known})
+        self.config = config or DeepSpeedInferenceConfig(**{
+            k: v for k, v in kwargs.items()
+            if k in {f.name for f in dataclasses.fields(DeepSpeedInferenceConfig)}})
+        if not isinstance(model, CausalLM):
+            raise TypeError(
+                "init_inference expects a deepspeed_tpu CausalLM (HF-flax "
+                "checkpoint conversion lives in models/hf.py)")
+        self.module = model
+        params = model_parameters if model_parameters is not None else \
+            getattr(model, "params", None)
+        if params is None:
+            raise ValueError("model_parameters required")
+
+        topo = get_topology()
+        if self.config.tensor_parallel > 1 and \
+                topo.get_tensor_parallel_world_size() != self.config.tensor_parallel:
+            from ..runtime.topology import TopologyConfig, initialize_mesh
+
+            topo = initialize_mesh(
+                TopologyConfig(tensor=self.config.tensor_parallel), force=True)
+        # TP placement (the AutoTP analogue: module_inject/auto_tp.py:192)
+        from jax.sharding import NamedSharding
+
+        specs = model.partition_specs
+        params = jax.tree.map(
+            lambda p, s: jax.device_put(jnp.asarray(p, self.config.dtype),
+                                        NamedSharding(topo.mesh, s)),
+            params, specs, is_leaf=lambda x: hasattr(x, "ndim"))
+
+        self._v2 = InferenceEngineV2(
+            model, params,
+            RaggedInferenceEngineConfig(
+                max_tokens=min(self.config.max_tokens, 256),
+                max_seqs=self.config.max_seqs,
+                max_ctx=model.config.max_seq_len,
+                block_size=self.config.block_size,
+                dtype=self.config.dtype))
+        log_dist(f"init_inference ready (tp={self.config.tensor_parallel})", ranks=[0])
+
+    def generate(self, input_ids, max_new_tokens: int = 32, temperature: float = 0.0,
+                 eos_token_id: Optional[int] = None, **kwargs) -> jnp.ndarray:
+        """HF-style batched generate over token-id arrays."""
+        import numpy as np
+
+        arr = np.asarray(input_ids)
+        if arr.ndim == 1:
+            arr = arr[None]
+        prompts = [row.tolist() for row in arr]
+        out = self._v2.generate(prompts, max_new_tokens=max_new_tokens,
+                                temperature=temperature, eos_token_id=eos_token_id)
+        width = max(len(o) for o in out)
+        padded = [o + [eos_token_id or 0] * (width - len(o)) for o in out]
+        return jnp.concatenate(
+            [jnp.asarray(arr, jnp.int32), jnp.asarray(padded, jnp.int32)], axis=1)
+
+    def forward(self, tokens) -> jnp.ndarray:
+        """Full (non-ragged) forward — logits over the whole input."""
+        return self.module(self._v2.params, tokens)
+
+    __call__ = forward

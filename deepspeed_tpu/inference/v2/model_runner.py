@@ -1,0 +1,128 @@
+"""Ragged-batch model execution (reference: inference/v2/model_implementations/
+inference_transformer_base.py:48 + the ragged_ops kernel chain in §3.4:
+qkv → linear_blocked_kv_rotary (paged KV append) → blocked_flash → logits_gather).
+
+One jitted step serves ANY mix of prefill and decode under fixed budgets
+(max_tokens/max_seqs/max_ctx), with the paged KV cache donated through the
+call so the update is in-place in HBM.
+
+Pipeline per layer over the flat token axis [T]:
+  rmsnorm → qkv proj → RoPE (per-token absolute positions) → scatter K/V to
+  cache slots → per-sequence blocked attention over gathered context slots →
+  o proj → MLP.  Logits are computed only for each sequence's last token
+  (logits_gather), like the reference.
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Any, Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...models.transformer import TransformerConfig, apply_rope, rms_norm
+
+
+def _rope_at(pos, head_dim, theta):
+    """cos/sin tables gathered at arbitrary positions [T] → [T, hd/2]."""
+    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    freqs = pos.astype(jnp.float32)[:, None] * inv[None, :]
+    return jnp.cos(freqs), jnp.sin(freqs)
+
+
+def _apply_rope_flat(x, cos, sin):
+    """x [T, H, hd] with per-token tables [T, hd/2]."""
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    c = cos[:, None, :].astype(x.dtype)
+    s = sin[:, None, :].astype(x.dtype)
+    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+
+
+def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
+                   batch: Dict[str, jnp.ndarray], cfg: TransformerConfig,
+                   max_q: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """→ (last-token logits [max_seqs, V], new kcache, new vcache)."""
+    tokens = batch["tokens"]              # [T]
+    kv_slot = batch["kv_slot"]            # [T]
+    pos = batch["pos_of_token"]           # [T]
+    seq_of = batch["seq_of_token"]        # [T]
+    q_offset = batch["q_offset"]          # [S]
+    q_len = batch["q_len"]                # [S]
+    ctx_len = batch["ctx_len"]            # [S]
+    kv_gather = batch["kv_gather"]        # [S, C]
+    logit_idx = batch["logit_idx"]        # [S]
+
+    T = tokens.shape[0]
+    S, C = kv_gather.shape
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    dtype = params["layers"]["q_proj"]["kernel"].dtype
+    scale = 1.0 / math.sqrt(hd)
+
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(dtype)  # [T, D]
+    cos, sin = _rope_at(pos, hd, cfg.rope_theta)
+
+    # per-seq gather indices for queries: [S, max_q]
+    q_idx = jnp.clip(q_offset[:, None] + jnp.arange(max_q)[None, :], 0, T - 1)
+    q_mask = jnp.arange(max_q)[None, :] < q_len[:, None]          # [S, mq]
+    q_pos = ctx_len[:, None] - q_len[:, None] + jnp.arange(max_q)[None, :]
+    ctx_pos = jnp.arange(C)[None, :]                              # [1, C]
+    attn_mask = (ctx_pos[:, None, :] <= q_pos[:, :, None]) & \
+        (ctx_pos[:, None, :] < ctx_len[:, None, None]) & q_mask[:, :, None]  # [S,mq,C]
+
+    def layer_step(carry, inputs):
+        x, = carry
+        lp, layer_k, layer_v = inputs
+        h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
+        q = (h @ lp["q_proj"]["kernel"]).reshape(T, H, hd)
+        k = (h @ lp["k_proj"]["kernel"]).reshape(T, KV, hd)
+        v = (h @ lp["v_proj"]["kernel"]).reshape(T, KV, hd)
+        q = _apply_rope_flat(q, cos, sin)
+        k = _apply_rope_flat(k, cos, sin)
+        # paged KV append (linear_blocked_kv_rotary equivalent)
+        layer_k = layer_k.at[kv_slot].set(k.astype(layer_k.dtype))
+        layer_v = layer_v.at[kv_slot].set(v.astype(layer_v.dtype))
+        # gather context and attend per sequence
+        k_ctx = jnp.take(layer_k, kv_gather.reshape(-1), axis=0
+                         ).reshape(S, C, KV, hd)
+        v_ctx = jnp.take(layer_v, kv_gather.reshape(-1), axis=0
+                         ).reshape(S, C, KV, hd)
+        if KV != H:
+            k_ctx = jnp.repeat(k_ctx, H // KV, axis=2)
+            v_ctx = jnp.repeat(v_ctx, H // KV, axis=2)
+        q_seq = jnp.take(q.reshape(T, -1), q_idx.reshape(-1), axis=0
+                         ).reshape(S, max_q, H, hd)
+        scores = jnp.einsum("sqhd,schd->shqc", q_seq.astype(jnp.float32),
+                            k_ctx.astype(jnp.float32)) * scale
+        scores = jnp.where(attn_mask[:, None, :, :], scores, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1)
+        o_seq = jnp.einsum("shqc,schd->sqhd", probs,
+                           v_ctx.astype(jnp.float32)).astype(dtype)
+        # scatter back to flat tokens: out[t] = o_seq[seq_of[t], t - q_offset[seq_of[t]]]
+        within = jnp.arange(T) - jnp.take(q_offset, seq_of)
+        within = jnp.clip(within, 0, max_q - 1)
+        o_flat = o_seq[seq_of, within].reshape(T, H * hd)
+        x = x + o_flat @ lp["o_proj"]["kernel"]
+        h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
+        gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
+        up = h @ lp["up_proj"]["kernel"]
+        x = x + (gate * up) @ lp["down_proj"]["kernel"]
+        return (x,), (layer_k, layer_v)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        layer_step, (x,), (params["layers"], kcache, vcache))
+
+    x = rms_norm(x, params["norm_f"]["scale"], cfg.norm_eps)
+    last = jnp.take(x, logit_idx, axis=0)                          # [S, D]
+    if cfg.tie_embeddings:
+        logits = last @ params["embed"]["embedding"].T
+    else:
+        logits = last @ params["lm_head"]["kernel"]
+    return logits.astype(jnp.float32), new_k, new_v
+
+
+def build_ragged_step(cfg: TransformerConfig, max_q: int):
+    """Jitted step with donated caches (the CUDA-graph analogue: one compiled
+    program reused for every batch; reference engine.py:494 _create_cuda_graph)."""
+    fn = partial(ragged_forward, cfg=cfg, max_q=max_q)
+    return jax.jit(fn, donate_argnums=(1, 2))

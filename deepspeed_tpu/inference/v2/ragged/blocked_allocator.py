@@ -1,0 +1,55 @@
+"""KV-cache block allocator (reference: inference/v2/ragged/blocked_allocator.py:11).
+
+Host-side free-list over a fixed pool of KV blocks.  The reference keeps the
+free list in a torch int32 tensor; plain numpy suffices on the host — the
+device only ever sees block *ids* inside block tables.
+"""
+from __future__ import annotations
+
+from typing import Iterable, List, Union
+
+import numpy as np
+
+
+class BlockedAllocator:
+    def __init__(self, num_blocks: int):
+        if num_blocks < 1:
+            raise ValueError(f"need at least 1 block, got {num_blocks}")
+        self._num_blocks = num_blocks
+        # linked free list: next_free[i] = next free block after i
+        self._next = np.arange(1, num_blocks + 1, dtype=np.int64)
+        self._head = 0
+        self._free = num_blocks
+
+    @property
+    def free_blocks(self) -> int:
+        return self._free
+
+    @property
+    def total_blocks(self) -> int:
+        return self._num_blocks
+
+    def allocate(self, num_blocks: int) -> np.ndarray:
+        if num_blocks > self._free:
+            raise ValueError(
+                f"cannot allocate {num_blocks} blocks; only {self._free} free")
+        out = np.empty(num_blocks, dtype=np.int64)
+        for i in range(num_blocks):
+            out[i] = self._head
+            self._head = self._next[self._head]
+        self._free -= num_blocks
+        return out
+
+    def free(self, blocks: Union[Iterable[int], np.ndarray]) -> None:
+        blocks = np.atleast_1d(np.asarray(blocks, dtype=np.int64))
+        seen = set()
+        for b in blocks:
+            b = int(b)
+            if not 0 <= b < self._num_blocks:
+                raise ValueError(f"block id {b} out of range")
+            if b in seen:
+                raise ValueError(f"double free of block {b} in one call")
+            seen.add(b)
+            self._next[b] = self._head
+            self._head = b
+        self._free += len(seen)

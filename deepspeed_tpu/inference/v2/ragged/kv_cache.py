@@ -1,0 +1,52 @@
+"""Blocked (paged) KV cache on TPU HBM (reference: inference/v2/ragged/kv_cache.py:40).
+
+Storage is one flat slot dimension: ``[layers, num_blocks*block_size + 1,
+kv_heads, head_dim]`` for K and V.  Block tables index into the slot dim; the
+final slot is a trash row that padded tokens write into, keeping the update a
+single dense scatter (no predication) — the XLA-friendly equivalent of the
+reference's per-block pointer indirection.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class KVCacheConfig:
+    num_layers: int
+    num_blocks: int
+    block_size: int
+    num_kv_heads: int
+    head_dim: int
+    dtype: object = jnp.bfloat16
+
+    @property
+    def num_slots(self) -> int:
+        return self.num_blocks * self.block_size
+
+    @property
+    def trash_slot(self) -> int:
+        return self.num_slots
+
+
+class BlockedKVCache:
+    def __init__(self, config: KVCacheConfig):
+        self.config = config
+        shape = (config.num_layers, config.num_slots + 1,
+                 config.num_kv_heads, config.head_dim)
+        self.k = jnp.zeros(shape, config.dtype)
+        self.v = jnp.zeros(shape, config.dtype)
+
+    @property
+    def arrays(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
+        return self.k, self.v
+
+    def update(self, k, v) -> None:
+        self.k, self.v = k, v
+
+    def mem_bytes(self) -> int:
+        return 2 * self.k.size * self.k.dtype.itemsize

@@ -1,0 +1,137 @@
+"""Ragged batch metadata (reference: inference/v2/ragged/ragged_wrapper.py:31
+``RaggedBatchWrapper`` + csrc fast host-to-device batch metadata).
+
+Builds the per-forward device arrays for a mixed prefill/decode batch under
+XLA's static-shape constraint: every array is padded to the engine's
+compile-time budgets (``max_tokens``, ``max_seqs``, ``max_blocks_per_seq``),
+so the same compiled program serves every batch composition.
+
+Device views produced:
+  tokens        [max_tokens]              flat input ids (padded 0)
+  kv_slot       [max_tokens]              flat cache slot per token (block*bs+off; -1 pad → slot 0 masked)
+  seq_of_token  [max_tokens]              owning sequence row (pad → max_seqs-1 dummy)
+  pos_of_token  [max_tokens]              absolute position in its sequence
+  q_offset      [max_seqs]                first flat index of each seq's queries
+  q_len         [max_seqs]                query tokens this forward
+  ctx_len       [max_seqs]                seen + in-flight tokens (attention span)
+  kv_gather     [max_seqs, max_ctx]       flat cache slots for each seq's context
+  logit_idx     [max_seqs]                flat index of each seq's last token
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Tuple
+
+import numpy as np
+
+from .sequence_descriptor import DSSequenceDescriptor
+
+
+@dataclasses.dataclass
+class RaggedBatch:
+    tokens: np.ndarray
+    kv_slot: np.ndarray
+    seq_of_token: np.ndarray
+    pos_of_token: np.ndarray
+    q_offset: np.ndarray
+    q_len: np.ndarray
+    ctx_len: np.ndarray
+    kv_gather: np.ndarray
+    logit_idx: np.ndarray
+    n_tokens: int
+    n_seqs: int
+    uids: List[int]
+
+    def to_device(self) -> Dict[str, Any]:
+        import jax.numpy as jnp
+
+        return {
+            "tokens": jnp.asarray(self.tokens, jnp.int32),
+            "kv_slot": jnp.asarray(self.kv_slot, jnp.int32),
+            "seq_of_token": jnp.asarray(self.seq_of_token, jnp.int32),
+            "pos_of_token": jnp.asarray(self.pos_of_token, jnp.int32),
+            "q_offset": jnp.asarray(self.q_offset, jnp.int32),
+            "q_len": jnp.asarray(self.q_len, jnp.int32),
+            "ctx_len": jnp.asarray(self.ctx_len, jnp.int32),
+            "kv_gather": jnp.asarray(self.kv_gather, jnp.int32),
+            "logit_idx": jnp.asarray(self.logit_idx, jnp.int32),
+        }
+
+
+class RaggedBatchWrapper:
+    def __init__(self, max_tokens: int, max_seqs: int, max_ctx: int,
+                 block_size: int, trash_slot: int = 0):
+        self.max_tokens = max_tokens
+        self.max_seqs = max_seqs
+        self.max_ctx = max_ctx
+        self.block_size = block_size
+        #: cache slot that padded tokens write into (must be the cache's
+        #: dedicated trash row, or they would corrupt block 0)
+        self.trash_slot = trash_slot
+        self.clear()
+
+    def clear(self):
+        self._entries: List[Tuple[DSSequenceDescriptor, List[int]]] = []
+        self._n_tokens = 0
+
+    @property
+    def current_tokens(self) -> int:
+        return self._n_tokens
+
+    @property
+    def current_sequences(self) -> int:
+        return len(self._entries)
+
+    def can_fit(self, n_new_tokens: int) -> bool:
+        return (self._n_tokens + n_new_tokens <= self.max_tokens and
+                len(self._entries) < self.max_seqs)
+
+    def insert_sequence(self, seq: DSSequenceDescriptor, new_tokens: List[int]):
+        if not self.can_fit(len(new_tokens)):
+            raise ValueError("batch budget exceeded")
+        seq.in_flight_tokens = len(new_tokens)
+        self._entries.append((seq, list(new_tokens)))
+        self._n_tokens += len(new_tokens)
+
+    def finalize(self) -> RaggedBatch:
+        """Build padded arrays (the [HOST→DEVICE boundary] of the reference)."""
+        mt, ms, mc, bs = self.max_tokens, self.max_seqs, self.max_ctx, self.block_size
+        tokens = np.zeros(mt, np.int32)
+        kv_slot = np.full(mt, self.trash_slot, np.int32)
+        seq_of = np.full(mt, ms - 1, np.int32)
+        pos_of = np.zeros(mt, np.int32)
+        q_offset = np.zeros(ms, np.int32)
+        q_len = np.zeros(ms, np.int32)
+        ctx_len = np.zeros(ms, np.int32)
+        kv_gather = np.zeros((ms, mc), np.int32)
+        logit_idx = np.zeros(ms, np.int32)
+        uids = []
+
+        cursor = 0
+        for row, (seq, new_toks) in enumerate(self._entries):
+            n = len(new_toks)
+            total = seq.seen_tokens + n
+            assert total <= mc, f"sequence length {total} exceeds max_ctx {mc}"
+            assert len(seq.blocks) * bs >= total, "KV blocks not allocated"
+            uids.append(seq.uid)
+            tokens[cursor:cursor + n] = new_toks
+            seq_of[cursor:cursor + n] = row
+            positions = np.arange(seq.seen_tokens, total, dtype=np.int32)
+            pos_of[cursor:cursor + n] = positions
+            blocks = np.asarray(seq.blocks, np.int64)
+            kv_slot[cursor:cursor + n] = (blocks[positions // bs] * bs +
+                                          positions % bs).astype(np.int32)
+            q_offset[row] = cursor
+            q_len[row] = n
+            ctx_len[row] = total
+            ctx_positions = np.arange(total, dtype=np.int64)
+            kv_gather[row, :total] = (blocks[ctx_positions // bs] * bs +
+                                      ctx_positions % bs).astype(np.int32)
+            logit_idx[row] = cursor + n - 1
+            cursor += n
+
+        return RaggedBatch(tokens=tokens, kv_slot=kv_slot, seq_of_token=seq_of,
+                           pos_of_token=pos_of, q_offset=q_offset, q_len=q_len,
+                           ctx_len=ctx_len, kv_gather=kv_gather,
+                           logit_idx=logit_idx, n_tokens=cursor,
+                           n_seqs=len(self._entries), uids=uids)
