@@ -1,0 +1,111 @@
+"""Universal checkpoint conversion (reference: deepspeed/checkpoint/
+ds_to_universal.py:469 main; extract :112/:152, TP-slice merge :232).
+
+The reference needs a multi-stage offline pipeline because its ZeRO shards are
+rank-local flat-buffer slices entangled with TP/PP layout.  Orbax checkpoints
+are already layout-agnostic (global-shape arrays + shard metadata), so a
+checkpoint saved on ANY mesh loads on any other — the "universal" property is
+intrinsic.  This module therefore provides:
+
+  * :func:`convert` — normalize any engine checkpoint into the explicit
+    universal layout (one array per param, fp32, plus optimizer moments named
+    ``exp_avg``/``exp_avg_sq`` like the reference's universal shards);
+  * :func:`load_universal` — restore a universal dir into a live engine
+    (the ``load_universal_checkpoint`` path, universal_checkpoint.py:22);
+  * the same CLI surface as the reference script.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+UNIVERSAL_SUBDIR = "zero"  # reference layout: <dir>/zero/<param>/fp32.pt etc.
+
+
+def _flatten(tree, prefix=""):
+    out = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(_flatten(v, f"{prefix}/{k}" if prefix else str(k)))
+    else:
+        out[prefix] = tree
+    return out
+
+
+def convert(checkpoint_dir: str, output_dir: str, tag: Optional[str] = None) -> None:
+    """Engine checkpoint → universal dir of per-param .npy files."""
+    import orbax.checkpoint as ocp
+
+    if tag is None:
+        with open(os.path.join(checkpoint_dir, "latest")) as f:
+            tag = f.read().strip()
+    with ocp.PyTreeCheckpointer() as ckptr:
+        state = ckptr.restore(os.path.join(checkpoint_dir, str(tag), "state"))
+
+    os.makedirs(os.path.join(output_dir, UNIVERSAL_SUBDIR), exist_ok=True)
+    params = _flatten(state["params"])
+    # optax adam-family states: find mu/nu trees by shape-matched names
+    opt_flat = _flatten(state.get("opt_state", {}))
+    moments: Dict[str, Dict[str, Any]] = {}
+    for name, arr in opt_flat.items():
+        low = name.lower()
+        if "/mu/" in low or low.startswith("mu/") or "/mu" == low[-3:]:
+            moments.setdefault(name.split("mu/", 1)[-1], {})["exp_avg"] = arr
+        elif "/nu/" in low or low.startswith("nu/"):
+            moments.setdefault(name.split("nu/", 1)[-1], {})["exp_avg_sq"] = arr
+
+    for name, arr in params.items():
+        pdir = os.path.join(output_dir, UNIVERSAL_SUBDIR, name.replace("/", "."))
+        os.makedirs(pdir, exist_ok=True)
+        np.save(os.path.join(pdir, "fp32.npy"),
+                np.asarray(arr, dtype=np.float32))
+        for mname, marr in moments.get(name, {}).items():
+            np.save(os.path.join(pdir, f"{mname}.npy"),
+                    np.asarray(marr, dtype=np.float32))
+
+    meta = {"step": int(np.asarray(state.get("global_step", 0))),
+            "source_tag": str(tag)}
+    with open(os.path.join(output_dir, "universal_meta.json"), "w") as f:
+        json.dump(meta, f)
+
+
+def load_universal(universal_dir: str) -> Dict[str, np.ndarray]:
+    """Universal dir → flat {param_name: fp32 ndarray}."""
+    zdir = os.path.join(universal_dir, UNIVERSAL_SUBDIR)
+    out = {}
+    for pname in sorted(os.listdir(zdir)):
+        fp32 = os.path.join(zdir, pname, "fp32.npy")
+        if os.path.exists(fp32):
+            out[pname.replace(".", "/")] = np.load(fp32)
+    return out
+
+
+def unflatten(flat: Dict[str, np.ndarray]) -> Dict:
+    tree: Dict[str, Any] = {}
+    for name, arr in flat.items():
+        node = tree
+        parts = name.split("/")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = arr
+    return tree
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--input_folder", required=True)
+    parser.add_argument("--output_folder", required=True)
+    parser.add_argument("--tag", default=None)
+    parser.add_argument("--num_extract_workers", type=int, default=1)  # parity knob
+    parser.add_argument("--num_merge_workers", type=int, default=1)
+    args = parser.parse_args()
+    convert(args.input_folder, args.output_folder, args.tag)
+    print(f"universal checkpoint written to {args.output_folder}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,75 @@
+"""Reconstruct a full fp32 state dict from a (sharded) checkpoint.
+
+Reference: ``deepspeed/utils/zero_to_fp32.py:40,391`` — the offline script the
+engine copies into every checkpoint dir so users can export ZeRO shards to a
+single consolidated file.
+
+On TPU the checkpoint is orbax/tensorstore: arrays are stored with global
+shape + per-shard metadata, so "consolidation" is simply a host-side restore —
+no shard-merging math like the reference needs for its flat-buffer ZeRO
+partitions.  Provided as both an API and a CLI.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+
+def get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir: str,
+                                             tag: Optional[str] = None) -> Dict[str, Any]:
+    """Load params from a checkpoint as host fp32 numpy arrays, flattened to
+    '/'.joined names (reference fn name kept)."""
+    import orbax.checkpoint as ocp
+
+    if tag is None:
+        latest = os.path.join(checkpoint_dir, "latest")
+        if os.path.exists(latest):
+            with open(latest) as f:
+                tag = f.read().strip()
+        else:
+            raise ValueError(f"no 'latest' file in {checkpoint_dir}; pass tag")
+    path = os.path.join(checkpoint_dir, str(tag), "state")
+    with ocp.PyTreeCheckpointer() as ckptr:
+        state = ckptr.restore(path)
+    params = state["params"] if isinstance(state, dict) and "params" in state else state
+
+    flat: Dict[str, Any] = {}
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}/{k}" if prefix else str(k), v)
+        else:
+            flat[prefix] = np.asarray(node, dtype=np.float32)
+
+    walk("", params)
+    return flat
+
+
+def convert_zero_checkpoint_to_fp32_state_dict(checkpoint_dir: str,
+                                               output_file: str,
+                                               tag: Optional[str] = None) -> None:
+    """Write the consolidated dict to ``output_file`` (pickle of name→ndarray;
+    loadable without jax)."""
+    sd = get_fp32_state_dict_from_zero_checkpoint(checkpoint_dir, tag)
+    with open(output_file, "wb") as f:
+        pickle.dump(sd, f)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("checkpoint_dir")
+    parser.add_argument("output_file")
+    parser.add_argument("-t", "--tag", default=None)
+    args = parser.parse_args()
+    convert_zero_checkpoint_to_fp32_state_dict(args.checkpoint_dir,
+                                               args.output_file, args.tag)
+    print(f"saved fp32 state dict to {args.output_file}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,171 @@
+"""Compression library (reference: deepspeed/compression/compress.py
+init_compression/redundancy_clean, basic_layer.py:121 LinearLayer_Compress,
+scheduler.py).
+
+The reference swaps nn.Linear for compress-aware modules; functionally that is
+a pair of pytree transforms:
+
+  * :func:`init_compression` — given params + compression config, returns
+    (params, CompressionSpec) where the spec records which leaves get which
+    treatment (weight quantization bits, sparse/row/head pruning ratios,
+    layer reduction);
+  * :func:`apply_compression` — quantize-dequantize (QAT fake-quant) and
+    pruning masks applied to params — called inside the loss fn each step
+    (training-time) or once at export (redundancy_clean).
+"""
+from __future__ import annotations
+
+import dataclasses
+import fnmatch
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class LeafCompression:
+    quantize_bits: Optional[int] = None           # weight fake-quant bits
+    quantize_groups: int = 1
+    sparse_ratio: Optional[float] = None          # unstructured pruning
+    row_ratio: Optional[float] = None             # structured row pruning
+    head_ratio: Optional[float] = None
+    num_heads: Optional[int] = None
+
+
+CompressionSpec = Dict[str, LeafCompression]
+
+
+def _match(patterns: List[str], path: str) -> bool:
+    """Glob-style module matching (reference uses substring/regex on module
+    names; globs are the dict-pytree equivalent)."""
+    return any(fnmatch.fnmatch(path, p) or fnmatch.fnmatch(path, p + "*") or
+               (not any(ch in p for ch in "*?[") and p in path)
+               for p in patterns)
+
+
+def init_compression(params: Any, compression_config: Dict[str, Any],
+                     mpu=None) -> Tuple[Any, CompressionSpec]:
+    """Build the per-leaf compression spec from a DeepSpeed-style config
+    (weight_quantization / sparse_pruning / row_pruning / head_pruning
+    sections with shared_parameters + different_groups)."""
+    spec: CompressionSpec = {}
+    flat = _flatten_paths(params)
+
+    def section(name):
+        sec = compression_config.get(name, {})
+        shared = sec.get("shared_parameters", {})
+        groups = sec.get("different_groups", {})
+        return sec, shared, groups
+
+    wq, wq_shared, wq_groups = section("weight_quantization")
+    if wq_shared.get("enabled", False):
+        for gname, g in wq_groups.items():
+            bits = g.get("params", {}).get("start_bits", 8)
+            for path in flat:
+                if _match(g.get("modules", ["*"]), path):
+                    spec.setdefault(path, LeafCompression()).quantize_bits = int(bits)
+                    spec[path].quantize_groups = wq_shared.get("quantize_groups", 1)
+
+    sp, sp_shared, sp_groups = section("sparse_pruning")
+    if sp_shared.get("enabled", False):
+        for gname, g in sp_groups.items():
+            ratio = g.get("params", {}).get("dense_ratio", 0.5)
+            for path in flat:
+                if _match(g.get("modules", ["*"]), path):
+                    spec.setdefault(path, LeafCompression()).sparse_ratio = float(ratio)
+
+    rp, rp_shared, rp_groups = section("row_pruning")
+    if rp_shared.get("enabled", False):
+        for gname, g in rp_groups.items():
+            ratio = g.get("params", {}).get("dense_ratio", 0.5)
+            for path in flat:
+                if _match(g.get("modules", ["*"]), path):
+                    spec.setdefault(path, LeafCompression()).row_ratio = float(ratio)
+
+    hp, hp_shared, hp_groups = section("head_pruning")
+    if hp_shared.get("enabled", False):
+        for gname, g in hp_groups.items():
+            ratio = g.get("params", {}).get("dense_ratio", 0.5)
+            for path in flat:
+                if _match(g.get("modules", ["*"]), path):
+                    lc = spec.setdefault(path, LeafCompression())
+                    lc.head_ratio = float(ratio)
+                    lc.num_heads = hp_shared.get("num_heads")
+    return params, spec
+
+
+def fake_quantize(w: jnp.ndarray, bits: int, groups: int = 1) -> jnp.ndarray:
+    """Symmetric per-group QAT fake quantization with straight-through grads."""
+    qmax = 2.0 ** (bits - 1) - 1
+    flat = w.reshape(groups, -1)
+    scale = jnp.max(jnp.abs(flat), axis=1, keepdims=True) / qmax
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(flat / scale), -qmax, qmax) * scale
+    dq = q.reshape(w.shape)
+    return w + jax.lax.stop_gradient(dq - w)  # STE
+
+
+def magnitude_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Keep top |dense_ratio| fraction by magnitude (unstructured)."""
+    k = max(int(w.size * dense_ratio), 1)
+    thresh = jnp.sort(jnp.abs(w).reshape(-1))[-k]
+    return (jnp.abs(w) >= thresh).astype(w.dtype)
+
+
+def row_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Keep top rows by L1 norm (structured row pruning; dim 0)."""
+    norms = jnp.sum(jnp.abs(w.reshape(w.shape[0], -1)), axis=1)
+    k = max(int(w.shape[0] * dense_ratio), 1)
+    thresh = jnp.sort(norms)[-k]
+    mask = (norms >= thresh).astype(w.dtype)
+    return mask.reshape((-1,) + (1,) * (w.ndim - 1))
+
+
+def apply_compression(params: Any, spec: CompressionSpec) -> Any:
+    """Apply the spec (inside the loss fn for QAT, or at export)."""
+    flat = _flatten_paths(params)
+
+    def transform(path, w):
+        lc = spec.get(path)
+        if lc is None or not hasattr(w, "ndim"):
+            return w
+        if lc.sparse_ratio is not None:
+            w = w * jax.lax.stop_gradient(magnitude_mask(w, lc.sparse_ratio))
+        if lc.row_ratio is not None and w.ndim >= 1:
+            w = w * jax.lax.stop_gradient(row_mask(w, lc.row_ratio))
+        if lc.quantize_bits is not None:
+            w = fake_quantize(w, lc.quantize_bits, lc.quantize_groups)
+        return w
+
+    return _map_with_paths(params, transform, flat)
+
+
+def redundancy_clean(params: Any, spec: CompressionSpec) -> Any:
+    """Materialize the compression permanently (reference redundancy_clean)."""
+    return jax.tree.map(jax.lax.stop_gradient, apply_compression(params, spec))
+
+
+def _flatten_paths(tree) -> List[str]:
+    paths = []
+
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            for k, v in node.items():
+                walk(f"{prefix}.{k}" if prefix else str(k), v)
+        else:
+            paths.append(prefix)
+
+    walk("", tree)
+    return paths
+
+
+def _map_with_paths(tree, fn, _paths=None):
+    def walk(prefix, node):
+        if isinstance(node, dict):
+            return {k: walk(f"{prefix}.{k}" if prefix else str(k), v)
+                    for k, v in node.items()}
+        return fn(prefix, node)
+
+    return walk("", tree)

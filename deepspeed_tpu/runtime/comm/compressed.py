@@ -1,0 +1,81 @@
+"""Error-feedback compressed gradient allreduce (1-bit Adam/LAMB transport).
+
+Reference analogues: ``deepspeed/runtime/comm/nccl.py:16``/``mpi.py``/
+``compressed.py:13`` — the compressed_allreduce used by OnebitAdam/OnebitLamb/
+ZeroOneAdam (runtime/fp16/onebit/*), with ⚙ packbits kernels.
+
+TPU formulation: sign-SGD style 1-bit compression with server-side majority
+vote, done with XLA collectives inside shard_map:
+
+  1. ``c = sign(grad + error)``, per-tensor scale = mean(|grad + error|)
+  2. ``error = (grad + error) - scale * c``           (error feedback)
+  3. exchange: reduce-scatter the sign votes (int8 sum ≡ majority count),
+     take sign of the sum (majority vote), allgather the result
+  4. reconstructed grad = vote_sign * psum(scale)/n
+
+Bit-packing into int8 words is left to XLA (int8 traffic is already 4× less
+than f32; a Pallas packbits kernel can halve it again later).
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class CompressionState(NamedTuple):
+    error: Any           # worker error feedback
+    server_error: Any    # server-side error feedback
+
+
+def init_compression_state(params: Any) -> CompressionState:
+    zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+    return CompressionState(error=jax.tree.map(zeros, params),
+                            server_error=jax.tree.map(zeros, params))
+
+
+def compressed_allreduce(grad: jnp.ndarray, error: jnp.ndarray,
+                         server_error: jnp.ndarray, axes) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """One tensor's 1-bit allreduce with two-level error feedback
+    (mirrors the reference's worker+server error structure).
+
+    Must run where ``axes`` are bound (inside shard_map).  Returns
+    (avg_grad, new_error, new_server_error).
+    """
+    n = 1
+    from ..topology import get_topology
+
+    topo = get_topology()
+    for a in (axes if isinstance(axes, (tuple, list)) else [axes]):
+        n *= topo.dims.get(a, 1)
+    if n <= 1:
+        return grad, error, server_error
+
+    corrected = grad.astype(jnp.float32) + error
+    scale = jnp.mean(jnp.abs(corrected))
+    sign = jnp.sign(corrected).astype(jnp.int8)
+    sign = jnp.where(sign == 0, jnp.int8(1), sign)
+    new_error = corrected - scale * sign.astype(jnp.float32)
+
+    votes = jax.lax.psum(sign.astype(jnp.int32), axes)       # majority count
+    scale_sum = jax.lax.psum(scale, axes)
+    server_in = votes.astype(jnp.float32) / n * (scale_sum / n) + server_error
+    server_scale = jnp.mean(jnp.abs(server_in))
+    server_sign = jnp.sign(server_in)
+    server_sign = jnp.where(server_sign == 0, 1.0, server_sign)
+    new_server_error = server_in - server_scale * server_sign
+    avg = server_scale * server_sign
+    return avg.astype(grad.dtype), new_error, new_server_error
+
+
+def compressed_allreduce_tree(grads: Any, state: CompressionState,
+                              axes) -> Tuple[Any, CompressionState]:
+    flat_g, treedef = jax.tree.flatten(grads)
+    flat_e = treedef.flatten_up_to(state.error)
+    flat_s = treedef.flatten_up_to(state.server_error)
+    outs = [compressed_allreduce(g, e, s, axes)
+            for g, e, s in zip(flat_g, flat_e, flat_s)]
+    return (treedef.unflatten([o[0] for o in outs]),
+            CompressionState(error=treedef.unflatten([o[1] for o in outs]),
+                             server_error=treedef.unflatten([o[2] for o in outs])))

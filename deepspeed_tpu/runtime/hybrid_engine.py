@@ -1,0 +1,61 @@
+"""Hybrid engine for RLHF (reference: runtime/hybrid_engine.py:30
+``DeepSpeedHybridEngine``: generate :168, _zero3_forward :362).
+
+The reference's complexity — gathering ZeRO-3 partitions into inference
+containers, fusing/unfusing LoRA — collapses on TPU: training params are a
+sharded pytree, and "switching to inference" is re-placing that pytree on the
+serving layout (TP specs) and feeding the ragged engine.  Weights are shared
+by construction (same arrays; re-placement is an ICI allgather XLA schedules).
+"""
+from __future__ import annotations
+
+from typing import Any, List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+
+from ..inference.v2.engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+from ..utils.logging import log_dist
+from .engine import DeepSpeedEngine
+
+
+class DeepSpeedHybridEngine(DeepSpeedEngine):
+    def __init__(self, *args, inference_config: Optional[RaggedInferenceEngineConfig] = None,
+                 **kwargs):
+        super().__init__(*args, **kwargs)
+        self._inference_config = inference_config or RaggedInferenceEngineConfig(
+            dtype=self.compute_dtype)
+        self._infer_engine: Optional[InferenceEngineV2] = None
+        self._infer_params_step = -1
+        log_dist("hybrid engine ready (train + generate share weights)", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    def _refresh_inference_params(self):
+        """Re-place current training params for serving (the reference's
+        container-gather, hybrid_engine.py:168 prologue)."""
+        if self._infer_params_step == self.global_steps and self._infer_engine:
+            return
+        cast = jax.tree.map(lambda p: p.astype(self._inference_config.dtype),
+                            self.state.params)
+        if self._infer_engine is None:
+            self._infer_engine = InferenceEngineV2(
+                self.module, cast, self._inference_config)
+        else:
+            self._infer_engine.params = cast
+        self._infer_params_step = self.global_steps
+
+    def generate(self, prompts: Sequence[Sequence[int]], max_new_tokens: int = 32,
+                 temperature: float = 1.0, rng: Optional[jax.Array] = None,
+                 eos_token_id: Optional[int] = None) -> List[List[int]]:
+        """Fast generation with the CURRENT training weights (reference :168)."""
+        self._refresh_inference_params()
+        return self._infer_engine.generate(
+            prompts, max_new_tokens=max_new_tokens, temperature=temperature,
+            rng=rng, eos_token_id=eos_token_id)
+
+    def eval(self):
+        self._refresh_inference_params()
+        return self
+
+    def train(self, mode: bool = True):
+        return self
