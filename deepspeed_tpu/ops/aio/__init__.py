@@ -1,0 +1,128 @@
+"""Python binding for the native async-IO engine.
+
+Reference analogues: ``op_builder/async_io.py`` (JIT build) +
+``deepspeed/ops/aio`` (binding).  pybind11 is not in this image, so the build
+is a direct g++ shared-object compile (cached by source mtime) bound with
+ctypes — the op_builder JIT-load pattern, TPU-host flavored.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "aio_engine.cpp")
+_SO = os.path.join(os.path.dirname(__file__), "libdstpu_aio.so")
+_LIB: Optional[ctypes.CDLL] = None
+
+
+def _build() -> str:
+    src = os.path.abspath(_SRC)
+    so = os.path.abspath(_SO)
+    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
+        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
+               src, "-o", so]
+        subprocess.run(cmd, check=True, capture_output=True)
+    return so
+
+
+def _lib() -> ctypes.CDLL:
+    global _LIB
+    if _LIB is None:
+        lib = ctypes.CDLL(_build())
+        lib.dstpu_aio_create.restype = ctypes.c_void_p
+        lib.dstpu_aio_create.argtypes = [ctypes.c_int, ctypes.c_int64]
+        lib.dstpu_aio_destroy.argtypes = [ctypes.c_void_p]
+        lib.dstpu_aio_open.restype = ctypes.c_int
+        lib.dstpu_aio_open.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.dstpu_aio_close.argtypes = [ctypes.c_int]
+        for fn in (lib.dstpu_aio_pwrite, lib.dstpu_aio_pread):
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int, ctypes.c_void_p,
+                           ctypes.c_int64, ctypes.c_int64]
+        for fn in (lib.dstpu_aio_wait, lib.dstpu_aio_poll):
+            fn.restype = ctypes.c_int64
+            fn.argtypes = [ctypes.c_void_p, ctypes.c_int64]
+        _LIB = lib
+    return _LIB
+
+
+class AsyncIOHandle:
+    """Reference analogue: deepspeed_py_aio_handle.cpp handle object."""
+
+    def __init__(self, block_size: int = 1 << 20, queue_depth: int = 8,
+                 thread_count: int = 4, single_submit: bool = False,
+                 overlap_events: bool = True):
+        self._lib = _lib()
+        self._h = self._lib.dstpu_aio_create(int(thread_count), int(block_size))
+        self.block_size = block_size
+        self.thread_count = thread_count
+
+    def __del__(self):
+        try:
+            if getattr(self, "_h", None):
+                self._lib.dstpu_aio_destroy(self._h)
+                self._h = None
+        except Exception:
+            pass
+
+    # ---------------------------------------------------------------- #
+    def async_pwrite(self, array: np.ndarray, path: str, offset: int = 0) -> "AioRequest":
+        arr = np.ascontiguousarray(array)
+        fd = self._lib.dstpu_aio_open(path.encode(), 1)
+        if fd < 0:
+            raise OSError(f"cannot open {path} for write")
+        rid = self._lib.dstpu_aio_pwrite(
+            self._h, fd, arr.ctypes.data_as(ctypes.c_void_p), arr.nbytes, offset)
+        return AioRequest(self, rid, fd, keepalive=arr)
+
+    def async_pread(self, array: np.ndarray, path: str, offset: int = 0) -> "AioRequest":
+        assert array.flags["C_CONTIGUOUS"], "read target must be contiguous"
+        fd = self._lib.dstpu_aio_open(path.encode(), 0)
+        if fd < 0:
+            raise OSError(f"cannot open {path} for read")
+        rid = self._lib.dstpu_aio_pread(
+            self._h, fd, array.ctypes.data_as(ctypes.c_void_p), array.nbytes, offset)
+        return AioRequest(self, rid, fd, keepalive=array)
+
+    def sync_pwrite(self, array: np.ndarray, path: str, offset: int = 0) -> int:
+        return self.async_pwrite(array, path, offset).wait()
+
+    def sync_pread(self, array: np.ndarray, path: str, offset: int = 0) -> int:
+        return self.async_pread(array, path, offset).wait()
+
+
+class AioRequest:
+    def __init__(self, handle: AsyncIOHandle, rid: int, fd: int, keepalive=None):
+        self.handle = handle
+        self.rid = rid
+        self.fd = fd
+        self._keepalive = keepalive  # keep buffer alive until completion
+        self._done = False
+
+    def wait(self) -> int:
+        if self._done:
+            return 0
+        status = self.handle._lib.dstpu_aio_wait(self.handle._h, self.rid)
+        self.handle._lib.dstpu_aio_close(self.fd)
+        self._done = True
+        self._keepalive = None
+        if status != 0:
+            raise OSError(f"aio request failed with errno {-status}")
+        return 0
+
+    def poll(self) -> bool:
+        if self._done:
+            return True
+        return bool(self.handle._lib.dstpu_aio_poll(self.handle._h, self.rid))
+
+
+def aio_available() -> bool:
+    try:
+        _lib()
+        return True
+    except Exception:
+        return False

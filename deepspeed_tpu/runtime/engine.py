@@ -519,6 +519,56 @@ class DeepSpeedEngine:
         return os.path.join(load_dir, str(tag)), payload.get("client_state", {})
 
     # ------------------------------------------------------------------ #
+    # State offload (reference: engine.offload_states :3844 / reload_states
+    # :3876 + runtime/zero/offload_states.py)
+    # ------------------------------------------------------------------ #
+    def offload_states(self, include=("optimizer",), device: str = "cpu",
+                       nvme_path: Optional[str] = None, pin_memory: bool = True,
+                       non_blocking: bool = False):
+        """Move engine state off HBM: 'cpu' = host memory, 'nvme' = disk via
+        the native aio engine."""
+        self._offloaded = getattr(self, "_offloaded", {})
+        for what in include:
+            if what == "optimizer":
+                tree = self.state.opt_state
+            elif what in ("hp_params", "params"):
+                tree = self.state.params
+            else:
+                raise ValueError(f"cannot offload {what!r}")
+            if device == "nvme":
+                from .swap_tensor.partitioned_param_swapper import AsyncTensorSwapper
+
+                swapper = AsyncTensorSwapper(nvme_path or "/tmp/dstpu_swap")
+                swapper.swap_out(what, tree, blocking=not non_blocking)
+                self._offloaded[what] = ("nvme", swapper,
+                                         jax.tree.map(lambda x: x.sharding, tree))
+            else:
+                cpu_dev = jax.devices("cpu")[0]
+                host_tree = jax.device_put(tree, cpu_dev)
+                self._offloaded[what] = ("cpu", host_tree,
+                                         jax.tree.map(lambda x: x.sharding, tree))
+            # drop device references so XLA frees HBM
+            if what == "optimizer":
+                self.state = self.state.replace(opt_state=None)
+            else:
+                self.state = self.state.replace(params=None)
+            self._compiled.clear()
+
+    def reload_states(self, non_blocking: bool = False):
+        for what, (kind, store, shardings) in getattr(self, "_offloaded", {}).items():
+            if kind == "nvme":
+                tree = store.swap_in(what, shardings=shardings)
+                store.cleanup()
+            else:
+                tree = jax.device_put(store, shardings)
+            if what == "optimizer":
+                self.state = self.state.replace(opt_state=tree)
+            else:
+                self.state = self.state.replace(params=tree)
+        self._offloaded = {}
+        self._compiled.clear()
+
+    # ------------------------------------------------------------------ #
     def get_fp32_state_dict(self):
         """Gather full (unsharded) fp32 params on host — the
         ``_zero3_consolidated_16bit_state_dict`` analogue (engine.py:3693)."""

@@ -1,0 +1,109 @@
+"""Tensor swap machinery: HBM ↔ host ↔ NVMe.
+
+Reference analogues: ``runtime/swap_tensor/partitioned_param_swapper.py:37``
+(AsyncPartitionedParameterSwapper — aio handles, pinned buffers, aligned IO)
+and ``partitioned_optimizer_swapper.py:29`` (+ pipelined variant).
+
+TPU version: the device→host leg is ``jax.device_put`` to the host platform
+(or ``np.asarray``); the host→disk leg is the native aio engine
+(:mod:`deepspeed_tpu.ops.aio`).  Swapping operates on whole pytrees with
+per-leaf files under a swap folder, double-buffered via async requests.
+"""
+from __future__ import annotations
+
+import os
+import shutil
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+
+from ...ops.aio import AsyncIOHandle, aio_available
+from ...utils.logging import logger
+
+
+class AsyncTensorSwapper:
+    def __init__(self, swap_folder: str, aio_config=None):
+        os.makedirs(swap_folder, exist_ok=True)
+        self.swap_folder = swap_folder
+        cfg = aio_config
+        self.handle = AsyncIOHandle(
+            block_size=getattr(cfg, "block_size", 1 << 20),
+            queue_depth=getattr(cfg, "queue_depth", 8),
+            thread_count=getattr(cfg, "thread_count", 4),
+        ) if aio_available() else None
+        self._meta: Dict[str, Dict[str, Any]] = {}
+        self._pending: List[Any] = []
+
+    def _path(self, name: str) -> str:
+        return os.path.join(self.swap_folder, name.replace("/", ".") + ".swp")
+
+    # ---------------------------------------------------------------- #
+    def swap_out(self, name: str, tree: Any, blocking: bool = True) -> None:
+        """Device pytree → NVMe files. Frees nothing on device by itself —
+        the caller drops its references (XLA frees the buffers)."""
+        flat, treedef = jax.tree.flatten(tree)
+        metas = []
+        for i, leaf in enumerate(flat):
+            host = np.ascontiguousarray(np.asarray(leaf))
+            path = self._path(f"{name}.{i}")
+            if self.handle is not None:
+                req = self.handle.async_pwrite(host, path)
+                self._pending.append(req)
+            else:  # pure-python fallback
+                host.tofile(path)
+            metas.append({"shape": host.shape, "dtype": str(host.dtype),
+                          "path": path})
+        self._meta[name] = {"treedef": treedef, "leaves": metas}
+        if blocking:
+            self.synchronize_writes()
+
+    def swap_in(self, name: str, device=None, shardings=None) -> Any:
+        """NVMe files → device pytree (with optional target shardings)."""
+        meta = self._meta[name]
+        leaves = []
+        reqs = []
+        for lm in meta["leaves"]:
+            buf = np.empty(lm["shape"], dtype=np.dtype(lm["dtype"]))
+            if self.handle is not None:
+                reqs.append((self.handle.async_pread(buf, lm["path"]), buf))
+            else:
+                buf = np.fromfile(lm["path"], dtype=np.dtype(lm["dtype"])
+                                  ).reshape(lm["shape"])
+                reqs.append((None, buf))
+        for req, buf in reqs:
+            if req is not None:
+                req.wait()
+            leaves.append(buf)
+        tree = jax.tree.unflatten(meta["treedef"], leaves)
+        if shardings is not None:
+            tree = jax.device_put(tree, shardings)
+        elif device is not None:
+            tree = jax.device_put(tree, device)
+        else:
+            tree = jax.tree.map(jax.numpy.asarray, tree)
+        return tree
+
+    def synchronize_writes(self) -> None:
+        for req in self._pending:
+            req.wait()
+        self._pending.clear()
+
+    def release(self, name: str) -> None:
+        meta = self._meta.pop(name, None)
+        if meta:
+            for lm in meta["leaves"]:
+                try:
+                    os.remove(lm["path"])
+                except OSError:
+                    pass
+
+    def cleanup(self) -> None:
+        for name in list(self._meta):
+            self.release(name)
+        shutil.rmtree(self.swap_folder, ignore_errors=True)
+
+
+# Reference class-name aliases
+AsyncPartitionedParameterSwapper = AsyncTensorSwapper
+PartitionedOptimizerSwapper = AsyncTensorSwapper

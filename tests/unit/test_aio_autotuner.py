@@ -1,0 +1,112 @@
+"""Native aio engine + tensor swapper + offload_states + autotuner tests.
+(reference: tests/unit/ops/aio/test_aio.py, runtime/zero/test_offload_states.py,
+autotuning/test_autotuning.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+
+def _aio_ok():
+    from deepspeed_tpu.ops.aio import aio_available
+
+    return aio_available()
+
+
+@pytest.mark.skipif(not _aio_ok(), reason="g++ unavailable")
+class TestNativeAio:
+    def test_write_read_roundtrip(self, tmp_path):
+        from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+        h = AsyncIOHandle(block_size=4096, thread_count=2)
+        data = np.random.default_rng(0).normal(size=(1000, 37)).astype(np.float32)
+        path = str(tmp_path / "t.bin")
+        h.sync_pwrite(data, path)
+        out = np.empty_like(data)
+        h.sync_pread(out, path)
+        np.testing.assert_array_equal(out, data)
+
+    def test_async_overlap(self, tmp_path):
+        from deepspeed_tpu.ops.aio import AsyncIOHandle
+
+        h = AsyncIOHandle(block_size=1 << 16, thread_count=4)
+        arrays = [np.full((256, 256), i, np.float32) for i in range(8)]
+        reqs = [h.async_pwrite(a, str(tmp_path / f"{i}.bin"))
+                for i, a in enumerate(arrays)]
+        for r in reqs:
+            r.wait()
+        outs = [np.empty((256, 256), np.float32) for _ in range(8)]
+        reqs = [h.async_pread(o, str(tmp_path / f"{i}.bin"))
+                for i, o in enumerate(outs)]
+        for r in reqs:
+            r.wait()
+        for i, o in enumerate(outs):
+            np.testing.assert_array_equal(o, arrays[i])
+
+    def test_swapper_pytree(self, tmp_path):
+        from deepspeed_tpu.runtime.swap_tensor.partitioned_param_swapper import (
+            AsyncTensorSwapper,
+        )
+
+        tree = {"a": jnp.arange(100.0), "b": {"c": jnp.ones((10, 10))}}
+        sw = AsyncTensorSwapper(str(tmp_path / "swap"))
+        sw.swap_out("opt", tree)
+        back = sw.swap_in("opt")
+        jax.tree.map(lambda x, y: np.testing.assert_array_equal(np.asarray(x),
+                                                                np.asarray(y)),
+                     tree, back)
+        sw.cleanup()
+
+
+class TestOffloadStates:
+    @pytest.mark.parametrize("device", ["cpu", "nvme"])
+    def test_offload_reload_optimizer(self, device, tmp_path):
+        import deepspeed_tpu
+
+        from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn, model_parameters=init_mlp_params(jax.random.PRNGKey(0)),
+            config={"train_micro_batch_size_per_gpu": 4,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}}},
+            topology=topo)
+        batch = random_batch(engine.train_batch_size())
+        l0 = float(engine.train_batch(batch))
+        engine.offload_states(include=("optimizer",), device=device,
+                              nvme_path=str(tmp_path / "swap"))
+        assert engine.state.opt_state is None
+        engine.reload_states()
+        assert engine.state.opt_state is not None
+        l1 = float(engine.train_batch(batch))  # training continues seamlessly
+        assert np.isfinite(l1) and l1 < l0 + 1.0
+
+
+class TestAutotuner:
+    def test_gridsearch_finds_best(self):
+        from deepspeed_tpu.autotuning.autotuner import Autotuner
+
+        from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        tuner = Autotuner(
+            model_factory=lambda: mlp_loss_fn,
+            params_factory=lambda: init_mlp_params(jax.random.PRNGKey(0)),
+            base_config={"optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+            batch_factory=lambda n: random_batch(n),
+            topology=topo, num_steps=2, warmup_steps=1)
+        best = tuner.tune(zero_stages=(0, 1), micro_batches=(2, 4))
+        assert best is not None and best.metric_value > 0
+        cfg = tuner.best_config()
+        assert cfg["train_micro_batch_size_per_gpu"] in (2, 4)
+
+    def test_memory_estimate_scales_with_stage(self):
+        from deepspeed_tpu.autotuning.autotuner import Autotuner
+
+        tuner = Autotuner(model_factory=None, params_factory=None,
+                          base_config={}, batch_factory=None)
+        m0 = tuner.estimated_memory({"zero_optimization": {"stage": 0}}, 1000, 8)
+        m3 = tuner.estimated_memory({"zero_optimization": {"stage": 3}}, 1000, 8)
+        assert m3 < m0
