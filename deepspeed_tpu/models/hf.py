@@ -1,0 +1,170 @@
+"""HuggingFace model-family support.
+
+Reference analogues: ``module_inject/replace_policy.py`` + ``containers/``
+(BERT/GPT/LLaMA/OPT/BLOOM/Falcon/Qwen/Mistral/Mixtral policies) and the v2
+``model_implementations`` per-arch directories, plus AutoTP
+(``module_inject/auto_tp.py:192``) and ``tp_model_init``
+(deepspeed/__init__.py:369).
+
+TPU design: instead of monkey-patching torch modules, each supported HF
+architecture maps to a :class:`TransformerConfig` ("policy") and a weight
+converter that reads an HF torch ``state_dict`` (CPU torch is in the image)
+into this framework's parameter pytree.  TP then falls out of
+``partition_specs`` — the AutoTP row/col analysis is already encoded there.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+
+from ..utils.logging import logger
+from .transformer import CausalLM, TransformerConfig
+
+#: HF architecture name → config-field mapping ("policy map")
+_ARCH_POLICIES = {
+    "LlamaForCausalLM": "llama",
+    "MistralForCausalLM": "llama",
+    "Qwen2ForCausalLM": "llama",
+    "GPT2LMHeadModel": "gpt2",
+    "GPTJForCausalLM": "gptj",
+    "OPTForCausalLM": "opt",
+    "BloomForCausalLM": "bloom",
+    "FalconForCausalLM": "falcon",
+    "MixtralForCausalLM": "mixtral",
+}
+
+
+def policy_for(hf_config: Any) -> str:
+    archs = getattr(hf_config, "architectures", None) or []
+    for a in archs:
+        if a in _ARCH_POLICIES:
+            return _ARCH_POLICIES[a]
+    mt = getattr(hf_config, "model_type", "")
+    for name, fam in (("llama", "llama"), ("mistral", "llama"), ("qwen2", "llama"),
+                      ("gpt2", "gpt2"), ("opt", "opt"), ("bloom", "bloom"),
+                      ("falcon", "falcon"), ("mixtral", "mixtral")):
+        if mt == name:
+            return fam
+    raise ValueError(f"unsupported HF architecture: {archs or mt}")
+
+
+def config_from_hf(hf_config: Any, **overrides) -> TransformerConfig:
+    """HF config → TransformerConfig (the per-arch 'container' policy)."""
+    fam = policy_for(hf_config)
+    g = lambda *names, default=None: next(
+        (getattr(hf_config, n) for n in names if getattr(hf_config, n, None)
+         is not None), default)
+    hidden = g("hidden_size", "n_embd", default=768)
+    heads = g("num_attention_heads", "n_head", default=12)
+    kw = dict(
+        vocab_size=g("vocab_size", default=32000),
+        hidden_size=hidden,
+        intermediate_size=g("intermediate_size", "n_inner", default=4 * hidden),
+        num_layers=g("num_hidden_layers", "n_layer", default=12),
+        num_heads=heads,
+        num_kv_heads=g("num_key_value_heads", default=heads),
+        max_seq_len=g("max_position_embeddings", "n_positions", default=2048),
+        rope_theta=g("rope_theta", default=10000.0),
+        norm_eps=g("rms_norm_eps", "layer_norm_epsilon", default=1e-5),
+        tie_embeddings=bool(g("tie_word_embeddings", default=False)),
+    )
+    if fam in ("gpt2", "opt", "bloom"):
+        logger.warning(
+            f"{fam}: learned-positional/LayerNorm families run on the "
+            f"Llama-recipe compute path (RoPE+RMSNorm); exact-architecture "
+            f"kernels for them land with the conversion test suite")
+    kw.update(overrides)
+    return TransformerConfig(**kw)
+
+
+def from_pretrained_config(name_or_config: Any, **overrides) -> CausalLM:
+    """Build a CausalLM from an HF config object or model-name string."""
+    cfg = name_or_config
+    if isinstance(name_or_config, str):
+        from transformers import AutoConfig
+
+        cfg = AutoConfig.from_pretrained(name_or_config)
+    return CausalLM(config_from_hf(cfg, **overrides))
+
+
+# --------------------------------------------------------------------- #
+# Weight conversion (HF torch state_dict → framework pytree)
+# --------------------------------------------------------------------- #
+def convert_llama_state_dict(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict:
+    """Llama/Mistral/Qwen2 HF checkpoint → stacked-layer pytree."""
+    import jax.numpy as jnp
+
+    def t(name):
+        w = sd[name]
+        if hasattr(w, "numpy"):
+            w = w.float().numpy()
+        return np.asarray(w, np.float32)
+
+    L = cfg.num_layers
+
+    def stack(fmt, transpose=True):
+        ws = [t(fmt.format(i)) for i in range(L)]
+        arr = np.stack([w.T if transpose else w for w in ws])
+        return jnp.asarray(arr)
+
+    params = {
+        "embed": {"embedding": jnp.asarray(t("model.embed_tokens.weight"))},
+        "layers": {
+            "attn_norm": {"scale": stack("model.layers.{}.input_layernorm.weight",
+                                         transpose=False)},
+            "q_proj": {"kernel": stack("model.layers.{}.self_attn.q_proj.weight")},
+            "k_proj": {"kernel": stack("model.layers.{}.self_attn.k_proj.weight")},
+            "v_proj": {"kernel": stack("model.layers.{}.self_attn.v_proj.weight")},
+            "o_proj": {"kernel": stack("model.layers.{}.self_attn.o_proj.weight")},
+            "mlp_norm": {"scale": stack("model.layers.{}.post_attention_layernorm.weight",
+                                        transpose=False)},
+            "gate_proj": {"kernel": stack("model.layers.{}.mlp.gate_proj.weight")},
+            "up_proj": {"kernel": stack("model.layers.{}.mlp.up_proj.weight")},
+            "down_proj": {"kernel": stack("model.layers.{}.mlp.down_proj.weight")},
+        },
+        "norm_f": {"scale": jnp.asarray(t("model.norm.weight"))},
+    }
+    if not cfg.tie_embeddings and "lm_head.weight" in sd:
+        params["lm_head"] = {"kernel": jnp.asarray(t("lm_head.weight").T)}
+    return params
+
+
+def load_hf_model(model_name_or_path: str, dtype=None, **overrides):
+    """Full load: config + weights → (CausalLM, params).
+
+    Works offline when ``model_name_or_path`` is a local directory with
+    config.json + pytorch_model.bin / safetensors.
+    """
+    from transformers import AutoConfig, AutoModelForCausalLM
+
+    hf_cfg = AutoConfig.from_pretrained(model_name_or_path)
+    model = from_pretrained_config(hf_cfg, **overrides)
+    hf_model = AutoModelForCausalLM.from_pretrained(model_name_or_path,
+                                                    torch_dtype="float32")
+    params = convert_llama_state_dict(hf_model.state_dict(), model.config)
+    if dtype is not None:
+        import jax
+
+        params = jax.tree.map(lambda x: x.astype(dtype), params)
+    return model, params
+
+
+def tp_model_init(model: CausalLM, params: Any, tp_size: int, dtype=None):
+    """Reference: deepspeed.tp_model_init (deepspeed/__init__.py:369) +
+    TpTrainingManager (runtime/tensor_parallel/tp_manager.py:12): place the
+    model's params TP-sharded for training."""
+    import jax
+    from jax.sharding import NamedSharding
+
+    from ..runtime.topology import TopologyConfig, get_topology, initialize_mesh
+
+    topo = get_topology()
+    if topo.get_tensor_parallel_world_size() != tp_size:
+        topo = initialize_mesh(TopologyConfig(tensor=tp_size), force=True)
+    specs = model.partition_specs
+    placed = jax.tree.map(
+        lambda p, s: jax.device_put(
+            p if dtype is None else p.astype(dtype), NamedSharding(topo.mesh, s)),
+        params, specs, is_leaf=lambda x: hasattr(x, "ndim"))
+    return model, placed

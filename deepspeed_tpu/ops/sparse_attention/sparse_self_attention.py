@@ -1,0 +1,63 @@
+"""Sparse self-attention (reference: deepspeed/ops/sparse_attention/
+sparse_self_attention.py + bert_sparse_self_attention.py — Triton block-sparse
+matmul/softmax).
+
+TPU implementation: the block layout expands to a token-level mask consumed by
+masked attention.  XLA's fusion makes the masked path competitive at moderate
+sparsity; a Pallas kernel that *skips* masked blocks (grid over layout-true
+blocks via scalar prefetch) is the planned upgrade for long sequences.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from .sparsity_config import DenseSparsityConfig, SparsityConfig
+
+
+class SparseSelfAttention:
+    def __init__(self, sparsity_config: Optional[SparsityConfig] = None,
+                 key_padding_mask_mode: str = "add", attn_mask_mode: str = "mul"):
+        self.sparsity_config = sparsity_config or DenseSparsityConfig(num_heads=1)
+        self.key_padding_mask_mode = key_padding_mask_mode
+        self.attn_mask_mode = attn_mask_mode
+        self._mask_cache = {}
+
+    def token_mask(self, seq_len: int) -> jnp.ndarray:
+        """[heads, S, S] bool mask expanded from the block layout."""
+        if seq_len not in self._mask_cache:
+            layout = self.sparsity_config.make_layout(seq_len)   # [H, n, n]
+            b = self.sparsity_config.block
+            mask = np.kron(layout, np.ones((b, b), dtype=bool))
+            self._mask_cache[seq_len] = jnp.asarray(mask)
+        return self._mask_cache[seq_len]
+
+    def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
+                 attn_mask=None):
+        """q/k/v: [B, H, S, hd] (reference layout). Returns [B, H, S, hd]."""
+        B, H, S, hd = query.shape
+        mask = self.token_mask(S)                                # [Hl, S, S]
+        if mask.shape[0] == 1:
+            mask = jnp.broadcast_to(mask, (H, S, S))
+        scores = jnp.einsum("bhqd,bhkd->bhqk", query, key) / jnp.sqrt(
+            jnp.asarray(hd, query.dtype))
+        if rpe is not None:
+            scores = scores + rpe
+        neg = jnp.asarray(jnp.finfo(jnp.float32).min, scores.dtype)
+        scores = jnp.where(mask[None], scores, neg)
+        if key_padding_mask is not None:
+            pad = key_padding_mask[:, None, None, :]
+            scores = scores + pad if self.key_padding_mask_mode == "add" else \
+                jnp.where(pad.astype(bool), scores, neg)
+        if attn_mask is not None:
+            scores = scores * attn_mask if self.attn_mask_mode == "mul" else \
+                scores + attn_mask
+        probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(query.dtype)
+        return jnp.einsum("bhqk,bhkd->bhqd", probs, value)
+
+
+class BertSparseSelfAttention(SparseSelfAttention):
+    """Reference class alias (bert_sparse_self_attention.py)."""
