@@ -1,0 +1,79 @@
+"""Environment report (reference: deepspeed/env_report.py, the ``ds_report``
+CLI): framework versions, device inventory, op/kernel availability."""
+from __future__ import annotations
+
+import importlib
+import shutil
+import sys
+
+GREEN_OK = "\033[92m[OKAY]\033[0m"
+RED_FAIL = "\033[91m[FAIL]\033[0m"
+YELLOW_NO = "\033[93m[NO]\033[0m"
+
+
+def _try_version(mod: str) -> str:
+    try:
+        m = importlib.import_module(mod)
+        return getattr(m, "__version__", "unknown")
+    except Exception:
+        return ""
+
+
+def op_report() -> list:
+    """Kernel/op availability (reference op compatibility table)."""
+    rows = []
+    try:
+        import jax
+
+        on_tpu = jax.default_backend() == "tpu"
+    except Exception:
+        on_tpu = False
+    rows.append(("pallas flash attention", True, on_tpu))
+    rows.append(("pallas fused adam/lion", True, on_tpu))
+    rows.append(("pallas int8/int4 quantizer", True, on_tpu))
+    try:
+        from .ops.aio import aio_available
+
+        rows.append(("native async-io (C++)", aio_available(), True))
+    except Exception:
+        rows.append(("native async-io (C++)", False, False))
+    return rows
+
+
+def main(hide_operator_status: bool = False, hide_errors_and_warnings: bool = False):
+    import deepspeed_tpu
+
+    lines = []
+    lines.append("-" * 70)
+    lines.append("DeepSpeed-TPU C++/Pallas op report")
+    lines.append("-" * 70)
+    if not hide_operator_status:
+        for name, installed, compatible in op_report():
+            status = GREEN_OK if installed else RED_FAIL
+            compat = GREEN_OK if compatible else YELLOW_NO
+            lines.append(f"{name:.<40} installed {status} compatible {compat}")
+    lines.append("-" * 70)
+    lines.append("General environment:")
+    lines.append(f"deepspeed_tpu version ......... {deepspeed_tpu.__version__}")
+    for mod in ("jax", "jaxlib", "flax", "optax", "orbax.checkpoint", "numpy"):
+        v = _try_version(mod)
+        lines.append(f"{mod:.<30} {v or 'not installed'}")
+    lines.append(f"python version ................ {sys.version.split()[0]}")
+    lines.append(f"g++ ........................... "
+                 f"{'found: ' + shutil.which('g++') if shutil.which('g++') else 'missing'}")
+    try:
+        import jax
+
+        devs = jax.devices()
+        lines.append(f"devices ....................... {[str(d) for d in devs]}")
+        lines.append(f"default backend ............... {jax.default_backend()}")
+    except Exception as e:
+        if not hide_errors_and_warnings:
+            lines.append(f"device probe failed: {e}")
+    report = "\n".join(lines)
+    print(report)
+    return report
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,79 @@
+"""Random layerwise token dropping (random-LTD).
+
+Reference: ``runtime/data_pipeline/data_routing/basic_layer.py:14`` with ⚙
+CUDA gather/scatter kernels (csrc/random_ltd/, 724 LoC).
+
+TPU version: token selection is a ``jax.random.choice`` of kept indices; the
+gather/scatter the reference needs custom kernels for are single XLA ``take``
+/ ``scatter`` ops (already fused).  The layer wraps any sequence-to-sequence
+layer fn: a random subset of tokens goes through the layer, dropped tokens
+bypass it (identity), and the schedule grows the kept count to full length
+over training (reference RandomLTDScheduler semantics).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class RandomLTDScheduler:
+    """Kept-token schedule (reference data_routing/scheduler.py)."""
+
+    def __init__(self, min_value: int, max_value: int, schedule_steps: int,
+                 schedule_type: str = "fixed_linear"):
+        self.min_value = min_value
+        self.max_value = max_value
+        self.schedule_steps = schedule_steps
+        self.schedule_type = schedule_type
+
+    def get_value(self, global_step: int) -> int:
+        frac = min(global_step / max(self.schedule_steps, 1), 1.0)
+        val = self.min_value + frac * (self.max_value - self.min_value)
+        return int(min(max(val, self.min_value), self.max_value))
+
+    def state_dict(self):
+        return {"min": self.min_value, "max": self.max_value,
+                "steps": self.schedule_steps}
+
+
+def random_ltd_layer(layer_fn: Callable, x: jnp.ndarray, keep: int,
+                     rng: jax.Array, *layer_args, **layer_kwargs) -> jnp.ndarray:
+    """Apply ``layer_fn`` to ``keep`` randomly selected tokens of x [B, S, D];
+    other tokens pass through unchanged (reference gpt-style random-LTD)."""
+    B, S, D = x.shape
+    keep = min(keep, S)
+    idx = jax.vmap(lambda k: jax.random.choice(k, S, (keep,), replace=False))(
+        jax.random.split(rng, B))                       # [B, keep]
+    idx = jnp.sort(idx, axis=1)                         # keep causal order
+    gathered = jnp.take_along_axis(x, idx[..., None], axis=1)   # [B, keep, D]
+    processed = layer_fn(gathered, *layer_args, **layer_kwargs)
+    out = x
+    return _scatter_tokens(out, processed, idx)
+
+
+def _scatter_tokens(base: jnp.ndarray, values: jnp.ndarray,
+                    idx: jnp.ndarray) -> jnp.ndarray:
+    """base [B,S,D] ← values [B,k,D] at positions idx [B,k] (⚙ token_scatter
+    equivalent — one XLA scatter)."""
+    B = base.shape[0]
+
+    def per_batch(b, v, i):
+        return b.at[i].set(v)
+
+    return jax.vmap(per_batch)(base, values, idx)
+
+
+class RandomLayerTokenDrop:
+    """Module-style wrapper (reference class name)."""
+
+    def __init__(self, layer_fn: Callable, scheduler: RandomLTDScheduler):
+        self.layer_fn = layer_fn
+        self.scheduler = scheduler
+
+    def __call__(self, x, global_step: int, rng: jax.Array, *args, **kwargs):
+        keep = self.scheduler.get_value(global_step)
+        if keep >= x.shape[1]:
+            return self.layer_fn(x, *args, **kwargs)
+        return random_ltd_layer(self.layer_fn, x, keep, rng, *args, **kwargs)

@@ -1,0 +1,84 @@
+"""Curriculum-aware data sampler (reference: runtime/data_pipeline/
+data_sampling/data_sampler.py:36 ``DeepSpeedDataSampler``).
+
+Yields index batches whose difficulty (per a metric-value array, e.g. sequence
+length) follows the curriculum schedule: at difficulty d only samples with
+metric ≤ d are eligible.  Deterministic across processes from a shared seed,
+so every data-parallel rank derives its own shard of the same global batch —
+no sampler communication (the reference broadcasts from rank 0).
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+from .curriculum_scheduler import CurriculumScheduler
+
+
+class DeepSpeedDataSampler:
+    def __init__(self, total_samples: int, micro_batch_size: int,
+                 data_parallel_rank: int, data_parallel_size: int,
+                 curriculum: Optional[CurriculumScheduler] = None,
+                 difficulty_values: Optional[np.ndarray] = None,
+                 gradient_accumulation_steps: int = 1,
+                 drop_last: bool = True, seed: int = 1234):
+        self.total_samples = total_samples
+        self.micro_batch_size = micro_batch_size
+        self.dp_rank = data_parallel_rank
+        self.dp_size = data_parallel_size
+        self.curriculum = curriculum
+        self.difficulty_values = difficulty_values
+        self.gas = gradient_accumulation_steps
+        self.drop_last = drop_last
+        self.seed = seed
+        self.epoch = 0
+        self.consumed_samples = 0
+        self.global_batch_size = micro_batch_size * data_parallel_size * \
+            gradient_accumulation_steps
+
+    def set_epoch(self, epoch: int):
+        self.epoch = epoch
+
+    def _eligible(self, step: int) -> np.ndarray:
+        if self.curriculum is None or self.difficulty_values is None:
+            return np.arange(self.total_samples)
+        difficulty = self.curriculum.update_difficulty(step)
+        idx = np.nonzero(self.difficulty_values <= difficulty)[0]
+        return idx if len(idx) >= self.global_batch_size else \
+            np.arange(self.total_samples)
+
+    def __iter__(self) -> Iterator[List[int]]:
+        rng = np.random.default_rng(self.seed + self.epoch)
+        step = 0
+        order = None
+        cursor = 0
+        while True:
+            eligible = self._eligible(step)
+            if order is None or cursor + self.global_batch_size > len(order):
+                order = rng.permutation(eligible)
+                cursor = 0
+                if len(order) < self.global_batch_size:
+                    if self.drop_last:
+                        return
+                    order = np.resize(order, self.global_batch_size)
+            batch = order[cursor:cursor + self.global_batch_size]
+            cursor += self.global_batch_size
+            # this rank's shard, preserving micro-batch structure
+            shard = batch.reshape(self.gas, self.dp_size, self.micro_batch_size)[
+                :, self.dp_rank, :].reshape(-1)
+            self.consumed_samples += self.global_batch_size
+            step += 1
+            yield shard.tolist()
+            if self.consumed_samples >= self.total_samples * max(self.epoch + 1, 1):
+                return
+
+    def state_dict(self) -> Dict:
+        return {"epoch": self.epoch, "consumed_samples": self.consumed_samples,
+                "curriculum": self.curriculum.state_dict() if self.curriculum else None}
+
+    def load_state_dict(self, sd: Dict):
+        self.epoch = sd["epoch"]
+        self.consumed_samples = sd["consumed_samples"]
+        if sd.get("curriculum") and self.curriculum:
+            self.curriculum.load_state_dict(sd["curriculum"])

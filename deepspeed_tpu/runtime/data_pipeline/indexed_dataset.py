@@ -1,0 +1,85 @@
+"""Memory-mapped token dataset (reference: runtime/data_pipeline/data_sampling/
+indexed_dataset.py:369 ``MMapIndexedDataset`` — Megatron binary format).
+
+Format (self-describing, little-endian):
+  <dataset>.idx : magic 'DSTPUIDX' | version u32 | dtype-code u8 |
+                  n_docs u64 | lengths u32[n_docs] | offsets u64[n_docs]
+  <dataset>.bin : concatenated token arrays
+
+Reads are zero-copy ``np.memmap`` slices — the TPU host feeds batches without
+materializing the corpus, same property as the reference's mmap reader.
+"""
+from __future__ import annotations
+
+import os
+import struct
+from typing import List, Sequence, Union
+
+import numpy as np
+
+_MAGIC = b"DSTPUIDX"
+_VERSION = 1
+_DTYPES = {1: np.uint8, 2: np.int8, 3: np.int16, 4: np.int32, 5: np.int64,
+           6: np.float32, 7: np.float64, 8: np.uint16}
+_DTYPE_CODES = {np.dtype(v): k for k, v in _DTYPES.items()}
+
+
+class MMapIndexedDatasetBuilder:
+    def __init__(self, out_path_prefix: str, dtype=np.int32):
+        self.prefix = out_path_prefix
+        self.dtype = np.dtype(dtype)
+        self._bin = open(out_path_prefix + ".bin", "wb")
+        self._lengths: List[int] = []
+        self._offsets: List[int] = []
+        self._cursor = 0
+
+    def add_item(self, tokens: Sequence[int]) -> None:
+        arr = np.asarray(tokens, dtype=self.dtype)
+        self._bin.write(arr.tobytes())
+        self._lengths.append(len(arr))
+        self._offsets.append(self._cursor)
+        self._cursor += arr.nbytes
+
+    def finalize(self) -> None:
+        self._bin.close()
+        with open(self.prefix + ".idx", "wb") as f:
+            f.write(_MAGIC)
+            f.write(struct.pack("<I", _VERSION))
+            f.write(struct.pack("<B", _DTYPE_CODES[self.dtype]))
+            f.write(struct.pack("<Q", len(self._lengths)))
+            f.write(np.asarray(self._lengths, np.uint32).tobytes())
+            f.write(np.asarray(self._offsets, np.uint64).tobytes())
+
+
+class MMapIndexedDataset:
+    def __init__(self, path_prefix: str):
+        idx_path = path_prefix + ".idx"
+        with open(idx_path, "rb") as f:
+            assert f.read(8) == _MAGIC, f"{idx_path}: bad magic"
+            (version,) = struct.unpack("<I", f.read(4))
+            assert version == _VERSION
+            (code,) = struct.unpack("<B", f.read(1))
+            self.dtype = np.dtype(_DTYPES[code])
+            (n,) = struct.unpack("<Q", f.read(8))
+            self.lengths = np.frombuffer(f.read(4 * n), np.uint32)
+            self.offsets = np.frombuffer(f.read(8 * n), np.uint64)
+        self._data = np.memmap(path_prefix + ".bin", dtype=self.dtype, mode="r")
+        self._itemsize = self.dtype.itemsize
+
+    def __len__(self) -> int:
+        return len(self.lengths)
+
+    def __getitem__(self, idx: Union[int, slice]) -> np.ndarray:
+        if isinstance(idx, slice):
+            return [self[i] for i in range(*idx.indices(len(self)))]
+        start = int(self.offsets[idx]) // self._itemsize
+        return np.asarray(self._data[start:start + int(self.lengths[idx])])
+
+    def get(self, idx: int, offset: int = 0, length: int = None) -> np.ndarray:
+        full = self[idx]
+        end = None if length is None else offset + length
+        return full[offset:end]
+
+    @property
+    def sizes(self) -> np.ndarray:
+        return self.lengths

@@ -1,0 +1,98 @@
+"""Domino: tensor-parallel communication/compute overlap.
+
+Reference: ``runtime/domino/transformer.py:453`` (``DominoTransformer``,
+``DominoTransformerLayer`` :228) — batch split into row μ-batches whose TP
+allreduces run async (handles stashed :55-101) while the other μ-batch's
+independent GEMMs execute.
+
+TPU mapping: XLA's latency-hiding scheduler already overlaps collectives with
+independent compute, but it can only overlap what the dataflow graph makes
+independent.  Domino's contribution is exactly that graph shape: splitting the
+batch into two halves creates two independent chains whose psum of half A
+overlaps half B's GEMMs.  This module reproduces that structure; the async
+streams/handles of the reference are XLA's scheduler.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...models.transformer import apply_rope, rms_norm, rope_tables
+from ..topology import TENSOR, get_topology
+
+
+def _tp_psum(x):
+    topo = get_topology()
+    if topo.dims.get(TENSOR, 1) > 1:
+        return jax.lax.psum(x, TENSOR)
+    return x
+
+
+class DominoTransformerLayer:
+    """One TP transformer layer executing in two interleaved μ-batches.
+
+    Use inside shard_map with the "tensor" axis bound and per-rank TP shards
+    of the layer params (column-parallel qkv/gate/up, row-parallel o/down).
+    """
+
+    def __init__(self, cfg, micro_splits: int = 2):
+        self.cfg = cfg
+        self.micro_splits = micro_splits
+
+    def __call__(self, lp: Dict, x: jnp.ndarray) -> jnp.ndarray:
+        cfg = self.cfg
+        B = x.shape[0]
+        n = self.micro_splits
+        assert B % n == 0, f"batch {B} must divide into {n} domino μ-batches"
+        halves = jnp.split(x, n, axis=0)
+
+        tp = get_topology().dims.get(TENSOR, 1)
+        H_loc = cfg.num_heads // tp
+        KV_loc = max(cfg.num_kv_heads // tp, 1)
+
+        def attn_part(h):
+            b, S = h.shape[0], h.shape[1]
+            hn = rms_norm(h, lp["attn_norm"]["scale"], cfg.norm_eps)
+            q = (hn @ lp["q_proj"]["kernel"]).reshape(b, S, H_loc, cfg.head_dim)
+            k = (hn @ lp["k_proj"]["kernel"]).reshape(b, S, KV_loc, cfg.head_dim)
+            v = (hn @ lp["v_proj"]["kernel"]).reshape(b, S, KV_loc, cfg.head_dim)
+            cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
+            q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
+            from ...models.transformer import _xla_attention
+
+            o = _xla_attention(q, k, v, causal=True)
+            return o.reshape(b, S, -1) @ lp["o_proj"]["kernel"]
+
+        def mlp_part(h):
+            hn = rms_norm(h, lp["mlp_norm"]["scale"], cfg.norm_eps)
+            gate = jax.nn.silu(hn @ lp["gate_proj"]["kernel"])
+            up = hn @ lp["up_proj"]["kernel"]
+            return (gate * up) @ lp["down_proj"]["kernel"]
+
+        # Interleave: compute attn partials for every μ-batch first, THEN
+        # reduce — the psum of μ-batch i is independent of μ-batch j's GEMMs,
+        # which is the overlap window XLA's scheduler exploits.
+        attn_partials = [attn_part(h) for h in halves]
+        attn_reduced = [_tp_psum(p) for p in attn_partials]
+        post_attn = [h + r for h, r in zip(halves, attn_reduced)]
+        mlp_partials = [mlp_part(h) for h in post_attn]
+        mlp_reduced = [_tp_psum(p) for p in mlp_partials]
+        out = [h + r for h, r in zip(post_attn, mlp_reduced)]
+        return jnp.concatenate(out, axis=0)
+
+
+class DominoTransformer:
+    """Stack of Domino layers (reference :453)."""
+
+    def __init__(self, cfg, micro_splits: int = 2):
+        self.cfg = cfg
+        self.layer = DominoTransformerLayer(cfg, micro_splits)
+
+    def __call__(self, layers_params: Dict, x: jnp.ndarray) -> jnp.ndarray:
+        def body(h, lp):
+            return self.layer(lp, h), None
+
+        out, _ = jax.lax.scan(body, x, layers_params)
+        return out

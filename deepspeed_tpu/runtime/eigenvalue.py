@@ -1,0 +1,71 @@
+"""Hessian top-eigenvalue estimation via power iteration (reference:
+runtime/eigenvalue.py:13 — used by MoQ to set per-layer quantization
+schedules from curvature).
+
+JAX makes this clean: Hessian-vector products are ``jax.jvp`` over
+``jax.grad`` (forward-over-reverse), no double-backward graph bookkeeping.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class Eigenvalue:
+    def __init__(self, verbose: bool = False, max_iter: int = 100,
+                 tol: float = 1e-2, stability: float = 1e-6,
+                 gas_boundary_resolution: int = 1):
+        self.verbose = verbose
+        self.max_iter = max_iter
+        self.tol = tol
+        self.stability = stability
+        self.gas_boundary_resolution = gas_boundary_resolution
+
+    def _normalize(self, tree):
+        norm = jnp.sqrt(sum(jnp.sum(jnp.square(l)) for l in jax.tree.leaves(tree)))
+        norm = jnp.maximum(norm, self.stability)
+        return jax.tree.map(lambda l: l / norm, tree), norm
+
+    def compute_eigenvalue(self, loss_fn: Callable, params: Any,
+                           rng: jax.Array) -> Tuple[jnp.ndarray, Any]:
+        """Top Hessian eigenvalue of ``loss_fn(params)`` by power iteration.
+
+        Returns (eigenvalue, eigenvector-pytree).
+        """
+        grad_fn = jax.grad(loss_fn)
+
+        def hvp(v):
+            return jax.jvp(grad_fn, (params,), (v,))[1]
+
+        v = jax.tree.map(lambda p, k: jax.random.normal(k, p.shape),
+                         params,
+                         jax.tree.unflatten(jax.tree.structure(params),
+                                            list(jax.random.split(
+                                                rng, len(jax.tree.leaves(params))))))
+        v, _ = self._normalize(v)
+        eig = jnp.zeros(())
+        for _ in range(self.max_iter):
+            hv = hvp(v)
+            new_eig = sum(jnp.sum(a * b) for a, b in
+                          zip(jax.tree.leaves(v), jax.tree.leaves(hv)))
+            v, _ = self._normalize(hv)
+            if bool(jnp.abs(new_eig - eig) <= self.tol * jnp.abs(new_eig) + 1e-12):
+                eig = new_eig
+                break
+            eig = new_eig
+        return eig, v
+
+    def layerwise_eigenvalues(self, loss_fn: Callable, params: Dict,
+                              rng: jax.Array) -> Dict[str, jnp.ndarray]:
+        """Per-top-level-layer eigenvalue (the MoQ schedule input)."""
+        out = {}
+        for name in params:
+            def sub_loss(sub):
+                merged = {**params, name: sub}
+                return loss_fn(merged)
+
+            eig, _ = self.compute_eigenvalue(sub_loss, params[name], rng)
+            out[name] = eig
+        return out

@@ -1,0 +1,43 @@
+"""Progressive layer dropping (reference: runtime/progressive_layer_drop.py:10).
+
+Keep probability follows theta(t) = (1 - theta) * exp(-gamma * t) + theta;
+during training each transformer layer is executed with probability p_l that
+decays with depth (deeper layers dropped more).  In JAX the per-layer bernoulli
+gate lives inside the scanned layer fn, so the whole schedule stays jittable.
+"""
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+
+
+class ProgressiveLayerDrop:
+    def __init__(self, theta: float = 0.5, gamma: float = 0.001):
+        self.theta = theta
+        self.gamma = gamma
+
+    def get_theta(self, global_step) -> jnp.ndarray:
+        step = jnp.asarray(global_step, jnp.float32)
+        return (1.0 - self.theta) * jnp.exp(-self.gamma * step) + self.theta
+
+    def get_state(self, global_step):
+        return {"progressive_layer_drop": True, "pld_theta": self.get_theta(global_step)}
+
+    def layer_keep_probs(self, num_layers: int, global_step) -> jnp.ndarray:
+        """p_l = 1 - l/L * (1 - theta(t)) — deeper layers dropped more."""
+        theta = self.get_theta(global_step)
+        depth_frac = jnp.arange(1, num_layers + 1, dtype=jnp.float32) / num_layers
+        return 1.0 - depth_frac * (1.0 - theta)
+
+
+def pld_layer(layer_fn: Callable, x, keep_prob, rng: jax.Array,
+              *args, **kwargs):
+    """Stochastic-depth execution: with prob keep_prob run the layer (output
+    scaled 1/p at train time), else identity."""
+    keep = jax.random.bernoulli(rng, keep_prob)
+    out = layer_fn(x, *args, **kwargs)
+    scaled = x + (out - x) / jnp.maximum(keep_prob, 1e-3)
+    return jnp.where(keep, scaled, x)

@@ -1,0 +1,170 @@
+"""FPDT / Ulysses-Offload: chunked long-context attention with host offload.
+
+Reference: ``deepspeed/sequence/fpdt_layer.py`` — online-softmax chunk merge
+(:40-78), double-buffered host-offloaded KV chunks (SequenceChunk :462,
+_FPDTGPUOffloadingAttentionImpl_ :510), chunked MLP (:1056) and chunked logits
+loss (:1137); enables 2M-token contexts on 4 GPUs.
+
+TPU design: queries are processed in chunks with ``lax.scan``; the KV history
+a chunk attends to is accumulated K/V stacked per chunk.  With
+``offload=True`` the KV history lives in pinned host memory
+(``jax.device_put`` with host memory-kind sharding) and each scan step fetches
+one chunk back — HBM holds only O(chunk) KV, giving the reference's
+memory-vs-bandwidth trade on TPU (host DMA instead of cudaMemcpyAsync).
+"""
+from __future__ import annotations
+
+import math
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..models.transformer import _xla_attention
+
+
+def _chunk_partials(q, k, v, scale, mask):
+    """(unnormalized out, rowmax m, rowsum l) for one q-chunk vs one kv-chunk."""
+    s = jnp.einsum("bqhd,bkhd->bqhk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = jnp.where(mask[None, :, None, :], s, -1e30)
+    m = jnp.max(s, axis=-1)
+    p = jnp.exp(s - m[..., None])
+    p = jnp.where(jnp.isfinite(m)[..., None], p, 0.0)
+    l = jnp.sum(p, axis=-1)
+    out = jnp.einsum("bqhk,bkhd->bqhd", p, v.astype(jnp.float32))
+    return out, m, l
+
+
+def _merge(acc, m_acc, l_acc, out, m, l):
+    """FPDT online-softmax merge (reference :40-78)."""
+    m_new = jnp.maximum(m_acc, m)
+    a1 = jnp.exp(m_acc - m_new)
+    a2 = jnp.exp(m - m_new)
+    return acc * a1[..., None] + out * a2[..., None], m_new, l_acc * a1 + l * a2
+
+
+def chunked_attention(q, k, v, chunk_size: int, causal: bool = True,
+                      scale: Optional[float] = None,
+                      offload: bool = False) -> jnp.ndarray:
+    """Attention over [B, S, H, hd] computed q-chunk × kv-chunk with O(S·c)
+    peak score memory instead of O(S²).
+
+    ``offload=True`` parks the K/V history in host memory and streams chunks
+    back per step (Ulysses-Offload's double-buffered host KV).
+    """
+    B, S, H, hd = q.shape
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    KV = k.shape[2]
+    if KV != H:
+        k = jnp.repeat(k, H // KV, axis=2)
+        v = jnp.repeat(v, H // KV, axis=2)
+    assert S % chunk_size == 0, "S must divide by chunk_size (pad upstream)"
+    n = S // chunk_size
+
+    kc = k.reshape(B, n, chunk_size, H, hd).transpose(1, 0, 2, 3, 4)  # [n,B,c,H,hd]
+    vc = v.reshape(B, n, chunk_size, H, hd).transpose(1, 0, 2, 3, 4)
+    if offload:
+        host = _host_device()
+        if host is not None:
+            kc = jax.device_put(kc, host)
+            vc = jax.device_put(vc, host)
+
+    qc = q.reshape(B, n, chunk_size, H, hd).transpose(1, 0, 2, 3, 4)
+
+    qi = jax.lax.broadcasted_iota(jnp.int32, (chunk_size, chunk_size), 0)
+    ki = jax.lax.broadcasted_iota(jnp.int32, (chunk_size, chunk_size), 1)
+    diag_mask = qi >= ki
+
+    def q_chunk_body(qi_idx, q_chunk):
+        acc = jnp.zeros((B, chunk_size, H, hd), jnp.float32)
+        m_acc = jnp.full((B, chunk_size, H), -1e30, jnp.float32)
+        l_acc = jnp.zeros((B, chunk_size, H), jnp.float32)
+
+        def kv_step(carry, ki_idx):
+            acc, m_acc, l_acc = carry
+            # dynamic_index of a pinned_host-resident array lowers to a host→
+            # device DMA of exactly one chunk — the double-buffered fetch.
+            k_t = jax.lax.dynamic_index_in_dim(kc, ki_idx, 0, keepdims=False)
+            v_t = jax.lax.dynamic_index_in_dim(vc, ki_idx, 0, keepdims=False)
+            if causal:
+                mask = jnp.where(ki_idx < qi_idx,
+                                 jnp.ones_like(diag_mask),
+                                 jnp.where(ki_idx == qi_idx, diag_mask,
+                                           jnp.zeros_like(diag_mask)))
+            else:
+                mask = None
+            out, m, l = _chunk_partials(q_chunk, k_t, v_t, scale, mask)
+            acc, m_acc, l_acc = _merge(acc, m_acc, l_acc, out, m, l)
+            return (acc, m_acc, l_acc), None
+
+        (acc, m_acc, l_acc), _ = jax.lax.scan(
+            kv_step, (acc, m_acc, l_acc), jnp.arange(n))
+        return (acc / jnp.maximum(l_acc, 1e-30)[..., None]).astype(q.dtype)
+
+    outs = jax.lax.map(lambda args: q_chunk_body(*args),
+                       (jnp.arange(n), qc))
+    return outs.transpose(1, 0, 2, 3, 4).reshape(B, S, H, hd)
+
+
+def _host_device():
+    """Pinned-host sharding for KV parking (None if unsupported)."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        from jax.sharding import SingleDeviceSharding
+
+        return SingleDeviceSharding(dev, memory_kind="pinned_host")
+    except Exception:
+        return None
+
+
+def chunked_mlp(mlp_fn, x: jnp.ndarray, chunk_size: int) -> jnp.ndarray:
+    """Chunked FFN (reference :1056): process [B, S, D] sequence-chunk-wise."""
+    B, S, D = x.shape
+    assert S % chunk_size == 0
+    n = S // chunk_size
+    xc = x.reshape(B, n, chunk_size, D).transpose(1, 0, 2, 3)
+    out = jax.lax.map(mlp_fn, xc)
+    return out.transpose(1, 0, 2, 3).reshape(B, S, -1)
+
+
+def chunked_lm_loss(hidden: jnp.ndarray, labels: jnp.ndarray,
+                    lm_head: jnp.ndarray, chunk_size: int,
+                    ignore_index: int = -100) -> jnp.ndarray:
+    """Chunked logits+loss (reference :1137): never materializes [B, S, V]."""
+    B, S, D = hidden.shape
+    assert S % chunk_size == 0
+    n = S // chunk_size
+    hc = hidden.reshape(B, n, chunk_size, D).transpose(1, 0, 2, 3)
+    lc = labels.reshape(B, n, chunk_size).transpose(1, 0, 2)
+
+    def chunk_loss(args):
+        h, lab = args
+        logits = (h @ lm_head).astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        valid = lab != ignore_index
+        safe = jnp.where(valid, lab, 0)
+        tok = jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        return jnp.sum(-tok * valid), jnp.sum(valid)
+
+    sums, counts = jax.lax.map(chunk_loss, (hc, lc))
+    return jnp.sum(sums) / jnp.maximum(jnp.sum(counts), 1)
+
+
+class FPDT_Attention:
+    """Reference class name (fpdt_layer.py:971)."""
+
+    def __init__(self, chunk_size: int = 1024, causal: bool = True,
+                 offload: bool = True):
+        self.chunk_size = chunk_size
+        self.causal = causal
+        self.offload = offload
+
+    def __call__(self, q, k, v):
+        return chunked_attention(q, k, v, self.chunk_size, self.causal,
+                                 offload=self.offload)

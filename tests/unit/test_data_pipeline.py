@@ -1,0 +1,146 @@
+"""Data-efficiency pipeline tests (reference: tests/unit/runtime/
+test_data_efficiency.py, data_sampling tests)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+    CurriculumScheduler,
+)
+from deepspeed_tpu.runtime.data_pipeline.data_sampler import DeepSpeedDataSampler
+from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
+    MMapIndexedDataset,
+    MMapIndexedDatasetBuilder,
+)
+
+
+class TestDataSampler:
+    def test_dp_shards_are_disjoint_and_cover(self):
+        samplers = [DeepSpeedDataSampler(
+            total_samples=64, micro_batch_size=2, data_parallel_rank=r,
+            data_parallel_size=4, gradient_accumulation_steps=1, seed=7)
+            for r in range(4)]
+        batches = [next(iter(s)) for s in samplers]
+        flat = [i for b in batches for i in b]
+        assert len(flat) == len(set(flat)) == 8  # disjoint, global batch 8
+
+    def test_curriculum_filters_difficulty(self):
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 10,
+            "max_difficulty": 100, "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 100,
+                                "difficulty_step": 10}})
+        difficulty = np.arange(64)  # sample i has difficulty i
+        s = DeepSpeedDataSampler(
+            total_samples=64, micro_batch_size=4, data_parallel_rank=0,
+            data_parallel_size=1, curriculum=sched,
+            difficulty_values=difficulty, seed=0)
+        first = next(iter(s))
+        assert all(difficulty[i] <= 10 for i in first)
+
+    def test_state_dict_roundtrip(self):
+        s = DeepSpeedDataSampler(total_samples=16, micro_batch_size=2,
+                                 data_parallel_rank=0, data_parallel_size=1)
+        it = iter(s)
+        next(it)
+        sd = s.state_dict()
+        s2 = DeepSpeedDataSampler(total_samples=16, micro_batch_size=2,
+                                  data_parallel_rank=0, data_parallel_size=1)
+        s2.load_state_dict(sd)
+        assert s2.consumed_samples == s.consumed_samples
+
+
+class TestIndexedDataset:
+    def test_build_and_read(self, tmp_path):
+        prefix = str(tmp_path / "corpus")
+        b = MMapIndexedDatasetBuilder(prefix, dtype=np.int32)
+        docs = [[1, 2, 3], [4, 5], [6, 7, 8, 9]]
+        for d in docs:
+            b.add_item(d)
+        b.finalize()
+        ds = MMapIndexedDataset(prefix)
+        assert len(ds) == 3
+        for i, d in enumerate(docs):
+            np.testing.assert_array_equal(ds[i], d)
+        np.testing.assert_array_equal(ds.get(2, offset=1, length=2), [7, 8])
+        np.testing.assert_array_equal(ds.sizes, [3, 2, 4])
+
+    def test_uint16_dtype(self, tmp_path):
+        prefix = str(tmp_path / "c16")
+        b = MMapIndexedDatasetBuilder(prefix, dtype=np.uint16)
+        b.add_item([65535, 1])
+        b.finalize()
+        ds = MMapIndexedDataset(prefix)
+        np.testing.assert_array_equal(ds[0], [65535, 1])
+
+
+class TestRandomLTD:
+    def test_scheduler_grows(self):
+        from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (
+            RandomLTDScheduler,
+        )
+
+        sched = RandomLTDScheduler(min_value=16, max_value=64, schedule_steps=100)
+        assert sched.get_value(0) == 16
+        assert sched.get_value(100) == 64
+        assert 16 < sched.get_value(50) < 64
+
+    def test_token_drop_passthrough_semantics(self):
+        from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (
+            random_ltd_layer,
+        )
+
+        x = jnp.arange(2 * 8 * 4, dtype=jnp.float32).reshape(2, 8, 4)
+        layer = lambda t: t + 100.0
+        out = random_ltd_layer(layer, x, keep=4, rng=jax.random.PRNGKey(0))
+        # exactly 4 tokens per batch row transformed, others untouched
+        changed = np.asarray((out != x).any(axis=-1)).sum(axis=1)
+        np.testing.assert_array_equal(changed, [4, 4])
+
+    def test_full_keep_is_identity_wrapper(self):
+        from deepspeed_tpu.runtime.data_pipeline.data_routing.basic_layer import (
+            RandomLayerTokenDrop,
+            RandomLTDScheduler,
+        )
+
+        wrap = RandomLayerTokenDrop(lambda t: t * 2,
+                                    RandomLTDScheduler(4, 8, 10))
+        x = jnp.ones((1, 8, 2))
+        out = wrap(x, global_step=100, rng=jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(out), 2.0)
+
+
+class TestPLD:
+    def test_theta_decay(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import ProgressiveLayerDrop
+
+        pld = ProgressiveLayerDrop(theta=0.5, gamma=0.01)
+        assert float(pld.get_theta(0)) == pytest.approx(1.0)
+        assert float(pld.get_theta(10_000)) == pytest.approx(0.5, abs=1e-3)
+        probs = pld.layer_keep_probs(4, 10_000)
+        assert probs[0] > probs[-1]  # deeper dropped more
+
+    def test_pld_layer_modes(self):
+        from deepspeed_tpu.runtime.progressive_layer_drop import pld_layer
+
+        x = jnp.ones((2, 4))
+        out_keep = pld_layer(lambda t: t + 1, x, keep_prob=1.0,
+                             rng=jax.random.PRNGKey(0))
+        np.testing.assert_allclose(np.asarray(out_keep), 2.0)
+
+
+class TestEigenvalue:
+    def test_quadratic_eigenvalue(self):
+        from deepspeed_tpu.runtime.eigenvalue import Eigenvalue
+
+        # loss = x^T A x / 2 with A = diag(1, 5) → top eigenvalue 5
+        A = jnp.diag(jnp.asarray([1.0, 5.0]))
+
+        def loss(params):
+            x = params["x"]
+            return 0.5 * x @ A @ x
+
+        eig, _ = Eigenvalue(max_iter=200, tol=1e-5).compute_eigenvalue(
+            loss, {"x": jnp.asarray([1.0, 1.0])}, jax.random.PRNGKey(0))
+        assert float(eig) == pytest.approx(5.0, rel=1e-2)
