@@ -14,6 +14,7 @@ __version__ = "0.1.0"
 
 from . import comm  # noqa: F401
 from .accelerator import get_accelerator  # noqa: F401
+from .runtime import zero  # noqa: F401
 from .runtime.config import DeepSpeedConfig  # noqa: F401
 from .runtime.topology import TopologyConfig, initialize_mesh  # noqa: F401
 

@@ -29,7 +29,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..runtime.topology import DATA, EXPERT, SEQ, TENSOR
+from ..runtime.topology import DATA, DATA_OUTER, EXPERT, SEQ, TENSOR
 
 
 @dataclasses.dataclass(frozen=True)
@@ -194,7 +194,7 @@ def attention(q, k, v, cfg: TransformerConfig, causal=True):
 # Forward
 # --------------------------------------------------------------------- #
 def _activation_spec():
-    return P((DATA, EXPERT), SEQ, None)
+    return P((DATA_OUTER, DATA, EXPERT), SEQ, None)
 
 
 def _constrain(x, spec):

@@ -31,7 +31,7 @@ from jax.sharding import PartitionSpec as P
 
 from ...utils.logging import log_dist
 from ..engine import DeepSpeedEngine
-from ..topology import DATA, EXPERT, PIPE, SEQ, TENSOR, get_topology
+from ..topology import DATA, DATA_OUTER, EXPERT, PIPE, SEQ, TENSOR, get_topology
 
 
 def _tp_psum(x, tp: int):
@@ -53,7 +53,7 @@ def pipeline_lm_loss(params: Dict, batch: Any, cfg, topo, rng,
         return lm_loss(params, {"input_ids": tokens}, cfg, rng)
 
     mesh = topo.mesh
-    batch_axes = tuple(a for a in (DATA, EXPERT) if topo.dims[a] > 1) or None
+    batch_axes = tuple(a for a in (DATA_OUTER, DATA, EXPERT) if topo.dims[a] > 1) or None
 
     # in_specs: params per the model's pipe/TP layout; tokens over data axes.
     spec_tree = _pipeline_param_specs(params, cfg)

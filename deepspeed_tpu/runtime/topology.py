@@ -42,25 +42,30 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 PIPE = "pipe"
+DATA_OUTER = "data_outer"  # MiCS replica groups (size dp/zero_shard_size)
 DATA = "data"
 EXPERT = "expert"
 SEQ = "seq"
 TENSOR = "tensor"
 
 #: Canonical outer→inner axis order of every mesh built here.
-AXIS_ORDER: Tuple[str, ...] = (PIPE, DATA, EXPERT, SEQ, TENSOR)
+AXIS_ORDER: Tuple[str, ...] = (PIPE, DATA_OUTER, DATA, EXPERT, SEQ, TENSOR)
 
 #: DeepSpeed group name → mesh axes.
 GROUP_AXES: Dict[str, Tuple[str, ...]] = {
-    "data_parallel": (DATA, EXPERT),
+    "data_parallel": (DATA_OUTER, DATA, EXPERT),
     "expert_parallel": (EXPERT,),
-    "expert_data_parallel": (DATA,),
+    "expert_data_parallel": (DATA_OUTER, DATA),
     "sequence_parallel": (SEQ,),
-    "sequence_data_parallel": (DATA, EXPERT, SEQ),
+    "sequence_data_parallel": (DATA_OUTER, DATA, EXPERT, SEQ),
     "tensor_parallel": (TENSOR,),
     "model_parallel": (PIPE, TENSOR),
     "pipe_parallel": (PIPE,),
+    #: ZeRO shards over the INNER data axes only; with zero_shard_size set
+    #: (MiCS, runtime/zero/mics.py:64) the outer axis replicates shards and
+    #: gradient allreduce spans it (allreduce_mics_shard_grads :432).
     "zero_partition": (DATA, EXPERT, SEQ),
+    "zero_replica": (DATA_OUTER,),
     "world": AXIS_ORDER,
 }
 
@@ -137,22 +142,37 @@ class PipeModelDataParallelTopology(ProcessTopology):
 
 @dataclasses.dataclass(frozen=True)
 class TopologyConfig:
-    """Parallelism degrees; sizes not given default to 1, data absorbs the rest."""
+    """Parallelism degrees; sizes not given default to 1, data absorbs the rest.
+
+    ``zero_shard_size`` (MiCS ``mics_shard_size`` / hpZ partition size): caps
+    the ZeRO shard group — the data dimension splits into
+    (data_outer × data) with data = zero_shard_size; shards replicate across
+    data_outer.
+    """
 
     pipe: int = 1
     data: int = -1  # -1: infer from device count
     expert: int = 1
     seq: int = 1
     tensor: int = 1
+    zero_shard_size: int = -1  # -1: shard over the full data extent
 
     def resolve(self, n_devices: int) -> Dict[str, int]:
-        dims = {PIPE: self.pipe, DATA: self.data, EXPERT: self.expert, SEQ: self.seq, TENSOR: self.tensor}
-        fixed = int(np.prod([d for d in dims.values() if d > 0]))
+        dims = {PIPE: self.pipe, DATA_OUTER: 1, DATA: self.data,
+                EXPERT: self.expert, SEQ: self.seq, TENSOR: self.tensor}
+        fixed = int(np.prod([d for k, d in dims.items() if d > 0 and k != DATA]))
         if self.data == -1:
             if n_devices % fixed != 0:
                 raise ValueError(
                     f"device count {n_devices} not divisible by pipe*expert*seq*tensor={fixed}")
             dims[DATA] = n_devices // fixed
+        if self.zero_shard_size > 0:
+            if dims[DATA] % self.zero_shard_size != 0:
+                raise ValueError(
+                    f"data dim {dims[DATA]} not divisible by zero_shard_size "
+                    f"{self.zero_shard_size}")
+            dims[DATA_OUTER] = dims[DATA] // self.zero_shard_size
+            dims[DATA] = self.zero_shard_size
         total = int(np.prod(list(dims.values())))
         if total != n_devices:
             raise ValueError(f"mesh dims {dims} product {total} != device count {n_devices}")
@@ -231,7 +251,8 @@ class MeshTopology:
         """PartitionSpec for a [batch, seq, ...] input array."""
         from jax.sharding import PartitionSpec
 
-        batch_axes = tuple(a for a in (DATA, EXPERT) if self.dims[a] > 1) or (DATA,)
+        batch_axes = tuple(a for a in (DATA_OUTER, DATA, EXPERT)
+                           if self.dims[a] > 1) or (DATA,)
         seq_axis = SEQ if self.dims[SEQ] > 1 else None
         return PartitionSpec(batch_axes, seq_axis)
 

@@ -1,0 +1,93 @@
+"""ZeRO public API (reference: deepspeed.zero — Init :824, GatheredParameters
+:2121 in runtime/zero/partition_parameters.py; MiCS_Init runtime/zero/mics.py:64).
+
+On TPU the reference's parameter-stub machinery is unnecessary: ``Init`` is a
+context that makes model init produce *already-sharded* params (jit with
+out_shardings, so each device only ever materializes its shard), and
+``GatheredParameters`` temporarily re-places shards as replicated arrays.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import Any, Optional
+
+import jax
+
+from .config import DeepSpeedZeroConfig
+from .sharding import ZeroShardingPlan, shard_param_spec
+
+
+class Init:
+    """Shard-on-init context (reference zero.Init, partition_parameters.py:824).
+
+    Usage::
+
+        with zero.Init(topology=topo) as zi:
+            params = zi.materialize(lambda: model.init_params(key))
+
+    ``materialize`` compiles the init fn with sharded out_shardings, so no
+    device ever holds the full parameter set — the property the reference
+    achieves by converting params to partitioned stubs at construction.
+    """
+
+    def __init__(self, module=None, topology=None, config_dict_or_path=None,
+                 zero_stage: int = 3, param_persistence_threshold: int = 100_000,
+                 dtype=None, enabled: bool = True, mpu=None, **kw):
+        from ..topology import get_topology
+
+        self.topology = topology or get_topology()
+        self.enabled = enabled
+        self.plan = ZeroShardingPlan(
+            self.topology, zero_stage,
+            param_persistence_threshold=param_persistence_threshold)
+        self.dtype = dtype
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+    def materialize(self, init_fn, *args) -> Any:
+        if not self.enabled:
+            return init_fn(*args)
+        shapes = jax.eval_shape(init_fn, *args)
+        shardings = self.plan.param_shardings(shapes)
+        out = jax.jit(init_fn, out_shardings=shardings)(*args)
+        if self.dtype is not None:
+            out = jax.tree.map(lambda x: x.astype(self.dtype), out)
+        return out
+
+
+class MiCS_Init(Init):
+    """Reference: runtime/zero/mics.py:64 — ZeRO-3 sharded within sub-groups,
+    replicated across (build the mesh with ``zero_shard_size``)."""
+
+    def __init__(self, *args, mics_shard_size: int = -1, **kw):
+        if mics_shard_size > 0:
+            from ..topology import TopologyConfig, initialize_mesh
+
+            kw["topology"] = initialize_mesh(
+                TopologyConfig(zero_shard_size=mics_shard_size), force=True)
+        super().__init__(*args, **kw)
+
+
+@contextlib.contextmanager
+def GatheredParameters(params: Any, modifier_rank: Optional[int] = None,
+                       fwd_module=None, enabled: bool = True):
+    """Temporarily materialize full (replicated) values of sharded params
+    (reference ctx :2121).  Yields the gathered pytree; mutations do NOT
+    propagate back (functional params — reassign explicitly)."""
+    if not enabled:
+        yield params
+        return
+    from ..topology import get_topology
+
+    topo = get_topology()
+    gathered = jax.device_put(
+        params, jax.tree.map(lambda _: topo.replicated(), params))
+    yield gathered
+
+
+def unwrap_model_for_generation(model):
+    return model

@@ -21,7 +21,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..runtime.topology import DATA, EXPERT, SEQ, get_topology
+from ..runtime.topology import DATA, DATA_OUTER, EXPERT, SEQ, get_topology
 
 
 def _attn_io_spec(x, topo, sp_axis: str):
@@ -35,7 +35,7 @@ def _attn_io_spec(x, topo, sp_axis: str):
         spec = list(sharding.spec) + [None] * (x.ndim - len(sharding.spec))
         spec[1] = sp_axis
         return P(*spec)
-    batch_axes = tuple(a for a in (DATA, EXPERT) if topo.dims[a] > 1)
+    batch_axes = tuple(a for a in (DATA_OUTER, DATA, EXPERT) if topo.dims[a] > 1)
     dp = 1
     for a in batch_axes:
         dp *= topo.dims[a]

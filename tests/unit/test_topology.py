@@ -52,8 +52,11 @@ class TestMeshTopology:
         assert topo.get_data_parallel_world_size() == 8
 
     def test_mixed_axes(self):
+        from deepspeed_tpu.runtime.topology import DATA_OUTER
+
         topo = MeshTopology(TopologyConfig(tensor=2, seq=2))
-        assert topo.dims == {PIPE: 1, DATA: 2, EXPERT: 1, SEQ: 2, TENSOR: 2}
+        assert topo.dims == {PIPE: 1, DATA_OUTER: 1, DATA: 2, EXPERT: 1,
+                             SEQ: 2, TENSOR: 2}
         assert topo.get_tensor_parallel_world_size() == 2
         assert topo.get_data_parallel_world_size() == 2
 

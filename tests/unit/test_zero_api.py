@@ -1,0 +1,92 @@
+"""zero.Init / GatheredParameters / MiCS tests (reference:
+tests/unit/runtime/zero/test_zero_context.py, test_mics_*)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime import zero
+from deepspeed_tpu.runtime.topology import (
+    DATA,
+    DATA_OUTER,
+    TopologyConfig,
+    initialize_mesh,
+)
+
+from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+
+class TestZeroInit:
+    def test_materialize_sharded(self):
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        with zero.Init(topology=topo, zero_stage=3,
+                       param_persistence_threshold=0) as zi:
+            params = zi.materialize(
+                lambda: init_mlp_params(jax.random.PRNGKey(0), hidden=16))
+        kernel = params["layer_0"]["kernel"]
+        assert not kernel.sharding.is_fully_replicated
+
+    def test_gathered_parameters(self):
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        with zero.Init(topology=topo, zero_stage=3,
+                       param_persistence_threshold=0) as zi:
+            params = zi.materialize(
+                lambda: init_mlp_params(jax.random.PRNGKey(0), hidden=16))
+        with zero.GatheredParameters(params) as full:
+            for leaf in jax.tree.leaves(full):
+                assert leaf.sharding.is_fully_replicated
+
+    def test_disabled_passthrough(self):
+        initialize_mesh(TopologyConfig(), force=True)
+        with zero.Init(enabled=False) as zi:
+            params = zi.materialize(
+                lambda: init_mlp_params(jax.random.PRNGKey(0)))
+        assert params is not None
+
+
+class TestMiCS:
+    def test_mesh_split(self):
+        topo = initialize_mesh(TopologyConfig(zero_shard_size=2), force=True)
+        assert topo.dims[DATA] == 2 and topo.dims[DATA_OUTER] == 4
+        assert topo.get_data_parallel_world_size() == 8  # dp unchanged
+        assert topo.zero_axes() == (DATA,)
+
+    def test_mics_training_matches_full_sharding(self):
+        """zero_shard_size=2 (shard in groups of 2, replicate 4×) must be
+        numerically identical to full ZeRO over 8."""
+        def build(shard_size):
+            cfg = TopologyConfig(zero_shard_size=shard_size) if shard_size else \
+                TopologyConfig()
+            topo = initialize_mesh(cfg, force=True)
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=mlp_loss_fn,
+                model_parameters=init_mlp_params(jax.random.PRNGKey(0)),
+                config={"train_micro_batch_size_per_gpu": 4,
+                        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                        "zero_optimization": {"stage": 3,
+                                              "stage3_param_persistence_threshold": 0}},
+                topology=topo)
+            return engine
+
+        full = build(None)
+        batch = random_batch(full.train_batch_size())
+        mics = build(2)
+        for _ in range(3):
+            l_full = float(full.train_batch(batch))
+            l_mics = float(mics.train_batch(batch))
+        np.testing.assert_allclose(l_full, l_mics, rtol=1e-4)
+        # MiCS shards params only over the inner (size-2) axis
+        k = mics.state.params["layer_0"]["kernel"]
+        assert not k.sharding.is_fully_replicated
+
+    def test_mics_init_context(self):
+        with zero.MiCS_Init(mics_shard_size=2, zero_stage=3,
+                            param_persistence_threshold=0) as zi:
+            params = zi.materialize(
+                lambda: init_mlp_params(jax.random.PRNGKey(0)))
+        assert params is not None
+
+    def test_invalid_shard_size(self):
+        with pytest.raises(ValueError):
+            initialize_mesh(TopologyConfig(zero_shard_size=3), force=True)
