@@ -65,6 +65,14 @@ def initialize(
             config = json.load(f)
     raw_cfg = config.raw if isinstance(config, DeepSpeedConfig) else (config or {})
 
+    if topology is None and mpu is not None:
+        # Megatron-style mpu object (reference: engine honors
+        # mpu.get_*_parallel_group(); here we honor the sizes).
+        tp = getattr(mpu, "get_tensor_model_parallel_world_size",
+                     getattr(mpu, "get_model_parallel_world_size", lambda: 1))()
+        pp = getattr(mpu, "get_pipeline_model_parallel_world_size", lambda: 1)()
+        topology = initialize_mesh(TopologyConfig(tensor=tp, pipe=pp), force=True)
+
     if topology is None:
         if mesh_config is not None:
             topology = initialize_mesh(mesh_config, force=True)

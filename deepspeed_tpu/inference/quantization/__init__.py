@@ -1,0 +1,63 @@
+"""Weight-only quantization for serving (reference: deepspeed/inference/
+quantization/ — layers.py wraps Linear in quantized versions).
+
+Functional version: quantize a parameter pytree's matmul kernels to int8
+groupwise (Pallas kernels), keep a spec of quantized leaves, and dequantize
+on-the-fly inside the forward.  Halves serving HBM for the weights; the
+dequant fuses into the matmul prologue under XLA.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...ops.quantizer.quantizer import dequantize_int8, quantize_int8
+
+_MIN_QUANT_SIZE = 1 << 14  # don't quantize tiny tensors (norms, biases)
+
+
+def quantize_params(params: Any, group_size: int = 256,
+                    min_size: int = _MIN_QUANT_SIZE) -> Tuple[Any, Dict]:
+    """→ (quantized pytree, meta). Quantized leaves become
+    {"__q__": int8, "__scale__": f32, "__shape__": ..., "__dtype__": ...}."""
+    flat, treedef = jax.tree.flatten(params)
+    out = []
+    quantized = 0
+    for leaf in flat:
+        if hasattr(leaf, "size") and leaf.size >= min_size and leaf.ndim >= 2 and \
+                jnp.issubdtype(leaf.dtype, jnp.floating):
+            q, s = quantize_int8(leaf, group_size)
+            out.append({"__q__": q, "__scale__": s,
+                        "__shape__": tuple(leaf.shape),
+                        "__dtype__": str(leaf.dtype)})
+            quantized += 1
+        else:
+            out.append(leaf)
+    return jax.tree.unflatten(treedef, out), {"quantized_leaves": quantized,
+                                              "group_size": group_size}
+
+
+def dequantize_params(qparams: Any, dtype=jnp.bfloat16) -> Any:
+    """Inverse of :func:`quantize_params` (call inside the jitted forward —
+    XLA keeps int8 in HBM and dequantizes into the matmul)."""
+
+    def is_q(node):
+        return isinstance(node, dict) and "__q__" in node
+
+    def deq(node):
+        if is_q(node):
+            return dequantize_int8(node["__q__"], node["__scale__"],
+                                   shape=node["__shape__"], dtype=dtype)
+        return node
+
+    return jax.tree.map(deq, qparams, is_leaf=is_q)
+
+
+def quantized_memory_bytes(qparams: Any) -> int:
+    total = 0
+    for leaf in jax.tree.leaves(qparams):
+        if hasattr(leaf, "size") and hasattr(leaf, "dtype"):
+            total += leaf.size * leaf.dtype.itemsize
+    return total

@@ -1,0 +1,52 @@
+"""Inference engine factory (reference: inference/v2/engine_factory.py —
+policy dispatch by HF architecture into per-arch model implementations).
+
+``build_hf_engine`` maps an HF checkpoint/config to the framework model family
+(models/hf.py policies cover llama/mistral/qwen2/mixtral/gpt2/opt/bloom/
+falcon) and returns a ready :class:`InferenceEngineV2`.
+"""
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import jax.numpy as jnp
+
+from ...models.hf import from_pretrained_config, load_hf_model
+from ...utils.logging import log_dist
+from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+
+
+def build_hf_engine(path: str, engine_config: Optional[RaggedInferenceEngineConfig] = None,
+                    dtype=jnp.bfloat16, random_weights: bool = False,
+                    **overrides) -> InferenceEngineV2:
+    """HF model dir/name → serving engine (reference build_hf_engine)."""
+    if random_weights:
+        import jax
+
+        model = from_pretrained_config(path, **overrides)
+        params = model.init_params(jax.random.PRNGKey(0), dtype=dtype)
+    else:
+        model, params = load_hf_model(path, dtype=dtype, **overrides)
+    cfg = engine_config or RaggedInferenceEngineConfig(
+        max_ctx=model.config.max_seq_len, dtype=dtype)
+    log_dist(f"serving {path}: {model.num_params(params)/1e6:.0f}M params", ranks=[0])
+    return InferenceEngineV2(model, params, cfg)
+
+
+def build_engine_from_ds_checkpoint(ckpt_dir: str, model: Any,
+                                    engine_config=None, tag: Optional[str] = None,
+                                    dtype=None) -> InferenceEngineV2:
+    """Serve from a framework training checkpoint."""
+    from ...checkpoint.ds_to_universal import unflatten
+    from ...checkpoint.zero_to_fp32 import get_fp32_state_dict_from_zero_checkpoint
+
+    if dtype is None:
+        dtype = engine_config.dtype if engine_config is not None else jnp.bfloat16
+    flat = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+    params = unflatten(flat)
+    import jax
+
+    params = jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
+    cfg = engine_config or RaggedInferenceEngineConfig(
+        max_ctx=model.config.max_seq_len, dtype=dtype)
+    return InferenceEngineV2(model, params, cfg)

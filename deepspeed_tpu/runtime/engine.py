@@ -372,10 +372,71 @@ class DeepSpeedEngine:
         if "train_batch" not in self._compiled:
             self._compiled["train_batch"] = self._build_train_batch_fn()
         self.tput_timer.start()
+        if self.config.wall_clock_breakdown:
+            self._timers("step").start()
         self.state, loss = self._compiled["train_batch"](self.state, batch)
         self.tput_timer.stop(sync=loss)
-        self._write_monitor_events(loss)
+        if self.config.wall_clock_breakdown:
+            self._timers("step").stop(sync=loss)
+        self._post_step_logging(loss, batch)
         return loss
+
+    def _post_step_logging(self, loss, batch):
+        self._write_monitor_events(loss)
+        step = self.global_steps
+        cfg = self.config
+        if cfg.steps_per_print and step > 0 and step % cfg.steps_per_print == 0:
+            log_dist(f"step={step} loss={float(loss):.4f} "
+                     f"lr={self.get_lr()[0]:.3e} "
+                     f"loss_scale={self.get_loss_scale():.0f} "
+                     f"samples/sec={self.tput_timer.avg_samples_per_sec():.1f}",
+                     ranks=[0])
+        if cfg.wall_clock_breakdown and step % cfg.steps_per_print == 0:
+            self._timers.log(["forward", "backward", "step"])
+        if cfg.flops_profiler.enabled and step == cfg.flops_profiler.profile_step:
+            from ..profiling.flops_profiler.profiler import FlopsProfiler
+
+            prof = FlopsProfiler(ds_engine=self)
+            try:
+                flat = batch
+                if self.gradient_accumulation_steps() > 1:
+                    flat = jax.tree.map(
+                        lambda x: x.reshape((-1,) + x.shape[2:]), batch)
+                prof.profile_engine_step(flat)
+                prof.latency = self.tput_timer.total_elapsed_time / max(
+                    self.tput_timer.global_step_count - self.tput_timer.start_step, 1)
+                prof.print_model_profile(output_file=cfg.flops_profiler.output_file)
+            except Exception as e:
+                logger.warning(f"flops profile failed: {e}")
+
+    # ------------------------------------------------------------------ #
+    # API-parity helpers
+    # ------------------------------------------------------------------ #
+    def compile(self, backend=None, compile_kwargs=None):
+        """Reference engine.compile() (engine.py:3820).  Every step here is
+        already jit-compiled; provided so callers can force ahead-of-time
+        compilation of the fused step."""
+        if "train_batch" not in self._compiled:
+            self._compiled["train_batch"] = self._build_train_batch_fn()
+        self._is_compiled = True
+        return self
+
+    @property
+    def is_compiled(self) -> bool:
+        return bool(getattr(self, "_is_compiled", False))
+
+    def no_sync(self):
+        """Reference engine.no_sync(): skip grad allreduce between boundaries.
+        The fused path only communicates at the optimizer step, so inside one
+        ``train_batch`` there is nothing to suppress — returns a no-op ctx."""
+        import contextlib
+
+        return contextlib.nullcontext()
+
+    def zero_grad(self):
+        if self.state.grad_acc is not None:
+            self.state = self.state.replace(
+                grad_acc=jax.tree.map(jnp.zeros_like, self.state.grad_acc))
 
     def _write_monitor_events(self, loss):
         if self.monitor is None or not getattr(self.monitor, "enabled", False):
@@ -443,7 +504,11 @@ class DeepSpeedEngine:
             self._compiled.pop("micro", None)
         if "micro" not in self._compiled:
             self._compiled["micro"] = self._build_micro_fn()
+        if self.config.wall_clock_breakdown:
+            self._timers("backward").start()
         self.state, loss = self._compiled["micro"](self.state, batch)
+        if self.config.wall_clock_breakdown:
+            self._timers("backward").stop(sync=loss)
         self._losses.append(loss)
         return loss
 

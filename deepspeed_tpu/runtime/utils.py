@@ -1,0 +1,76 @@
+"""Runtime utilities (reference: deepspeed/runtime/utils.py — see_memory_usage,
+clip helpers, partition math)."""
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..utils.logging import log_dist, logger
+
+
+def see_memory_usage(message: str, force: bool = False) -> Optional[dict]:
+    """Device + host memory report (reference runtime/utils.py)."""
+    if not force:
+        return None
+    stats = {}
+    try:
+        dev = jax.devices()[0]
+        ms = dev.memory_stats() or {}
+        stats["device_in_use_MB"] = ms.get("bytes_in_use", 0) / 1e6
+        stats["device_peak_MB"] = ms.get("peak_bytes_in_use", 0) / 1e6
+        stats["device_limit_MB"] = ms.get("bytes_limit", 0) / 1e6
+    except Exception:
+        pass
+    try:
+        with open("/proc/self/status") as f:
+            for line in f:
+                if line.startswith("VmRSS"):
+                    stats["host_rss_MB"] = int(line.split()[1]) / 1e3
+    except OSError:
+        pass
+    log_dist(f"{message} | " + " ".join(f"{k}={v:.0f}" for k, v in stats.items()),
+             ranks=[0])
+    return stats
+
+
+def clip_grad_norm_(grads: Any, max_norm: float, norm_type: float = 2.0):
+    """Global-norm clip over a pytree; returns (clipped, total_norm)."""
+    leaves = jax.tree.leaves(grads)
+    if norm_type == 2.0:
+        total = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                             for g in leaves))
+    else:
+        total = sum(jnp.sum(jnp.abs(g.astype(jnp.float32)) ** norm_type)
+                    for g in leaves) ** (1.0 / norm_type)
+    scale = jnp.minimum(1.0, max_norm / (total + 1e-6))
+    return jax.tree.map(lambda g: g * scale, grads), total
+
+
+def partition_uniform(num_items: int, num_parts: int) -> List[int]:
+    """Reference partition helper: boundaries of a near-uniform split."""
+    parts = [0]
+    for p in range(1, num_parts + 1):
+        parts.append(round(p * num_items / num_parts))
+    return parts
+
+
+def partition_balanced(weights: List[float], num_parts: int) -> List[int]:
+    """Weight-balanced split boundaries (prefix-sum bisection)."""
+    import numpy as np
+
+    cum = np.concatenate([[0.0], np.cumsum(np.asarray(weights, float))])
+    targets = np.linspace(0, cum[-1], num_parts + 1)
+    parts = [int(np.searchsorted(cum, t)) for t in targets]
+    parts[0], parts[-1] = 0, len(weights)
+    for i in range(1, len(parts)):
+        parts[i] = max(parts[i], parts[i - 1])
+    return parts
+
+
+class DummyOptim:
+    """Placeholder optimizer (reference runtime/utils.py DummyOptim)."""
+
+    def __init__(self, params=None):
+        self.params = params

@@ -1,0 +1,107 @@
+"""Inference extras: weight-only quant serving, engine factory from checkpoint,
+TP-sharded serving (reference: inference/quantization tests, engine factory)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+
+class TestWeightOnlyQuant:
+    def test_quant_dequant_forward_close(self):
+        from deepspeed_tpu.inference.quantization import (
+            dequantize_params,
+            quantize_params,
+        )
+
+        initialize_mesh(TopologyConfig(), force=True)
+        cfg = TransformerConfig.tiny(use_flash=False)
+        model = CausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        qparams, meta = quantize_params(params, group_size=64, min_size=1024)
+        assert meta["quantized_leaves"] > 0
+        deq = dequantize_params(qparams, dtype=jnp.float32)
+        tokens = jnp.asarray(
+            np.random.default_rng(0).integers(0, 256, size=(2, 16)), jnp.int32)
+        ref = model(params, tokens)
+        out = model(deq, tokens)
+        # logits close despite int8 weights
+        assert float(jnp.mean(jnp.abs(ref - out))) < 0.15
+
+    def test_memory_reduction(self):
+        from deepspeed_tpu.inference.quantization import (
+            quantize_params,
+            quantized_memory_bytes,
+        )
+
+        params = {"w": jnp.ones((512, 512), jnp.float32)}
+        q, _ = quantize_params(params, min_size=1024)
+        orig = 512 * 512 * 4
+        assert quantized_memory_bytes(q) < orig / 3  # int8 + scales
+
+
+class TestEngineFromCheckpoint:
+    def test_serve_from_training_checkpoint(self, tmp_path):
+        import deepspeed_tpu
+        from deepspeed_tpu.inference.v2.engine_factory import (
+            build_engine_from_ds_checkpoint,
+        )
+        from deepspeed_tpu.inference.v2.engine_v2 import RaggedInferenceEngineConfig
+
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        cfg = TransformerConfig.tiny(use_flash=False)
+        model = CausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-3}}},
+            topology=topo)
+        batch = {"input_ids": jnp.asarray(
+            np.random.default_rng(0).integers(0, 256, size=(8, 16)), jnp.int32)}
+        engine.train_batch(batch)
+        engine.save_checkpoint(str(tmp_path))
+
+        serve = build_engine_from_ds_checkpoint(
+            str(tmp_path), model,
+            engine_config=RaggedInferenceEngineConfig(
+                max_tokens=32, max_seqs=4, max_ctx=64, block_size=8,
+                dtype=jnp.float32))
+        logits = serve.put([0], [[1, 2, 3]])
+        # matches the trained engine's forward
+        trained = jax.tree.map(lambda x: x.astype(jnp.float32),
+                               engine.state.params)
+        dense = model(trained, jnp.asarray([[1, 2, 3]], jnp.int32))
+        np.testing.assert_allclose(np.asarray(logits[0]),
+                                   np.asarray(dense[0, -1]), atol=2e-3, rtol=2e-2)
+
+
+class TestTPServing:
+    def test_v2_engine_under_tp_mesh(self):
+        """Serving with TP=2-sharded params produces the same logits."""
+        from jax.sharding import NamedSharding
+
+        from deepspeed_tpu.inference.v2.engine_v2 import (
+            InferenceEngineV2,
+            RaggedInferenceEngineConfig,
+        )
+
+        cfg = TransformerConfig.tiny(use_flash=False)
+        model = CausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        initialize_mesh(TopologyConfig(), force=True)
+        ref_engine = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            max_tokens=32, max_seqs=4, max_ctx=64, block_size=8, dtype=jnp.float32))
+        ref = ref_engine.put([0], [[1, 2, 3, 4]])
+
+        topo = initialize_mesh(TopologyConfig(tensor=2), force=True)
+        sharded = jax.tree.map(
+            lambda p, s: jax.device_put(p, NamedSharding(topo.mesh, s)),
+            params, model.partition_specs, is_leaf=lambda x: hasattr(x, "ndim"))
+        tp_engine = InferenceEngineV2(model, sharded, RaggedInferenceEngineConfig(
+            max_tokens=32, max_seqs=4, max_ctx=64, block_size=8, dtype=jnp.float32))
+        out = tp_engine.put([0], [[1, 2, 3, 4]])
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=1e-4, rtol=1e-4)
