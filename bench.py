@@ -1,10 +1,14 @@
 """Benchmark: ZeRO training throughput on the available chip(s).
 
-Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
-Metric: training tokens/sec/chip on a Llama-family model (bf16, flash
-attention, remat) via the deepspeed_tpu.initialize() engine.  vs_baseline is
-MFU / 0.50 — the reference's north-star target (BASELINE.md: Llama-3-8B ZeRO-3
-at >50% MFU on v5p; scaled here to the single-chip model that fits).
+Prints ONE JSON line to stdout: {"metric", "value", "unit", "vs_baseline"}.
+Progress/diagnostics go to stderr.  Metric: training tokens/sec/chip on a
+Llama-family model (bf16, flash attention, remat) via the
+deepspeed_tpu.initialize() engine.  vs_baseline is MFU / 0.50 — the
+reference's north-star target (BASELINE.md: Llama-3-8B ZeRO-3 at >50% MFU on
+v5p; scaled to the model size that fits the available chip).
+
+Env knobs: DSTPU_BENCH_LAYERS / HIDDEN / SEQ / BATCH / STEPS, DSTPU_BENCH_MODE
+(train | inference).
 """
 from __future__ import annotations
 
@@ -17,36 +21,53 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+
+def log(msg: str) -> None:
+    print(f"[bench {time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
 PEAK_FLOPS = {
     "TPU v5 lite": 197e12,   # v5e bf16
     "TPU v5e": 197e12,
     "TPU v5p": 459e12,
     "TPU v4": 275e12,
-    "cpu": 1e12,
+    "TPU v6": 918e12,
 }
 
 
 def peak_flops_per_chip() -> float:
     d = jax.devices()[0]
-    kind = getattr(d, "device_kind", "cpu")
+    kind = str(getattr(d, "device_kind", "cpu"))
     for key, val in PEAK_FLOPS.items():
-        if key.lower() in str(kind).lower():
+        if key.lower() in kind.lower():
             return val
     return 197e12 if d.platform == "tpu" else 1e12
 
 
+def env_int(name, default):
+    return int(os.environ.get(name, default))
+
+
 def main():
     on_tpu = jax.default_backend() == "tpu"
+    log(f"backend={jax.default_backend()} devices={jax.devices()}")
     import deepspeed_tpu
     from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
     from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
     if on_tpu:
         cfg = TransformerConfig(
-            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
-            num_layers=16, num_heads=16, num_kv_heads=8, max_seq_len=2048,
+            vocab_size=32000,
+            hidden_size=env_int("DSTPU_BENCH_HIDDEN", 2048),
+            intermediate_size=env_int("DSTPU_BENCH_HIDDEN", 2048) * 11 // 4,
+            num_layers=env_int("DSTPU_BENCH_LAYERS", 12),
+            num_heads=16, num_kv_heads=8,
+            max_seq_len=env_int("DSTPU_BENCH_SEQ", 2048),
             remat=True, use_flash=True)
-        batch_size, seq, steps, warmup = 8, 2048, 20, 3
+        batch_size = env_int("DSTPU_BENCH_BATCH", 8)
+        seq = cfg.max_seq_len
+        steps = env_int("DSTPU_BENCH_STEPS", 10)
+        warmup = 2
     else:  # CPU smoke mode
         cfg = TransformerConfig.tiny(use_flash=False)
         batch_size, seq, steps, warmup = 4, 128, 3, 1
@@ -54,12 +75,16 @@ def main():
     topo = initialize_mesh(TopologyConfig(), force=True)
     n_chips = topo.world_size()
     model = CausalLM(cfg)
+    log(f"initializing {model.num_params()/1e6:.0f}M-param model "
+        f"(layers={cfg.num_layers} hidden={cfg.hidden_size} seq={seq})")
     params = model.init_params(jax.random.PRNGKey(0))
+    jax.block_until_ready(params)
+    log("params ready; building engine")
 
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params,
         config={
-            "train_micro_batch_size_per_gpu": batch_size // n_chips or 1,
+            "train_micro_batch_size_per_gpu": max(batch_size // n_chips, 1),
             "optimizer": {"type": "AdamW",
                           "params": {"lr": 3e-4, "weight_decay": 0.1}},
             "gradient_clipping": 1.0,
@@ -73,10 +98,14 @@ def main():
         rng.integers(0, cfg.vocab_size, size=(engine.train_batch_size(), seq)),
         jnp.int32)}
 
-    for _ in range(warmup):
+    log("compiling + warmup")
+    t_compile = time.perf_counter()
+    for i in range(warmup):
         loss = engine.train_batch(batch)
-    jax.block_until_ready(loss)
+        jax.block_until_ready(loss)
+        log(f"warmup step {i} done ({time.perf_counter()-t_compile:.1f}s)")
 
+    log(f"timing {steps} steps")
     t0 = time.perf_counter()
     for _ in range(steps):
         loss = engine.train_batch(batch)
@@ -85,9 +114,11 @@ def main():
 
     tokens = engine.train_batch_size() * seq * steps
     tok_per_sec_chip = tokens / dt / n_chips
-    flops_per_token = model.flops_per_token() + \
-        3 * 2 * 2 * cfg.num_layers * cfg.hidden_size * seq  # attention term
+    # 6N params-flops + 12*L*D*S attention-flops per token, ×1.33 for remat
+    attn = 12 * cfg.num_layers * cfg.hidden_size * seq
+    flops_per_token = model.flops_per_token() + 3 * attn
     mfu = tok_per_sec_chip * flops_per_token / peak_flops_per_chip()
+    log(f"done: {tok_per_sec_chip:.0f} tok/s/chip, mfu={mfu:.3f}")
 
     print(json.dumps({
         "metric": "zero_train_tokens_per_sec_per_chip",
@@ -100,9 +131,10 @@ def main():
             "loss": float(loss),
             "chips": n_chips,
             "seq_len": seq,
-            "device": str(jax.devices()[0].device_kind),
+            "step_time_s": round(dt / steps, 4),
+            "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
         },
-    }))
+    }), flush=True)
 
 
 if __name__ == "__main__":

@@ -59,7 +59,7 @@ def fetch_hostfile(hostfile_path: str) -> Optional[Dict[str, int]]:
             try:
                 host, slots = line.split()
                 count = int(slots.split("=")[1])
-            except ValueError:
+            except (ValueError, IndexError):
                 raise ValueError(f"malformed hostfile line: {line!r}")
             if host in resource_pool:
                 raise ValueError(f"duplicate host {host!r} in hostfile")

@@ -1,0 +1,83 @@
+"""NVMe/host-IO performance sweep (reference: deepspeed/nvme/ —
+perf_run_sweep.py, test_ds_aio.py benchmark harness for the aio engine).
+
+Sweeps (block_size × queue_depth/thread_count) over the native aio engine and
+reports read/write GB/s so ZeRO-offload configs can be tuned per machine.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import tempfile
+import time
+from typing import Dict, List
+
+import numpy as np
+
+
+def run_single(path: str, size_mb: int, block_size: int, threads: int,
+               read: bool) -> float:
+    """Return GB/s for one config."""
+    from ..ops.aio import AsyncIOHandle
+
+    handle = AsyncIOHandle(block_size=block_size, thread_count=threads)
+    data = np.random.default_rng(0).integers(
+        0, 255, size=(size_mb * (1 << 20),), dtype=np.uint8)
+    if read:
+        handle.sync_pwrite(data, path)
+    t0 = time.perf_counter()
+    if read:
+        buf = np.empty_like(data)
+        handle.sync_pread(buf, path)
+    else:
+        handle.sync_pwrite(data, path)
+    dt = time.perf_counter() - t0
+    return data.nbytes / dt / 1e9
+
+
+def sweep(folder: str, size_mb: int = 64,
+          block_sizes=(1 << 18, 1 << 20, 1 << 22),
+          thread_counts=(1, 2, 4, 8)) -> List[Dict]:
+    results = []
+    os.makedirs(folder, exist_ok=True)
+    path = os.path.join(folder, "aio_sweep.bin")
+    for bs in block_sizes:
+        for tc in thread_counts:
+            for op in ("write", "read"):
+                gbps = run_single(path, size_mb, bs, tc, read=(op == "read"))
+                results.append({"op": op, "block_size": bs, "threads": tc,
+                                "GBps": round(gbps, 3)})
+    try:
+        os.remove(path)
+    except OSError:
+        pass
+    return results
+
+
+def best_config(results: List[Dict]) -> Dict:
+    best = {}
+    for op in ("read", "write"):
+        rows = [r for r in results if r["op"] == op]
+        best[op] = max(rows, key=lambda r: r["GBps"]) if rows else None
+    return best
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--nvme_dir", default=tempfile.gettempdir())
+    p.add_argument("--size_mb", type=int, default=64)
+    p.add_argument("--json", action="store_true")
+    args = p.parse_args()
+    results = sweep(args.nvme_dir, args.size_mb)
+    if args.json:
+        print(json.dumps(results))
+    else:
+        for r in results:
+            print(f"{r['op']:>5} block={r['block_size']:>8} threads={r['threads']:>2} "
+                  f"-> {r['GBps']:.2f} GB/s")
+        print("best:", best_config(results))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,105 @@
+"""Evoformer attention + nvme sweep + launcher tests."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+class TestEvoformer:
+    def _inputs(self, B=1, N=2, S=32, H=2, D=8, seed=0):
+        ks = jax.random.split(jax.random.PRNGKey(seed), 5)
+        q = jax.random.normal(ks[0], (B, N, S, H, D))
+        k = jax.random.normal(ks[1], (B, N, S, H, D))
+        v = jax.random.normal(ks[2], (B, N, S, H, D))
+        mask_bias = jnp.where(
+            jax.random.bernoulli(ks[3], 0.9, (B, N, 1, 1, S)), 0.0, -1e9)
+        pair_bias = jax.random.normal(ks[4], (B, 1, H, S, S)) * 0.1
+        return q, k, v, mask_bias, pair_bias
+
+    def test_matches_naive(self):
+        from deepspeed_tpu.ops.evoformer_attn import evoformer_attention
+
+        q, k, v, mb, pb = self._inputs()
+        out = evoformer_attention(q, k, v, [mb, pb])
+        # naive reference
+        scores = jnp.einsum("bnqhd,bnkhd->bnhqk", q, k) / np.sqrt(8)
+        scores = scores + mb + pb
+        probs = jax.nn.softmax(scores, axis=-1)
+        ref = jnp.einsum("bnhqk,bnkhd->bnqhd", probs, v)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_chunked_matches_dense(self):
+        from deepspeed_tpu.ops.evoformer_attn import evoformer_attention
+
+        q, k, v, mb, pb = self._inputs(S=64)
+        dense = evoformer_attention(q, k, v, [mb, pb], chunk_size=128)
+        chunked = evoformer_attention(q, k, v, [mb, pb], chunk_size=16)
+        np.testing.assert_allclose(np.asarray(chunked), np.asarray(dense),
+                                   atol=2e-5, rtol=2e-5)
+
+    def test_gradients(self):
+        from deepspeed_tpu.ops.evoformer_attn import evoformer_attention
+
+        q, k, v, mb, pb = self._inputs(S=32)
+        g1 = jax.grad(lambda q: jnp.sum(
+            evoformer_attention(q, k, v, [mb, pb], chunk_size=8) ** 2))(q)
+        g2 = jax.grad(lambda q: jnp.sum(
+            evoformer_attention(q, k, v, [mb, pb], chunk_size=128) ** 2))(q)
+        np.testing.assert_allclose(np.asarray(g1), np.asarray(g2), atol=1e-4)
+
+
+class TestNvmeSweep:
+    def test_sweep_runs(self, tmp_path):
+        from deepspeed_tpu.nvme.perf_sweep import best_config, sweep
+
+        results = sweep(str(tmp_path), size_mb=1, block_sizes=(1 << 18,),
+                        thread_counts=(1, 2))
+        assert len(results) == 4
+        assert all(r["GBps"] > 0 for r in results)
+        best = best_config(results)
+        assert best["read"] and best["write"]
+
+
+class TestLauncher:
+    def test_hostfile_parse(self, tmp_path):
+        from deepspeed_tpu.launcher.runner import fetch_hostfile
+
+        hf = tmp_path / "hostfile"
+        hf.write_text("worker-0 slots=4\nworker-1 slots=4  # trailing\n# comment\n")
+        pool = fetch_hostfile(str(hf))
+        assert pool == {"worker-0": 4, "worker-1": 4}
+
+    def test_hostfile_malformed(self, tmp_path):
+        from deepspeed_tpu.launcher.runner import fetch_hostfile
+
+        hf = tmp_path / "hostfile"
+        hf.write_text("worker-0 4\n")
+        with pytest.raises(ValueError):
+            fetch_hostfile(str(hf))
+
+    def test_include_exclude(self):
+        from deepspeed_tpu.launcher.runner import parse_inclusion_exclusion
+
+        pool = {"a": 4, "b": 4, "c": 4}
+        assert list(parse_inclusion_exclusion(pool, "a@c", "")) == ["a", "c"]
+        assert list(parse_inclusion_exclusion(pool, "", "b")) == ["a", "c"]
+        with pytest.raises(ValueError):
+            parse_inclusion_exclusion(pool, "zzz", "")
+
+    def test_launch_env(self):
+        from deepspeed_tpu.launcher.runner import build_launch_env
+
+        env = build_launch_env(rank=2, world_size=4, master_addr="h0",
+                               master_port=29500)
+        assert env["DSTPU_RANK"] == "2"
+        assert env["COORDINATOR_ADDRESS"] == "h0:29500"
+
+
+class TestEnvReport:
+    def test_report_renders(self):
+        from deepspeed_tpu.env_report import main
+
+        report = main()
+        assert "deepspeed_tpu version" in report
+        assert "jax" in report
