@@ -56,6 +56,10 @@ class InferenceEngineV2:
                  config: Optional[RaggedInferenceEngineConfig] = None):
         self.model = model
         self.cfg = model.config
+        if getattr(self.cfg, "num_experts", 1) > 1:
+            raise NotImplementedError(
+                "ragged serving of MoE models lands with the moe_gather/"
+                "moe_scatter ragged kernels; dense families only for now")
         self.config = config or RaggedInferenceEngineConfig()
         c = self.config
         num_blocks = c.num_blocks or (c.max_seqs * -(-c.max_ctx // c.block_size))

@@ -47,6 +47,12 @@ class TransformerConfig:
     remat: bool = False
     use_flash: bool = True          # pallas flash attention on TPU
     attn_impl: str = "auto"         # auto | flash | xla | ring | ulysses
+    # MoE (Mixtral-family): >1 experts replaces the dense MLP with a
+    # top-k routed expert MLP on every layer.
+    num_experts: int = 1
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 2.0
+    moe_aux_loss_coef: float = 0.01
 
     @property
     def head_dim(self) -> int:
@@ -57,6 +63,20 @@ class TransformerConfig:
         return TransformerConfig(vocab_size=256, hidden_size=64, intermediate_size=128,
                                  num_layers=2, num_heads=4, num_kv_heads=2,
                                  max_seq_len=128, **kw)
+
+    @staticmethod
+    def tiny_moe(**kw):
+        return TransformerConfig(vocab_size=256, hidden_size=64,
+                                 intermediate_size=128, num_layers=2,
+                                 num_heads=4, num_kv_heads=2, max_seq_len=128,
+                                 num_experts=4, moe_top_k=2, **kw)
+
+    @staticmethod
+    def mixtral_8x7b(**kw):
+        return TransformerConfig(vocab_size=32000, hidden_size=4096,
+                                 intermediate_size=14336, num_layers=32,
+                                 num_heads=32, num_kv_heads=8, max_seq_len=32768,
+                                 rope_theta=1e6, num_experts=8, moe_top_k=2, **kw)
 
     @staticmethod
     def llama3_8b(**kw):
@@ -87,20 +107,28 @@ def init_params(cfg: TransformerConfig, key: jax.Array, dtype=jnp.float32) -> Di
     def dense_init(k, shape, fan_in):
         return (jax.random.normal(k, shape) / math.sqrt(fan_in)).astype(dtype)
 
-    ks = jax.random.split(k_layers, 7)
+    ks = jax.random.split(k_layers, 8)
+    layers = {
+        "attn_norm": {"scale": norm_init(L, D)},
+        "q_proj": {"kernel": dense_init(ks[0], (L, D, H * hd), D)},
+        "k_proj": {"kernel": dense_init(ks[1], (L, D, KV * hd), D)},
+        "v_proj": {"kernel": dense_init(ks[2], (L, D, KV * hd), D)},
+        "o_proj": {"kernel": dense_init(ks[3], (L, H * hd, D), H * hd)},
+        "mlp_norm": {"scale": norm_init(L, D)},
+    }
+    if cfg.num_experts > 1:
+        E = cfg.num_experts
+        layers["router"] = {"kernel": dense_init(ks[7], (L, D, E), D).astype(jnp.float32)}
+        layers["gate_proj"] = {"kernel": dense_init(ks[4], (L, E, D, F), D)}
+        layers["up_proj"] = {"kernel": dense_init(ks[5], (L, E, D, F), D)}
+        layers["down_proj"] = {"kernel": dense_init(ks[6], (L, E, F, D), F)}
+    else:
+        layers["gate_proj"] = {"kernel": dense_init(ks[4], (L, D, F), D)}
+        layers["up_proj"] = {"kernel": dense_init(ks[5], (L, D, F), D)}
+        layers["down_proj"] = {"kernel": dense_init(ks[6], (L, F, D), F)}
     params = {
         "embed": {"embedding": (jax.random.normal(k_embed, (cfg.vocab_size, D)) * 0.02).astype(dtype)},
-        "layers": {
-            "attn_norm": {"scale": norm_init(L, D)},
-            "q_proj": {"kernel": dense_init(ks[0], (L, D, H * hd), D)},
-            "k_proj": {"kernel": dense_init(ks[1], (L, D, KV * hd), D)},
-            "v_proj": {"kernel": dense_init(ks[2], (L, D, KV * hd), D)},
-            "o_proj": {"kernel": dense_init(ks[3], (L, H * hd, D), H * hd)},
-            "mlp_norm": {"scale": norm_init(L, D)},
-            "gate_proj": {"kernel": dense_init(ks[4], (L, D, F), D)},
-            "up_proj": {"kernel": dense_init(ks[5], (L, D, F), D)},
-            "down_proj": {"kernel": dense_init(ks[6], (L, F, D), F)},
-        },
+        "layers": layers,
         "norm_f": {"scale": norm_init(D)},
     }
     if not cfg.tie_embeddings:
@@ -115,19 +143,27 @@ def partition_specs(cfg: TransformerConfig) -> Dict:
     Row-parallel (input dim over "tensor"): o, down.  Embedding + lm_head
     sharded over the vocab/hidden as appropriate.
     """
+    layer_specs = {
+        "attn_norm": {"scale": P(None, None)},
+        "q_proj": {"kernel": P(None, None, TENSOR)},
+        "k_proj": {"kernel": P(None, None, TENSOR)},
+        "v_proj": {"kernel": P(None, None, TENSOR)},
+        "o_proj": {"kernel": P(None, TENSOR, None)},
+        "mlp_norm": {"scale": P(None, None)},
+    }
+    if cfg.num_experts > 1:
+        # experts sharded over the "expert" mesh axis, TP within each expert
+        layer_specs["router"] = {"kernel": P(None, None, None)}
+        layer_specs["gate_proj"] = {"kernel": P(None, EXPERT, None, TENSOR)}
+        layer_specs["up_proj"] = {"kernel": P(None, EXPERT, None, TENSOR)}
+        layer_specs["down_proj"] = {"kernel": P(None, EXPERT, TENSOR, None)}
+    else:
+        layer_specs["gate_proj"] = {"kernel": P(None, None, TENSOR)}
+        layer_specs["up_proj"] = {"kernel": P(None, None, TENSOR)}
+        layer_specs["down_proj"] = {"kernel": P(None, TENSOR, None)}
     specs = {
         "embed": {"embedding": P(TENSOR, None)},
-        "layers": {
-            "attn_norm": {"scale": P(None, None)},
-            "q_proj": {"kernel": P(None, None, TENSOR)},
-            "k_proj": {"kernel": P(None, None, TENSOR)},
-            "v_proj": {"kernel": P(None, None, TENSOR)},
-            "o_proj": {"kernel": P(None, TENSOR, None)},
-            "mlp_norm": {"scale": P(None, None)},
-            "gate_proj": {"kernel": P(None, None, TENSOR)},
-            "up_proj": {"kernel": P(None, None, TENSOR)},
-            "down_proj": {"kernel": P(None, TENSOR, None)},
-        },
+        "layers": layer_specs,
         "norm_f": {"scale": P(None)},
     }
     if not cfg.tie_embeddings:
@@ -205,15 +241,40 @@ def _constrain(x, spec):
 
 
 def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
-            dropout_rng: Optional[jax.Array] = None) -> jax.Array:
-    """tokens [B, S] int32 → logits [B, S, V]."""
+            dropout_rng: Optional[jax.Array] = None,
+            return_aux_loss: bool = False) -> jax.Array:
+    """tokens [B, S] int32 → logits [B, S, V] (+ MoE aux loss if requested)."""
     dtype = params["layers"]["q_proj"]["kernel"].dtype
     x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
     x = _constrain(x, _activation_spec())
     S = tokens.shape[1]
     cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
 
-    def layer(x, lp):
+    def mlp_block(h, lp):
+        if cfg.num_experts > 1:
+            # Mixtral-style routed expert MLP (GShard dispatch; see moe/)
+            from ..moe.sharded_moe import topkgating
+
+            B_, S_, D_ = h.shape
+            tokens = h.reshape(-1, D_)
+            logits_r = tokens.astype(jnp.float32) @ lp["router"]["kernel"]
+            gate_out = topkgating(logits_r, k=cfg.moe_top_k,
+                                  capacity_factor=cfg.moe_capacity_factor)
+            w = lp["gate_proj"]["kernel"].dtype
+            dispatched = jnp.einsum("sec,sd->ecd",
+                                    gate_out.dispatch.astype(w), tokens)
+            act = jax.nn.silu(jnp.einsum("ecd,edf->ecf", dispatched,
+                                         lp["gate_proj"]["kernel"]))
+            up = jnp.einsum("ecd,edf->ecf", dispatched, lp["up_proj"]["kernel"])
+            eo = jnp.einsum("ecf,efd->ecd", act * up, lp["down_proj"]["kernel"])
+            out = jnp.einsum("sec,ecd->sd", gate_out.combine.astype(w), eo)
+            return out.reshape(B_, S_, D_), gate_out.l_aux
+        gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
+        up = h @ lp["up_proj"]["kernel"]
+        return (gate * up) @ lp["down_proj"]["kernel"], jnp.zeros((), jnp.float32)
+
+    def layer(carry, lp):
+        x, aux = carry
         B = x.shape[0]
         h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
         q = (h @ lp["q_proj"]["kernel"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
@@ -225,25 +286,24 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
         x = x + (o.reshape(B, S, -1) @ lp["o_proj"]["kernel"])
         x = _constrain(x, _activation_spec())
         h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
-        up = h @ lp["up_proj"]["kernel"]
-        x = x + ((gate * up) @ lp["down_proj"]["kernel"])
+        mlp_out, l_aux = mlp_block(h, lp)
+        x = x + mlp_out
         x = _constrain(x, _activation_spec())
-        return x, None
+        return (x, aux + l_aux), None
 
     layer_fn = layer
     if cfg.remat:
         layer_fn = jax.checkpoint(layer, policy=jax.checkpoint_policies.nothing_saveable)
 
-    def scan_body(carry, lp):
-        return layer_fn(carry, lp)
-
-    x, _ = jax.lax.scan(scan_body, x, params["layers"])
+    (x, aux_loss), _ = jax.lax.scan(layer_fn, (x, jnp.zeros((), jnp.float32)),
+                                    params["layers"])
     x = rms_norm(x, params["norm_f"]["scale"], cfg.norm_eps)
     if cfg.tie_embeddings:
         logits = x @ params["embed"]["embedding"].T
     else:
         logits = x @ params["lm_head"]["kernel"]
+    if return_aux_loss:
+        return logits, aux_loss
     return logits
 
 
@@ -255,7 +315,7 @@ def lm_loss(params: Dict, batch: Any, cfg: TransformerConfig,
     """
     tokens = batch["input_ids"] if isinstance(batch, dict) else batch
     labels = batch.get("labels") if isinstance(batch, dict) else None
-    logits = forward(params, tokens, cfg)
+    logits, aux_loss = forward(params, tokens, cfg, return_aux_loss=True)
     if labels is None:
         labels = jnp.pad(tokens[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
     logits = logits.astype(jnp.float32)
@@ -263,7 +323,10 @@ def lm_loss(params: Dict, batch: Any, cfg: TransformerConfig,
     valid = labels >= 0
     safe_labels = jnp.where(valid, labels, 0)
     token_logp = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-    return -jnp.sum(token_logp * valid) / jnp.maximum(jnp.sum(valid), 1)
+    loss = -jnp.sum(token_logp * valid) / jnp.maximum(jnp.sum(valid), 1)
+    if cfg.num_experts > 1:
+        loss = loss + cfg.moe_aux_loss_coef * aux_loss / cfg.num_layers
+    return loss
 
 
 class CausalLM:

@@ -1,0 +1,90 @@
+"""Mixtral-style MoE CausalLM tests (reference: Mixtral container/model tests +
+moe engine integration)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+
+def batch(n, seq=32, vocab=256, seed=0):
+    rng = np.random.default_rng(seed)
+    return {"input_ids": jnp.asarray(rng.integers(0, vocab, size=(n, seq)), jnp.int32)}
+
+
+class TestMoECausalLM:
+    def test_forward_and_aux_loss(self):
+        initialize_mesh(TopologyConfig(), force=True)
+        from deepspeed_tpu.models.transformer import forward
+
+        cfg = TransformerConfig.tiny_moe(use_flash=False)
+        model = CausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        logits, aux = forward(params, batch(4)["input_ids"], cfg,
+                              return_aux_loss=True)
+        assert logits.shape == (4, 32, 256)
+        assert float(aux) > 0  # load-balance loss accumulated over layers
+
+    def test_trains_and_loss_decreases(self):
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        cfg = TransformerConfig.tiny_moe(use_flash=False)
+        model = CausalLM(cfg)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init_params(jax.random.PRNGKey(0)),
+            config={"train_micro_batch_size_per_gpu": 1,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}},
+            topology=topo)
+        b = batch(engine.train_batch_size())
+        losses = [float(engine.train_batch(b)) for _ in range(8)]
+        assert losses[-1] < losses[0]
+
+    def test_ep_sharded_matches_dp(self):
+        """ep=4 expert-sharded training == pure-DP numerics."""
+        cfg = TransformerConfig.tiny_moe(use_flash=False)
+
+        def build(topo_cfg, micro):
+            topo = initialize_mesh(topo_cfg, force=True)
+            model = CausalLM(cfg)
+            engine, _, _, _ = deepspeed_tpu.initialize(
+                model=model,
+                model_parameters=model.init_params(jax.random.PRNGKey(0)),
+                config={"train_micro_batch_size_per_gpu": micro,
+                        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}},
+                topology=topo)
+            return engine
+
+        e_dp = build(TopologyConfig(), 2)             # dp8, global 16
+        e_ep = build(TopologyConfig(expert=4), 8)     # dp2×ep4, global 16
+        b = batch(16)
+        for _ in range(2):
+            l_dp = float(e_dp.train_batch(b))
+            l_ep = float(e_ep.train_batch(b))
+        np.testing.assert_allclose(l_dp, l_ep, rtol=1e-4)
+        # experts actually sharded over the expert axis
+        gk = e_ep.state.params["layers"]["gate_proj"]["kernel"]
+        assert not gk.sharding.is_fully_replicated
+
+    def test_moe_with_zero3(self):
+        topo = initialize_mesh(TopologyConfig(expert=2), force=True)
+        cfg = TransformerConfig.tiny_moe(use_flash=False)
+        model = CausalLM(cfg)
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init_params(jax.random.PRNGKey(0)),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 3}},
+            topology=topo)
+        l0 = float(engine.train_batch(batch(engine.train_batch_size())))
+        assert np.isfinite(l0)
+
+    def test_moe_serving_rejected_for_now(self):
+        from deepspeed_tpu.inference.v2.engine_v2 import InferenceEngineV2
+
+        initialize_mesh(TopologyConfig(), force=True)
+        cfg = TransformerConfig.tiny_moe(use_flash=False)
+        model = CausalLM(cfg)
+        with pytest.raises(NotImplementedError):
+            InferenceEngineV2(model, model.init_params(jax.random.PRNGKey(0)))
