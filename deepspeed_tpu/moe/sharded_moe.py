@@ -150,6 +150,19 @@ def moe_partition_specs() -> Dict:
     }
 
 
+def dispatch_to_experts(dispatch: jnp.ndarray, tokens: jnp.ndarray,
+                        dtype) -> jnp.ndarray:
+    """[S,E,C] mask × [S,D] tokens → [E,C,D] expert inputs (the GShard
+    dispatch einsum; shared by moe_layer and the MoE transformer block)."""
+    return jnp.einsum("sec,sd->ecd", dispatch.astype(dtype), tokens.astype(dtype))
+
+
+def combine_from_experts(combine: jnp.ndarray, expert_out: jnp.ndarray,
+                         dtype) -> jnp.ndarray:
+    """[S,E,C] weights × [E,C,D] expert outputs → [S,D]."""
+    return jnp.einsum("sec,ecd->sd", combine.astype(dtype), expert_out)
+
+
 def moe_layer(params: Dict, x: jnp.ndarray, k: int = 1,
               capacity_factor: float = 1.0, eval_capacity_factor: float = 1.0,
               min_capacity: int = 4, drop_tokens: bool = True,
@@ -174,9 +187,8 @@ def moe_layer(params: Dict, x: jnp.ndarray, k: int = 1,
 
     w = params["experts"]
     dtype = w["w1"].dtype
-    dispatched = jnp.einsum("sec,sd->ecd", gate.dispatch.astype(dtype),
-                            tokens.astype(dtype))                  # [E, C, D]
+    dispatched = dispatch_to_experts(gate.dispatch, tokens, dtype)  # [E, C, D]
     h = activation(jnp.einsum("ecd,edf->ecf", dispatched, w["w1"]) + w["b1"][:, None, :])
     expert_out = jnp.einsum("ecf,efd->ecd", h, w["w2"]) + w["b2"][:, None, :]
-    out = jnp.einsum("sec,ecd->sd", gate.combine.astype(dtype), expert_out)
+    out = combine_from_experts(gate.combine, expert_out, dtype)
     return out.reshape(orig_shape), gate.l_aux, gate.exp_counts
