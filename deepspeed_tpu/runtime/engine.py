@@ -120,11 +120,15 @@ class DeepSpeedEngine:
         # ---- state layout + placement -------------------------------- #
         self.param_shardings = self.plan.param_shardings(params)
         params = jax.device_put(params, self.param_shardings)
-        opt_state = jax.jit(
-            self.optimizer.init,
-            out_shardings=self.plan.opt_state_shardings(
-                jax.eval_shape(self.optimizer.init, params), params),
-        )(params)
+        opt_shardings = self.plan.opt_state_shardings(
+            jax.eval_shape(self.optimizer.init, params), params)
+        # ZeRO-Offload: optimizer state lives in pinned host memory; XLA
+        # streams it through the update (reference: cpu-Adam on host,
+        # offload_config 'device: cpu'). Ratio<1 keeps a device-resident
+        # fraction (Twin-Flow) — approximated as all-or-nothing per leaf.
+        if config.zero_config.offload_optimizer_device() == "cpu":
+            opt_shardings = jax.tree.map(self._to_host_memory, opt_shardings)
+        opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
 
         gas = config.gradient_accumulation_steps
         grad_acc = None
@@ -200,6 +204,21 @@ class DeepSpeedEngine:
         if cfg is None:
             return build_optimizer("adam", {}, learning_rate=self._schedule_fn)
         return build_optimizer(cfg.type, cfg.params, learning_rate=self._schedule_fn)
+
+    def _to_host_memory(self, sharding):
+        """NamedSharding → pinned_host memory kind (TPU only: the CPU backend's
+        SPMD partitioner rejects host-placement annotations)."""
+        if jax.default_backend() != "tpu":
+            from ..utils.logging import warning_once
+
+            warning_once("offload_optimizer device=cpu: pinned_host placement "
+                         "needs the TPU backend; optimizer state stays in "
+                         "device memory on this backend")
+            return sharding
+        try:
+            return sharding.with_memory_kind("pinned_host")
+        except Exception:
+            return sharding
 
     def _configure_monitor(self):
         try:
@@ -298,6 +317,13 @@ class DeepSpeedEngine:
         grads = self.loss_scaler.unscale_grads(grads, state.scaler)
         if grad_norm_scale is not None:
             grads = jax.tree.map(lambda g: g * grad_norm_scale, grads)
+        # prescale_gradients / gradient_predivide_factor (reference
+        # engine.py:2048 allreduce epilogue knobs): with sharded autodiff the
+        # mean is already exact, so predivide is applied as a plain scale.
+        if self.config.prescale_gradients and \
+                self.config.gradient_predivide_factor != 1.0:
+            f = 1.0 / self.config.gradient_predivide_factor
+            grads = jax.tree.map(lambda g: g * f, grads)
         overflow = self.loss_scaler.check_overflow(grads) \
             if self.loss_scaler.dynamic else jnp.zeros((), bool)
 
