@@ -68,6 +68,28 @@ class WandbMonitor(Monitor):
             self._wandb.log({label: value}, step=step)
 
 
+class CometMonitor(Monitor):
+    """Reference: monitor/comet.py — gated on comet_ml availability."""
+
+    def __init__(self, config):
+        super().__init__(config)
+        if not self.enabled:
+            return
+        try:
+            import comet_ml
+
+            self._exp = comet_ml.Experiment(project_name=getattr(config, "project", None))
+        except Exception as e:
+            logger.warning(f"comet unavailable: {e}")
+            self.enabled = False
+
+    def write_events(self, event_list: List[Event]) -> None:
+        if not self.enabled:
+            return
+        for label, value, step in event_list:
+            self._exp.log_metric(label, value, step=step)
+
+
 class csvMonitor(Monitor):  # reference class name
     def __init__(self, config):
         super().__init__(config)
@@ -93,13 +115,18 @@ class csvMonitor(Monitor):  # reference class name
 
 class MonitorMaster(Monitor):
     def __init__(self, ds_config):
+        from ..runtime.config import MonitorWriterConfig
+
         self.tb_monitor = TensorBoardMonitor(ds_config.tensorboard)
         self.wandb_monitor = WandbMonitor(ds_config.wandb)
         self.csv_monitor = csvMonitor(ds_config.csv_monitor)
-        self.enabled = any(m.enabled for m in
-                           (self.tb_monitor, self.wandb_monitor, self.csv_monitor))
+        self.comet_monitor = CometMonitor(
+            getattr(ds_config, "comet", None) or MonitorWriterConfig())
+        self._writers = (self.tb_monitor, self.wandb_monitor, self.csv_monitor,
+                         self.comet_monitor)
+        self.enabled = any(m.enabled for m in self._writers)
 
     def write_events(self, event_list: List[Event]) -> None:
-        for m in (self.tb_monitor, self.wandb_monitor, self.csv_monitor):
+        for m in self._writers:
             if m.enabled:
                 m.write_events(event_list)

@@ -1,0 +1,60 @@
+"""Sparse gradient representation (reference: runtime/sparse_tensor.py:13
+``SparseTensor`` + engine.sparse_allreduce_bucket, engine.py:2636).
+
+The reference compresses embedding gradients (mostly-zero rows) into
+(indices, values) before allreduce.  In JAX, embedding grads from ``jnp.take``
+are dense by the time autodiff surfaces them, so this module provides the
+conversion + gather-based "sparse allreduce" (allgather of nonzero rows, the
+reference's strategy) for explicit use inside shard_map training loops.
+The engine's fused path does not yet route embedding grads through it — the
+``sparse_gradients`` config flag wiring is tracked in ROADMAP.md.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+class SparseTensor(NamedTuple):
+    indices: jnp.ndarray   # [nnz] row ids
+    values: jnp.ndarray    # [nnz, dim]
+    dense_shape: Tuple[int, int]
+
+    @staticmethod
+    def from_dense(dense: jnp.ndarray, max_nnz: int) -> "SparseTensor":
+        """Top-``max_nnz`` rows by L1 mass (static shape for jit).
+
+        LOSSY when the dense input has more than ``max_nnz`` nonzero rows —
+        size ``max_nnz`` to bound the unique rows touched per step (e.g. the
+        micro-batch token count for embedding grads), or check with
+        :func:`truncation_count` outside jit.
+        """
+        mass = jnp.sum(jnp.abs(dense), axis=tuple(range(1, dense.ndim)))
+        _, idx = jax.lax.top_k(mass, max_nnz)
+        return SparseTensor(indices=idx, values=dense[idx],
+                            dense_shape=tuple(dense.shape))
+
+    def to_dense(self) -> jnp.ndarray:
+        out = jnp.zeros(self.dense_shape, self.values.dtype)
+        return out.at[self.indices].add(self.values)
+
+
+def truncation_count(dense: jnp.ndarray, max_nnz: int) -> jnp.ndarray:
+    """Number of nonzero rows that ``from_dense(max_nnz)`` would drop."""
+    mass = jnp.sum(jnp.abs(dense), axis=tuple(range(1, dense.ndim)))
+    return jnp.maximum(jnp.sum(mass > 0) - max_nnz, 0)
+
+
+def sparse_allreduce(sparse: SparseTensor, axes) -> jnp.ndarray:
+    """Gather-based sparse allreduce (reference sparse_allreduce_bucket):
+    allgather (indices, values) over the group, scatter-add into dense.
+    Returns the dense mean.  Run inside shard_map with ``axes`` bound —
+    the group size comes from the bound axes themselves, so an unbound or
+    misspelled axis name raises instead of silently skipping the reduction."""
+    n = jax.lax.psum(1, axes)
+    all_idx = jax.lax.all_gather(sparse.indices, axes, axis=0, tiled=True)
+    all_val = jax.lax.all_gather(sparse.values, axes, axis=0, tiled=True)
+    dense = jnp.zeros(sparse.dense_shape, sparse.values.dtype)
+    return dense.at[all_idx].add(all_val) / n

@@ -191,3 +191,17 @@ class TestMonitor:
         files = list((tmp_path / "job").glob("*.csv"))
         assert len(files) == 1
         assert "1.5" in files[0].read_text()
+
+
+class TestMonitorMaster:
+    def test_comet_writer_configured_from_config(self):
+        from deepspeed_tpu.monitor.monitor import MonitorMaster
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        initialize_mesh(TopologyConfig(), force=True)
+        cfg = DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                               "comet": {"enabled": False, "project": "x"}})
+        assert cfg.comet.project == "x"
+        m = MonitorMaster(cfg)
+        assert hasattr(m, "comet_monitor")
+        assert not m.enabled  # nothing enabled
