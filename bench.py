@@ -7,13 +7,20 @@ deepspeed_tpu.initialize() engine.  vs_baseline is MFU / 0.50 — the
 reference's north-star target (BASELINE.md: Llama-3-8B ZeRO-3 at >50% MFU on
 v5p; scaled to the model size that fits the available chip).
 
-Env knobs: DSTPU_BENCH_LAYERS / HIDDEN / SEQ / BATCH / STEPS, DSTPU_BENCH_MODE
-(train | inference).
+Backend safety: the TPU relay in this environment admits one client and can
+wedge; backend init is therefore probed in a subprocess with a timeout
+(SIGTERM only — never SIGKILL a live TPU client), and any failure degrades to
+a parseable JSON result instead of a crash.
+
+Env knobs: DSTPU_BENCH_LAYERS / HIDDEN / SEQ / BATCH / STEPS,
+DSTPU_BENCH_MODE (train | flash_sweep), DSTPU_BENCH_FORCE_CPU=1,
+DSTPU_BENCH_PROBE_TIMEOUT (seconds, default 300).
 """
 from __future__ import annotations
 
 import json
 import os
+import subprocess
 import sys
 import time
 
@@ -48,9 +55,48 @@ def env_int(name, default):
     return int(os.environ.get(name, default))
 
 
-def main():
-    on_tpu = jax.default_backend() == "tpu"
-    log(f"backend={jax.default_backend()} devices={jax.devices()}")
+def emit(metric, value, unit, vs_baseline, extra):
+    print(json.dumps({
+        "metric": metric, "value": value, "unit": unit,
+        "vs_baseline": vs_baseline, "extra": extra,
+    }), flush=True)
+
+
+def probe_tpu(timeout: float) -> tuple[bool, str]:
+    """Initialize the TPU backend in a throwaway subprocess so a wedged relay
+    or broken plugin can't hang/crash the bench itself.  The child exits
+    before we init our own client, so TPU access stays serialized."""
+    code = "import jax; print('PROBE_BACKEND=' + jax.default_backend())"
+    try:
+        proc = subprocess.Popen(
+            [sys.executable, "-c", code], stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True)
+    except Exception as exc:  # noqa: BLE001
+        return False, f"probe spawn failed: {exc}"
+    try:
+        out, _ = proc.communicate(timeout=timeout)
+    except subprocess.TimeoutExpired:
+        proc.terminate()          # SIGTERM; a SIGKILL would wedge the relay
+        try:
+            proc.wait(timeout=30)
+        except subprocess.TimeoutExpired:
+            pass
+        return False, f"backend probe timed out after {timeout:.0f}s"
+    if proc.returncode != 0:
+        return False, f"probe rc={proc.returncode}: {out.strip()[-500:]}"
+    if "PROBE_BACKEND=tpu" in out:
+        return True, "ok"
+    return False, f"probe backend not tpu: {out.strip()[-200:]}"
+
+
+def force_cpu_backend() -> None:
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception as exc:  # noqa: BLE001
+        log(f"could not force cpu backend: {exc}")
+
+
+def run_train_bench(on_tpu: bool, tpu_reason: str) -> None:
     import deepspeed_tpu
     from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
     from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
@@ -120,21 +166,104 @@ def main():
     mfu = tok_per_sec_chip * flops_per_token / peak_flops_per_chip()
     log(f"done: {tok_per_sec_chip:.0f} tok/s/chip, mfu={mfu:.3f}")
 
-    print(json.dumps({
-        "metric": "zero_train_tokens_per_sec_per_chip",
-        "value": round(tok_per_sec_chip, 1),
-        "unit": "tokens/s/chip",
-        "vs_baseline": round(mfu / 0.50, 4),
-        "extra": {
-            "mfu": round(mfu, 4),
-            "model_params": model.num_params(),
-            "loss": float(loss),
-            "chips": n_chips,
-            "seq_len": seq,
-            "step_time_s": round(dt / steps, 4),
-            "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
-        },
-    }), flush=True)
+    extra = {
+        "mfu": round(mfu, 4),
+        "model_params": model.num_params(),
+        "loss": float(loss),
+        "chips": n_chips,
+        "seq_len": seq,
+        "step_time_s": round(dt / steps, 4),
+        "device": str(getattr(jax.devices()[0], "device_kind", "cpu")),
+        "backend": jax.default_backend(),
+    }
+    if not on_tpu:
+        extra["tpu_unavailable_reason"] = tpu_reason
+    emit("zero_train_tokens_per_sec_per_chip", round(tok_per_sec_chip, 1),
+         "tokens/s/chip", round(mfu / 0.50, 4), extra)
+
+
+def run_flash_sweep(on_tpu: bool) -> None:
+    """Sweep flash-attention block sizes; one JSON line with the best config
+    and the full table in extra (recorded for kernel tuning)."""
+    from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+
+    B, H, hd = 4, 16, 128
+    S = env_int("DSTPU_BENCH_SEQ", 2048 if on_tpu else 256)
+    steps = env_int("DSTPU_BENCH_STEPS", 20 if on_tpu else 2)
+    blocks = [128, 256, 512, 1024] if on_tpu else [128, 256]
+    key = jax.random.PRNGKey(0)
+    q = jax.random.normal(key, (B, S, H, hd), jnp.bfloat16)
+    k = jax.random.normal(key, (B, S, H, hd), jnp.bfloat16)
+    v = jax.random.normal(key, (B, S, H, hd), jnp.bfloat16)
+
+    results = []
+    for bq in blocks:
+        for bk in blocks:
+            if bq > S or bk > S:
+                continue
+            fn = jax.jit(lambda q, k, v, bq=bq, bk=bk: flash_attention(
+                q, k, v, causal=True, block_q=bq, block_k=bk))
+            try:
+                jax.block_until_ready(fn(q, k, v))  # compile
+                t0 = time.perf_counter()
+                for _ in range(steps):
+                    out = fn(q, k, v)
+                jax.block_until_ready(out)
+                dt = (time.perf_counter() - t0) / steps
+            except Exception as exc:  # noqa: BLE001
+                log(f"bq={bq} bk={bk}: FAILED {str(exc)[:120]}")
+                continue
+            # causal ≈ half the 4*B*H*S²*hd matmul flops (fwd: QK^T + PV)
+            flops = 2 * B * H * S * S * hd
+            tflops = flops / dt / 1e12
+            results.append({"block_q": bq, "block_k": bk,
+                            "ms": round(dt * 1e3, 3),
+                            "tflops": round(tflops, 1)})
+            log(f"bq={bq} bk={bk}: {dt*1e3:.2f} ms, {tflops:.1f} TF/s")
+    if not results:
+        emit("flash_attention_tflops", 0.0, "TFLOP/s", 0.0,
+             {"error": "all configs failed", "seq_len": S})
+        return
+    best = max(results, key=lambda r: (r["tflops"], -r["ms"]))
+    emit("flash_attention_tflops", best["tflops"], "TFLOP/s",
+         round(best["tflops"] / (peak_flops_per_chip() / 1e12), 4),
+         {"best": best, "sweep": results, "seq_len": S,
+          "backend": jax.default_backend()})
+
+
+def main():
+    mode = os.environ.get("DSTPU_BENCH_MODE", "train")
+    tpu_ok, reason = False, "forced cpu"
+    if os.environ.get("DSTPU_BENCH_FORCE_CPU") != "1":
+        timeout = float(os.environ.get("DSTPU_BENCH_PROBE_TIMEOUT", "300"))
+        log(f"probing TPU backend (timeout {timeout:.0f}s)")
+        tpu_ok, reason = probe_tpu(timeout)
+        log(f"probe: tpu_ok={tpu_ok} ({reason})")
+    if not tpu_ok:
+        force_cpu_backend()
+    fail_metric, fail_unit = (
+        ("flash_attention_tflops", "TFLOP/s") if mode == "flash_sweep"
+        else ("zero_train_tokens_per_sec_per_chip", "tokens/s/chip"))
+    try:
+        backend = jax.default_backend()
+    except Exception as exc:  # noqa: BLE001
+        emit(fail_metric, 0.0, fail_unit, 0.0,
+             {"error": f"backend init failed: {str(exc)[-300:]}",
+              "tpu_unavailable_reason": reason})
+        return
+    on_tpu = backend == "tpu"
+    log(f"backend={backend} devices={len(jax.devices())}")
+    try:
+        if mode == "flash_sweep":
+            run_flash_sweep(on_tpu)
+        else:
+            run_train_bench(on_tpu, reason)
+    except Exception as exc:  # noqa: BLE001
+        import traceback
+        traceback.print_exc(file=sys.stderr)
+        emit(fail_metric, 0.0, fail_unit, 0.0,
+             {"error": f"bench failed on {backend}: {str(exc)[-300:]}",
+              "tpu_unavailable_reason": reason})
 
 
 if __name__ == "__main__":

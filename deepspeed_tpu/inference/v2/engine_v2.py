@@ -49,6 +49,9 @@ class RaggedInferenceEngineConfig:
     block_size: int = 64
     num_blocks: Optional[int] = None  # default: enough for max_seqs * max_ctx
     dtype: object = jnp.bfloat16
+    #: "paged" = Pallas paged-attention kernel (blocked_flash equivalent);
+    #: "gather" = dense slot-gather reference path (numerics oracle).
+    attn_impl: str = "paged"
 
 
 class InferenceEngineV2:
@@ -71,7 +74,9 @@ class InferenceEngineV2:
             head_dim=self.cfg.head_dim, dtype=c.dtype))
         self.params = jax.tree.map(lambda x: jnp.asarray(x, c.dtype), params)
         # gate/norm params stay f32 where the model expects; logits are f32.
-        self._step = build_ragged_step(self.cfg, max_q=c.max_tokens)
+        self._step = build_ragged_step(self.cfg, max_q=c.max_tokens,
+                                       block_size=c.block_size,
+                                       attn_impl=c.attn_impl)
         self._wrapper = RaggedBatchWrapper(c.max_tokens, c.max_seqs, c.max_ctx,
                                            c.block_size,
                                            trash_slot=self.kv.config.trash_slot)

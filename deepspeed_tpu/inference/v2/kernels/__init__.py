@@ -1,0 +1,9 @@
+"""Ragged serving kernels (reference: deepspeed/inference/v2/kernels/ —
+blocked_flash, linear_blocked_kv_rotary, moe_gather/moe_scatter, logits_gather).
+
+TPU equivalents live here as Pallas kernels + XLA-native ops; see
+``ragged_ops.py``.
+"""
+from .ragged_ops import paged_attention, paged_kv_append
+
+__all__ = ["paged_attention", "paged_kv_append"]

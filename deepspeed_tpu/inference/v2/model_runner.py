@@ -3,14 +3,19 @@ inference_transformer_base.py:48 + the ragged_ops kernel chain in §3.4:
 qkv → linear_blocked_kv_rotary (paged KV append) → blocked_flash → logits_gather).
 
 One jitted step serves ANY mix of prefill and decode under fixed budgets
-(max_tokens/max_seqs/max_ctx), with the paged KV cache donated through the
+(max_tokens/max_seqs/max_blocks), with the paged KV cache donated through the
 call so the update is in-place in HBM.
 
 Pipeline per layer over the flat token axis [T]:
-  rmsnorm → qkv proj → RoPE (per-token absolute positions) → scatter K/V to
-  cache slots → per-sequence blocked attention over gathered context slots →
-  o proj → MLP.  Logits are computed only for each sequence's last token
-  (logits_gather), like the reference.
+  rmsnorm → qkv proj → RoPE (per-token absolute positions) → paged KV append
+  → Pallas paged attention over the sequence's block table → o proj → MLP.
+Logits are computed only for each sequence's last token (logits_gather).
+
+Two attention impls:
+  "paged"  — Pallas paged-attention kernel (kernels/ragged_ops.py); HBM
+             traffic O(cached tokens), serves 32k+ contexts.
+  "gather" — dense slot-gather reference path (round-1 semantics, O(S·C)
+             HBM per layer); kept as the numerics oracle for kernel tests.
 """
 from __future__ import annotations
 
@@ -21,7 +26,8 @@ from typing import Any, Dict, Tuple
 import jax
 import jax.numpy as jnp
 
-from ...models.transformer import TransformerConfig, apply_rope, rms_norm
+from ...models.transformer import TransformerConfig, rms_norm
+from .kernels.ragged_ops import paged_attention, paged_kv_append
 
 
 def _rope_at(pos, head_dim, theta):
@@ -39,9 +45,46 @@ def _apply_rope_flat(x, cos, sin):
     return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
 
 
+def _attend_gather(q_seq, layer_k, layer_v, block_table, q_len, ctx_len,
+                   block_size, scale):
+    """Dense-gather reference attention (the round-1 path).
+
+    Derives the flat slot map from the block table on device, gathers the
+    full padded context per sequence, and runs masked softmax attention.
+    """
+    S, mq, H, hd = q_seq.shape
+    KV = layer_k.shape[0]
+    NB = block_table.shape[1]
+    C = NB * block_size
+    ctx_pos = jnp.arange(C, dtype=jnp.int32)
+    kv_gather = jnp.take_along_axis(
+        block_table, (ctx_pos // block_size)[None, :].repeat(S, 0), axis=1
+    ) * block_size + (ctx_pos % block_size)[None, :]          # [S, C]
+
+    k_ctx = jnp.take(layer_k, kv_gather.reshape(-1), axis=1) \
+        .reshape(KV, S, C, hd).transpose(1, 2, 0, 3)          # [S, C, KV, hd]
+    v_ctx = jnp.take(layer_v, kv_gather.reshape(-1), axis=1) \
+        .reshape(KV, S, C, hd).transpose(1, 2, 0, 3)
+    if KV != H:
+        k_ctx = jnp.repeat(k_ctx, H // KV, axis=2)
+        v_ctx = jnp.repeat(v_ctx, H // KV, axis=2)
+
+    q_pos = ctx_len[:, None] - q_len[:, None] + jnp.arange(mq)[None, :]
+    q_mask = jnp.arange(mq)[None, :] < q_len[:, None]
+    attn_mask = (ctx_pos[None, None, :] <= q_pos[:, :, None]) & \
+        (ctx_pos[None, None, :] < ctx_len[:, None, None]) & q_mask[:, :, None]
+
+    scores = jnp.einsum("sqhd,schd->shqc", q_seq.astype(jnp.float32),
+                        k_ctx.astype(jnp.float32)) * scale
+    scores = jnp.where(attn_mask[:, None, :, :], scores, -1e30)
+    probs = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("shqc,schd->sqhd", probs, v_ctx.astype(jnp.float32))
+
+
 def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
                    batch: Dict[str, jnp.ndarray], cfg: TransformerConfig,
-                   max_q: int) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                   max_q: int, block_size: int,
+                   attn_impl: str = "paged") -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """→ (last-token logits [max_seqs, V], new kcache, new vcache)."""
     tokens = batch["tokens"]              # [T]
     kv_slot = batch["kv_slot"]            # [T]
@@ -50,11 +93,10 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
     q_offset = batch["q_offset"]          # [S]
     q_len = batch["q_len"]                # [S]
     ctx_len = batch["ctx_len"]            # [S]
-    kv_gather = batch["kv_gather"]        # [S, C]
+    block_table = batch["block_table"]    # [S, NB]
     logit_idx = batch["logit_idx"]        # [S]
 
     T = tokens.shape[0]
-    S, C = kv_gather.shape
     H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     dtype = params["layers"]["q_proj"]["kernel"].dtype
     scale = 1.0 / math.sqrt(hd)
@@ -64,11 +106,6 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
 
     # per-seq gather indices for queries: [S, max_q]
     q_idx = jnp.clip(q_offset[:, None] + jnp.arange(max_q)[None, :], 0, T - 1)
-    q_mask = jnp.arange(max_q)[None, :] < q_len[:, None]          # [S, mq]
-    q_pos = ctx_len[:, None] - q_len[:, None] + jnp.arange(max_q)[None, :]
-    ctx_pos = jnp.arange(C)[None, :]                              # [1, C]
-    attn_mask = (ctx_pos[:, None, :] <= q_pos[:, :, None]) & \
-        (ctx_pos[:, None, :] < ctx_len[:, None, None]) & q_mask[:, :, None]  # [S,mq,C]
 
     def layer_step(carry, inputs):
         x, = carry
@@ -79,25 +116,18 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
         v = (h @ lp["v_proj"]["kernel"]).reshape(T, KV, hd)
         q = _apply_rope_flat(q, cos, sin)
         k = _apply_rope_flat(k, cos, sin)
-        # paged KV append (linear_blocked_kv_rotary equivalent)
-        layer_k = layer_k.at[kv_slot].set(k.astype(layer_k.dtype))
-        layer_v = layer_v.at[kv_slot].set(v.astype(layer_v.dtype))
-        # gather context and attend per sequence
-        k_ctx = jnp.take(layer_k, kv_gather.reshape(-1), axis=0
-                         ).reshape(S, C, KV, hd)
-        v_ctx = jnp.take(layer_v, kv_gather.reshape(-1), axis=0
-                         ).reshape(S, C, KV, hd)
-        if KV != H:
-            k_ctx = jnp.repeat(k_ctx, H // KV, axis=2)
-            v_ctx = jnp.repeat(v_ctx, H // KV, axis=2)
+        layer_k, layer_v = paged_kv_append(layer_k, layer_v, k, v, kv_slot)
+
         q_seq = jnp.take(q.reshape(T, -1), q_idx.reshape(-1), axis=0
-                         ).reshape(S, max_q, H, hd)
-        scores = jnp.einsum("sqhd,schd->shqc", q_seq.astype(jnp.float32),
-                            k_ctx.astype(jnp.float32)) * scale
-        scores = jnp.where(attn_mask[:, None, :, :], scores, -1e30)
-        probs = jax.nn.softmax(scores, axis=-1)
-        o_seq = jnp.einsum("shqc,schd->sqhd", probs,
-                           v_ctx.astype(jnp.float32)).astype(dtype)
+                         ).reshape(-1, max_q, H, hd)           # [S, mq, H, hd]
+        if attn_impl == "paged":
+            o_seq = paged_attention(q_seq, layer_k, layer_v, block_table,
+                                    q_len, ctx_len, block_size=block_size,
+                                    scale=scale)
+        else:
+            o_seq = _attend_gather(q_seq, layer_k, layer_v, block_table,
+                                   q_len, ctx_len, block_size, scale)
+        o_seq = o_seq.astype(dtype)
         # scatter back to flat tokens: out[t] = o_seq[seq_of[t], t - q_offset[seq_of[t]]]
         within = jnp.arange(T) - jnp.take(q_offset, seq_of)
         within = jnp.clip(within, 0, max_q - 1)
@@ -121,8 +151,12 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
     return logits.astype(jnp.float32), new_k, new_v
 
 
-def build_ragged_step(cfg: TransformerConfig, max_q: int):
+def build_ragged_step(cfg: TransformerConfig, max_q: int, block_size: int,
+                      attn_impl: str = "paged"):
     """Jitted step with donated caches (the CUDA-graph analogue: one compiled
     program reused for every batch; reference engine.py:494 _create_cuda_graph)."""
-    fn = partial(ragged_forward, cfg=cfg, max_q=max_q)
+    assert attn_impl in ("paged", "gather"), \
+        f"attn_impl must be 'paged' or 'gather', got {attn_impl!r}"
+    fn = partial(ragged_forward, cfg=cfg, max_q=max_q, block_size=block_size,
+                 attn_impl=attn_impl)
     return jax.jit(fn, donate_argnums=(1, 2))

@@ -1,10 +1,12 @@
 """Blocked (paged) KV cache on TPU HBM (reference: inference/v2/ragged/kv_cache.py:40).
 
-Storage is one flat slot dimension: ``[layers, num_blocks*block_size + 1,
-kv_heads, head_dim]`` for K and V.  Block tables index into the slot dim; the
-final slot is a trash row that padded tokens write into, keeping the update a
-single dense scatter (no predication) — the XLA-friendly equivalent of the
-reference's per-block pointer indirection.
+Storage is kv-head-major with a flat, block-contiguous slot dimension:
+``[layers, kv_heads, (num_blocks+1)*block_size, head_dim]`` for K and V.
+Block tables index physical blocks; slot = block*block_size + offset.  The
+FINAL block is a trash block that padded tokens write into, keeping the
+append a single dense scatter (no predication).  Head-major layout lets the
+paged-attention kernel view the cache as ``[KV, blocks, block_size, hd]``
+with lane/sublane-aligned (block_size, hd) tiles.
 """
 from __future__ import annotations
 
@@ -26,18 +28,25 @@ class KVCacheConfig:
 
     @property
     def num_slots(self) -> int:
+        """Addressable (non-trash) slots."""
         return self.num_blocks * self.block_size
 
     @property
+    def total_slots(self) -> int:
+        """Including the trailing trash block."""
+        return (self.num_blocks + 1) * self.block_size
+
+    @property
     def trash_slot(self) -> int:
+        """First slot of the trash block (any slot in it is safe)."""
         return self.num_slots
 
 
 class BlockedKVCache:
     def __init__(self, config: KVCacheConfig):
         self.config = config
-        shape = (config.num_layers, config.num_slots + 1,
-                 config.num_kv_heads, config.head_dim)
+        shape = (config.num_layers, config.num_kv_heads,
+                 config.total_slots, config.head_dim)
         self.k = jnp.zeros(shape, config.dtype)
         self.v = jnp.zeros(shape, config.dtype)
 

@@ -8,14 +8,18 @@ so the same compiled program serves every batch composition.
 
 Device views produced:
   tokens        [max_tokens]              flat input ids (padded 0)
-  kv_slot       [max_tokens]              flat cache slot per token (block*bs+off; -1 pad → slot 0 masked)
+  kv_slot       [max_tokens]              flat cache slot per token (block*bs+off; pad → trash block)
   seq_of_token  [max_tokens]              owning sequence row (pad → max_seqs-1 dummy)
   pos_of_token  [max_tokens]              absolute position in its sequence
   q_offset      [max_seqs]                first flat index of each seq's queries
   q_len         [max_seqs]                query tokens this forward
   ctx_len       [max_seqs]                seen + in-flight tokens (attention span)
-  kv_gather     [max_seqs, max_ctx]       flat cache slots for each seq's context
+  block_table   [max_seqs, max_blocks]    physical KV block ids per sequence
   logit_idx     [max_seqs]                flat index of each seq's last token
+
+The block table is O(max_ctx / block_size) per sequence — long contexts
+(32k+) cost a few hundred ints of metadata, not a dense slot map; the paged
+attention kernel dereferences it on-chip (SMEM scalar prefetch).
 """
 from __future__ import annotations
 
@@ -36,7 +40,7 @@ class RaggedBatch:
     q_offset: np.ndarray
     q_len: np.ndarray
     ctx_len: np.ndarray
-    kv_gather: np.ndarray
+    block_table: np.ndarray
     logit_idx: np.ndarray
     n_tokens: int
     n_seqs: int
@@ -53,7 +57,7 @@ class RaggedBatch:
             "q_offset": jnp.asarray(self.q_offset, jnp.int32),
             "q_len": jnp.asarray(self.q_len, jnp.int32),
             "ctx_len": jnp.asarray(self.ctx_len, jnp.int32),
-            "kv_gather": jnp.asarray(self.kv_gather, jnp.int32),
+            "block_table": jnp.asarray(self.block_table, jnp.int32),
             "logit_idx": jnp.asarray(self.logit_idx, jnp.int32),
         }
 
@@ -65,8 +69,9 @@ class RaggedBatchWrapper:
         self.max_seqs = max_seqs
         self.max_ctx = max_ctx
         self.block_size = block_size
-        #: cache slot that padded tokens write into (must be the cache's
-        #: dedicated trash row, or they would corrupt block 0)
+        self.max_blocks = -(-max_ctx // block_size)
+        #: cache slot that padded tokens write into (must be inside the
+        #: cache's dedicated trash block, or they would corrupt block 0)
         self.trash_slot = trash_slot
         self.clear()
 
@@ -95,7 +100,7 @@ class RaggedBatchWrapper:
 
     def finalize(self) -> RaggedBatch:
         """Build padded arrays (the [HOST→DEVICE boundary] of the reference)."""
-        mt, ms, mc, bs = self.max_tokens, self.max_seqs, self.max_ctx, self.block_size
+        mt, ms, bs = self.max_tokens, self.max_seqs, self.block_size
         tokens = np.zeros(mt, np.int32)
         kv_slot = np.full(mt, self.trash_slot, np.int32)
         seq_of = np.full(mt, ms - 1, np.int32)
@@ -103,7 +108,7 @@ class RaggedBatchWrapper:
         q_offset = np.zeros(ms, np.int32)
         q_len = np.zeros(ms, np.int32)
         ctx_len = np.zeros(ms, np.int32)
-        kv_gather = np.zeros((ms, mc), np.int32)
+        block_table = np.zeros((ms, self.max_blocks), np.int32)
         logit_idx = np.zeros(ms, np.int32)
         uids = []
 
@@ -111,7 +116,8 @@ class RaggedBatchWrapper:
         for row, (seq, new_toks) in enumerate(self._entries):
             n = len(new_toks)
             total = seq.seen_tokens + n
-            assert total <= mc, f"sequence length {total} exceeds max_ctx {mc}"
+            assert total <= self.max_ctx, \
+                f"sequence length {total} exceeds max_ctx {self.max_ctx}"
             assert len(seq.blocks) * bs >= total, "KV blocks not allocated"
             uids.append(seq.uid)
             tokens[cursor:cursor + n] = new_toks
@@ -124,14 +130,12 @@ class RaggedBatchWrapper:
             q_offset[row] = cursor
             q_len[row] = n
             ctx_len[row] = total
-            ctx_positions = np.arange(total, dtype=np.int64)
-            kv_gather[row, :total] = (blocks[ctx_positions // bs] * bs +
-                                      ctx_positions % bs).astype(np.int32)
+            block_table[row, :len(blocks)] = blocks.astype(np.int32)
             logit_idx[row] = cursor + n - 1
             cursor += n
 
         return RaggedBatch(tokens=tokens, kv_slot=kv_slot, seq_of_token=seq_of,
                            pos_of_token=pos_of, q_offset=q_offset, q_len=q_len,
-                           ctx_len=ctx_len, kv_gather=kv_gather,
+                           ctx_len=ctx_len, block_table=block_table,
                            logit_idx=logit_idx, n_tokens=cursor,
                            n_seqs=len(self._entries), uids=uids)

@@ -287,8 +287,12 @@ def flash_attention(q, k, v, causal: bool = True, scale: Optional[float] = None,
         v = jnp.repeat(v, rep, axis=2)
     if scale is None:
         scale = 1.0 / math.sqrt(hd)
-    bq = min(block_q, max(128, S))
-    bk = min(block_k, max(128, S))
+    # Clamp block sizes to the sequence, rounded UP to a lane-aligned
+    # multiple of 128 (padding handles S not divisible by the block); a
+    # non-128-multiple minor dim fails Mosaic lowering on real TPUs.
+    align = lambda x: ((x + 127) // 128) * 128
+    bq = min(block_q, align(max(128, S)))
+    bk = min(block_k, align(max(128, S)))
     # [B,S,H,hd] -> [B,H,S,hd]
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)

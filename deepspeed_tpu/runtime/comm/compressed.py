@@ -43,12 +43,11 @@ def compressed_allreduce(grad: jnp.ndarray, error: jnp.ndarray,
     Must run where ``axes`` are bound (inside shard_map).  Returns
     (avg_grad, new_error, new_server_error).
     """
-    n = 1
-    from ..topology import get_topology
-
-    topo = get_topology()
-    for a in (axes if isinstance(axes, (tuple, list)) else [axes]):
-        n *= topo.dims.get(a, 1)
+    axes = tuple(axes) if isinstance(axes, (tuple, list)) else (axes,)
+    # psum of a constant resolves statically to the bound group size and
+    # raises on unbound/misspelled axis names — a silent n=1 here would skip
+    # the collective and let workers diverge without any error.
+    n = jax.lax.psum(1, axes)
     if n <= 1:
         return grad, error, server_error
 

@@ -318,12 +318,11 @@ class DeepSpeedEngine:
         if grad_norm_scale is not None:
             grads = jax.tree.map(lambda g: g * grad_norm_scale, grads)
         # prescale_gradients / gradient_predivide_factor (reference
-        # engine.py:2048 allreduce epilogue knobs): with sharded autodiff the
-        # mean is already exact, so predivide is applied as a plain scale.
-        if self.config.prescale_gradients and \
-                self.config.gradient_predivide_factor != 1.0:
-            f = 1.0 / self.config.gradient_predivide_factor
-            grads = jax.tree.map(lambda g: g * f, grads)
+        # engine.py:2501-2508): in DeepSpeed these only reorder the divide
+        # around the allreduce and always net out to the exact DP mean.
+        # Sharded autodiff already yields that exact mean, so both knobs are
+        # numerical no-ops here — applying 1/f permanently would silently
+        # shrink the effective LR for any ported config.
         overflow = self.loss_scaler.check_overflow(grads) \
             if self.loss_scaler.dynamic else jnp.zeros((), bool)
 

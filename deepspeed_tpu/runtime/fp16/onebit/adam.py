@@ -18,7 +18,11 @@ import jax
 import jax.numpy as jnp
 import optax
 
-from ...comm.compressed import CompressionState, compressed_allreduce_tree, init_compression_state
+from ...comm.compressed import (
+    CompressionState,
+    compressed_allreduce,
+    init_compression_state,
+)
 
 
 class OnebitAdamState(NamedTuple):
@@ -30,10 +34,16 @@ class OnebitAdamState(NamedTuple):
 
 def onebit_adam(learning_rate=1e-3, b1: float = 0.9, b2: float = 0.999,
                 eps: float = 1e-8, weight_decay: float = 0.0,
-                freeze_step: int = 100000, comm_axes=("data",),
+                freeze_step: int = 100000, comm_axes=None,
                 cuda_aware: bool = False) -> optax.GradientTransformation:
     """``freeze_step``: warmup steps before compression kicks in (reference
-    OnebitAdam(freeze_step=...)).  ``comm_axes``: mesh axes of the DP group.
+    OnebitAdam(freeze_step=...)).  ``comm_axes``: mesh axes of the DP group;
+    default (None) resolves the group PER PARAMETER from the topology:
+    params under an "expert*" tree key reduce over expert_data_parallel
+    (data_outer × data) — summing them over the expert axis would mix
+    distinct experts' gradients — while dense params reduce over the full
+    data-parallel group (data_outer × data × expert), mirroring the
+    reference's separate expert-gradient reduction (engine.py:2588).
     """
 
     def init(params):
@@ -46,20 +56,30 @@ def onebit_adam(learning_rate=1e-3, b1: float = 0.9, b2: float = 0.999,
 
     def update(grads, state, params=None):
         from ....comm.comm import _active_axes, _axis_size
+        from ...topology import GROUP_AXES
 
         count = state.count + 1
         in_warmup = state.count < freeze_step
-        axes = _active_axes(tuple(comm_axes))
-        n = _axis_size(axes) if axes else 1
+
+        def leaf_axes(path):
+            if comm_axes is not None:
+                return _active_axes(tuple(comm_axes))
+            is_expert = any(
+                "expert" in str(getattr(k, "key", "")).lower() for k in path)
+            group = "expert_data_parallel" if is_expert else "data_parallel"
+            return _active_axes(GROUP_AXES[group])
 
         def warmup_branch(operand):
             mu, nu, comp = operand
+
             # warmup = exact allreduced Adam (reference warmup stage)
-            if axes:
-                g_avg = jax.tree.map(
-                    lambda g: jax.lax.psum(g.astype(jnp.float32), axes) / n, grads)
-            else:
-                g_avg = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+            def avg(path, g):
+                axes = leaf_axes(path)
+                if not axes:
+                    return g.astype(jnp.float32)
+                return jax.lax.psum(g.astype(jnp.float32), axes) / _axis_size(axes)
+
+            g_avg = jax.tree_util.tree_map_with_path(avg, grads)
             mu2 = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g, mu, g_avg)
             nu2 = jax.tree.map(lambda v, g: b2 * v + (1 - b2) * jnp.square(g),
                                nu, g_avg)
@@ -69,13 +89,24 @@ def onebit_adam(learning_rate=1e-3, b1: float = 0.9, b2: float = 0.999,
             mu, nu, comp = operand
             # momentum advances on LOCAL grads; the momentum itself is then
             # 1-bit-compressed + majority-voted (the 1-bit Adam trick) —
-            # variance stays frozen.
+            # variance stays frozen.  Per-leaf comm group: expert params must
+            # not be voted across the expert axis.
             mu_local = jax.tree.map(
                 lambda m, g: b1 * m + (1 - b1) * g.astype(jnp.float32), mu, grads)
-            if axes:
-                mu2, comp2 = compressed_allreduce_tree(mu_local, comp, axes)
-            else:
-                mu2, comp2 = mu_local, comp
+            flat, treedef = jax.tree_util.tree_flatten_with_path(mu_local)
+            flat_e = treedef.flatten_up_to(comp.error)
+            flat_s = treedef.flatten_up_to(comp.server_error)
+            outs = []
+            for (path, m), e, s in zip(flat, flat_e, flat_s):
+                axes = leaf_axes(path)
+                if axes:
+                    outs.append(compressed_allreduce(m, e, s, axes))
+                else:
+                    outs.append((m, e, s))
+            mu2 = treedef.unflatten([o[0] for o in outs])
+            comp2 = CompressionState(
+                error=treedef.unflatten([o[1] for o in outs]),
+                server_error=treedef.unflatten([o[2] for o in outs]))
             return mu2, nu, comp2
 
         mu, nu, comp = jax.lax.cond(

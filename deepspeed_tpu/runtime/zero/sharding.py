@@ -139,21 +139,29 @@ class ZeroShardingPlan:
     def opt_state_shardings(self, opt_state: Any, params: Any) -> Any:
         """Match optimizer-state leaves to their parameter's sharding.
 
-        Optax states mirror the param pytree inside each moment container;
-        scalar leaves (counts) stay replicated.  We key by shape: a state leaf
-        with the same shape as some param follows that param's moment spec.
+        Optax states mirror the param pytree inside each moment container
+        (mu/nu/trace/… have the params' exact tree structure), so the mapping
+        is structural: any opt-state subtree whose treedef equals the param
+        treedef gets the moment spec tree leaf-for-leaf.  Shape-keyed lookup
+        would mis-place state when two params share a shape but carry
+        different base/TP specs (e.g. D==F collides gate_proj/down_proj).
+        Leaves outside param-shaped subtrees (step counts, scalars) stay
+        replicated.
         """
         mesh = self.topology.mesh
         spec_tree = self.opt_state_specs_for_param(params)
-        param_leaves = jax.tree.leaves(params)
-        spec_leaves = jax.tree.leaves(
-            spec_tree, is_leaf=lambda x: isinstance(x, PartitionSpec))
-        shape_to_spec = {}
-        for p, s in zip(param_leaves, spec_leaves):
-            shape_to_spec.setdefault(tuple(p.shape), s)
+        param_struct = jax.tree_util.tree_structure(params)
+        sharding_tree = jax.tree.map(
+            lambda s: NamedSharding(mesh, s), spec_tree,
+            is_leaf=lambda x: isinstance(x, PartitionSpec))
+        replicated = NamedSharding(mesh, PartitionSpec())
 
-        def assign(leaf):
-            spec = shape_to_spec.get(tuple(leaf.shape), PartitionSpec())
-            return NamedSharding(mesh, spec)
+        def mirrors_params(node) -> bool:
+            return jax.tree_util.tree_structure(node) == param_struct
 
-        return jax.tree.map(assign, opt_state)
+        def assign(node):
+            if mirrors_params(node):
+                return sharding_tree
+            return jax.tree.map(lambda _: replicated, node)
+
+        return jax.tree.map(assign, opt_state, is_leaf=mirrors_params)

@@ -85,6 +85,7 @@ class TestOffloadStates:
 
 
 class TestAutotuner:
+    @pytest.mark.slow
     def test_gridsearch_finds_best(self):
         from deepspeed_tpu.autotuning.autotuner import Autotuner
 

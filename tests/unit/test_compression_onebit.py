@@ -110,6 +110,7 @@ class TestCompressionLib:
 
 
 class TestHybridEngine:
+    @pytest.mark.slow
     def test_train_then_generate(self):
         import deepspeed_tpu
         from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig

@@ -67,6 +67,8 @@ class TestTrainBatch:
                      if l.ndim >= 2]
         assert not all(shardings)
 
+    @pytest.mark.slow
+
     def test_gradient_accumulation_equivalence(self):
         """gas=2 over batch B == gas=1 over batch B (mean-of-micro-means)."""
         e1 = make_engine(gas=1, micro=4)

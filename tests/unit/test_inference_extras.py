@@ -43,6 +43,7 @@ class TestWeightOnlyQuant:
 
 
 class TestEngineFromCheckpoint:
+    @pytest.mark.slow
     def test_serve_from_training_checkpoint(self, tmp_path):
         import deepspeed_tpu
         from deepspeed_tpu.inference.v2.engine_factory import (

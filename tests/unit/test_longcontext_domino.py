@@ -25,6 +25,8 @@ class TestChunkedAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
+
     def test_gqa_and_grads(self):
         from deepspeed_tpu.sequence.fpdt_layer import chunked_attention
 

@@ -54,6 +54,7 @@ class TestGating:
 
 
 class TestMoELayer:
+    @pytest.mark.slow
     def test_identity_routing_recovers_ffn(self):
         """With capacity ample and k=1, MoE output equals the chosen expert's FFN."""
         initialize_mesh(TopologyConfig(), force=True)
@@ -99,6 +100,7 @@ class TestMoELayer:
 
 
 class TestMoEModule:
+    @pytest.mark.slow
     def test_moe_class(self):
         initialize_mesh(TopologyConfig(), force=True)
         moe = MoE(hidden_size=8, num_experts=4, k=2, capacity_factor=2.0,
@@ -108,6 +110,8 @@ class TestMoEModule:
         out, l_aux, counts = moe(params, x)
         assert out.shape == x.shape
         assert np.isfinite(float(l_aux))
+
+    @pytest.mark.slow
 
     def test_residual_moe(self):
         initialize_mesh(TopologyConfig(), force=True)
@@ -120,6 +124,8 @@ class TestMoEModule:
     def test_invalid_ep_size(self):
         with pytest.raises(ValueError):
             MoE(hidden_size=8, num_experts=3, ep_size=2)
+
+    @pytest.mark.slow
 
     def test_moe_trains_with_engine(self):
         import deepspeed_tpu

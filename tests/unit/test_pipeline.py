@@ -106,12 +106,16 @@ class TestPipelineEngine:
         return {"input_ids": jnp.asarray(
             rng.integers(0, vocab, size=(n, seq)), jnp.int32)}
 
+    @pytest.mark.slow
+
     def test_pp_trains(self):
         engine = self._build(pp=2)
         batch = self._batch(engine.train_batch_size())
         losses = [float(engine.train_batch(batch)) for _ in range(5)]
         assert losses[-1] < losses[0]
         assert engine.global_steps == 5
+
+    @pytest.mark.slow
 
     def test_pp_matches_non_pp(self):
         """PP=2 must be numerically equivalent to the plain engine on the
@@ -135,6 +139,8 @@ class TestPipelineEngine:
             l_pp = float(pp_engine.train_batch(batch))
         np.testing.assert_allclose(l_ref, l_pp, rtol=2e-3)
 
+    @pytest.mark.slow
+
     def test_pp_with_tp(self):
         engine = self._build(pp=2, tp=2)
         batch = self._batch(engine.train_batch_size())
@@ -144,6 +150,8 @@ class TestPipelineEngine:
     def test_pp_rejects_zero2(self):
         with pytest.raises(ValueError, match="ZeRO"):
             self._build(pp=2, zero=2)
+
+    @pytest.mark.slow
 
     def test_pp4(self):
         engine = self._build(pp=4, gas=8, num_layers=4)

@@ -30,6 +30,7 @@ def place_seq_sharded(topo, *arrays):
 
 class TestUlysses:
     @pytest.mark.parametrize("sp", [2, 4, 8])
+    @pytest.mark.slow
     def test_matches_single_device(self, sp):
         topo = initialize_mesh(TopologyConfig(seq=sp), force=True)
         q, k, v = qkv(H=8)
@@ -53,6 +54,8 @@ class TestUlysses:
         with pytest.raises(ValueError, match="divisible"):
             attn(q, k, v)
 
+    @pytest.mark.slow
+
     def test_gradients_flow(self):
         topo = initialize_mesh(TopologyConfig(seq=2), force=True)
         q, k, v = qkv(H=4)
@@ -72,6 +75,7 @@ class TestUlysses:
 class TestRingAttention:
     @pytest.mark.parametrize("sp", [2, 4])
     @pytest.mark.parametrize("causal", [True, False])
+    @pytest.mark.slow
     def test_matches_single_device(self, sp, causal):
         topo = initialize_mesh(TopologyConfig(seq=sp), force=True)
         q, k, v = qkv(S=64)
@@ -79,12 +83,16 @@ class TestRingAttention:
         out = ring_attention(*place_seq_sharded(topo, q, k, v), causal=causal)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
 
+    @pytest.mark.slow
+
     def test_gqa(self):
         topo = initialize_mesh(TopologyConfig(seq=2), force=True)
         q, k, v = qkv(H=8, kv=2)
         ref = _xla_attention(q, k, v, causal=True)
         out = ring_attention(*place_seq_sharded(topo, q, k, v), causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref), atol=2e-5, rtol=2e-5)
+
+    @pytest.mark.slow
 
     def test_gradients_flow(self):
         topo = initialize_mesh(TopologyConfig(seq=2), force=True)

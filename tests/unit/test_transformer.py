@@ -35,12 +35,16 @@ class TestCausalLM:
         logits = model(params, tiny_batch()["input_ids"])
         assert logits.shape == (8, 32, 256)
 
+    @pytest.mark.slow
+
     def test_train_loss_decreases(self):
         engine = build()
         batch = tiny_batch(engine.train_batch_size())
         losses = [float(engine.train_batch(batch)) for _ in range(10)]
         assert losses[-1] < losses[0]
         assert np.isfinite(losses).all()
+
+    @pytest.mark.slow
 
     def test_tp_matches_dp(self):
         """TP=2 mesh must produce the same loss trajectory as pure DP."""
@@ -55,6 +59,8 @@ class TestCausalLM:
         # possible; so just check TP runs and loss is finite + decreasing
         assert l_tp[-1] < l_tp[0]
 
+    @pytest.mark.slow
+
     def test_tp_numerics_match_exactly(self):
         """Same global batch under TP=2 vs DP-only: losses must agree."""
         e_dp = build(TopologyConfig(), micro=2)          # dp=8  → global 16
@@ -64,6 +70,8 @@ class TestCausalLM:
             l_dp = float(e_dp.train_batch(batch))
             l_tp = float(e_tp.train_batch(batch))
         np.testing.assert_allclose(l_dp, l_tp, rtol=1e-4)
+
+    @pytest.mark.slow
 
     def test_zero3_with_tp(self):
         engine = build(TopologyConfig(tensor=2), zero_stage=3)
