@@ -59,10 +59,6 @@ class InferenceEngineV2:
                  config: Optional[RaggedInferenceEngineConfig] = None):
         self.model = model
         self.cfg = model.config
-        if getattr(self.cfg, "num_experts", 1) > 1:
-            raise NotImplementedError(
-                "ragged serving of MoE models lands with the moe_gather/"
-                "moe_scatter ragged kernels; dense families only for now")
         self.config = config or RaggedInferenceEngineConfig()
         c = self.config
         num_blocks = c.num_blocks or (c.max_seqs * -(-c.max_ctx // c.block_size))
@@ -72,8 +68,15 @@ class InferenceEngineV2:
             num_layers=self.cfg.num_layers, num_blocks=num_blocks,
             block_size=c.block_size, num_kv_heads=self.cfg.num_kv_heads,
             head_dim=self.cfg.head_dim, dtype=c.dtype))
-        self.params = jax.tree.map(lambda x: jnp.asarray(x, c.dtype), params)
-        # gate/norm params stay f32 where the model expects; logits are f32.
+        # Cast to serving dtype, EXCEPT router kernels: routing must run in
+        # f32 so serving picks the same experts as the training forward — a
+        # bf16 round-trip flips top-k selection on near-tie tokens.
+        def _cast(path, x):
+            if any("router" in str(getattr(k, "key", "")) for k in path):
+                return jnp.asarray(x, jnp.float32)
+            return jnp.asarray(x, c.dtype)
+
+        self.params = jax.tree_util.tree_map_with_path(_cast, params)
         self._step = build_ragged_step(self.cfg, max_q=c.max_tokens,
                                        block_size=c.block_size,
                                        attn_impl=c.attn_impl)

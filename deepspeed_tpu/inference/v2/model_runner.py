@@ -106,6 +106,8 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
 
     # per-seq gather indices for queries: [S, max_q]
     q_idx = jnp.clip(q_offset[:, None] + jnp.arange(max_q)[None, :], 0, T - 1)
+    # ragged-padding mask: padded tokens write into the trailing trash block
+    batch_valid = kv_slot < (kcache.shape[2] - block_size)
 
     def layer_step(carry, inputs):
         x, = carry
@@ -134,9 +136,21 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
         o_flat = o_seq[seq_of, within].reshape(T, H * hd)
         x = x + o_flat @ lp["o_proj"]["kernel"]
         h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
-        gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
-        up = h @ lp["up_proj"]["kernel"]
-        x = x + (gate * up) @ lp["down_proj"]["kernel"]
+        if cfg.num_experts > 1:
+            # MoE serving (moe_gather/moe_scatter analogue): sparse-slot
+            # dispatch over flat ragged tokens; padded tokens (kv_slot in
+            # the trash block) are excluded from expert capacity.
+            from ...moe.sharded_moe import moe_mlp_block
+
+            mlp_out, _ = moe_mlp_block(
+                lp, h, k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dispatch_impl="sparse", valid=batch_valid)
+            x = x + mlp_out
+        else:
+            gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
+            up = h @ lp["up_proj"]["kernel"]
+            x = x + (gate * up) @ lp["down_proj"]["kernel"]
         return (x,), (layer_k, layer_v)
 
     (x,), (new_k, new_v) = jax.lax.scan(

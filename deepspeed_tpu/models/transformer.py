@@ -53,6 +53,7 @@ class TransformerConfig:
     moe_top_k: int = 2
     moe_capacity_factor: float = 2.0
     moe_aux_loss_coef: float = 0.01
+    moe_dispatch: str = "sparse"    # sparse (scatter, linear in tokens) | dense (oracle)
 
     @property
     def head_dim(self) -> int:
@@ -73,12 +74,12 @@ class TransformerConfig:
 
     @staticmethod
     def mixtral_8x7b(**kw):
-        # max_seq_len kept at 4096 until the segment-sum MoE dispatch lands:
-        # the dense [S,E,C] dispatch tensors are O(S²·E/cf) and make 32k-token
-        # routing chunks OOM; shard sequence (Ulysses/ring) for longer context.
+        # 32k context (Mixtral's published window): the default sparse-slot
+        # dispatch is linear in routing-chunk tokens, so long chunks no
+        # longer materialize an O(S²·E/cf) dispatch tensor.
         return TransformerConfig(vocab_size=32000, hidden_size=4096,
                                  intermediate_size=14336, num_layers=32,
-                                 num_heads=32, num_kv_heads=8, max_seq_len=4096,
+                                 num_heads=32, num_kv_heads=8, max_seq_len=32768,
                                  rope_theta=1e6, num_experts=8, moe_top_k=2, **kw)
 
     @staticmethod
@@ -255,34 +256,17 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
 
     def mlp_block(h, lp):
         if cfg.num_experts > 1:
-            # Mixtral-style routed expert MLP (GShard dispatch; see moe/).
-            # NOTE: the dense [S,E,C] dispatch scales quadratically with token
-            # count — fine for training-sized chunks; long-context MoE should
-            # shard sequence first (Ulysses/ring) or wait for the
-            # segment-sum dispatch path.
-            from ..moe.sharded_moe import (
-                combine_from_experts,
-                dispatch_to_experts,
-                topkgating,
-            )
+            # Mixtral-style routed expert MLP (see moe/).  Default dispatch
+            # is the sparse scatter/gather path (linear in routing-chunk
+            # tokens); "dense" keeps the GShard [S,E,C] einsum as the oracle.
+            from ..moe.sharded_moe import moe_mlp_block
 
             B_, S_, D_ = h.shape
-            tokens = h.reshape(-1, D_)
-            # router runs in f32 regardless of compute dtype (reference keeps
-            # the gate fp32; the engine casts params to compute dtype, so
-            # re-cast here to preserve routing decisions under bf16)
-            logits_r = tokens.astype(jnp.float32) @ \
-                lp["router"]["kernel"].astype(jnp.float32)
-            gate_out = topkgating(logits_r, k=cfg.moe_top_k,
-                                  capacity_factor=cfg.moe_capacity_factor)
-            w = lp["gate_proj"]["kernel"].dtype
-            dispatched = dispatch_to_experts(gate_out.dispatch, tokens, w)
-            act = jax.nn.silu(jnp.einsum("ecd,edf->ecf", dispatched,
-                                         lp["gate_proj"]["kernel"]))
-            up = jnp.einsum("ecd,edf->ecf", dispatched, lp["up_proj"]["kernel"])
-            eo = jnp.einsum("ecf,efd->ecd", act * up, lp["down_proj"]["kernel"])
-            out = combine_from_experts(gate_out.combine, eo, w)
-            return out.reshape(B_, S_, D_), gate_out.l_aux
+            out, l_aux = moe_mlp_block(
+                lp, h.reshape(-1, D_), k=cfg.moe_top_k,
+                capacity_factor=cfg.moe_capacity_factor,
+                dispatch_impl=cfg.moe_dispatch)
+            return out.reshape(B_, S_, D_), l_aux
         gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
         up = h @ lp["up_proj"]["kernel"]
         return (gate * up) @ lp["down_proj"]["kernel"], jnp.zeros((), jnp.float32)

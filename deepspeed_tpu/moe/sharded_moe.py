@@ -117,6 +117,137 @@ def top2gating(logits, capacity_factor: float = 1.0, min_capacity: int = 4,
 
 
 # --------------------------------------------------------------------- #
+# Sparse (scatter/gather) dispatch — the scalable path
+# --------------------------------------------------------------------- #
+class SparseGateOutput(NamedTuple):
+    """Routing as flat slot ids instead of dense [S,E,C] one-hots.
+
+    ``slot[s, choice]`` = expert*C + position-in-expert, or E*C (a trash row)
+    when the token was dropped; ``gate_val`` carries the combine weight
+    (zeroed for drops).  Dispatch becomes an O(S·D) scatter-add and combine
+    an O(S·D) gather — vs the dense einsum's O(S·E·C·D) ≈ O(S²·k·D), which
+    is quadratic in routing-chunk tokens (reference sharded_moe.py:533's
+    einsum dispatch has the same blowup; its sort-based top-k path :374 is
+    the analogue of this).
+    """
+    l_aux: jnp.ndarray
+    slot: jnp.ndarray           # [S, k] int32
+    gate_val: jnp.ndarray       # [S, k] f32
+    exp_counts: jnp.ndarray     # [E]
+    capacity: int
+
+
+def top1gating_sparse(logits: jnp.ndarray, capacity_factor: float = 1.0,
+                      min_capacity: int = 4,
+                      noisy_gate_policy: Optional[str] = None,
+                      rng: Optional[jax.Array] = None,
+                      drop_tokens: bool = True) -> SparseGateOutput:
+    """Sparse-form top-1 gating; routing decisions identical to top1gating."""
+    S, E = logits.shape
+    C = _capacity(S, E, capacity_factor, min_capacity)
+    gates = jax.nn.softmax(logits, axis=1)
+
+    select_logits = logits
+    if noisy_gate_policy == "RSample" and rng is not None:
+        select_logits = logits + jax.random.gumbel(rng, logits.shape)
+    idx = jnp.argmax(select_logits, axis=1)
+    mask = _one_hot(idx, E)
+
+    me = jnp.mean(gates, axis=0)
+    ce = jnp.mean(mask, axis=0)
+    l_aux = jnp.sum(me * ce) * E
+
+    pos = jnp.cumsum(mask, axis=0) - mask
+    if drop_tokens:
+        mask = mask * (pos < C)
+    kept = jnp.sum(mask, axis=1) > 0
+    pos_in_expert = jnp.sum(pos * mask, axis=1).astype(jnp.int32)
+    gate_val = jnp.sum(gates * mask, axis=1)
+    # Beyond-capacity tokens always go to the trash row: the dense path's
+    # one_hot(pos>=C, C) row is all-zeros, i.e. a silent zero-contribution —
+    # a raw idx*C+pos slot would land in the NEXT expert's rows.
+    kept = jnp.logical_and(kept, pos_in_expert < C)
+    slot = jnp.where(kept, idx.astype(jnp.int32) * C + pos_in_expert, E * C)
+    counts = jnp.sum(_one_hot(idx, E), axis=0).astype(jnp.int32)
+    return SparseGateOutput(l_aux, slot[:, None], gate_val[:, None], counts, C)
+
+
+def topkgating_sparse(logits: jnp.ndarray, k: int = 2,
+                      capacity_factor: float = 1.0, min_capacity: int = 4,
+                      drop_tokens: bool = True,
+                      rng: Optional[jax.Array] = None,
+                      normalize_weights: bool = True,
+                      valid: Optional[jnp.ndarray] = None) -> SparseGateOutput:
+    """Sparse-form top-k gating; routing decisions identical to topkgating.
+
+    ``valid`` [S] bool: tokens marked invalid (ragged-batch padding) are
+    routed to the trash slot and consume no expert capacity.
+    """
+    S, E = logits.shape
+    C = _capacity(S * k, E, capacity_factor, min_capacity)
+    gates = jax.nn.softmax(logits, axis=1)
+
+    topk_val, topk_idx = jax.lax.top_k(gates, k)
+    if normalize_weights:
+        topk_val = topk_val / jnp.sum(topk_val, axis=1, keepdims=True)
+
+    slots, vals = [], []
+    counts = jnp.zeros((E,), jnp.float32)
+    ce_total = jnp.zeros((E,), jnp.float32)
+    for choice in range(k):
+        idx = topk_idx[:, choice]
+        mask = _one_hot(idx, E)
+        if valid is not None:
+            mask = mask * valid.astype(jnp.float32)[:, None]
+        ce_total = ce_total + jnp.sum(mask, axis=0)
+        pos = jnp.cumsum(mask, axis=0) - mask + counts[None, :]
+        if drop_tokens:
+            mask = mask * (pos < C)
+        counts = counts + jnp.sum(mask, axis=0)
+        kept = jnp.sum(mask, axis=1) > 0
+        pos_in_expert = jnp.sum(pos * mask, axis=1).astype(jnp.int32)
+        # beyond-capacity → trash row (dense one_hot(pos>=C) is all-zeros)
+        kept = jnp.logical_and(kept, pos_in_expert < C)
+        slots.append(jnp.where(kept, idx.astype(jnp.int32) * C + pos_in_expert,
+                               E * C))
+        vals.append(jnp.where(kept, topk_val[:, choice], 0.0))
+
+    me = jnp.mean(gates, axis=0)
+    ce = ce_total / jnp.maximum(jnp.sum(ce_total), 1.0)
+    l_aux = jnp.sum(me * ce) * E
+    return SparseGateOutput(l_aux, jnp.stack(slots, axis=1),
+                            jnp.stack(vals, axis=1),
+                            ce_total.astype(jnp.int32), C)
+
+
+def dispatch_sparse(slot: jnp.ndarray, tokens: jnp.ndarray, num_experts: int,
+                    capacity: int, dtype) -> jnp.ndarray:
+    """[S,k] slots × [S,D] tokens → [E,C,D] via scatter-add (O(S·k·D))."""
+    S, D = tokens.shape
+    EC = num_experts * capacity
+    flat = jnp.zeros((EC + 1, D), dtype)          # +1 trash row for drops
+    t = tokens.astype(dtype)
+    for choice in range(slot.shape[1]):
+        flat = flat.at[slot[:, choice]].add(t)
+    return flat[:EC].reshape(num_experts, capacity, D)
+
+
+def combine_sparse(slot: jnp.ndarray, gate_val: jnp.ndarray,
+                   expert_out: jnp.ndarray, dtype) -> jnp.ndarray:
+    """[S,k] slots + weights × [E,C,D] expert outputs → [S,D] via gather."""
+    E, C, D = expert_out.shape
+    flat = jnp.concatenate(
+        [expert_out.reshape(E * C, D),
+         jnp.zeros((1, D), expert_out.dtype)], axis=0)
+    out = None
+    for choice in range(slot.shape[1]):
+        contrib = gate_val[:, choice, None].astype(dtype) * \
+            jnp.take(flat, slot[:, choice], axis=0).astype(dtype)
+        out = contrib if out is None else out + contrib
+    return out
+
+
+# --------------------------------------------------------------------- #
 # Expert FFN + MOELayer
 # --------------------------------------------------------------------- #
 def init_moe_params(key, hidden: int, ffn: int, num_experts: int,
@@ -163,32 +294,93 @@ def combine_from_experts(combine: jnp.ndarray, expert_out: jnp.ndarray,
     return jnp.einsum("sec,ecd->sd", combine.astype(dtype), expert_out)
 
 
+def moe_mlp_block(lp: Dict, tokens: jnp.ndarray, k: int = 2,
+                  capacity_factor: float = 2.0, dispatch_impl: str = "sparse",
+                  rng: Optional[jax.Array] = None,
+                  valid: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Mixtral-style routed SwiGLU expert MLP over flat tokens [T, D].
+
+    ``lp`` carries router [D,E] (f32) + stacked expert weights
+    gate_proj/up_proj [E,D,F], down_proj [E,F,D].  Shared by the training
+    transformer (models/transformer.py) and the ragged serving runner, so
+    train and serve route identically.  Router always runs in f32 (the
+    reference keeps the gate fp32; under bf16 compute we re-cast to preserve
+    routing decisions).
+    """
+    assert dispatch_impl in ("sparse", "dense"), dispatch_impl
+    logits_r = tokens.astype(jnp.float32) @ lp["router"]["kernel"].astype(jnp.float32)
+    dtype = lp["gate_proj"]["kernel"].dtype
+    if dispatch_impl == "sparse":
+        gate_out = topkgating_sparse(logits_r, k=k,
+                                     capacity_factor=capacity_factor, rng=rng,
+                                     valid=valid)
+        dispatched = dispatch_sparse(gate_out.slot, tokens,
+                                     logits_r.shape[1], gate_out.capacity, dtype)
+    else:
+        assert valid is None, "ragged validity masks need dispatch_impl='sparse'"
+        gate_out = topkgating(logits_r, k=k, capacity_factor=capacity_factor,
+                              rng=rng)
+        dispatched = dispatch_to_experts(gate_out.dispatch, tokens, dtype)
+    act = jax.nn.silu(jnp.einsum("ecd,edf->ecf", dispatched,
+                                 lp["gate_proj"]["kernel"]))
+    up = jnp.einsum("ecd,edf->ecf", dispatched, lp["up_proj"]["kernel"])
+    eo = jnp.einsum("ecf,efd->ecd", act * up, lp["down_proj"]["kernel"])
+    if dispatch_impl == "sparse":
+        out = combine_sparse(gate_out.slot, gate_out.gate_val, eo, dtype)
+    else:
+        out = combine_from_experts(gate_out.combine, eo, dtype)
+    return out, gate_out.l_aux
+
+
 def moe_layer(params: Dict, x: jnp.ndarray, k: int = 1,
               capacity_factor: float = 1.0, eval_capacity_factor: float = 1.0,
               min_capacity: int = 4, drop_tokens: bool = True,
               noisy_gate_policy: Optional[str] = None,
               rng: Optional[jax.Array] = None, training: bool = True,
-              activation=jax.nn.gelu) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+              activation=jax.nn.gelu,
+              dispatch_impl: str = "sparse") -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Apply the MoE layer to x [..., D] → (out [..., D], l_aux, exp_counts).
 
     Reference: MOELayer.forward (sharded_moe.py:586): einsum dispatch →
     all-to-all → expert FFN → all-to-all → einsum combine.
+
+    ``dispatch_impl``: "sparse" (default) routes via flat-slot scatter/gather
+    — linear in tokens, required for 32k+ routing chunks; "dense" is the
+    GShard [S,E,C] einsum kept as the numerics oracle.
     """
+    assert dispatch_impl in ("sparse", "dense"), dispatch_impl
     orig_shape = x.shape
     D = orig_shape[-1]
     tokens = x.reshape(-1, D)
     S = tokens.shape[0]
     logits = tokens.astype(jnp.float32) @ params["gate"]["kernel"]
     cf = capacity_factor if training else eval_capacity_factor
-    if k == 1:
-        gate = top1gating(logits, cf, min_capacity, noisy_gate_policy, rng, drop_tokens)
-    else:
-        gate = topkgating(logits, k, cf, min_capacity, drop_tokens, rng)
 
     w = params["experts"]
     dtype = w["w1"].dtype
-    dispatched = dispatch_to_experts(gate.dispatch, tokens, dtype)  # [E, C, D]
-    h = activation(jnp.einsum("ecd,edf->ecf", dispatched, w["w1"]) + w["b1"][:, None, :])
-    expert_out = jnp.einsum("ecf,efd->ecd", h, w["w2"]) + w["b2"][:, None, :]
-    out = combine_from_experts(gate.combine, expert_out, dtype)
+
+    def expert_ffn(dispatched):
+        h = activation(jnp.einsum("ecd,edf->ecf", dispatched, w["w1"]) +
+                       w["b1"][:, None, :])
+        return jnp.einsum("ecf,efd->ecd", h, w["w2"]) + w["b2"][:, None, :]
+
+    if dispatch_impl == "sparse":
+        if k == 1:
+            gate = top1gating_sparse(logits, cf, min_capacity,
+                                     noisy_gate_policy, rng, drop_tokens)
+        else:
+            gate = topkgating_sparse(logits, k, cf, min_capacity, drop_tokens, rng)
+        E = logits.shape[1]
+        dispatched = dispatch_sparse(gate.slot, tokens, E, gate.capacity, dtype)
+        expert_out = expert_ffn(dispatched)
+        out = combine_sparse(gate.slot, gate.gate_val, expert_out, dtype)
+    else:
+        if k == 1:
+            gate = top1gating(logits, cf, min_capacity, noisy_gate_policy, rng,
+                              drop_tokens)
+        else:
+            gate = topkgating(logits, k, cf, min_capacity, drop_tokens, rng)
+        dispatched = dispatch_to_experts(gate.dispatch, tokens, dtype)  # [E, C, D]
+        expert_out = expert_ffn(dispatched)
+        out = combine_from_experts(gate.combine, expert_out, dtype)
     return out.reshape(orig_shape), gate.l_aux, gate.exp_counts
