@@ -1,0 +1,340 @@
+"""Explicit-communication train path — ZeRO++ (qwZ/qgZ) + sparse gradients.
+
+The engine's fused path lets XLA insert the gradient-mean / reduce-scatter
+collectives, which is the right default on TPU.  But three DeepSpeed config
+surfaces exist precisely to change the WIRE FORMAT of those collectives, so
+when any of them is enabled the loss/grad computation runs under
+``shard_map`` over the data axes and the exchanges are written by hand:
+
+  ``zero_quantized_weights`` (qwZ)  — ZeRO-3 bf16 param shards allgather on
+      an int8 wire (reference: partition_parameters.py:769 CUDAQuantizer,
+      zero/config.py:294).
+  ``zero_quantized_gradients`` (qgZ) — gradients exchange as an int4/int8
+      reduce-scatter followed by a quantized allgather, with optional LoCo
+      error feedback (reference: runtime/comm/coalesced_collectives.py:31
+      all_to_all_quant_reduce, :81 LoCo).
+  ``sparse_gradients`` — embedding-row gradients exchange as (indices,
+      values) pairs instead of the dense [V, D] tensor (reference:
+      runtime/sparse_tensor.py:13 + engine.sparse_allreduce_bucket
+      engine.py:2636).
+
+Constraints: this path covers DP/ZeRO meshes (tensor = seq = pipe = expert
+= 1); model-parallel composition stays on the fused path where XLA owns the
+collectives.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from ..ops.quantizer.quantizer import (
+    dequantize_int4,
+    dequantize_int8,
+    quantize_int4,
+    quantize_int8,
+)
+from .sparse_tensor import SparseTensor, sparse_allreduce
+from .topology import DATA, DATA_OUTER
+
+
+def _quant_fns(bits: int):
+    if bits == 4:
+        return quantize_int4, dequantize_int4
+    return quantize_int8, dequantize_int8
+
+
+def dp_axes_info(topology):
+    """Active data-parallel axes + size + the PartitionSpec entry for a
+    leading per-rank axis (LoCo error buffers).  Single source of truth for
+    engine init and the shard_map specs — they must agree exactly."""
+    axes = tuple(a for a in (DATA_OUTER, DATA) if topology.dims.get(a, 1) > 1)
+    n = 1
+    for a in axes:
+        n *= topology.dims[a]
+    entry = axes if len(axes) > 1 else (axes[0] if axes else None)
+    return axes, n, entry
+
+
+# --------------------------------------------------------------------- #
+# Wire primitives (must run inside shard_map with ``axes`` bound)
+# --------------------------------------------------------------------- #
+def quantized_allreduce(grad: jnp.ndarray, axes, bits: int = 8,
+                        group_size: int = 256,
+                        error: Optional[jnp.ndarray] = None
+                        ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+    """Mean-allreduce with a fully quantized wire (qgZ analogue).
+
+    Stage 1: each rank quantizes its local contribution and all-to-alls it
+    (via psum-free reduce-scatter on the int-dequantized values); stage 2:
+    the reduced partition is re-quantized and allgathered.  With LoCo,
+    ``error`` carries the per-rank quantization residual across steps.
+    """
+    n = jax.lax.psum(1, axes)
+    if n <= 1:
+        return grad, error
+    quant, dequant = _quant_fns(bits)
+    flat = grad.reshape(-1).astype(jnp.float32)
+    if error is not None:
+        flat = flat + error.reshape(-1)
+    size = flat.shape[0]
+    pad = (-size) % (n * group_size)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+
+    # stage 1: quantize local contributions, exchange, reduce my partition
+    q, s = quant(flat, group_size)                 # wire: int(size) + f32 scales
+    sent = dequant(q, s, shape=flat.shape)         # what actually hit the wire
+    new_error = None
+    if error is not None:
+        new_error = (flat - sent)[:size].reshape(grad.shape)
+    per = flat.shape[0] // n
+    groups_per = q.shape[0] // n
+    q_x = jax.lax.all_to_all(q.reshape(n, groups_per, -1), axes,
+                             split_axis=0, concat_axis=0, tiled=True)
+    s_x = jax.lax.all_to_all(s.reshape(n, groups_per, 1), axes,
+                             split_axis=0, concat_axis=0, tiled=True)
+    contribs = dequant(q_x.reshape(n * groups_per, -1),
+                       s_x.reshape(n * groups_per, 1)).reshape(n, per)
+    mine = jnp.mean(contribs, axis=0)              # my reduced partition
+
+    # stage 2: quantized allgather of the reduced partitions
+    q2, s2 = quant(mine, group_size)
+    q2_all = jax.lax.all_gather(q2, axes, axis=0, tiled=False)   # [n, g, w]
+    s2_all = jax.lax.all_gather(s2, axes, axis=0, tiled=False)
+    full = dequant(q2_all.reshape(-1, q2.shape[1]),
+                   s2_all.reshape(-1, 1)).reshape(-1)[:size]
+    return full.reshape(grad.shape).astype(grad.dtype), new_error
+
+
+def quantized_all_gather_shard(shard: jnp.ndarray, axes, dim: int,
+                               bits: int = 8, group_size: int = 256,
+                               out_dtype=jnp.bfloat16) -> jnp.ndarray:
+    """qwZ: reconstruct a full parameter from its ZeRO-3 shard over an int8
+    wire.  ``dim`` is the sharded dimension; shards must be equal-size."""
+    n = jax.lax.psum(1, axes)
+    if n <= 1:
+        return shard.astype(out_dtype)
+    quant, dequant = _quant_fns(bits)
+    flat = shard.reshape(-1)
+    q, s = quant(flat, group_size)
+    q_all = jax.lax.all_gather(q, axes, axis=0, tiled=False)     # [n, g, w]
+    s_all = jax.lax.all_gather(s, axes, axis=0, tiled=False)
+    vals = dequant(q_all.reshape(-1, q.shape[1]), s_all.reshape(-1, 1),
+                   dtype=out_dtype).reshape(n, -1)[:, :flat.shape[0]]
+    pieces = vals.reshape((n,) + shard.shape)
+    return jnp.concatenate([pieces[i] for i in range(n)], axis=dim)
+
+
+def sparse_embedding_allreduce(grad: jnp.ndarray, token_ids: jnp.ndarray,
+                               axes) -> jnp.ndarray:
+    """Mean-allreduce an embedding-row gradient as (indices, values) pairs.
+
+    Exact only when the grad's nonzero rows are the batch's tokens — true
+    for a pure input embedding, FALSE for tied embeddings (the lm-head
+    matmul makes the grad dense over the whole vocab); the step builder
+    refuses the sparse wire for tied-embedding models.  Wire volume:
+    T·(D+1) vs V·D dense."""
+    max_nnz = min(int(token_ids.size), grad.shape[0])
+    sp = SparseTensor.from_dense(grad, max_nnz)
+    return sparse_allreduce(sp, axes)
+
+
+# --------------------------------------------------------------------- #
+# Engine step builder
+# --------------------------------------------------------------------- #
+def _sharded_dim(spec, zero_axes) -> Optional[int]:
+    """Which dim of a param spec carries the ZeRO axes (None = replicated)."""
+    if spec is None:
+        return None
+    zset = set(zero_axes)
+    for d, entry in enumerate(spec):
+        entries = entry if isinstance(entry, (tuple, list)) else (entry,)
+        if any(a in zset for a in entries if a is not None):
+            return d
+    return None
+
+
+def build_explicit_comm_step(engine):
+    """Build the shard_map'd train-batch step for the explicit-comm config
+    surface.  Mirrors engine._build_train_batch_fn's semantics (micro-step
+    scan, loss scaling, clipping, overflow skip) with hand-written wires."""
+    cfg = engine.config
+    topo = engine.topology
+    zc = cfg.zero_config
+    qwz = bool(zc.zero_quantized_weights)
+    qgz = bool(zc.zero_quantized_gradients)
+    loco = bool(getattr(zc, "zeropp_loco", False))
+    sparse = bool(getattr(cfg, "sparse_gradients_enabled", False))
+    grad_bits = 4   # qgZ wire (reference quant_reduce.cu uses int4)
+    if sparse and bool(getattr(getattr(engine.module, "config", None),
+                               "tie_embeddings", False)):
+        from ..utils.logging import logger
+
+        logger.warning("sparse_gradients disabled: tied embeddings make the "
+                       "embedding grad dense over the vocab (lm-head rows), "
+                       "so a token-indexed sparse exchange would drop mass")
+        sparse = False
+
+    for ax in ("tensor", "seq", "pipe", "expert"):
+        if topo.dims.get(ax, 1) > 1:
+            raise ValueError(
+                f"explicit-comm path (zero_quantized_*/sparse_gradients) "
+                f"supports DP/ZeRO meshes only; axis {ax!r} has size "
+                f"{topo.dims[ax]} — use the fused path for model parallelism")
+    data_axes, _, dp_axes_entry = dp_axes_info(topo)
+    gas = engine.gradient_accumulation_steps()
+
+    params_t = engine.state.params
+    stage3 = engine.zero_stage >= 3
+    param_specs = engine.plan.param_specs(params_t)
+    zero_axes = engine.plan.zero_axes
+    shard_dims = jax.tree.map(lambda s: _sharded_dim(s, zero_axes), param_specs,
+                              is_leaf=lambda x: isinstance(x, P))
+
+    def gather_full(params_local):
+        """Local shards → full compute-dtype params (qwZ wire if enabled)."""
+        def leaf(x, d):
+            if d is None:
+                return x.astype(engine.compute_dtype)
+            xb = x.astype(engine.compute_dtype)
+            if qwz:
+                return quantized_all_gather_shard(
+                    xb, zero_axes, d, bits=8, out_dtype=engine.compute_dtype)
+            return jax.lax.all_gather(xb, zero_axes, axis=d, tiled=True)
+        return jax.tree.map(leaf, params_local, shard_dims)
+
+    def exchange_grads(grads, batch, comm_error):
+        """Per-leaf wire selection: sparse rows for embeddings, quantized
+        allreduce for the rest (or plain psum-mean when qgZ is off).
+
+        LoCo error leaves carry a leading per-device axis of size 1 inside
+        shard_map (stored sharded over the data axes outside)."""
+        ids = None
+        if sparse and isinstance(batch, dict):
+            ids = batch.get("input_ids")
+        n = jax.lax.psum(1, data_axes) if data_axes else 1
+
+        flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
+        err_flat = treedef.flatten_up_to(comm_error) if loco else \
+            [None] * len(flat)
+        outs, errs = [], []
+        for (path, g), e in zip(flat, err_flat):
+            is_embed = any("embed" in str(getattr(k, "key", "")).lower()
+                           for k in path)
+            if sparse and is_embed and ids is not None and g.ndim == 2 \
+                    and data_axes:
+                outs.append(sparse_embedding_allreduce(g, ids, data_axes))
+                errs.append(e)
+            elif qgz and data_axes:
+                out, new_e = quantized_allreduce(
+                    g, data_axes, bits=grad_bits,
+                    error=e[0] if loco else None)
+                outs.append(out)
+                errs.append(new_e[None] if loco else e)
+            elif data_axes:
+                outs.append(jax.lax.psum(g, data_axes) / n)
+                errs.append(e)
+            else:
+                outs.append(g)
+                errs.append(e)
+        new_error = treedef.unflatten(errs) if loco else None
+        return treedef.unflatten(outs), new_error
+
+    def local_loss_and_grads(params_full, batch, rng, scaler_state):
+        """LOCAL full-shape grads (no cross-device reduction).
+
+        Differentiates w.r.t. the GATHERED params — autodiff must not flow
+        through the quantize→round→dequantize wire (round has zero
+        gradient), and full-shape grads are what the exchange and the
+        (logically full, sharded-layout) optimizer update both expect.
+        """
+        def scaled_loss(p):
+            out = engine.loss_fn(p, batch, rng)
+            loss = out[0] if isinstance(out, tuple) else out
+            return engine.loss_scaler.scale_loss(
+                loss.astype(jnp.float32), scaler_state), loss
+
+        grads, loss = jax.grad(scaled_loss, has_aux=True)(params_full)
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
+        return loss, grads
+
+    def local_step(params_local, batch, rng, scaler_state, comm_error):
+        params_full = gather_full(jax.lax.stop_gradient(params_local))
+        if gas == 1:
+            loss, grads = local_loss_and_grads(params_full, batch, rng,
+                                               scaler_state)
+            mean_loss = loss
+        else:
+            def micro(carry, mb):
+                acc, r = carry
+                r, r2 = jax.random.split(r)
+                loss, g = local_loss_and_grads(params_full, mb, r2,
+                                               scaler_state)
+                return (jax.tree.map(jnp.add, acc, g), r), loss
+
+            zeros = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32),
+                                 params_t)
+            (grads, _), losses = jax.lax.scan(micro, (zeros, rng), batch)
+            grads = jax.tree.map(lambda g: g / gas, grads)
+            mean_loss = losses.mean()
+
+        # Unscale BEFORE the wire: LoCo residuals must live in true gradient
+        # units, or a dynamic-loss-scale change would make the carried error
+        # wrong by the scale ratio.
+        grads = engine.loss_scaler.unscale_grads(grads, scaler_state)
+        flat_batch = batch if gas == 1 else \
+            jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), batch)
+        grads, new_error = exchange_grads(grads, flat_batch, comm_error)
+        mean_loss = jax.lax.pmean(mean_loss, data_axes) if data_axes else mean_loss
+        return mean_loss, grads, new_error
+
+    mesh = topo.mesh
+    batch_dim = 0 if gas == 1 else 1
+
+    def batch_spec(x):
+        spec = [None] * x.ndim
+        if data_axes:
+            spec[batch_dim] = dp_axes_entry
+        return P(*spec)
+
+    param_in = param_specs if stage3 else P()
+    err_spec = P(dp_axes_entry) if loco else None
+
+    def step_fn(state, batch):
+        rng, sub = jax.random.split(state.rng)
+        args = [state.params, batch, sub, state.scaler]
+        in_specs = [param_in, jax.tree.map(batch_spec, batch), P(), P()]
+        out_specs = (P(), P(), err_spec) if loco else (P(), P())
+
+        if loco:
+            body = local_step
+            args.append(state.comm_error)
+            in_specs.append(err_spec)
+        else:
+            def body(p, b, r, sc):
+                loss, grads, _ = local_step(p, b, r, sc, None)
+                return loss, grads
+
+        fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                           out_specs=out_specs, check_vma=False)
+        res = fn(*args)
+        loss, grads = res[0], res[1]
+        new_error = res[2] if loco else None
+        grads = engine._constrain_grads(grads)
+        new_state = engine._apply_update(state, grads, unscale=False)
+        if loco:
+            # A skipped (overflow) step must not commit inf/nan residuals —
+            # they would poison every subsequent corrected gradient.
+            overflow = engine.loss_scaler.check_overflow(grads) \
+                if engine.loss_scaler.dynamic else jnp.zeros((), bool)
+            new_error = jax.tree.map(
+                lambda new, old: jnp.where(overflow, old, new),
+                new_error, state.comm_error)
+        new_state = new_state.replace(micro_step=state.micro_step + gas,
+                                      rng=rng, comm_error=new_error)
+        return new_state, loss
+
+    return jax.jit(step_fn, donate_argnums=(0,))

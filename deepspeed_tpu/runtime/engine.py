@@ -57,6 +57,7 @@ class EngineState:
     scaler: LossScalerState
     grad_acc: Any                  # fp32 grad accumulation buffer (or None)
     rng: jax.Array
+    comm_error: Any = None         # LoCo error feedback (explicit-comm path)
 
 
 def _tree_zeros_like(tree, dtype=jnp.float32):
@@ -140,6 +141,30 @@ class DeepSpeedEngine:
                     is_leaf=lambda x: isinstance(x, PartitionSpec)),
             )(params)
 
+        # Explicit-comm path (ZeRO++ quantized wires / sparse grads): the
+        # shard_map step in comm_path.py replaces XLA's inserted collectives.
+        zc = config.zero_config
+        # qwZ only matters at stage 3 (below it params are replicated — no
+        # allgather exists to quantize); don't reroute training for a no-op.
+        self._explicit_comm = bool(
+            (zc.zero_quantized_weights and self.zero_stage >= 3)
+            or zc.zero_quantized_gradients
+            or getattr(config, "sparse_gradients_enabled", False))
+        if zc.zero_quantized_weights and self.zero_stage < 3:
+            logger.warning("zero_quantized_weights ignored below ZeRO stage 3")
+        comm_error = None
+        if zc.zero_quantized_gradients and getattr(zc, "zeropp_loco", False):
+            from .comm_path import dp_axes_info
+
+            _, n_dp, dp_entry = dp_axes_info(self.topology)
+            err_spec = PartitionSpec(dp_entry)
+            comm_error = jax.jit(
+                lambda p: jax.tree.map(
+                    lambda x: jnp.zeros((n_dp,) + x.shape, jnp.float32), p),
+                out_shardings=jax.tree.map(
+                    lambda _: NamedSharding(self.mesh, err_spec), params),
+            )(params)
+
         self.state = EngineState(
             global_step=jnp.zeros((), jnp.int32),
             micro_step=jnp.zeros((), jnp.int32),
@@ -149,6 +174,7 @@ class DeepSpeedEngine:
             scaler=self.loss_scaler.init(),
             grad_acc=grad_acc,
             rng=jax.random.PRNGKey(seed),
+            comm_error=comm_error,
         )
 
         # ---- data ---------------------------------------------------- #
@@ -312,9 +338,15 @@ class DeepSpeedEngine:
                 grads, specs, is_leaf=lambda x: isinstance(x, PartitionSpec))
         return grads
 
-    def _apply_update(self, state: EngineState, grads, grad_norm_scale=None):
-        """Unscale, clip, optimizer update, loss-scale update, skip-on-overflow."""
-        grads = self.loss_scaler.unscale_grads(grads, state.scaler)
+    def _apply_update(self, state: EngineState, grads, grad_norm_scale=None,
+                      unscale=True):
+        """Unscale, clip, optimizer update, loss-scale update, skip-on-overflow.
+
+        ``unscale=False`` when the caller already unscaled (the explicit-comm
+        path unscales before the wire so LoCo residuals live in true units).
+        """
+        if unscale:
+            grads = self.loss_scaler.unscale_grads(grads, state.scaler)
         if grad_norm_scale is not None:
             grads = jax.tree.map(lambda g: g * grad_norm_scale, grads)
         # prescale_gradients / gradient_predivide_factor (reference
@@ -354,6 +386,10 @@ class DeepSpeedEngine:
     # Fused path
     # ------------------------------------------------------------------ #
     def _build_train_batch_fn(self):
+        if self._explicit_comm:
+            from .comm_path import build_explicit_comm_step
+
+            return build_explicit_comm_step(self)
         gas = self.gradient_accumulation_steps()
 
         def step_fn(state: EngineState, batch):
@@ -477,6 +513,12 @@ class DeepSpeedEngine:
     # Imperative path (reference API shape)
     # ------------------------------------------------------------------ #
     def _build_micro_fn(self):
+        if self._explicit_comm:
+            logger.warning(
+                "explicit-comm wire formats (zero_quantized_*/"
+                "sparse_gradients) apply to train_batch(); the imperative "
+                "backward()/step() path uses the fused XLA collectives")
+
         def micro_fn(state: EngineState, batch):
             rng, sub = jax.random.split(state.rng)
             loss, grads = self._loss_and_grads(state.params, batch, sub, state.scaler)

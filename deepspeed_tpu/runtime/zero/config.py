@@ -86,6 +86,9 @@ class DeepSpeedZeroConfig(DeepSpeedConfigModel):
     zero_quantized_weights: bool = False
     zero_quantized_nontrainable_weights: bool = False
     zero_quantized_gradients: bool = False
+    #: LoCo error feedback on the quantized gradient wire (reference
+    #: coalesced_collectives.py:81 loco variant)
+    zeropp_loco: bool = False
     zero_hpz_partition_size: int = Field(1, ge=0)
     mics_shard_size: int = Field(-1)
     mics_hierarchical_params_gather: bool = False

