@@ -59,6 +59,13 @@ class InferenceEngineV2:
                  config: Optional[RaggedInferenceEngineConfig] = None):
         self.model = model
         self.cfg = model.config
+        if not isinstance(self.cfg, TransformerConfig):
+            raise NotImplementedError(
+                f"ragged serving covers the native CausalLM families "
+                f"(llama/mistral/qwen2/mixtral); got a "
+                f"{type(self.cfg).__name__} model — universal compat "
+                f"families (gpt2/opt/bloom/falcon/phi) serve via "
+                f"model(params, tokens) directly")
         self.config = config or RaggedInferenceEngineConfig()
         c = self.config
         num_blocks = c.num_blocks or (c.max_seqs * -(-c.max_ctx // c.block_size))

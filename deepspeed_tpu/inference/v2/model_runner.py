@@ -113,9 +113,16 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
         x, = carry
         lp, layer_k, layer_v = inputs
         h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
-        q = (h @ lp["q_proj"]["kernel"]).reshape(T, H, hd)
-        k = (h @ lp["k_proj"]["kernel"]).reshape(T, KV, hd)
-        v = (h @ lp["v_proj"]["kernel"]).reshape(T, KV, hd)
+
+        def proj(p, n):
+            y = h @ p["kernel"]
+            if "bias" in p:
+                y = y + p["bias"]
+            return y.reshape(T, n, hd)
+
+        q = proj(lp["q_proj"], H)
+        k = proj(lp["k_proj"], KV)
+        v = proj(lp["v_proj"], KV)
         q = _apply_rope_flat(q, cos, sin)
         k = _apply_rope_flat(k, cos, sin)
         layer_k, layer_v = paged_kv_append(layer_k, layer_v, k, v, kv_slot)

@@ -25,14 +25,18 @@ from .transformer import CausalLM, TransformerConfig
 _ARCH_POLICIES = {
     "LlamaForCausalLM": "llama",
     "MistralForCausalLM": "llama",
-    "Qwen2ForCausalLM": "llama",
+    "Qwen2ForCausalLM": "qwen2",      # llama + qkv bias, native CausalLM path
     "GPT2LMHeadModel": "gpt2",
     "GPTJForCausalLM": "gptj",
     "OPTForCausalLM": "opt",
     "BloomForCausalLM": "bloom",
     "FalconForCausalLM": "falcon",
+    "PhiForCausalLM": "phi",
     "MixtralForCausalLM": "mixtral",
 }
+
+#: families on the TP/MoE/flash/paged-serving-native CausalLM path
+NATIVE_FAMILIES = ("llama", "qwen2", "mixtral")
 
 
 def policy_for(hf_config: Any) -> str:
@@ -41,20 +45,26 @@ def policy_for(hf_config: Any) -> str:
         if a in _ARCH_POLICIES:
             return _ARCH_POLICIES[a]
     mt = getattr(hf_config, "model_type", "")
-    for name, fam in (("llama", "llama"), ("mistral", "llama"), ("qwen2", "llama"),
-                      ("gpt2", "gpt2"), ("opt", "opt"), ("bloom", "bloom"),
-                      ("falcon", "falcon"), ("mixtral", "mixtral")):
+    for name, fam in (("llama", "llama"), ("mistral", "llama"),
+                      ("qwen2", "qwen2"), ("gpt2", "gpt2"), ("opt", "opt"),
+                      ("bloom", "bloom"), ("falcon", "falcon"), ("phi", "phi"),
+                      ("mixtral", "mixtral")):
         if mt == name:
             return fam
     raise ValueError(f"unsupported HF architecture: {archs or mt}")
 
 
+def _hf_get(hf_config, *names, default=None):
+    return next((getattr(hf_config, n) for n in names
+                 if getattr(hf_config, n, None) is not None), default)
+
+
 def config_from_hf(hf_config: Any, **overrides) -> TransformerConfig:
-    """HF config → TransformerConfig (the per-arch 'container' policy)."""
+    """HF config → TransformerConfig (the per-arch 'container' policy) for
+    the llama/mixtral (RoPE+RMSNorm) families.  Other families get exact
+    per-arch recipes via :func:`arch_config_from_hf` (models/families.py)."""
     fam = policy_for(hf_config)
-    g = lambda *names, default=None: next(
-        (getattr(hf_config, n) for n in names if getattr(hf_config, n, None)
-         is not None), default)
+    g = lambda *names, default=None: _hf_get(hf_config, *names, default=default)
     hidden = g("hidden_size", "n_embd", default=768)
     heads = g("num_attention_heads", "n_head", default=12)
     kw = dict(
@@ -69,23 +79,96 @@ def config_from_hf(hf_config: Any, **overrides) -> TransformerConfig:
         norm_eps=g("rms_norm_eps", "layer_norm_epsilon", default=1e-5),
         tie_embeddings=bool(g("tie_word_embeddings", default=False)),
     )
-    if fam in ("gpt2", "opt", "bloom"):
-        logger.warning(
-            f"{fam}: learned-positional/LayerNorm families run on the "
-            f"Llama-recipe compute path (RoPE+RMSNorm); exact-architecture "
-            f"kernels for them land with the conversion test suite")
+    if fam == "mixtral":
+        kw.update(num_experts=g("num_local_experts", default=8),
+                  moe_top_k=g("num_experts_per_tok", default=2))
+    if fam == "qwen2":
+        kw.update(attn_bias=True)   # qwen2 = llama + q/k/v biases
     kw.update(overrides)
     return TransformerConfig(**kw)
 
 
-def from_pretrained_config(name_or_config: Any, **overrides) -> CausalLM:
-    """Build a CausalLM from an HF config object or model-name string."""
+def arch_config_from_hf(hf_config: Any, **overrides):
+    """HF config → exact :class:`ArchConfig` for the non-llama families."""
+    from .families import ArchConfig
+
+    fam = policy_for(hf_config)
+    g = lambda *names, default=None: _hf_get(hf_config, *names, default=default)
+    hidden = g("hidden_size", "n_embd", default=768)
+    heads = g("num_attention_heads", "n_head", default=12)
+    base = dict(
+        vocab_size=g("vocab_size", default=50257),
+        hidden_size=hidden,
+        intermediate_size=g("intermediate_size", "n_inner",
+                            "ffn_hidden_size", default=4 * hidden),
+        num_layers=g("num_hidden_layers", "n_layer", default=12),
+        num_heads=heads,
+        num_kv_heads=heads,
+        max_seq_len=g("max_position_embeddings", "n_positions", default=2048),
+        norm_eps=g("layer_norm_epsilon", "layer_norm_eps", "rms_norm_eps",
+                   default=1e-5),
+        tie_embeddings=bool(g("tie_word_embeddings", default=True)),
+    )
+    if fam == "gpt2":
+        base.update(pos="learned", norm="layernorm", mlp="gelu",
+                    qkv_bias=True, out_bias=True)
+    elif fam == "opt":
+        proj_dim = g("word_embed_proj_dim", default=hidden)
+        if proj_dim != hidden:
+            raise ValueError(
+                f"OPT word_embed_proj_dim={proj_dim} != hidden_size={hidden} "
+                f"(opt-350m's project_in/out) is not supported yet")
+        if not getattr(hf_config, "do_layer_norm_before", True):
+            raise ValueError("OPT do_layer_norm_before=False (opt-350m "
+                             "post-LN variant) is not supported yet")
+        base.update(pos="learned", pos_offset=2, norm="layernorm", mlp="relu",
+                    qkv_bias=True, out_bias=True,
+                    intermediate_size=g("ffn_dim", default=4 * hidden))
+    elif fam == "bloom":
+        base.update(pos="alibi", norm="layernorm", mlp="gelu",
+                    embed_layernorm=True, qkv_bias=True, out_bias=True,
+                    intermediate_size=4 * hidden)
+    elif fam == "falcon":
+        new_arch = bool(g("new_decoder_architecture", default=False))
+        kv = g("num_kv_heads", default=None) if new_arch else \
+            (1 if g("multi_query", default=True) else heads)
+        base.update(pos="rope", norm="layernorm", mlp="gelu", gelu_exact=True,
+                    parallel_attn=bool(g("parallel_attn", default=True)),
+                    dual_ln=new_arch, num_kv_heads=kv or heads,
+                    qkv_bias=bool(g("bias", default=False)),
+                    out_bias=bool(g("bias", default=False)),
+                    rope_theta=g("rope_theta", default=10000.0),
+                    intermediate_size=4 * hidden)
+    elif fam == "phi":
+        base.update(pos="rope", norm="layernorm", mlp="gelu",
+                    parallel_attn=True, dual_ln=False,
+                    qkv_bias=True, out_bias=True,
+                    rope_pct=float(g("partial_rotary_factor", default=0.5)),
+                    rope_theta=g("rope_theta", default=10000.0),
+                    num_kv_heads=g("num_key_value_heads", default=heads),
+                    tie_embeddings=False)
+    else:
+        raise ValueError(f"no exact ArchConfig recipe for family {fam!r}")
+    base.update(overrides)
+    return ArchConfig(**base)
+
+
+def from_pretrained_config(name_or_config: Any, **overrides):
+    """Build a model from an HF config object or model-name string.
+
+    llama/mistral/mixtral map onto the TP/MoE-native :class:`CausalLM`;
+    other families get exact per-arch :class:`UniversalCausalLM` recipes."""
     cfg = name_or_config
     if isinstance(name_or_config, str):
         from transformers import AutoConfig
 
         cfg = AutoConfig.from_pretrained(name_or_config)
-    return CausalLM(config_from_hf(cfg, **overrides))
+    fam = policy_for(cfg)
+    if fam in NATIVE_FAMILIES:
+        return CausalLM(config_from_hf(cfg, **overrides))
+    from .families import UniversalCausalLM
+
+    return UniversalCausalLM(arch_config_from_hf(cfg, **overrides))
 
 
 # --------------------------------------------------------------------- #
@@ -119,15 +202,213 @@ def convert_llama_state_dict(sd: Dict[str, Any], cfg: TransformerConfig) -> Dict
             "o_proj": {"kernel": stack("model.layers.{}.self_attn.o_proj.weight")},
             "mlp_norm": {"scale": stack("model.layers.{}.post_attention_layernorm.weight",
                                         transpose=False)},
-            "gate_proj": {"kernel": stack("model.layers.{}.mlp.gate_proj.weight")},
-            "up_proj": {"kernel": stack("model.layers.{}.mlp.up_proj.weight")},
-            "down_proj": {"kernel": stack("model.layers.{}.mlp.down_proj.weight")},
         },
         "norm_f": {"scale": jnp.asarray(t("model.norm.weight"))},
     }
+    if cfg.attn_bias:
+        for proj in ("q_proj", "k_proj", "v_proj"):
+            params["layers"][proj]["bias"] = stack(
+                "model.layers.{}.self_attn." + proj + ".bias", transpose=False)
+    if cfg.num_experts > 1:
+        # Mixtral expert import (reference: model_implementations/mixtral):
+        # w1=gate, w3=up, w2=down per expert; router = block_sparse_moe.gate.
+        E = cfg.num_experts
+        moe = "model.layers.{}.block_sparse_moe"
+
+        def stack_experts(w_name):
+            return jnp.asarray(np.stack([
+                np.stack([t(f"{moe.format(i)}.experts.{e}.{w_name}.weight").T
+                          for e in range(E)]) for i in range(L)]))
+
+        params["layers"]["router"] = {
+            "kernel": stack(moe + ".gate.weight")}
+        params["layers"]["gate_proj"] = {"kernel": stack_experts("w1")}
+        params["layers"]["up_proj"] = {"kernel": stack_experts("w3")}
+        params["layers"]["down_proj"] = {"kernel": stack_experts("w2")}
+    else:
+        params["layers"]["gate_proj"] = {
+            "kernel": stack("model.layers.{}.mlp.gate_proj.weight")}
+        params["layers"]["up_proj"] = {
+            "kernel": stack("model.layers.{}.mlp.up_proj.weight")}
+        params["layers"]["down_proj"] = {
+            "kernel": stack("model.layers.{}.mlp.down_proj.weight")}
     if not cfg.tie_embeddings and "lm_head.weight" in sd:
         params["lm_head"] = {"kernel": jnp.asarray(t("lm_head.weight").T)}
     return params
+
+
+# --------------------------------------------------------------------- #
+# Exact per-arch conversions (UniversalCausalLM families)
+# --------------------------------------------------------------------- #
+def convert_arch_state_dict(sd: Dict[str, Any], cfg, fam: str) -> Dict:
+    """gpt2/opt/bloom/falcon/phi/qwen2 HF checkpoint → UniversalCausalLM
+    pytree (reference: module_inject/containers per-arch param mappings)."""
+    import jax.numpy as jnp
+
+    def t(name):
+        w = sd[name]
+        if hasattr(w, "numpy"):
+            w = w.float().numpy()
+        return np.asarray(w, np.float32)
+
+    L, D, H, KV, hd = (cfg.num_layers, cfg.hidden_size, cfg.num_heads,
+                       cfg.num_kv_heads, cfg.head_dim)
+
+    def stack(fmt, transpose=True):
+        ws = [t(fmt.format(i)) for i in range(L)]
+        return jnp.asarray(np.stack([w.T if transpose else w for w in ws]))
+
+    def lin(fmt, bias_fmt=None, transpose=True):
+        p = {"kernel": stack(fmt, transpose)}
+        if bias_fmt is not None:
+            p["bias"] = stack(bias_fmt, transpose=False)
+        return p
+
+    def ln(w_fmt, b_fmt):
+        return {"scale": stack(w_fmt, transpose=False),
+                "bias": stack(b_fmt, transpose=False)}
+
+    if fam == "gpt2":
+        # Conv1D weights are [in, out] — NOT transposed.  Fused c_attn
+        # [D, 3D] splits along the output dim.
+        qkv = np.stack([t(f"transformer.h.{i}.attn.c_attn.weight")
+                        for i in range(L)])                     # [L, D, 3D]
+        qkv_b = np.stack([t(f"transformer.h.{i}.attn.c_attn.bias")
+                          for i in range(L)])                   # [L, 3D]
+        q, k, v = np.split(qkv, 3, axis=2)
+        qb, kb, vb = np.split(qkv_b, 3, axis=1)
+        layers = {
+            "ln1": ln("transformer.h.{}.ln_1.weight", "transformer.h.{}.ln_1.bias"),
+            "ln2": ln("transformer.h.{}.ln_2.weight", "transformer.h.{}.ln_2.bias"),
+            "q_proj": {"kernel": jnp.asarray(q), "bias": jnp.asarray(qb)},
+            "k_proj": {"kernel": jnp.asarray(k), "bias": jnp.asarray(kb)},
+            "v_proj": {"kernel": jnp.asarray(v), "bias": jnp.asarray(vb)},
+            "o_proj": lin("transformer.h.{}.attn.c_proj.weight",
+                          "transformer.h.{}.attn.c_proj.bias", transpose=False),
+            "fc1": lin("transformer.h.{}.mlp.c_fc.weight",
+                       "transformer.h.{}.mlp.c_fc.bias", transpose=False),
+            "fc2": lin("transformer.h.{}.mlp.c_proj.weight",
+                       "transformer.h.{}.mlp.c_proj.bias", transpose=False),
+        }
+        return {
+            "embed": {"embedding": jnp.asarray(t("transformer.wte.weight"))},
+            "pos_embed": {"embedding": jnp.asarray(t("transformer.wpe.weight"))},
+            "layers": layers,
+            "norm_f": {"scale": jnp.asarray(t("transformer.ln_f.weight")),
+                       "bias": jnp.asarray(t("transformer.ln_f.bias"))},
+        }
+
+    if fam == "opt":
+        p = "model.decoder.layers.{}"
+        layers = {
+            "ln1": ln(p + ".self_attn_layer_norm.weight",
+                      p + ".self_attn_layer_norm.bias"),
+            "ln2": ln(p + ".final_layer_norm.weight",
+                      p + ".final_layer_norm.bias"),
+            "q_proj": lin(p + ".self_attn.q_proj.weight", p + ".self_attn.q_proj.bias"),
+            "k_proj": lin(p + ".self_attn.k_proj.weight", p + ".self_attn.k_proj.bias"),
+            "v_proj": lin(p + ".self_attn.v_proj.weight", p + ".self_attn.v_proj.bias"),
+            "o_proj": lin(p + ".self_attn.out_proj.weight", p + ".self_attn.out_proj.bias"),
+            "fc1": lin(p + ".fc1.weight", p + ".fc1.bias"),
+            "fc2": lin(p + ".fc2.weight", p + ".fc2.bias"),
+        }
+        return {
+            "embed": {"embedding": jnp.asarray(t("model.decoder.embed_tokens.weight"))},
+            "pos_embed": {"embedding": jnp.asarray(t("model.decoder.embed_positions.weight"))},
+            "layers": layers,
+            "norm_f": {"scale": jnp.asarray(t("model.decoder.final_layer_norm.weight")),
+                       "bias": jnp.asarray(t("model.decoder.final_layer_norm.bias"))},
+        }
+
+    if fam == "bloom":
+        p = "transformer.h.{}"
+        # fused qkv rows are ordered [H, 3, hd] (modeling_bloom)
+        qs, ks, vs, qbs, kbs, vbs = [], [], [], [], [], []
+        for i in range(L):
+            w = t(f"transformer.h.{i}.self_attention.query_key_value.weight")
+            b = t(f"transformer.h.{i}.self_attention.query_key_value.bias")
+            w = w.reshape(H, 3, hd, D)
+            b = b.reshape(H, 3, hd)
+            qs.append(w[:, 0].reshape(H * hd, D).T)
+            ks.append(w[:, 1].reshape(H * hd, D).T)
+            vs.append(w[:, 2].reshape(H * hd, D).T)
+            qbs.append(b[:, 0].reshape(-1))
+            kbs.append(b[:, 1].reshape(-1))
+            vbs.append(b[:, 2].reshape(-1))
+        layers = {
+            "ln1": ln(p + ".input_layernorm.weight", p + ".input_layernorm.bias"),
+            "ln2": ln(p + ".post_attention_layernorm.weight",
+                      p + ".post_attention_layernorm.bias"),
+            "q_proj": {"kernel": jnp.asarray(np.stack(qs)), "bias": jnp.asarray(np.stack(qbs))},
+            "k_proj": {"kernel": jnp.asarray(np.stack(ks)), "bias": jnp.asarray(np.stack(kbs))},
+            "v_proj": {"kernel": jnp.asarray(np.stack(vs)), "bias": jnp.asarray(np.stack(vbs))},
+            "o_proj": lin(p + ".self_attention.dense.weight",
+                          p + ".self_attention.dense.bias"),
+            "fc1": lin(p + ".mlp.dense_h_to_4h.weight", p + ".mlp.dense_h_to_4h.bias"),
+            "fc2": lin(p + ".mlp.dense_4h_to_h.weight", p + ".mlp.dense_4h_to_h.bias"),
+        }
+        return {
+            "embed": {"embedding": jnp.asarray(t("transformer.word_embeddings.weight"))},
+            "embed_ln": {"scale": jnp.asarray(t("transformer.word_embeddings_layernorm.weight")),
+                         "bias": jnp.asarray(t("transformer.word_embeddings_layernorm.bias"))},
+            "layers": layers,
+            "norm_f": {"scale": jnp.asarray(t("transformer.ln_f.weight")),
+                       "bias": jnp.asarray(t("transformer.ln_f.bias"))},
+        }
+
+    if fam == "falcon":
+        p = "transformer.h.{}"
+        G = H // KV                     # query heads per kv head
+        qs, ks, vs = [], [], []
+        for i in range(L):
+            w = t(f"transformer.h.{i}.self_attention.query_key_value.weight")
+            # rows ordered [KV, G+2, hd]: G query heads then k then v per group
+            w = w.reshape(KV, G + 2, hd, D)
+            qs.append(w[:, :G].reshape(KV * G * hd, D).T)
+            ks.append(w[:, G].reshape(KV * hd, D).T)
+            vs.append(w[:, G + 1].reshape(KV * hd, D).T)
+        layers = {
+            "q_proj": {"kernel": jnp.asarray(np.stack(qs))},
+            "k_proj": {"kernel": jnp.asarray(np.stack(ks))},
+            "v_proj": {"kernel": jnp.asarray(np.stack(vs))},
+            "o_proj": lin(p + ".self_attention.dense.weight"),
+            "fc1": lin(p + ".mlp.dense_h_to_4h.weight"),
+            "fc2": lin(p + ".mlp.dense_4h_to_h.weight"),
+        }
+        if cfg.dual_ln:
+            layers["ln1"] = ln(p + ".ln_attn.weight", p + ".ln_attn.bias")
+            layers["ln2"] = ln(p + ".ln_mlp.weight", p + ".ln_mlp.bias")
+        else:
+            layers["ln1"] = ln(p + ".input_layernorm.weight",
+                               p + ".input_layernorm.bias")
+        return {
+            "embed": {"embedding": jnp.asarray(t("transformer.word_embeddings.weight"))},
+            "layers": layers,
+            "norm_f": {"scale": jnp.asarray(t("transformer.ln_f.weight")),
+                       "bias": jnp.asarray(t("transformer.ln_f.bias"))},
+        }
+
+    if fam == "phi":
+        p = "model.layers.{}"
+        params = {
+            "embed": {"embedding": jnp.asarray(t("model.embed_tokens.weight"))},
+            "layers": {
+                "ln1": ln(p + ".input_layernorm.weight", p + ".input_layernorm.bias"),
+                "q_proj": lin(p + ".self_attn.q_proj.weight", p + ".self_attn.q_proj.bias"),
+                "k_proj": lin(p + ".self_attn.k_proj.weight", p + ".self_attn.k_proj.bias"),
+                "v_proj": lin(p + ".self_attn.v_proj.weight", p + ".self_attn.v_proj.bias"),
+                "o_proj": lin(p + ".self_attn.dense.weight", p + ".self_attn.dense.bias"),
+                "fc1": lin(p + ".mlp.fc1.weight", p + ".mlp.fc1.bias"),
+                "fc2": lin(p + ".mlp.fc2.weight", p + ".mlp.fc2.bias"),
+            },
+            "norm_f": {"scale": jnp.asarray(t("model.final_layernorm.weight")),
+                       "bias": jnp.asarray(t("model.final_layernorm.bias"))},
+            "lm_head": {"kernel": jnp.asarray(t("lm_head.weight").T),
+                        "bias": jnp.asarray(t("lm_head.bias"))},
+        }
+        return params
+
+    raise ValueError(f"no converter for family {fam!r}")
 
 
 def load_hf_model(model_name_or_path: str, dtype=None, **overrides):
@@ -142,7 +423,12 @@ def load_hf_model(model_name_or_path: str, dtype=None, **overrides):
     model = from_pretrained_config(hf_cfg, **overrides)
     hf_model = AutoModelForCausalLM.from_pretrained(model_name_or_path,
                                                     torch_dtype="float32")
-    params = convert_llama_state_dict(hf_model.state_dict(), model.config)
+    fam = policy_for(hf_cfg)
+    if fam in NATIVE_FAMILIES:
+        params = convert_llama_state_dict(hf_model.state_dict(), model.config)
+    else:
+        params = convert_arch_state_dict(hf_model.state_dict(), model.config,
+                                         fam)
     if dtype is not None:
         import jax
 

@@ -44,6 +44,8 @@ class TransformerConfig:
     rope_theta: float = 10000.0
     norm_eps: float = 1e-5
     tie_embeddings: bool = False
+    #: bias on q/k/v projections (qwen2-family); o_proj stays bias-free
+    attn_bias: bool = False
     remat: bool = False
     use_flash: bool = True          # pallas flash attention on TPU
     attn_impl: str = "auto"         # auto | flash | xla | ring | ulysses
@@ -120,6 +122,10 @@ def init_params(cfg: TransformerConfig, key: jax.Array, dtype=jnp.float32) -> Di
         "o_proj": {"kernel": dense_init(ks[3], (L, H * hd, D), H * hd)},
         "mlp_norm": {"scale": norm_init(L, D)},
     }
+    if cfg.attn_bias:
+        layers["q_proj"]["bias"] = jnp.zeros((L, H * hd), dtype)
+        layers["k_proj"]["bias"] = jnp.zeros((L, KV * hd), dtype)
+        layers["v_proj"]["bias"] = jnp.zeros((L, KV * hd), dtype)
     if cfg.num_experts > 1:
         E = cfg.num_experts
         layers["router"] = {"kernel": dense_init(ks[7], (L, D, E), D).astype(jnp.float32)}
@@ -155,6 +161,11 @@ def partition_specs(cfg: TransformerConfig) -> Dict:
         "o_proj": {"kernel": P(None, TENSOR, None)},
         "mlp_norm": {"scale": P(None, None)},
     }
+    if cfg.attn_bias:
+        # column-parallel biases shard with the projection's output dim
+        layer_specs["q_proj"]["bias"] = P(None, TENSOR)
+        layer_specs["k_proj"]["bias"] = P(None, TENSOR)
+        layer_specs["v_proj"]["bias"] = P(None, TENSOR)
     if cfg.num_experts > 1:
         # experts sharded over the "expert" mesh axis, TP within each expert
         layer_specs["router"] = {"kernel": P(None, None, None)}
@@ -271,13 +282,19 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
         up = h @ lp["up_proj"]["kernel"]
         return (gate * up) @ lp["down_proj"]["kernel"], jnp.zeros((), jnp.float32)
 
+    def proj(h, p, B, n_heads):
+        y = h @ p["kernel"]
+        if "bias" in p:
+            y = y + p["bias"]
+        return y.reshape(B, S, n_heads, cfg.head_dim)
+
     def layer(carry, lp):
         x, aux = carry
         B = x.shape[0]
         h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
-        q = (h @ lp["q_proj"]["kernel"]).reshape(B, S, cfg.num_heads, cfg.head_dim)
-        k = (h @ lp["k_proj"]["kernel"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
-        v = (h @ lp["v_proj"]["kernel"]).reshape(B, S, cfg.num_kv_heads, cfg.head_dim)
+        q = proj(h, lp["q_proj"], B, cfg.num_heads)
+        k = proj(h, lp["k_proj"], B, cfg.num_kv_heads)
+        v = proj(h, lp["v_proj"], B, cfg.num_kv_heads)
         q = apply_rope(q, cos, sin)
         k = apply_rope(k, cos, sin)
         o = attention(q, k, v, cfg, causal=True)

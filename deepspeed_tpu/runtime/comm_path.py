@@ -61,20 +61,30 @@ def dp_axes_info(topology):
 # --------------------------------------------------------------------- #
 # Wire primitives (must run inside shard_map with ``axes`` bound)
 # --------------------------------------------------------------------- #
+def loco_partition_size(numel: int, n: int, group_size: int = 256) -> int:
+    """Length of one rank's reduced partition (stage-2 LoCo buffer size)."""
+    pad = (-numel) % (n * group_size)
+    return (numel + pad) // n
+
+
 def quantized_allreduce(grad: jnp.ndarray, axes, bits: int = 8,
                         group_size: int = 256,
-                        error: Optional[jnp.ndarray] = None
-                        ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray]]:
+                        error: Optional[jnp.ndarray] = None,
+                        server_error: Optional[jnp.ndarray] = None
+                        ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
+                                   Optional[jnp.ndarray]]:
     """Mean-allreduce with a fully quantized wire (qgZ analogue).
 
-    Stage 1: each rank quantizes its local contribution and all-to-alls it
-    (via psum-free reduce-scatter on the int-dequantized values); stage 2:
-    the reduced partition is re-quantized and allgathered.  With LoCo,
-    ``error`` carries the per-rank quantization residual across steps.
+    Stage 1: each rank quantizes its local contribution and all-to-alls it;
+    stage 2: the reduced partition is re-quantized and allgathered.  With
+    LoCo, BOTH hops carry error feedback (reference coalesced_collectives
+    loco variant): ``error`` holds the stage-1 residual of my local
+    contribution, ``server_error`` the stage-2 residual of my reduced
+    partition.
     """
     n = jax.lax.psum(1, axes)
     if n <= 1:
-        return grad, error
+        return grad, error, server_error
     quant, dequant = _quant_fns(bits)
     flat = grad.reshape(-1).astype(jnp.float32)
     if error is not None:
@@ -86,9 +96,9 @@ def quantized_allreduce(grad: jnp.ndarray, axes, bits: int = 8,
 
     # stage 1: quantize local contributions, exchange, reduce my partition
     q, s = quant(flat, group_size)                 # wire: int(size) + f32 scales
-    sent = dequant(q, s, shape=flat.shape)         # what actually hit the wire
     new_error = None
     if error is not None:
+        sent = dequant(q, s, shape=flat.shape)     # what actually hit the wire
         new_error = (flat - sent)[:size].reshape(grad.shape)
     per = flat.shape[0] // n
     groups_per = q.shape[0] // n
@@ -101,12 +111,19 @@ def quantized_allreduce(grad: jnp.ndarray, axes, bits: int = 8,
     mine = jnp.mean(contribs, axis=0)              # my reduced partition
 
     # stage 2: quantized allgather of the reduced partitions
+    new_server_error = None
+    if server_error is not None:
+        mine = mine + server_error.reshape(-1)
     q2, s2 = quant(mine, group_size)
+    if server_error is not None:
+        sent2 = dequant(q2, s2, shape=mine.shape)
+        new_server_error = (mine - sent2).reshape(server_error.shape)
     q2_all = jax.lax.all_gather(q2, axes, axis=0, tiled=False)   # [n, g, w]
     s2_all = jax.lax.all_gather(s2, axes, axis=0, tiled=False)
     full = dequant(q2_all.reshape(-1, q2.shape[1]),
                    s2_all.reshape(-1, 1)).reshape(-1)[:size]
-    return full.reshape(grad.shape).astype(grad.dtype), new_error
+    return (full.reshape(grad.shape).astype(grad.dtype), new_error,
+            new_server_error)
 
 
 def quantized_all_gather_shard(shard: jnp.ndarray, axes, dim: int,
@@ -229,11 +246,13 @@ def build_explicit_comm_step(engine):
                 outs.append(sparse_embedding_allreduce(g, ids, data_axes))
                 errs.append(e)
             elif qgz and data_axes:
-                out, new_e = quantized_allreduce(
+                out, new_w, new_s = quantized_allreduce(
                     g, data_axes, bits=grad_bits,
-                    error=e[0] if loco else None)
+                    error=e["worker"][0] if loco else None,
+                    server_error=e["server"][0] if loco else None)
                 outs.append(out)
-                errs.append(new_e[None] if loco else e)
+                errs.append({"worker": new_w[None], "server": new_s[None]}
+                            if loco else e)
             elif data_axes:
                 outs.append(jax.lax.psum(g, data_axes) / n)
                 errs.append(e)

@@ -154,15 +154,22 @@ class DeepSpeedEngine:
             logger.warning("zero_quantized_weights ignored below ZeRO stage 3")
         comm_error = None
         if zc.zero_quantized_gradients and getattr(zc, "zeropp_loco", False):
-            from .comm_path import dp_axes_info
+            from .comm_path import dp_axes_info, loco_partition_size
 
             _, n_dp, dp_entry = dp_axes_info(self.topology)
             err_spec = PartitionSpec(dp_entry)
+
+            # Two-level LoCo state (reference loco variant): stage-1 worker
+            # residual per local contribution, stage-2 server residual per
+            # reduced partition; leading axis = one row per DP rank.
+            def _mk_error(x):
+                per = loco_partition_size(int(np.prod(x.shape)), n_dp)
+                return {"worker": jnp.zeros((n_dp,) + x.shape, jnp.float32),
+                        "server": jnp.zeros((n_dp, per), jnp.float32)}
+
             comm_error = jax.jit(
-                lambda p: jax.tree.map(
-                    lambda x: jnp.zeros((n_dp,) + x.shape, jnp.float32), p),
-                out_shardings=jax.tree.map(
-                    lambda _: NamedSharding(self.mesh, err_spec), params),
+                lambda p: jax.tree.map(_mk_error, p),
+                out_shardings=NamedSharding(self.mesh, err_spec),
             )(params)
 
         self.state = EngineState(
