@@ -75,14 +75,26 @@ def init_distributed(
         port = distributed_port or os.environ.get("MASTER_PORT", "29500")
         coordinator_address = f"{os.environ['MASTER_ADDR']}:{port}"
     if world_size is None:
-        for var in ("DSTPU_WORLD_SIZE", "WORLD_SIZE", "OMPI_COMM_WORLD_SIZE"):
+        for var in ("DSTPU_WORLD_SIZE", "WORLD_SIZE", "OMPI_COMM_WORLD_SIZE",
+                    "PMI_SIZE", "SLURM_NTASKS"):
             if os.environ.get(var):
                 world_size = int(os.environ[var])
                 break
     if rank is None:
-        for var in ("DSTPU_RANK", "RANK", "OMPI_COMM_WORLD_RANK"):
+        # launcher env → MPI (openmpi/mpich) → slurm → pdsh hostname lookup
+        for var in ("DSTPU_RANK", "RANK", "OMPI_COMM_WORLD_RANK", "PMI_RANK",
+                    "SLURM_PROCID"):
             if os.environ.get(var):
                 rank = int(os.environ[var])
+                break
+    if rank is None and os.environ.get("DSTPU_NODE_LIST"):
+        import socket
+
+        hosts = os.environ["DSTPU_NODE_LIST"].split(",")
+        name = socket.gethostname()
+        for i, h in enumerate(hosts):
+            if name == h or name.split(".")[0] == h.split(".")[0]:
+                rank = i
                 break
 
     cdb = XlaBackend()

@@ -1,0 +1,134 @@
+"""Elastic worker agent (reference: elasticity/elastic_agent.py:32
+``DSElasticAgent`` — worker env setup :65, ``_invoke_run`` monitor loop :127).
+
+TPU formulation: torchelastic's rendezvous is replaced by
+``jax.distributed.initialize`` (coordinator address in env) and recovery is
+"restart all workers from the latest (reshardable) universal checkpoint".
+The agent owns the worker processes: it spawns one per local rank, monitors
+exits, and on any failure tears the group down (SIGTERM — never SIGKILL a
+live TPU client) and restarts the whole gang with a fresh rendezvous, up to
+``max_restarts`` times.  ``DSTPU_ELASTIC_RESTART_COUNT`` tells workers they
+are a restart so they resume from their checkpoint.
+"""
+from __future__ import annotations
+
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from ..utils.logging import logger
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+class WorkerGroupFailure(RuntimeError):
+    pass
+
+
+class DSElasticAgent:
+    """Monitor-restart loop for a gang of local workers.
+
+    Parameters mirror the reference agent's spec: ``cmd`` is the worker
+    command line; each worker gets RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT
+    plus COORDINATOR_ADDRESS for ``jax.distributed.initialize``.
+    """
+
+    def __init__(self, cmd: Sequence[str], world_size: int,
+                 max_restarts: int = 3, monitor_interval: float = 0.5,
+                 env: Optional[Dict[str, str]] = None,
+                 term_timeout: float = 30.0):
+        self.cmd = list(cmd)
+        self.world_size = int(world_size)
+        self.max_restarts = int(max_restarts)
+        self.monitor_interval = float(monitor_interval)
+        self.base_env = dict(env if env is not None else os.environ)
+        self.term_timeout = term_timeout
+        self.restart_count = 0
+
+    # -------------------------------------------------------------- #
+    def _spawn_workers(self) -> List[subprocess.Popen]:
+        port = _free_port()
+        procs = []
+        for rank in range(self.world_size):
+            env = dict(self.base_env)
+            env.update({
+                "RANK": str(rank),
+                "DSTPU_RANK": str(rank),
+                "WORLD_SIZE": str(self.world_size),
+                "DSTPU_WORLD_SIZE": str(self.world_size),
+                "MASTER_ADDR": "localhost",
+                "MASTER_PORT": str(port),
+                "COORDINATOR_ADDRESS": f"localhost:{port}",
+                "DSTPU_ELASTIC_RESTART_COUNT": str(self.restart_count),
+            })
+            procs.append(subprocess.Popen(self.cmd, env=env))
+        logger.info(f"elastic agent: spawned {self.world_size} workers "
+                    f"(restart {self.restart_count}, rendezvous :{port})")
+        return procs
+
+    def _terminate(self, procs: List[subprocess.Popen]) -> None:
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.time() + self.term_timeout
+        for p in procs:
+            remaining = max(deadline - time.time(), 0.1)
+            try:
+                p.wait(timeout=remaining)
+            except subprocess.TimeoutExpired:
+                logger.warning(f"worker pid {p.pid} ignored SIGTERM; leaving "
+                               f"it to the OS (never SIGKILL a TPU client)")
+
+    # -------------------------------------------------------------- #
+    def run(self) -> int:
+        """Reference ``_invoke_run``: monitor until success or restart
+        budget exhausted.  Returns 0 on success."""
+        while True:
+            procs = self._spawn_workers()
+            failed: Optional[int] = None
+            while True:
+                states = [p.poll() for p in procs]
+                if any(rc not in (None, 0) for rc in states):
+                    failed = next(rc for rc in states if rc not in (None, 0))
+                    break
+                if all(rc == 0 for rc in states):
+                    return 0
+                time.sleep(self.monitor_interval)
+
+            logger.warning(f"elastic agent: worker failed rc={failed} "
+                           f"(restart {self.restart_count}/{self.max_restarts})")
+            self._terminate(procs)
+            if self.restart_count >= self.max_restarts:
+                raise WorkerGroupFailure(
+                    f"worker group failed rc={failed} after "
+                    f"{self.restart_count} restarts")
+            self.restart_count += 1
+
+
+def main(argv: Optional[Sequence[str]] = None) -> None:
+    """CLI: ``python -m deepspeed_tpu.elasticity.elastic_agent --world-size N
+    -- cmd args…`` (the launcher's --enable_elastic_training path)."""
+    import argparse
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--world-size", type=int, default=1)
+    parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("cmd", nargs=argparse.REMAINDER)
+    args = parser.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        parser.error("worker command required after --")
+    agent = DSElasticAgent(cmd, args.world_size, args.max_restarts)
+    sys.exit(agent.run())
+
+
+if __name__ == "__main__":
+    main()

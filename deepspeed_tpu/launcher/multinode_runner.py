@@ -1,0 +1,123 @@
+"""Multinode launcher backends (reference: launcher/multinode_runner.py —
+PDSHRunner :51, OpenMPIRunner :120, MPICHRunner :200, SlurmRunner :357).
+
+Each runner builds ONE fan-out command that starts a worker per host; on TPU
+pods each host runs one process (jax.distributed handles the in-host chips).
+Rank is NOT baked into the exported env — a single fan-out command cannot
+carry per-host values — so each worker derives its rank from the backend's
+native env (OMPI_COMM_WORLD_RANK / PMI_RANK / SLURM_PROCID) or, for pdsh,
+from its hostname's position in ``DSTPU_NODE_LIST``; ``comm.init_distributed``
+implements that discovery order.
+"""
+from __future__ import annotations
+
+import os
+import shlex
+import shutil
+import sys
+from typing import Dict, List, Sequence
+
+#: env keys that must never be fanned out identically to every host
+_RANK_KEYS = ("RANK", "DSTPU_RANK", "LOCAL_RANK")
+
+
+class MultiNodeRunner:
+    name = "base"
+
+    def __init__(self, user_script: str, user_args: Sequence[str],
+                 exports: Dict[str, str]):
+        self.user_script = user_script
+        self.user_args = list(user_args)
+        self.exports = {k: v for k, v in exports.items()
+                        if k not in _RANK_KEYS}
+
+    def backend_installed(self) -> bool:
+        raise NotImplementedError
+
+    def _set_rendezvous(self, master_addr: str, master_port: int) -> None:
+        self.exports.update({
+            "MASTER_ADDR": master_addr,
+            "MASTER_PORT": str(master_port),
+            "COORDINATOR_ADDRESS": f"{master_addr}:{master_port}",
+        })
+
+    def get_cmd(self, hosts: List[str], master_addr: str,
+                master_port: int) -> List[str]:
+        raise NotImplementedError
+
+    def worker_cmdline(self, extra_env: Dict[str, str] = ()) -> str:
+        """Shell line that cd's into the workdir, applies exports, and runs
+        the user script (shared by pdsh and the ssh per-host path)."""
+        env = dict(self.exports)
+        env.update(extra_env or {})
+        exports = " ".join(f"{k}={shlex.quote(str(v))}"
+                           for k, v in env.items())
+        return (f"cd {shlex.quote(os.getcwd())} && {exports} "
+                f"{sys.executable} {shlex.quote(self.user_script)} "
+                + " ".join(map(shlex.quote, self.user_args)))
+
+
+class PDSHRunner(MultiNodeRunner):
+    name = "pdsh"
+
+    def backend_installed(self) -> bool:
+        return shutil.which("pdsh") is not None
+
+    def get_cmd(self, hosts, master_addr, master_port):
+        self._set_rendezvous(master_addr, master_port)
+        # workers find their rank via hostname position in this list
+        # (comm.init_distributed's DSTPU_NODE_LIST fallback)
+        self.exports["DSTPU_NODE_LIST"] = ",".join(hosts)
+        return ["pdsh", "-S", "-w", ",".join(hosts), self.worker_cmdline()]
+
+
+class OpenMPIRunner(MultiNodeRunner):
+    name = "openmpi"
+
+    def backend_installed(self) -> bool:
+        return shutil.which("mpirun") is not None
+
+    def get_cmd(self, hosts, master_addr, master_port):
+        self._set_rendezvous(master_addr, master_port)
+        cmd = ["mpirun", "-np", str(len(hosts)), "--host", ",".join(hosts),
+               "--map-by", "ppr:1:node"]
+        for k, v in self.exports.items():
+            cmd += ["-x", f"{k}={v}"]
+        return cmd + [sys.executable, self.user_script] + self.user_args
+
+
+class MPICHRunner(MultiNodeRunner):
+    name = "mpich"
+
+    def backend_installed(self) -> bool:
+        return shutil.which("mpiexec") is not None
+
+    def get_cmd(self, hosts, master_addr, master_port):
+        self._set_rendezvous(master_addr, master_port)
+        cmd = ["mpiexec", "-n", str(len(hosts)), "-hosts", ",".join(hosts)]
+        for k, v in self.exports.items():
+            cmd += ["-genv", k, str(v)]
+        return cmd + [sys.executable, self.user_script] + self.user_args
+
+
+class SlurmRunner(MultiNodeRunner):
+    name = "slurm"
+
+    def backend_installed(self) -> bool:
+        return shutil.which("srun") is not None
+
+    def get_cmd(self, hosts, master_addr, master_port):
+        self._set_rendezvous(master_addr, master_port)
+        # env values (XLA_FLAGS…) may contain commas/spaces that srun's
+        # --export K=V parser mangles: rely on --export=ALL propagating the
+        # parent process env instead (runner.py launches this command with
+        # self.exports merged into the subprocess env).
+        cmd = ["srun", "--ntasks", str(len(hosts)), "--ntasks-per-node", "1",
+               "--export=ALL"]
+        if hosts:
+            cmd += ["--nodelist", ",".join(hosts)]
+        return cmd + [sys.executable, self.user_script] + self.user_args
+
+
+RUNNERS = {r.name: r for r in
+           (PDSHRunner, OpenMPIRunner, MPICHRunner, SlurmRunner)}

@@ -39,7 +39,8 @@ def parse_args(args=None):
     parser.add_argument("--master_port", type=int, default=29500)
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="ssh",
-                        choices=["ssh", "pdsh", "local"])
+                        choices=["ssh", "pdsh", "openmpi", "mpich", "slurm",
+                                 "local"])
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -127,19 +128,39 @@ def main(args=None):
     master_addr = args.master_addr or hosts[0]
     world_size = len(hosts)
 
+    # rank-free shared env: workers derive rank from the backend's native
+    # env (or DSTPU_NODE_LIST for pdsh/ssh) — see multinode_runner.py
+    shared_env = build_launch_env(0, world_size, master_addr, args.master_port)
+    for key in ("RANK", "DSTPU_RANK", "LOCAL_RANK"):
+        shared_env.pop(key, None)
+
+    from .multinode_runner import RUNNERS, MultiNodeRunner
+
+    if args.launcher in RUNNERS:
+        # single fan-out command (reference multinode_runner.py backends)
+        runner = RUNNERS[args.launcher](args.user_script, args.user_args,
+                                        shared_env)
+        if not runner.backend_installed():
+            logger.error(f"launcher backend {args.launcher!r} not installed")
+            sys.exit(1)
+        cmd = runner.get_cmd(hosts, master_addr, args.master_port)
+        logger.info(f"launching via {args.launcher}: "
+                    f"{' '.join(map(shlex.quote, cmd))}")
+        env = dict(os.environ)
+        env.update(runner.exports)      # slurm --export=ALL inherits these
+        sys.exit(subprocess.run(cmd, env=env).returncode)
+
+    # ssh: one remote command per host, with the true per-rank env
+    base = MultiNodeRunner(args.user_script, args.user_args, shared_env)
+    base._set_rendezvous(master_addr, args.master_port)
     procs: List[subprocess.Popen] = []
     for rank, host in enumerate(hosts):
-        env = build_launch_env(rank, world_size, master_addr, args.master_port)
-        exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in env.items())
-        remote_cmd = f"cd {shlex.quote(os.getcwd())} && {exports} " \
-            f"{sys.executable} {shlex.quote(args.user_script)} " \
-            + " ".join(map(shlex.quote, args.user_args))
-        if args.launcher == "pdsh":
-            cmd = ["pdsh", "-w", host, remote_cmd]
-        else:
-            cmd = ["ssh", host, remote_cmd]
+        remote_cmd = base.worker_cmdline(
+            {"RANK": str(rank), "DSTPU_RANK": str(rank),
+             "WORLD_SIZE": str(world_size),
+             "DSTPU_WORLD_SIZE": str(world_size)})
         logger.info(f"rank {rank} @ {host}")
-        procs.append(subprocess.Popen(cmd))
+        procs.append(subprocess.Popen(["ssh", host, remote_cmd]))
 
     rc = 0
     for p in procs:

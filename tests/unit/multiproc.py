@@ -1,0 +1,104 @@
+"""Multi-process distributed test harness (reference: tests/unit/common.py:416
+``DistributedTest`` — forked procs + file-store rendezvous).
+
+TPU translation: fork ``world_size`` REAL processes, each with its own CPU
+backend (``--xla_force_host_platform_device_count=K``), rendezvoused via
+``jax.distributed.initialize`` on a localhost coordinator — cross-process
+collectives run over the distributed runtime exactly as they would across
+pod hosts.  Test bodies are module-level functions imported by file path in
+the child, so launcher/elastic/checkpoint flows execute truly cross-process.
+
+Usage (from a test):
+    def _body(ctx):            # module-level, runs in EVERY child
+        import jax
+        assert len(jax.devices()) == ctx["world_size"] * ctx["local_devices"]
+
+    def test_x():
+        run_distributed(__file__, "_body", world_size=2)
+"""
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+from typing import Any, Dict, List, Optional
+
+REPO = os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def run_distributed(test_file: str, fn_name: str, world_size: int = 2,
+                    local_devices: int = 2, timeout: float = 300.0,
+                    payload: Optional[Dict[str, Any]] = None,
+                    env_extra: Optional[Dict[str, str]] = None) -> List[str]:
+    """Fork ``world_size`` procs, each running ``fn_name(ctx)`` from
+    ``test_file``.  Raises on any nonzero exit; returns child stdouts."""
+    port = free_port()
+    procs = []
+    for rank in range(world_size):
+        ctx = {
+            "rank": rank, "world_size": world_size,
+            "local_devices": local_devices, "port": port,
+            "test_file": os.path.abspath(test_file), "fn": fn_name,
+            "payload": payload or {},
+        }
+        env = dict(os.environ)
+        env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={local_devices}"
+        env["JAX_PLATFORMS"] = "cpu"
+        env["PYTHONPATH"] = os.pathsep.join(
+            [REPO, os.path.join(REPO, "tests")] +
+            ([env["PYTHONPATH"]] if env.get("PYTHONPATH") else []))
+        env.update(env_extra or {})
+        procs.append(subprocess.Popen(
+            [sys.executable, os.path.abspath(__file__), json.dumps(ctx)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True))
+    outs = []
+    failed = []
+    for rank, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=timeout)
+        except subprocess.TimeoutExpired:
+            p.terminate()
+            out, _ = p.communicate()
+            failed.append((rank, "timeout", out))
+            continue
+        outs.append(out)
+        if p.returncode != 0:
+            failed.append((rank, p.returncode, out))
+    if failed:
+        detail = "\n".join(f"--- rank {r} rc={rc}:\n{out[-3000:]}"
+                           for r, rc, out in failed)
+        raise AssertionError(f"distributed test failed:\n{detail}")
+    return outs
+
+
+def _child_main(ctx_json: str) -> None:
+    ctx = json.loads(ctx_json)
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.distributed.initialize(f"localhost:{ctx['port']}",
+                               num_processes=ctx["world_size"],
+                               process_id=ctx["rank"])
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location("dstpu_mp_target",
+                                                  ctx["test_file"])
+    mod = importlib.util.module_from_spec(spec)
+    sys.modules["dstpu_mp_target"] = mod
+    spec.loader.exec_module(mod)
+    fn = getattr(mod, ctx["fn"])
+    fn(ctx)
+    print(f"[rank {ctx['rank']}] OK", flush=True)
+
+
+if __name__ == "__main__":
+    _child_main(sys.argv[1])
