@@ -91,11 +91,23 @@ def init_distributed(
         import socket
 
         hosts = os.environ["DSTPU_NODE_LIST"].split(",")
-        name = socket.gethostname()
-        for i, h in enumerate(hosts):
-            if name == h or name.split(".")[0] == h.split(".")[0]:
-                rank = i
-                break
+        # exact matches only: hostname, FQDN, short name, or a local IP —
+        # fuzzy first-label matching would collide across clusters
+        names = {socket.gethostname(), socket.getfqdn(),
+                 socket.gethostname().split(".")[0]}
+        try:
+            names.update(i[4][0] for i in socket.getaddrinfo(
+                socket.gethostname(), None))
+        except socket.gaierror:
+            pass
+        matches = [i for i, h in enumerate(hosts) if h in names]
+        if len(matches) == 1:
+            rank = matches[0]
+        else:
+            raise RuntimeError(
+                f"cannot derive rank from DSTPU_NODE_LIST={hosts}: host "
+                f"identities {sorted(names)} matched {matches} — set RANK "
+                f"explicitly or use a hostname-based hostfile")
 
     cdb = XlaBackend()
     cdb.init_process_group(

@@ -45,7 +45,7 @@ class MultiNodeRunner:
                 master_port: int) -> List[str]:
         raise NotImplementedError
 
-    def worker_cmdline(self, extra_env: Dict[str, str] = ()) -> str:
+    def worker_cmdline(self, extra_env: "Dict[str, str] | None" = None) -> str:
         """Shell line that cd's into the workdir, applies exports, and runs
         the user script (shared by pdsh and the ssh per-host path)."""
         env = dict(self.exports)

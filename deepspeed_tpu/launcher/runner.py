@@ -148,7 +148,9 @@ def main(args=None):
                     f"{' '.join(map(shlex.quote, cmd))}")
         env = dict(os.environ)
         env.update(runner.exports)      # slurm --export=ALL inherits these
-        sys.exit(subprocess.run(cmd, env=env).returncode)
+        for key in ("RANK", "DSTPU_RANK", "LOCAL_RANK"):
+            env.pop(key, None)          # stale launcher-env ranks would be
+        sys.exit(subprocess.run(cmd, env=env).returncode)  # fanned to all tasks
 
     # ssh: one remote command per host, with the true per-rank env
     base = MultiNodeRunner(args.user_script, args.user_args, shared_env)

@@ -1,0 +1,5 @@
+"""FP quantizer (reference ⚙: csrc/fp_quantizer/fp_quantize.{cpp,cu} 852 LoC,
+bound via deepspeed/ops/fp_quantizer/quantize.py)."""
+from .quantize import FP_Quantize, fp_dequantize, fp_quantize
+
+__all__ = ["fp_quantize", "fp_dequantize", "FP_Quantize"]

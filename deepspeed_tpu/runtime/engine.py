@@ -236,7 +236,13 @@ class DeepSpeedEngine:
         cfg = self.config.optimizer
         if cfg is None:
             return build_optimizer("adam", {}, learning_rate=self._schedule_fn)
-        return build_optimizer(cfg.type, cfg.params, learning_rate=self._schedule_fn)
+        params = dict(cfg.params)
+        if cfg.type.lower() in ("onebitadam", "onebitlamb", "zerooneadam"):
+            # The fused engine step runs outside shard_map: grads arrive
+            # already globally averaged (XLA-inserted collectives), so the
+            # 1-bit transforms must not attempt their own named-axis comm.
+            params.setdefault("comm_axes", ())
+        return build_optimizer(cfg.type, params, learning_rate=self._schedule_fn)
 
     def _to_host_memory(self, sharding):
         """NamedSharding → pinned_host memory kind (TPU only: the CPU backend's

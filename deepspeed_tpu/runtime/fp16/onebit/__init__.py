@@ -1,0 +1,9 @@
+"""1-bit / 0-1 communication-efficient optimizers (reference:
+runtime/fp16/onebit/{adam,lamb,zoadam}.py)."""
+from .adam import OnebitAdam, OnebitAdamState, onebit_adam
+from .lamb import OnebitLamb, OnebitLambState, onebit_lamb
+from .zoadam import ZeroOneAdam, ZeroOneAdamState, zero_one_adam
+
+__all__ = ["onebit_adam", "OnebitAdam", "OnebitAdamState",
+           "onebit_lamb", "OnebitLamb", "OnebitLambState",
+           "zero_one_adam", "ZeroOneAdam", "ZeroOneAdamState"]

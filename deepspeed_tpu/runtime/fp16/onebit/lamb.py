@@ -1,0 +1,165 @@
+"""1-bit LAMB (reference: runtime/fp16/onebit/lamb.py:15 ``OnebitLamb``).
+
+Two-phase LAMB: full-precision LAMB during warmup while per-leaf trust
+("scaling") coefficients settle; after ``freeze_step`` the variance AND the
+trust coefficients freeze, and only the momentum is communicated — 1-bit
+sign-compressed with two-level error feedback (the same transport as 1-bit
+Adam).  The frozen coefficients are the reference's "lamb scaling
+coefficients" (lamb.py:67 freeze_step handling): after compression starts,
+the layer-adaptive ratio ||p||/||u|| can no longer be trusted on quantized
+momentum, so the warmup-estimated coefficient is applied instead.
+
+Like :func:`onebit_adam`, the transform degrades gracefully outside a bound
+mesh axis (``comm_axes=()``): the algorithmic phases (warmup LAMB → frozen
+variance/coefficients) still apply to the already-averaged gradients the
+fused engine path provides, while the compressed transport runs when the
+caller binds data axes (shard_map / explicit-comm path).
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ...comm.compressed import (
+    CompressionState,
+    compressed_allreduce,
+    init_compression_state,
+)
+
+
+class OnebitLambState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+    nu: Any
+    scaling: Any                 # per-leaf frozen trust coefficients
+    compression: CompressionState
+
+
+def _leaf_norm(x):
+    return jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32))))
+
+
+def onebit_lamb(learning_rate=1e-3, b1: float = 0.9, b2: float = 0.999,
+                eps: float = 1e-6, weight_decay: float = 0.0,
+                freeze_step: int = 100000, comm_axes=None,
+                coeff_beta: float = 0.9, max_coeff: float = 10.0,
+                min_coeff: float = 0.01) -> optax.GradientTransformation:
+    """``coeff_beta``: EMA factor for the warmup trust-coefficient estimate
+    (reference OnebitLamb(coeff_beta=0.9)); ``max_coeff``/``min_coeff``
+    clamp it (reference defaults)."""
+
+    def init(params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return OnebitLambState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(zeros, params),
+            nu=jax.tree.map(zeros, params),
+            scaling=jax.tree.map(lambda p: jnp.ones((), jnp.float32), params),
+            compression=init_compression_state(params))
+
+    def update(grads, state, params=None):
+        from ....comm.comm import _active_axes, _axis_size
+
+        count = state.count + 1
+        in_warmup = state.count < freeze_step
+        if comm_axes is None:
+            # default: the topology's full DP group (like onebit_adam);
+            # pass comm_axes=() explicitly for pre-averaged-grad contexts
+            from ...topology import GROUP_AXES
+
+            base_axes = GROUP_AXES["data_parallel"]
+        else:
+            base_axes = tuple(comm_axes)
+        axes = _active_axes(base_axes) if base_axes else ()
+        n = _axis_size(axes) if axes else 1
+
+        def warmup_branch(operand):
+            mu, nu, scaling, comp = operand
+            if axes:
+                g = jax.tree.map(
+                    lambda x: jax.lax.psum(x.astype(jnp.float32), axes) / n,
+                    grads)
+            else:
+                g = jax.tree.map(lambda x: x.astype(jnp.float32), grads)
+            mu2 = jax.tree.map(lambda m, x: b1 * m + (1 - b1) * x, mu, g)
+            nu2 = jax.tree.map(lambda v, x: b2 * v + (1 - b2) * jnp.square(x),
+                               nu, g)
+            return mu2, nu2, scaling, comp
+
+        def compressed_branch(operand):
+            mu, nu, scaling, comp = operand
+            mu_local = jax.tree.map(
+                lambda m, x: b1 * m + (1 - b1) * x.astype(jnp.float32),
+                mu, grads)
+            if axes:
+                flat, treedef = jax.tree_util.tree_flatten(mu_local)
+                flat_e = treedef.flatten_up_to(comp.error)
+                flat_s = treedef.flatten_up_to(comp.server_error)
+                outs = [compressed_allreduce(m, e, s, axes)
+                        for m, e, s in zip(flat, flat_e, flat_s)]
+                mu2 = treedef.unflatten([o[0] for o in outs])
+                comp2 = CompressionState(
+                    error=treedef.unflatten([o[1] for o in outs]),
+                    server_error=treedef.unflatten([o[2] for o in outs]))
+            else:
+                mu2, comp2 = mu_local, comp
+            return mu2, nu, scaling, comp2
+
+        mu, nu, scaling, comp = jax.lax.cond(
+            in_warmup, warmup_branch, compressed_branch,
+            (state.mu, state.nu, state.scaling, state.compression))
+
+        bc1 = 1 - b1 ** count.astype(jnp.float32)
+        bc2 = 1 - b2 ** count.astype(jnp.float32)
+        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
+
+        def raw_update(m, v, p):
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if weight_decay:
+                u = u + weight_decay * p.astype(jnp.float32)
+            return u
+
+        updates_raw = jax.tree.map(raw_update, mu, nu, params)
+
+        # LAMB trust ratio per leaf; during warmup it also feeds the EMA of
+        # the frozen coefficient used after freeze_step.
+        def trust(u, p, coeff):
+            pn = _leaf_norm(p)
+            un = _leaf_norm(u)
+            live = jnp.where((pn > 0) & (un > 0), pn / jnp.maximum(un, 1e-12),
+                             1.0)
+            live = jnp.clip(live, min_coeff, max_coeff)
+            new_coeff = jnp.where(in_warmup,
+                                  coeff_beta * coeff + (1 - coeff_beta) * live,
+                                  coeff)
+            ratio = jnp.where(in_warmup, live, new_coeff)
+            return ratio, new_coeff
+
+        flat_u, treedef = jax.tree_util.tree_flatten(updates_raw)
+        flat_p = treedef.flatten_up_to(params)
+        flat_c = treedef.flatten_up_to(scaling)
+        ratios_coeffs = [trust(u, p, c)
+                         for u, p, c in zip(flat_u, flat_p, flat_c)]
+        new_scaling = treedef.unflatten([rc[1] for rc in ratios_coeffs])
+        updates = treedef.unflatten(
+            [(-lr * rc[0] * u).astype(p.dtype)
+             for (u, p, rc) in zip(flat_u, flat_p, ratios_coeffs)])
+        return updates, OnebitLambState(count=count, mu=mu, nu=nu,
+                                        scaling=new_scaling, compression=comp)
+
+    return optax.GradientTransformation(init, update)
+
+
+class OnebitLamb:
+    """Class-shaped alias for API parity with the reference constructor."""
+
+    def __new__(cls, params=None, deepspeed=None, lr=1e-3, freeze_step=100000,
+                betas=(0.9, 0.999), eps=1e-6, weight_decay=0.0,
+                coeff_beta=0.9, max_coeff=10.0, min_coeff=0.01, **kw):
+        return onebit_lamb(learning_rate=lr, b1=betas[0], b2=betas[1],
+                           eps=eps, weight_decay=weight_decay,
+                           freeze_step=freeze_step, coeff_beta=coeff_beta,
+                           max_coeff=max_coeff, min_coeff=min_coeff)

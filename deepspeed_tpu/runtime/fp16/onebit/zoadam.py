@@ -1,0 +1,144 @@
+"""0/1 Adam (reference: runtime/fp16/onebit/zoadam.py:14 ``ZeroOneAdam``).
+
+0/1 Adam reduces communication FREQUENCY on top of 1-bit compression:
+
+  * variance policy: ``nu`` updates normally until ``var_freeze_step``, then
+    freezes (reference var_freeze_step / var_update_scaler policy).
+  * learning-rate/sync policy: the compressed momentum exchange runs only at
+    "sync steps"; between syncs each rank takes LOCAL momentum steps and the
+    skipped synchronization is recovered through the error-feedback buffers
+    at the next sync.  The interval between syncs doubles every
+    ``local_step_scaler`` steps, capped at ``local_step_clipper`` (reference
+    constructor knobs of the same names).
+
+Degrades gracefully without bound axes like the other 1-bit optimizers: the
+variance-freeze and interval policies still apply; the compressed transport
+activates when the caller binds data axes (shard_map / explicit-comm path).
+"""
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from ...comm.compressed import (
+    CompressionState,
+    compressed_allreduce,
+    init_compression_state,
+)
+
+
+class ZeroOneAdamState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+    nu: Any
+    compression: CompressionState
+
+
+def zero_one_adam(learning_rate=1e-3, b1: float = 0.9, b2: float = 0.999,
+                  eps: float = 1e-8, weight_decay: float = 0.0,
+                  var_freeze_step: int = 100000,
+                  local_step_scaler: int = 32768,
+                  local_step_clipper: int = 16,
+                  comm_axes=None) -> optax.GradientTransformation:
+    def init(params):
+        zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
+        return ZeroOneAdamState(
+            count=jnp.zeros((), jnp.int32),
+            mu=jax.tree.map(zeros, params),
+            nu=jax.tree.map(zeros, params),
+            compression=init_compression_state(params))
+
+    def update(grads, state, params=None):
+        from ....comm.comm import _active_axes, _axis_size
+
+        count = state.count + 1
+        if comm_axes is None:
+            # default: the topology's full DP group (like onebit_adam);
+            # pass comm_axes=() explicitly for pre-averaged-grad contexts
+            from ...topology import GROUP_AXES
+
+            base_axes = GROUP_AXES["data_parallel"]
+        else:
+            base_axes = tuple(comm_axes)
+        axes = _active_axes(base_axes) if base_axes else ()
+        n = _axis_size(axes) if axes else 1
+
+        import math
+
+        var_live = state.count < var_freeze_step
+        # sync interval: 2^(count // local_step_scaler), capped at clipper
+        cap = max(int(math.log2(max(local_step_clipper, 1))), 0)
+        exponent = jnp.minimum(state.count // local_step_scaler, cap)
+        interval = jnp.left_shift(jnp.int32(1), exponent)
+        is_sync = (count % interval) == 0
+
+        g32 = jax.tree.map(lambda x: x.astype(jnp.float32), grads)
+        mu_local = jax.tree.map(lambda m, g: b1 * m + (1 - b1) * g,
+                                state.mu, g32)
+
+        def sync_branch(operand):
+            mu_l, comp = operand
+            if not axes:
+                return mu_l, comp
+            flat, treedef = jax.tree_util.tree_flatten(mu_l)
+            flat_e = treedef.flatten_up_to(comp.error)
+            flat_s = treedef.flatten_up_to(comp.server_error)
+            outs = [compressed_allreduce(m, e, s, axes)
+                    for m, e, s in zip(flat, flat_e, flat_s)]
+            return (treedef.unflatten([o[0] for o in outs]),
+                    CompressionState(
+                        error=treedef.unflatten([o[1] for o in outs]),
+                        server_error=treedef.unflatten([o[2] for o in outs])))
+
+        def local_branch(operand):
+            mu_l, comp = operand
+            return mu_l, comp
+
+        mu, comp = jax.lax.cond(is_sync, sync_branch, local_branch,
+                                (mu_local, state.compression))
+
+        # variance: exact (allreduced) second moments while live, frozen
+        # after var_freeze_step — the psum is cond-gated so the frozen phase
+        # pays no variance communication at all.
+        def nu_live(_):
+            if axes:
+                g = jax.tree.map(lambda x: jax.lax.psum(x, axes) / n, g32)
+            else:
+                g = g32
+            return jax.tree.map(
+                lambda v, x: b2 * v + (1 - b2) * jnp.square(x), state.nu, g)
+
+        nu = jax.lax.cond(var_live, nu_live, lambda _: state.nu, None)
+
+        bc1 = 1 - b1 ** count.astype(jnp.float32)
+        bc2 = 1 - b2 ** count.astype(jnp.float32)
+        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
+
+        def upd(m, v, p):
+            u = (m / bc1) / (jnp.sqrt(v / bc2) + eps)
+            if weight_decay:
+                u = u + weight_decay * p.astype(jnp.float32)
+            return (-lr * u).astype(p.dtype)
+
+        updates = jax.tree.map(upd, mu, nu, params)
+        return updates, ZeroOneAdamState(count=count, mu=mu, nu=nu,
+                                         compression=comp)
+
+    return optax.GradientTransformation(init, update)
+
+
+class ZeroOneAdam:
+    """Class-shaped alias for API parity with the reference constructor."""
+
+    def __new__(cls, params=None, deepspeed=None, lr=1e-3,
+                var_freeze_step=100000, local_step_scaler=32768,
+                local_step_clipper=16, betas=(0.9, 0.999), eps=1e-8,
+                weight_decay=0.0, **kw):
+        return zero_one_adam(learning_rate=lr, b1=betas[0], b2=betas[1],
+                             eps=eps, weight_decay=weight_decay,
+                             var_freeze_step=var_freeze_step,
+                             local_step_scaler=local_step_scaler,
+                             local_step_clipper=local_step_clipper)

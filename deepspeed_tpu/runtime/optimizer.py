@@ -53,7 +53,39 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
     if learning_rate is not None:
         lr = learning_rate
 
-    if name in (ADAM_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER):
+    if name == ONEBIT_ADAM_OPTIMIZER:
+        # Real 1-bit Adam (fp16/onebit/adam.py): warmup Adam → frozen
+        # variance + momentum exchange.  Without bound axes (the fused
+        # engine path, where grads arrive pre-averaged) the algorithmic
+        # phases still apply; the compressed transport runs wherever data
+        # axes are bound (shard_map / explicit-comm).
+        from .fp16.onebit.adam import onebit_adam
+
+        return onebit_adam(learning_rate=lr, b1=betas[0], b2=betas[1],
+                           eps=eps, weight_decay=wd,
+                           freeze_step=params.get("freeze_step", 100000),
+                           comm_axes=params.get("comm_axes"))
+    if name == ONEBIT_LAMB_OPTIMIZER:
+        from .fp16.onebit.lamb import onebit_lamb
+
+        return onebit_lamb(learning_rate=lr, b1=betas[0], b2=betas[1],
+                           eps=eps, weight_decay=wd,
+                           freeze_step=params.get("freeze_step", 100000),
+                           coeff_beta=params.get("coeff_beta", 0.9),
+                           max_coeff=params.get("max_coeff", 10.0),
+                           min_coeff=params.get("min_coeff", 0.01),
+                           comm_axes=params.get("comm_axes"))
+    if name == ZERO_ONE_ADAM_OPTIMIZER:
+        from .fp16.onebit.zoadam import zero_one_adam
+
+        return zero_one_adam(
+            learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
+            weight_decay=wd,
+            var_freeze_step=params.get("var_freeze_step", 100000),
+            local_step_scaler=params.get("local_step_scaler", 32768),
+            local_step_clipper=params.get("local_step_clipper", 16),
+            comm_axes=params.get("comm_axes"))
+    if name == ADAM_OPTIMIZER:
         adam_w_mode = params.get("adam_w_mode", True)
         if wd and adam_w_mode:
             return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
@@ -63,7 +95,7 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
         return tx
     if name == ADAMW_OPTIMIZER:
         return optax.adamw(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
-    if name in (LAMB_OPTIMIZER, ONEBIT_LAMB_OPTIMIZER):
+    if name == LAMB_OPTIMIZER:
         return optax.lamb(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
     if name == LION_OPTIMIZER:
         b1, b2 = (betas if len(betas) == 2 else (0.9, 0.99))

@@ -156,8 +156,16 @@ class ZeroShardingPlan:
             is_leaf=lambda x: isinstance(x, PartitionSpec))
         replicated = NamedSharding(mesh, PartitionSpec())
 
+        param_leaves = jax.tree.leaves(params)
+
         def mirrors_params(node) -> bool:
-            return jax.tree_util.tree_structure(node) == param_struct
+            """Same treedef AND same leaf shapes: a scalar-leaf tree with the
+            param structure (e.g. onebit-LAMB trust coefficients) must stay
+            replicated, not inherit moment specs."""
+            if jax.tree_util.tree_structure(node) != param_struct:
+                return False
+            return all(getattr(l, "shape", None) == p.shape
+                       for l, p in zip(jax.tree.leaves(node), param_leaves))
 
         def assign(node):
             if mirrors_params(node):

@@ -67,7 +67,13 @@ def run_distributed(test_file: str, fn_name: str, world_size: int = 2,
             out, _ = p.communicate(timeout=timeout)
         except subprocess.TimeoutExpired:
             p.terminate()
-            out, _ = p.communicate()
+            try:
+                out, _ = p.communicate(timeout=15)
+            except subprocess.TimeoutExpired:
+                # CPU-backend children only — kill() is safe here (a TPU
+                # client would need PID-targeted SIGTERM discipline)
+                p.kill()
+                out, _ = p.communicate()
             failed.append((rank, "timeout", out))
             continue
         outs.append(out)

@@ -1,0 +1,60 @@
+"""FP8/FP6 quantizer (reference: csrc/fp_quantizer/fp_quantize.cu +
+tests/unit/ops/fp_quantizer)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.ops.fp_quantizer import FP_Quantize, fp_dequantize, fp_quantize
+
+
+class TestFPQuantize:
+    @pytest.mark.parametrize("fmt,rel_tol", [("e4m3", 0.07), ("e5m2", 0.3),
+                                             ("fp6", 0.2)])
+    def test_roundtrip_error_bounded(self, fmt, rel_tol):
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(1000,)) * 3.0, jnp.float32)
+        q, s = fp_quantize(x, fmt=fmt, group_size=128)
+        y = fp_dequantize(q, s, shape=x.shape)
+        rel = float(jnp.max(jnp.abs(y - x)) / jnp.max(jnp.abs(x)))
+        assert rel < rel_tol, (fmt, rel)
+
+    def test_e4m3_storage_is_real_fp8(self):
+        x = jnp.ones((256,))
+        q, _ = fp_quantize(x, fmt="e4m3")
+        assert q.dtype == jnp.float8_e4m3fn
+        q2, _ = fp_quantize(x, fmt="e5m2")
+        assert q2.dtype == jnp.float8_e5m2
+
+    def test_group_scaling_uses_local_range(self):
+        """A huge group must not destroy a tiny group's resolution."""
+        x = jnp.concatenate([jnp.full((128,), 1e-3), jnp.full((128,), 1e3)])
+        q, s = fp_quantize(x, fmt="e4m3", group_size=128)
+        y = fp_dequantize(q, s, shape=x.shape)
+        np.testing.assert_allclose(np.asarray(y[:128]), 1e-3, rtol=0.05)
+        np.testing.assert_allclose(np.asarray(y[128:]), 1e3, rtol=0.05)
+
+    def test_fp6_values_on_e3m2_grid(self):
+        x = jnp.asarray(np.linspace(-5, 5, 333), jnp.float32)
+        q, s = fp_quantize(x, fmt="fp6", group_size=128)
+        vals = np.unique(np.abs(np.asarray(q, np.float64)))
+        vals = vals[vals > 0]
+        # e3m2: at most 4 mantissa steps per octave over 7 octaves + zero
+        assert len(vals) <= 7 * 4 + 4, len(vals)
+
+    def test_class_api_roundtrip(self):
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+        fpq = FP_Quantize(group_size=64)
+        q, s = fpq.quantize(x, q_bits=8)
+        y = fpq.dequantize(q, s)
+        assert y.shape == x.shape
+        assert float(jnp.max(jnp.abs(y - x))) < 0.5
+
+    def test_padding_tail_group(self):
+        x = jnp.arange(300, dtype=jnp.float32)  # not a multiple of 128
+        q, s = fp_quantize(x, fmt="e4m3", group_size=128)
+        y = fp_dequantize(q, s, shape=x.shape)
+        assert y.shape == (300,)
+        rel = np.abs(np.asarray(y) - np.arange(300)) / np.maximum(np.arange(300), 1)
+        assert rel.max() < 0.07
