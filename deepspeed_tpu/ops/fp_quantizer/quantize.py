@@ -72,7 +72,11 @@ def fp_dequantize(q: jnp.ndarray, scales: jnp.ndarray, shape=None,
 
 class FP_Quantize:
     """API-parity wrapper (reference deepspeed/ops/fp_quantizer/quantize.py
-    ``FP_Quantize``: quantize(..., q_bits) / dequantize)."""
+    ``FP_Quantize``: quantize(..., q_bits) / dequantize).
+
+    ``return_meta_tensor`` is accepted for signature parity but both paths
+    return the same (values, scales) pair — scales ARE the meta tensor here
+    (no byte-flattening needed on TPU)."""
 
     def __init__(self, group_size: int = 512):
         self.group_size = group_size
@@ -80,14 +84,17 @@ class FP_Quantize:
 
     def quantize(self, x, q_bits: int = 8, stochastic_mode: bool = False,
                  return_meta_tensor: bool = False):
-        fmt = {8: "e4m3", 6: "fp6", 12: "e5m2"}.get(q_bits)
+        fmt = {8: "e4m3", 6: "fp6"}.get(q_bits)
         if fmt is None:
-            raise ValueError(f"unsupported q_bits {q_bits}; use 6, 8, or 12")
+            raise NotImplementedError(
+                f"q_bits={q_bits} not supported (6=fp6/e3m2, 8=fp8/e4m3); "
+                f"the reference's 12-bit path has no TPU dtype yet")
         self.orig_shape = x.shape
-        q, s = fp_quantize(x, fmt=fmt, group_size=self.group_size)
-        if return_meta_tensor:
-            return q, s
-        return q, s
+        return fp_quantize(x, fmt=fmt, group_size=self.group_size)
 
-    def dequantize(self, q, scale=None, q_bits: int = 8, fp_out=None):
-        return fp_dequantize(q, scale, shape=self.orig_shape)
+    def dequantize(self, q, scale=None, q_bits: int = 8, fp_out=None,
+                   shape=None):
+        if scale is None:
+            raise ValueError("dequantize needs the scales returned by "
+                             "quantize (per-group f32 tensor)")
+        return fp_dequantize(q, scale, shape=shape or self.orig_shape)

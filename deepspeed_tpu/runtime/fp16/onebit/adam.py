@@ -133,6 +133,8 @@ class OnebitAdam:
     """Class-shaped alias for API parity with the reference constructor."""
 
     def __new__(cls, params=None, deepspeed=None, lr=1e-3, freeze_step=100000,
-                betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0, **kw):
+                betas=(0.9, 0.999), eps=1e-8, weight_decay=0.0,
+                comm_axes=None, **kw):
         return onebit_adam(learning_rate=lr, b1=betas[0], b2=betas[1], eps=eps,
-                           weight_decay=weight_decay, freeze_step=freeze_step)
+                           weight_decay=weight_decay, freeze_step=freeze_step,
+                           comm_axes=comm_axes)

@@ -158,8 +158,10 @@ class OnebitLamb:
 
     def __new__(cls, params=None, deepspeed=None, lr=1e-3, freeze_step=100000,
                 betas=(0.9, 0.999), eps=1e-6, weight_decay=0.0,
-                coeff_beta=0.9, max_coeff=10.0, min_coeff=0.01, **kw):
+                coeff_beta=0.9, max_coeff=10.0, min_coeff=0.01,
+                comm_axes=None, **kw):
         return onebit_lamb(learning_rate=lr, b1=betas[0], b2=betas[1],
                            eps=eps, weight_decay=weight_decay,
                            freeze_step=freeze_step, coeff_beta=coeff_beta,
-                           max_coeff=max_coeff, min_coeff=min_coeff)
+                           max_coeff=max_coeff, min_coeff=min_coeff,
+                           comm_axes=comm_axes)

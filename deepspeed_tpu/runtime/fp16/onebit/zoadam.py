@@ -136,9 +136,10 @@ class ZeroOneAdam:
     def __new__(cls, params=None, deepspeed=None, lr=1e-3,
                 var_freeze_step=100000, local_step_scaler=32768,
                 local_step_clipper=16, betas=(0.9, 0.999), eps=1e-8,
-                weight_decay=0.0, **kw):
+                weight_decay=0.0, comm_axes=None, **kw):
         return zero_one_adam(learning_rate=lr, b1=betas[0], b2=betas[1],
                              eps=eps, weight_decay=weight_decay,
                              var_freeze_step=var_freeze_step,
                              local_step_scaler=local_step_scaler,
-                             local_step_clipper=local_step_clipper)
+                             local_step_clipper=local_step_clipper,
+                             comm_axes=comm_axes)

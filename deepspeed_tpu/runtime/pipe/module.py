@@ -104,9 +104,12 @@ class PipelineModule:
                 params[f"layer_{i}"] = spec.init_fn(k)
         return params
 
-    def apply_sequential(self, params: Dict, x, rng: Optional[jax.Array] = None):
-        """Reference PipelineModule.forward (:340) — single-stage execution."""
-        for i, spec in enumerate(self.specs):
+    def apply_range(self, params: Dict, lo: int, hi: int, x,
+                    rng: Optional[jax.Array] = None):
+        """Apply layers [lo, hi) — shared by sequential execution and the
+        per-stage bodies of the pp>1 lax.switch executor."""
+        for i in range(lo, hi):
+            spec = self.specs[i]
             p = params[f"tied_{spec.key}"] if isinstance(spec, TiedLayerSpec) \
                 else params[f"layer_{i}"]
             fn = spec.apply_fn
@@ -115,6 +118,10 @@ class PipelineModule:
                 fn = jax.checkpoint(fn)
             x = fn(p, x, rng=rng)
         return x
+
+    def apply_sequential(self, params: Dict, x, rng: Optional[jax.Array] = None):
+        """Reference PipelineModule.forward (:340) — single-stage execution."""
+        return self.apply_range(params, 0, len(self.specs), x, rng=rng)
 
 
 # --------------------------------------------------------------------- #
@@ -159,4 +166,6 @@ class PipelinedCausalLM:
     def loss_fn(self, params, batch, rng):
         from .engine import pipeline_lm_loss
 
-        return pipeline_lm_loss(params, batch, self.config, self.topology, rng)
+        # num_micro=1: outside PipelineEngine there is no microbatch loop
+        return pipeline_lm_loss(params, batch, self.config, self.topology,
+                                rng, num_micro=1)
