@@ -97,4 +97,8 @@ class FP_Quantize:
         if scale is None:
             raise ValueError("dequantize needs the scales returned by "
                              "quantize (per-group f32 tensor)")
-        return fp_dequantize(q, scale, shape=shape or self.orig_shape)
+        out_shape = shape if shape is not None else self.orig_shape
+        if out_shape is None:
+            raise ValueError("pass shape= (no prior quantize call recorded "
+                             "the original shape on this instance)")
+        return fp_dequantize(q, scale, shape=out_shape)

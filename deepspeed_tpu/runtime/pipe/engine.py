@@ -106,9 +106,11 @@ def pipeline_lm_loss(params: Dict, batch: Any, cfg, topo, rng,
             k = (h @ lp["k_proj"]["kernel"]).reshape(mb, S_loc, KV_loc, cfg.head_dim)
             v = (h @ lp["v_proj"]["kernel"]).reshape(mb, S_loc, KV_loc, cfg.head_dim)
             q, k = apply_rope(q, cos, sin), apply_rope(k, cos, sin)
-            if sp > 1 and KV_loc != H_loc:
-                # Ulysses splits the head dim across seq ranks: expand GQA
-                # kv heads first so both sides split evenly
+            if sp > 1 and KV_loc != H_loc and KV_loc % sp != 0:
+                # GQA kv heads don't split over the seq ranks: expand before
+                # the all-to-all (pays H/KV× payload — only when unavoidable;
+                # when KV_loc % sp == 0 the kv heads ride the wire as-is and
+                # _xla_attention repeats them after the gather)
                 k = jnp.repeat(k, H_loc // KV_loc, axis=2)
                 v = jnp.repeat(v, H_loc // KV_loc, axis=2)
             o = attend(q, k, v)
@@ -251,9 +253,13 @@ def pipeline_module_loss(module, params: Dict, batch: Any, rng,
             l_out = jax.lax.dynamic_index_in_dim(lmb, out_idx, 0, keepdims=False) \
                 if lmb is not None else None
             is_emit = jnp.logical_and(stage == pp - 1, t >= pp - 1)
-            mb_loss = jax.lax.cond(
-                is_emit, lambda: module.loss_fn(h, l_out).astype(jnp.float32),
-                lambda: jnp.zeros((), jnp.float32))
+            # loss_fn runs UNCONDITIONALLY on every stage and is masked after:
+            # user code may contain collectives, which must execute uniformly
+            # (a stage-gated cond would hang them — same hazard the lm path's
+            # label ppermute avoids by hoisting).
+            mb_loss = jnp.where(is_emit,
+                                module.loss_fn(h, l_out).astype(jnp.float32),
+                                0.0)
             return (jax.lax.ppermute(h, PIPE, perm), loss_acc + mb_loss), None
 
         buf0 = jnp.zeros(bound.shape, bound.dtype)
@@ -269,14 +275,13 @@ def pipeline_module_loss(module, params: Dict, batch: Any, rng,
 
     spec_tree = jax.tree.map(lambda _: P(), params)
     data_spec = P(batch_axes)
-    in_specs = (spec_tree, data_spec, data_spec)
-    args = (params, x, labels if labels is not None else x)
     if labels is None:
-        def body2(p, xx, _):
-            return body(p, xx, None)
-        return jax.shard_map(body2, mesh=mesh, in_specs=in_specs,
-                             out_specs=P(), check_vma=False)(*args)
-    return jax.shard_map(body, mesh=mesh, in_specs=in_specs,
+        fn = lambda p, xx: body(p, xx, None)
+        in_specs, args = (spec_tree, data_spec), (params, x)
+    else:
+        fn, in_specs, args = body, (spec_tree, data_spec, data_spec), \
+            (params, x, labels)
+    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
                          out_specs=P(), check_vma=False)(*args)
 
 

@@ -48,12 +48,20 @@ def _merge(acc, m_acc, l_acc, out, m, l):
 
 def chunked_attention(q, k, v, chunk_size: int, causal: bool = True,
                       scale: Optional[float] = None,
-                      offload: bool = False) -> jnp.ndarray:
+                      offload: bool = False,
+                      remat: bool = True) -> jnp.ndarray:
     """Attention over [B, S, H, hd] computed q-chunk × kv-chunk with O(S·c)
     peak score memory instead of O(S²).
 
     ``offload=True`` parks the K/V history in host memory and streams chunks
     back per step (Ulysses-Offload's double-buffered host KV).
+
+    ``remat=True`` (default) checkpoints each kv-step so the BACKWARD pass
+    refetches chunks instead of keeping autodiff residuals of every fetched
+    K/V chunk alive — without it, reverse-mode through the scan would
+    re-materialize the entire KV history in device memory, defeating the
+    offload (reference fpdt_layer.py:510 streams chunks in backward too;
+    verified by the peak-memory test in tests/unit/test_fpdt_memory.py).
     """
     B, S, H, hd = q.shape
     if scale is None:
@@ -86,10 +94,16 @@ def chunked_attention(q, k, v, chunk_size: int, causal: bool = True,
 
         def kv_step(carry, ki_idx):
             acc, m_acc, l_acc = carry
-            # dynamic_index of a pinned_host-resident array lowers to a host→
-            # device DMA of exactly one chunk — the double-buffered fetch.
+            # dynamic_index of a pinned_host-resident array + explicit
+            # Space.Device transfer = a host→device DMA of exactly one chunk
+            # (the double-buffered fetch); compute ops must see device memory.
             k_t = jax.lax.dynamic_index_in_dim(kc, ki_idx, 0, keepdims=False)
             v_t = jax.lax.dynamic_index_in_dim(vc, ki_idx, 0, keepdims=False)
+            if offload:
+                from jax.memory import Space
+
+                k_t = jax.device_put(k_t, Space.Device)
+                v_t = jax.device_put(v_t, Space.Device)
             if causal:
                 mask = jnp.where(ki_idx < qi_idx,
                                  jnp.ones_like(diag_mask),
@@ -101,8 +115,9 @@ def chunked_attention(q, k, v, chunk_size: int, causal: bool = True,
             acc, m_acc, l_acc = _merge(acc, m_acc, l_acc, out, m, l)
             return (acc, m_acc, l_acc), None
 
+        body = jax.checkpoint(kv_step) if remat else kv_step
         (acc, m_acc, l_acc), _ = jax.lax.scan(
-            kv_step, (acc, m_acc, l_acc), jnp.arange(n))
+            body, (acc, m_acc, l_acc), jnp.arange(n))
         return (acc / jnp.maximum(l_acc, 1e-30)[..., None]).astype(q.dtype)
 
     outs = jax.lax.map(lambda args: q_chunk_body(*args),

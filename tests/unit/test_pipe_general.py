@@ -7,7 +7,7 @@ import numpy as np
 import pytest
 
 import deepspeed_tpu
-from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+from deepspeed_tpu.models.transformer import TransformerConfig
 from deepspeed_tpu.runtime.pipe import PipelinedCausalLM
 from deepspeed_tpu.runtime.pipe.engine import (
     pipeline_lm_loss,
@@ -56,11 +56,6 @@ def _hetero_module(topo, num_stages):
         _conv_like_spec(d, 8),           # stage-2 material differs again
         _mlp_spec(d, 4, 0.3, act=False), # head — output shape must match
     ]
-    # boundary shapes: all middle activations are [mb, 16]; wrap first/last
-    # so boundaries stay uniform
-    class Wrap(PipelineModule):
-        pass
-
     # first layer maps 8->16; to keep the ppermute boundary uniform ALL
     # stages must emit [mb, 16]; keep the head inside loss instead
     head = specs.pop()
