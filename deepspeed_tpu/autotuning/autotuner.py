@@ -108,6 +108,15 @@ class Autotuner:
             logger.warning(f"experiment {exp.name} failed: {exp.error[:120]}")
         return exp
 
+    def run_experiment_patch(self, config_patch: Dict[str, Any]) -> float:
+        """Scheduler-facing single-trial entry: run one config patch and
+        return its metric (raises on failure so the scheduler records it)."""
+        exp = Experiment(name="trial", config_patch=config_patch)
+        self.run_experiment(exp)
+        if exp.error is not None:
+            raise RuntimeError(exp.error)
+        return exp.metric_value
+
     def tune(self, **gen_kwargs) -> Optional[Experiment]:
         exps = self.generate_experiments(**gen_kwargs)
         best: Optional[Experiment] = None

@@ -1,0 +1,6 @@
+"""Autotuning CLI entry (reference: ``deepspeed --autotuning run``):
+``python -m deepspeed_tpu.autotuning.cli --module my_factories``."""
+from .scheduler import main
+
+if __name__ == "__main__":
+    main()

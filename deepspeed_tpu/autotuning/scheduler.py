@@ -1,0 +1,129 @@
+"""Autotuning experiment scheduler with persistence (reference:
+autotuning/scheduler.py ``ResourceManager`` + autotuner.py:304 experiment
+dirs — each trial gets a directory with its config and recorded metrics,
+so interrupted searches resume and results survive for inspection).
+"""
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from ..utils.logging import logger
+from .autotuner import Experiment
+
+
+class ExperimentScheduler:
+    """Runs experiments through a callable and persists per-trial results.
+
+    ``run_fn(config_patch) -> float`` returns the metric (higher better) or
+    raises.  Completed trials found on disk are skipped (resume)."""
+
+    def __init__(self, results_dir: str = "autotuning_results",
+                 cache_errors: bool = False):
+        self.results_dir = results_dir
+        #: False (default): failed trials RE-RUN on resume — errors here are
+        #: often transient (busy TPU runtime); only successful metrics cache.
+        self.cache_errors = cache_errors
+        os.makedirs(results_dir, exist_ok=True)
+
+    def _trial_dir(self, exp: Experiment) -> str:
+        return os.path.join(self.results_dir, exp.name)
+
+    def _load_cached(self, exp: Experiment) -> bool:
+        path = os.path.join(self._trial_dir(exp), "metrics.json")
+        if not os.path.exists(path):
+            return False
+        with open(path) as f:
+            rec = json.load(f)
+        if rec.get("metric_value") is None and not self.cache_errors:
+            return False
+        exp.metric_value = rec.get("metric_value")
+        exp.error = rec.get("error")
+        return True
+
+    def run(self, experiments: List[Experiment],
+            run_fn: Callable[[Dict[str, Any]], float]) -> List[Experiment]:
+        for exp in experiments:
+            if self._load_cached(exp):
+                logger.info(f"autotuning: {exp.name} cached "
+                            f"(metric={exp.metric_value})")
+                continue
+            trial = self._trial_dir(exp)
+            os.makedirs(trial, exist_ok=True)
+            with open(os.path.join(trial, "config.json"), "w") as f:
+                json.dump(exp.config_patch, f, indent=2)
+            t0 = time.perf_counter()
+            try:
+                exp.metric_value = float(run_fn(exp.config_patch))
+            except Exception as e:  # noqa: BLE001
+                exp.error = f"{type(e).__name__}: {e}"
+                logger.warning(f"autotuning: {exp.name} failed: {exp.error}")
+            with open(os.path.join(trial, "metrics.json"), "w") as f:
+                json.dump({"metric_value": exp.metric_value,
+                           "error": exp.error,
+                           "wall_s": round(time.perf_counter() - t0, 3)}, f)
+        self._write_summary(experiments)
+        return experiments
+
+    def _write_summary(self, experiments: List[Experiment]) -> None:
+        ranked = sorted((e for e in experiments if e.metric_value is not None),
+                        key=lambda e: -e.metric_value)
+        summary = {
+            "best": ranked[0].name if ranked else None,
+            "best_metric": ranked[0].metric_value if ranked else None,
+            "best_config": ranked[0].config_patch if ranked else None,
+            "trials": [{"name": e.name, "metric": e.metric_value,
+                        "error": e.error} for e in experiments],
+        }
+        with open(os.path.join(self.results_dir, "summary.json"), "w") as f:
+            json.dump(summary, f, indent=2)
+
+    def best(self) -> Optional[Dict[str, Any]]:
+        path = os.path.join(self.results_dir, "summary.json")
+        if not os.path.exists(path):
+            return None
+        with open(path) as f:
+            return json.load(f)
+
+
+def main(argv=None):
+    """CLI (reference: ``deepspeed --autotuning run``): searches the config
+    space for a user factory module.
+
+        python -m deepspeed_tpu.autotuning.cli --module my_factories \\
+            --results-dir autotuning_results [--max-trials N]
+
+    The module must expose ``model_factory()``, ``params_factory()``,
+    ``batch_factory(batch_size)`` and optionally ``base_config`` (dict).
+    """
+    import argparse
+    import importlib
+
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--module", required=True)
+    parser.add_argument("--results-dir", default="autotuning_results")
+    parser.add_argument("--max-trials", type=int, default=24)
+    parser.add_argument("--steps", type=int, default=3)
+    args = parser.parse_args(argv)
+
+    mod = importlib.import_module(args.module)
+    from .autotuner import Autotuner
+
+    tuner = Autotuner(
+        model_factory=mod.model_factory, params_factory=mod.params_factory,
+        base_config=getattr(mod, "base_config", {
+            "train_micro_batch_size_per_gpu": 1,
+            "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}}}),
+        batch_factory=mod.batch_factory, num_steps=args.steps,
+        max_trials=args.max_trials)
+    exps = tuner.generate_experiments()
+    sched = ExperimentScheduler(args.results_dir)
+    sched.run(exps, tuner.run_experiment_patch)
+    best = sched.best()
+    print(json.dumps(best, indent=2))
+
+
+if __name__ == "__main__":
+    main()

@@ -32,6 +32,8 @@ class LeafCompression:
     row_ratio: Optional[float] = None             # structured row pruning
     head_ratio: Optional[float] = None
     num_heads: Optional[int] = None
+    channel_ratio: Optional[float] = None         # output-channel pruning
+    act_bits: Optional[int] = None                # activation quantization
 
 
 CompressionSpec = Dict[str, LeafCompression]
@@ -93,7 +95,94 @@ def init_compression(params: Any, compression_config: Dict[str, Any],
                     lc = spec.setdefault(path, LeafCompression())
                     lc.head_ratio = float(ratio)
                     lc.num_heads = hp_shared.get("num_heads")
+
+    cp, cp_shared, cp_groups = section("channel_pruning")
+    if cp_shared.get("enabled", False):
+        for gname, g in cp_groups.items():
+            ratio = g.get("params", {}).get("dense_ratio", 0.5)
+            for path in flat:
+                if _match(g.get("modules", ["*"]), path):
+                    spec.setdefault(path, LeafCompression()).channel_ratio = \
+                        float(ratio)
+
+    aq, aq_shared, aq_groups = section("activation_quantization")
+    if aq_shared.get("enabled", False):
+        for gname, g in aq_groups.items():
+            bits = g.get("params", {}).get("bits", 8)
+            for path in flat:
+                if _match(g.get("modules", ["*"]), path):
+                    spec.setdefault(path, LeafCompression()).act_bits = int(bits)
+
+    lr_cfg = compression_config.get("layer_reduction", {})
+    if lr_cfg.get("enabled", False):
+        params = apply_layer_reduction(params, lr_cfg)
     return params, spec
+
+
+def apply_layer_reduction(params: Any, lr_cfg: Dict[str, Any]) -> Any:
+    """Layer reduction / distillation init (reference compress.py
+    student_initialization): slice stacked [L, ...] layer arrays down to
+    ``teacher_layer`` indices (or the first ``keep_number`` layers)."""
+    import numpy as np
+
+    keep = lr_cfg.get("teacher_layer")
+    if keep is None:
+        keep = list(range(int(lr_cfg.get("keep_number", 1))))
+    keep = np.asarray(keep, np.int32)
+
+    def maybe_slice(path, w):
+        if not hasattr(w, "ndim") or w.ndim < 1:
+            return w
+        if "layers" in path and w.shape[0] > keep.max():
+            return w[keep]
+        return w
+
+    return _map_with_paths(params, maybe_slice)
+
+
+def head_mask(w: jnp.ndarray, dense_ratio: float, num_heads: int) -> jnp.ndarray:
+    """Keep top heads by L2 norm.  ``w`` [..., D, H*hd] (column-parallel qkv
+    layout; leading dims = stacked layers/experts get INDEPENDENT masks):
+    mask whole head blocks of the output dim."""
+    hd = w.shape[-1] // num_heads
+    per_head = w.reshape(w.shape[:-1] + (num_heads, hd))      # [..., D, H, hd]
+    norms = jnp.sqrt(jnp.sum(jnp.square(per_head), axis=(-3, -1)))  # [..., H]
+    k = max(int(num_heads * dense_ratio), 1)
+    thresh = jnp.sort(norms, axis=-1)[..., -k][..., None]
+    mask = (norms >= thresh).astype(w.dtype)                  # [..., H]
+    mask = jnp.repeat(mask, hd, axis=-1)                      # [..., H*hd]
+    return mask[..., None, :]                                 # broadcast over D
+
+
+def channel_mask(w: jnp.ndarray, dense_ratio: float) -> jnp.ndarray:
+    """Keep top output channels (last dim) by L1 norm, ranked PER leading
+    slice (each stacked layer/expert keeps its own strongest channels)."""
+    norms = jnp.sum(jnp.abs(w), axis=-2)                      # [..., C]
+    k = max(int(w.shape[-1] * dense_ratio), 1)
+    thresh = jnp.sort(norms, axis=-1)[..., -k][..., None]
+    mask = (norms >= thresh).astype(w.dtype)
+    return mask[..., None, :]
+
+
+def quantize_activation(x: jnp.ndarray, bits: int = 8) -> jnp.ndarray:
+    """Activation fake-quant with STE (reference activation_quantization):
+    call inside the model on the activations feeding a compressed layer."""
+    return fake_quantize(x, bits, groups=1)
+
+
+def activation_quantizer(spec: CompressionSpec, path: str):
+    """The config-driven consumer of ``act_bits``: returns a function the
+    model applies to the activation feeding the layer at ``path`` (identity
+    when activation quantization isn't configured for it).
+
+        aq = activation_quantizer(spec, "layers.fc1.kernel")
+        h = aq(h); y = h @ w
+    """
+    lc = spec.get(path)
+    if lc is None or lc.act_bits is None:
+        return lambda x: x
+    bits = lc.act_bits
+    return lambda x: quantize_activation(x, bits)
 
 
 def fake_quantize(w: jnp.ndarray, bits: int, groups: int = 1) -> jnp.ndarray:
@@ -135,6 +224,11 @@ def apply_compression(params: Any, spec: CompressionSpec) -> Any:
             w = w * jax.lax.stop_gradient(magnitude_mask(w, lc.sparse_ratio))
         if lc.row_ratio is not None and w.ndim >= 1:
             w = w * jax.lax.stop_gradient(row_mask(w, lc.row_ratio))
+        if lc.head_ratio is not None and lc.num_heads and w.ndim >= 2:
+            w = w * jax.lax.stop_gradient(
+                head_mask(w, lc.head_ratio, lc.num_heads))
+        if lc.channel_ratio is not None and w.ndim >= 2:
+            w = w * jax.lax.stop_gradient(channel_mask(w, lc.channel_ratio))
         if lc.quantize_bits is not None:
             w = fake_quantize(w, lc.quantize_bits, lc.quantize_groups)
         return w

@@ -1,0 +1,54 @@
+"""Compression scheduler (reference: compression/scheduler.py —
+``compression_scheduler.step()`` gates each method on its
+``schedule_offset`` so e.g. pruning only kicks in after N warmup steps).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict
+
+from .compress import CompressionSpec, LeafCompression
+
+#: spec field → config section whose shared_parameters carry the offset
+_METHOD_SECTIONS = {
+    "quantize_bits": "weight_quantization",
+    "sparse_ratio": "sparse_pruning",
+    "row_ratio": "row_pruning",
+    "head_ratio": "head_pruning",
+    "channel_ratio": "channel_pruning",
+    "act_bits": "activation_quantization",
+}
+
+
+class CompressionScheduler:
+    """Step-gates a :data:`CompressionSpec` by per-method schedule offsets."""
+
+    def __init__(self, spec: CompressionSpec,
+                 compression_config: Dict[str, Any]):
+        self.spec = spec
+        self.offsets = {
+            field: int(compression_config.get(section, {})
+                       .get("shared_parameters", {})
+                       .get("schedule_offset", 0))
+            for field, section in _METHOD_SECTIONS.items()
+        }
+        self.current_step = 0
+
+    def step(self, n: int = 1) -> None:
+        self.current_step += n
+
+    def spec_at(self, step: int = None) -> CompressionSpec:
+        """The spec with methods whose offset hasn't been reached disabled.
+
+        Pass the result to :func:`apply_compression` inside the loss fn;
+        re-derive per grad-accumulation boundary (cheap — host-side dict)."""
+        step = self.current_step if step is None else step
+        out: CompressionSpec = {}
+        for path, lc in self.spec.items():
+            gated = dataclasses.replace(lc)
+            for field, offset in self.offsets.items():
+                if step < offset:
+                    setattr(gated, field, None)
+            if any(getattr(gated, f) is not None for f in _METHOD_SECTIONS):
+                out[path] = gated
+        return out

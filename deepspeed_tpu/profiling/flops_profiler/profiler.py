@@ -100,9 +100,19 @@ class FlopsProfiler:
 
     def print_model_profile(self, profile_step=1, module_depth=-1, top_modules=1,
                             detailed=True, output_file=None):
-        msg = (f"flops profiler: params={_fmt(self.params, '')} "
-               f"flops/step={_fmt(self.flops, 'FLOPS')} "
-               f"latency={self.latency:.3f}s")
+        lines = [(f"flops profiler: params={_fmt(self.params, '')} "
+                  f"flops/step={_fmt(self.flops, 'FLOPS')} "
+                  f"latency={self.latency:.3f}s")]
+        if detailed and self.ds_engine is not None:
+            model = getattr(self.ds_engine, "module", None)
+            cfg = getattr(model, "config", None)
+            if cfg is not None and hasattr(cfg, "num_layers"):
+                try:
+                    tree = model_profile_tree(cfg, self.flops)
+                    lines += format_profile_tree(tree)
+                except Exception as e:  # noqa: BLE001
+                    logger.debug(f"per-module tree unavailable: {e}")
+        msg = "\n".join(lines)
         if output_file:
             with open(output_file, "a") as f:
                 f.write(msg + "\n")
@@ -111,6 +121,78 @@ class FlopsProfiler:
 
     def end_profile(self):
         self.stop_profile()
+
+
+def model_profile_tree(cfg, measured_total: float = 0.0,
+                       seq_len: int = None) -> Dict[str, Any]:
+    """Per-module flops/params breakdown for a TransformerConfig-style model
+    (reference: print_model_profile's module tree, profiler.py:286).
+
+    XLA fuses the whole program, so sub-module costs come from the standard
+    analytic formulas; ``measured_total`` (XLA cost analysis of the compiled
+    step) anchors the absolute scale — the tree reports each module's params
+    and share of the analytic forward flops.
+    """
+    D, F, L, V = (cfg.hidden_size, cfg.intermediate_size, cfg.num_layers,
+                  cfg.vocab_size)
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    S = seq_len or cfg.max_seq_len
+    E = getattr(cfg, "num_experts", 1)
+    k = getattr(cfg, "moe_top_k", 2) if E > 1 else 1
+
+    qkv_p = D * (H + 2 * KV) * hd
+    o_p = H * hd * D
+    attn_mm = 2 * 2 * S * H * hd            # QK^T + PV per token
+    mlp_p = 3 * D * F * (E if E > 1 else 1)
+    mlp_active = 3 * D * F * k              # routed experts actually used
+    per_layer = {
+        "attention": {
+            "params": qkv_p + o_p,
+            "flops": 2 * (qkv_p + o_p) + attn_mm,
+        },
+        "mlp" + (f" (moe x{E}, top-{k})" if E > 1 else ""): {
+            "params": mlp_p,
+            "flops": 2 * mlp_active,
+        },
+        "norms": {"params": 2 * D, "flops": 8 * D},
+    }
+    layer_flops = sum(m["flops"] for m in per_layer.values())
+    tree = {
+        "embed": {"params": V * D, "flops": 0},
+        f"layers (x{L})": {
+            "params": L * sum(m["params"] for m in per_layer.values()),
+            "flops": L * layer_flops,
+            "children": per_layer,
+        },
+        "lm_head": {"params": 0 if cfg.tie_embeddings else V * D,
+                    "flops": 2 * V * D},
+    }
+    total_flops = sum(m["flops"] for m in tree.values())
+    for m in tree.values():
+        m["pct"] = 100.0 * m["flops"] / max(total_flops, 1)
+        for c in m.get("children", {}).values():
+            c["pct"] = 100.0 * c["flops"] / max(layer_flops, 1)
+    tree["_total"] = {"analytic_fwd_flops_per_token": total_flops,
+                      "measured_step_flops": measured_total}
+    return tree
+
+
+def format_profile_tree(tree: Dict[str, Any], indent: int = 2) -> list:
+    lines = []
+    for name, node in tree.items():
+        if name == "_total":
+            lines.append(f"analytic fwd flops/token: "
+                         f"{_fmt(node['analytic_fwd_flops_per_token'], '')}")
+            continue
+        lines.append(" " * indent +
+                     f"{name}: params={_fmt(node['params'], '')} "
+                     f"flops/token={_fmt(node['flops'], '')} "
+                     f"({node.get('pct', 0):.1f}%)")
+        for cname, c in node.get("children", {}).items():
+            lines.append(" " * indent * 2 +
+                         f"{cname}: params={_fmt(c['params'], '')} "
+                         f"({c.get('pct', 0):.1f}% of layer)")
+    return lines
 
 
 def get_model_profile(model_fn: Callable, args=(), kwargs=None, print_profile=True,

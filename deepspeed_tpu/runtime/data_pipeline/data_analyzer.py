@@ -1,0 +1,156 @@
+"""Offline dataset analysis for curriculum learning (reference:
+runtime/data_pipeline/data_sampling/data_analyzer.py:22 ``DataAnalyzer`` +
+:455 ``DistributedDataAnalyzer``).
+
+Map-reduce over the dataset: each worker computes per-sample difficulty
+metrics for its shard (``run_map``), then ``run_reduce`` merges worker files
+into (a) ``sample_to_metric`` — metric value per sample index — and (b)
+``metric_to_sample`` buckets the curriculum sampler consumes.  Pure
+host/numpy logic (the reference's is torch-CPU); workers parallelize with
+``DistributedDataAnalyzer`` via multiprocessing.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ...utils.logging import logger
+
+
+def metric_seqlen(sample) -> float:
+    """Built-in metric: sequence length (reference seqlen metric)."""
+    ids = sample["input_ids"] if isinstance(sample, dict) else sample
+    arr = np.asarray(ids)
+    return float(arr.shape[-1] if arr.ndim else 1)
+
+
+def metric_vocab_rarity(vocab_freq: np.ndarray) -> Callable:
+    """Built-in metric factory: mean -log frequency of the sample's tokens
+    (reference vocabularyrarity)."""
+    logp = -np.log(np.maximum(vocab_freq / max(vocab_freq.sum(), 1), 1e-12))
+
+    def fn(sample) -> float:
+        ids = np.asarray(sample["input_ids"] if isinstance(sample, dict)
+                         else sample).reshape(-1)
+        return float(np.mean(logp[ids]))
+
+    return fn
+
+
+class DataAnalyzer:
+    def __init__(self, dataset: Sequence, save_path: str,
+                 metric_names: List[str],
+                 metric_functions: List[Callable[[Any], float]],
+                 num_workers: int = 1, worker_id: int = 0,
+                 num_buckets: int = 10):
+        assert len(metric_names) == len(metric_functions)
+        self.dataset = dataset
+        self.save_path = save_path
+        self.metric_names = list(metric_names)
+        self.metric_functions = list(metric_functions)
+        self.num_workers = num_workers
+        self.worker_id = worker_id
+        self.num_buckets = num_buckets
+        os.makedirs(save_path, exist_ok=True)
+
+    # ------------------------------------------------------------------ #
+    def _shard_indices(self, worker_id: Optional[int] = None) -> np.ndarray:
+        w = self.worker_id if worker_id is None else worker_id
+        return np.arange(w, len(self.dataset), self.num_workers)
+
+    def run_map(self) -> str:
+        """Compute metrics for this worker's shard → one .npz per worker."""
+        idx = self._shard_indices()
+        values = {name: np.empty(len(idx), np.float64)
+                  for name in self.metric_names}
+        for row, i in enumerate(idx):
+            sample = self.dataset[int(i)]
+            for name, fn in zip(self.metric_names, self.metric_functions):
+                values[name][row] = fn(sample)
+        out = os.path.join(self.save_path,
+                           f"worker_{self.worker_id}_metrics.npz")
+        np.savez(out, indices=idx, **values)
+        logger.info(f"DataAnalyzer map: worker {self.worker_id} wrote "
+                    f"{len(idx)} samples → {out}")
+        return out
+
+    def run_reduce(self) -> Dict[str, str]:
+        """Merge all worker files → sample_to_metric + metric_to_sample."""
+        n = len(self.dataset)
+        merged = {name: np.zeros(n, np.float64) for name in self.metric_names}
+        seen = np.zeros(n, bool)
+        for w in range(self.num_workers):
+            path = os.path.join(self.save_path, f"worker_{w}_metrics.npz")
+            data = np.load(path)
+            idx = data["indices"]
+            seen[idx] = True
+            for name in self.metric_names:
+                merged[name][idx] = data[name]
+        assert seen.all(), "run_map missing for some workers/samples"
+
+        outputs = {}
+        for name in self.metric_names:
+            vals = merged[name]
+            s2m = os.path.join(self.save_path, f"{name}_sample_to_metric.npy")
+            np.save(s2m, vals)
+            # equal-frequency buckets: difficulty bucket → sample indices
+            edges = np.quantile(vals, np.linspace(0, 1, self.num_buckets + 1))
+            edges[-1] += 1e-9
+            buckets = {int(b): np.where((vals >= edges[b]) &
+                                        (vals < edges[b + 1]))[0]
+                       for b in range(self.num_buckets)}
+            m2s = os.path.join(self.save_path, f"{name}_metric_to_sample.npz")
+            np.savez(m2s, edges=edges,
+                     **{f"bucket_{b}": v for b, v in buckets.items()})
+            outputs[name] = m2s
+        index = {"metrics": self.metric_names, "num_samples": n,
+                 "num_buckets": self.num_buckets}
+        with open(os.path.join(self.save_path, "index.json"), "w") as f:
+            json.dump(index, f)
+        return outputs
+
+
+def _analyzer_worker(dataset, save_path, metric_names, metric_functions,
+                     num_workers, worker_id, num_buckets):
+    """Module-level mp target (picklable under the spawn start method)."""
+    DataAnalyzer(dataset, save_path, metric_names, metric_functions,
+                 num_workers, worker_id, num_buckets).run_map()
+
+
+class DistributedDataAnalyzer(DataAnalyzer):
+    """Reference :455 — runs the map phase across worker processes."""
+
+    def run_map_reduce(self) -> Dict[str, str]:
+        import multiprocessing as mp
+
+        procs = [mp.Process(target=_analyzer_worker, args=(
+            self.dataset, self.save_path, self.metric_names,
+            self.metric_functions, self.num_workers, w, self.num_buckets))
+            for w in range(self.num_workers)]
+        for p in procs:
+            p.start()
+        for p in procs:
+            p.join()
+            assert p.exitcode == 0, f"analyzer worker failed rc={p.exitcode}"
+        return self.run_reduce()
+
+
+class CurriculumMetricIndex:
+    """Loader for the reduce outputs, consumed by the curriculum sampler
+    (reference: curriculum sampler's index_to_sample_path files)."""
+
+    def __init__(self, save_path: str, metric_name: str):
+        data = np.load(os.path.join(save_path,
+                                    f"{metric_name}_metric_to_sample.npz"))
+        self.edges = data["edges"]
+        self.buckets = [data[f"bucket_{b}"]
+                        for b in range(len(self.edges) - 1)]
+        self.sample_to_metric = np.load(os.path.join(
+            save_path, f"{metric_name}_sample_to_metric.npy"))
+
+    def samples_up_to_difficulty(self, difficulty: float) -> np.ndarray:
+        """All sample indices whose metric ≤ difficulty (CL admission)."""
+        return np.where(self.sample_to_metric <= difficulty)[0]
