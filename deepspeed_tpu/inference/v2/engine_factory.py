@@ -19,11 +19,25 @@ from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
 def build_hf_engine(path: str, engine_config: Optional[RaggedInferenceEngineConfig] = None,
                     dtype=jnp.bfloat16, random_weights: bool = False,
                     **overrides) -> InferenceEngineV2:
-    """HF model dir/name → serving engine (reference build_hf_engine)."""
+    """HF model dir/name → serving engine (reference build_hf_engine:
+    dispatches through the model_implementations registry)."""
+    from transformers import AutoConfig
+
+    from .model_implementations import get_implementation, list_implementations
+
+    hf_cfg = AutoConfig.from_pretrained(path) if isinstance(path, str) else path
+    impl = get_implementation(hf_cfg)
+    if not impl.ragged_native:
+        native = [a for a in list_implementations()
+                  if get_implementation(a).ragged_native]
+        raise NotImplementedError(
+            f"{impl.arch} ({impl.notes}) serves on the UniversalCausalLM "
+            f"compat forward — call model(params, tokens) directly; the "
+            f"ragged paged-KV engine covers: {native}")
     if random_weights:
         import jax
 
-        model = from_pretrained_config(path, **overrides)
+        model = from_pretrained_config(hf_cfg, **overrides)
         params = model.init_params(jax.random.PRNGKey(0), dtype=dtype)
     else:
         model, params = load_hf_model(path, dtype=dtype, **overrides)

@@ -1,0 +1,18 @@
+"""Per-architecture serving model implementations (reference:
+inference/v2/model_implementations/ — llama_v2, mistral, mixtral, falcon,
+opt, phi/phi3, qwen/qwen_v2(+moe) directories + flat_model_helpers).
+
+Each implementation records the policy for one HF architecture: which
+framework model family serves it, how its checkpoint converts, and whether
+the ragged (paged-KV) engine supports it natively.  ``get_implementation``
+is the registry the engine factory dispatches through (reference
+engine_factory.py policy map).
+"""
+from .registry import (
+    ModelImplementation,
+    get_implementation,
+    list_implementations,
+)
+
+__all__ = ["ModelImplementation", "get_implementation",
+           "list_implementations"]

@@ -1,0 +1,83 @@
+"""Serving model-implementation registry (reference:
+inference/v2/engine_factory.py:70 policy map → per-arch
+``DSTransformerModelBase`` subclasses, model_implementations/*).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional
+
+
+@dataclasses.dataclass
+class ModelImplementation:
+    """Policy for serving one HF architecture.
+
+    ``family``: models/hf.py policy name; ``ragged_native``: True when the
+    paged-KV ragged engine serves it (CausalLM recipe), False when it runs
+    on the UniversalCausalLM compat forward (dense batch serving only).
+    """
+    arch: str
+    family: str
+    ragged_native: bool
+    notes: str = ""
+
+    def build(self, hf_config: Any, **overrides):
+        """HF config → framework model (the make_*_layer factory analogue)."""
+        from ....models.hf import from_pretrained_config
+
+        return from_pretrained_config(hf_config, **overrides)
+
+    def convert(self, state_dict: Dict, model) -> Dict:
+        from ....models.hf import (
+            NATIVE_FAMILIES,
+            convert_arch_state_dict,
+            convert_llama_state_dict,
+        )
+
+        if self.family in NATIVE_FAMILIES:
+            return convert_llama_state_dict(state_dict, model.config)
+        return convert_arch_state_dict(state_dict, model.config, self.family)
+
+
+_IMPLS: Dict[str, ModelImplementation] = {}
+
+
+def _register(arch, family, ragged_native, notes=""):
+    _IMPLS[arch] = ModelImplementation(arch, family, ragged_native, notes)
+
+
+# reference model_implementations/ inventory (16 entries → TPU equivalents)
+_register("LlamaForCausalLM", "llama", True)
+_register("MistralForCausalLM", "llama", True)
+_register("Qwen2ForCausalLM", "qwen2", True, "llama + qkv bias")
+_register("MixtralForCausalLM", "mixtral", True,
+          "MoE serving via sparse-slot dispatch")
+_register("GPT2LMHeadModel", "gpt2", False, "learned positions + LN")
+_register("OPTForCausalLM", "opt", False, "learned positions offset 2")
+_register("BloomForCausalLM", "bloom", False, "ALiBi")
+_register("FalconForCausalLM", "falcon", False, "parallel attn / MQA")
+_register("PhiForCausalLM", "phi", False, "partial rotary, parallel attn")
+
+
+def get_implementation(arch_or_config: Any) -> ModelImplementation:
+    """Resolve by HF architecture name or config object."""
+    if isinstance(arch_or_config, str):
+        if arch_or_config in _IMPLS:
+            return _IMPLS[arch_or_config]
+        raise KeyError(f"no serving implementation for {arch_or_config!r}; "
+                       f"known: {sorted(_IMPLS)}")
+    archs = getattr(arch_or_config, "architectures", None) or []
+    for a in archs:
+        if a in _IMPLS:
+            return _IMPLS[a]
+    from ....models.hf import policy_for
+
+    fam = policy_for(arch_or_config)
+    for impl in _IMPLS.values():
+        if impl.family == fam:
+            return impl
+    raise KeyError(f"no serving implementation for {archs or fam}")
+
+
+def list_implementations():
+    return sorted(_IMPLS)

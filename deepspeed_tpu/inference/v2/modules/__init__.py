@@ -1,0 +1,17 @@
+"""Modular layer interface/registry (reference:
+inference/v2/modules/{interfaces,configs,implementations} — e.g.
+``DSDenseBlockedAttention`` registered under the attention interface).
+
+Registry pattern: implementations register under (interface, name); model
+implementations resolve the op they want by name, so alternate kernels
+(paged vs gather attention, sparse vs dense MoE dispatch) swap without
+touching model code.
+"""
+from .registry import (
+    DSModuleRegistry,
+    get_module,
+    list_modules,
+    register_module,
+)
+
+__all__ = ["DSModuleRegistry", "register_module", "get_module", "list_modules"]

@@ -1,0 +1,94 @@
+"""Interface registry for serving modules (reference:
+inference/v2/modules/module_registry.py ``DSModuleRegistryBase`` +
+interfaces/{attention,linear,moe,embedding,norms,unembed}_base).
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Tuple
+
+#: the reference's six module interfaces (SURVEY §2.5)
+INTERFACES = ("attention", "linear", "moe", "embedding", "norm", "unembed")
+
+
+class DSModuleRegistry:
+    _registry: Dict[Tuple[str, str], Callable] = {}
+
+    @classmethod
+    def register(cls, interface: str, name: str, impl: Callable) -> None:
+        if interface not in INTERFACES:
+            raise ValueError(f"unknown interface {interface!r}; "
+                             f"known: {INTERFACES}")
+        cls._registry[(interface, name)] = impl
+
+    @classmethod
+    def get(cls, interface: str, name: str) -> Callable:
+        key = (interface, name)
+        if key not in cls._registry:
+            avail = [n for (i, n) in cls._registry if i == interface]
+            raise KeyError(f"no {interface!r} implementation {name!r}; "
+                           f"available: {avail}")
+        return cls._registry[key]
+
+    @classmethod
+    def list(cls, interface: str = None):
+        return sorted(n for (i, n) in cls._registry
+                      if interface is None or i == interface)
+
+
+def register_module(interface: str, name: str):
+    """Decorator: ``@register_module("attention", "paged")``."""
+    def deco(impl):
+        DSModuleRegistry.register(interface, name, impl)
+        return impl
+
+    return deco
+
+
+def get_module(interface: str, name: str) -> Callable:
+    return DSModuleRegistry.get(interface, name)
+
+
+def list_modules(interface: str = None):
+    return DSModuleRegistry.list(interface)
+
+
+# --------------------------------------------------------------------- #
+# Built-in implementations (reference implementations/ dirs)
+# --------------------------------------------------------------------- #
+def _register_builtins():
+    import jax
+    import jax.numpy as jnp
+
+    from ....models.transformer import rms_norm
+    from ..kernels.ragged_ops import paged_attention
+    from ..model_runner import _attend_gather
+
+    DSModuleRegistry.register("attention", "paged", paged_attention)
+    DSModuleRegistry.register("attention", "gather", _attend_gather)
+
+    DSModuleRegistry.register(
+        "linear", "dense",
+        lambda x, p: (x @ p["kernel"]) + p.get("bias", 0))
+
+    from ....moe.sharded_moe import moe_mlp_block
+
+    DSModuleRegistry.register("moe", "sparse", moe_mlp_block)
+
+    DSModuleRegistry.register(
+        "embedding", "lookup",
+        lambda tokens, p: jnp.take(p["embedding"], tokens, axis=0))
+
+    DSModuleRegistry.register("norm", "rmsnorm", rms_norm)
+    from ....models.families import layer_norm
+
+    DSModuleRegistry.register("norm", "layernorm", layer_norm)
+
+    DSModuleRegistry.register(
+        "unembed", "tied",
+        lambda h, p: h @ p["embedding"].T)
+    DSModuleRegistry.register(
+        "unembed", "lm_head",
+        lambda h, p: h @ p["kernel"])
+
+
+_register_builtins()

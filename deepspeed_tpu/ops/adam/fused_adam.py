@@ -27,14 +27,18 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
-def _adam_kernel(p_ref, g_ref, m_ref, v_ref, bc1_ref, bc2_ref, p_out, m_out, v_out,
-                 *, lr, beta1, beta2, eps, weight_decay, adam_w_mode):
+def _adam_kernel(p_ref, g_ref, m_ref, v_ref, bc1_ref, bc2_ref, lr_ref,
+                 p_out, m_out, v_out,
+                 *, beta1, beta2, eps, weight_decay, adam_w_mode):
     p = p_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     m = m_ref[:].astype(jnp.float32)
     v = v_ref[:].astype(jnp.float32)
     bc1 = bc1_ref[0, 0]
     bc2 = bc2_ref[0, 0]
+    # lr rides an SMEM operand like bc1/bc2: under the engine's jitted step
+    # it's a TRACED schedule value — a closure constant would fail lowering
+    lr = lr_ref[0, 0]
 
     if weight_decay and not adam_w_mode:
         g = g + weight_decay * p
@@ -71,23 +75,25 @@ def fused_adam_update(p, g, m, v, step, lr=1e-3, beta1=0.9, beta2=0.999,
     t = step.astype(jnp.float32) + 1.0
     bc1 = (1.0 - beta1 ** t if bias_correction else jnp.float32(1.0)).reshape(1, 1)
     bc2 = (1.0 - beta2 ** t if bias_correction else jnp.float32(1.0)).reshape(1, 1)
+    lr_arr = jnp.asarray(lr, jnp.float32).reshape(1, 1)
 
     block_rows = max(min(rows, BLOCK // width), 8)
     grid = (-(-rows // block_rows),)
     spec = pl.BlockSpec((block_rows, width), lambda i: (i, 0))
     kernel = functools.partial(
-        _adam_kernel, lr=lr, beta1=beta1, beta2=beta2, eps=eps,
+        _adam_kernel, beta1=beta1, beta2=beta2, eps=eps,
         weight_decay=weight_decay, adam_w_mode=adam_w_mode)
     p2, m2, v2 = pl.pallas_call(
         kernel,
         grid=grid,
         in_specs=[spec, spec, spec, spec,
                   pl.BlockSpec(memory_space=pltpu.SMEM),
+                  pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=[spec, spec, spec],
         out_shape=[jax.ShapeDtypeStruct((rows, width), jnp.float32)] * 3,
         interpret=_interpret(),
-    )(pf, gf, mf, vf, bc1, bc2)
+    )(pf, gf, mf, vf, bc1, bc2, lr_arr)
 
     unflat = lambda x: x.reshape(-1)[:n].reshape(shape)
     return unflat(p2).astype(dtype), unflat(m2), unflat(v2)
@@ -99,50 +105,60 @@ class FusedAdamState(NamedTuple):
     nu: Any
 
 
-def fused_adam(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8,
-               weight_decay=0.0, adam_w_mode=True,
-               bias_correction=True) -> optax.GradientTransformation:
-    """Optax-compatible fused Adam.
-
-    Note: computes new params inside the kernel, so ``update`` needs params
-    and returns additive updates (new_p - p) to stay optax-conformant.
-    """
+def optax_wrap(per_leaf_update, state_cls, num_moments: int,
+               learning_rate) -> optax.GradientTransformation:
+    """Shared optax wrapper for fused kernels that compute NEW PARAMS
+    in-kernel: flattens the tree, applies ``per_leaf_update(lr, count, p, g,
+    *moments) -> (new_p, *new_moments)`` per leaf, and returns additive
+    updates (new_p - p) to stay optax-conformant.  Used by
+    fused_adam/fused_lion here and fused_lamb (ops/lamb)."""
 
     def init(params):
         zeros = lambda p: jnp.zeros(p.shape, jnp.float32)
-        return FusedAdamState(count=jnp.zeros((), jnp.int32),
-                              mu=jax.tree.map(zeros, params),
-                              nu=jax.tree.map(zeros, params))
+        moments = [jax.tree.map(zeros, params) for _ in range(num_moments)]
+        return state_cls(jnp.zeros((), jnp.int32), *moments)
 
     def update(grads, state, params=None):
-        assert params is not None, "fused_adam requires params"
-        lr = learning_rate(state.count) if callable(learning_rate) else learning_rate
-        new_p, new_m, new_v = {}, {}, {}
+        assert params is not None, "fused optimizers require params"
+        lr = learning_rate(state.count) if callable(learning_rate) \
+            else learning_rate
         flat_p, treedef = jax.tree.flatten(params)
         flat_g = treedef.flatten_up_to(grads)
-        flat_m = treedef.flatten_up_to(state.mu)
-        flat_v = treedef.flatten_up_to(state.nu)
-        outs = [fused_adam_update(p, g, m, v, state.count, lr=lr, beta1=b1,
-                                  beta2=b2, eps=eps, weight_decay=weight_decay,
-                                  adam_w_mode=adam_w_mode,
-                                  bias_correction=bias_correction)
-                for p, g, m, v in zip(flat_p, flat_g, flat_m, flat_v)]
+        flat_moments = [treedef.flatten_up_to(state[i + 1])
+                        for i in range(num_moments)]
+        outs = [per_leaf_update(lr, state.count, p, g, *ms)
+                for p, g, *ms in zip(flat_p, flat_g, *flat_moments)]
         new_params = treedef.unflatten([o[0] for o in outs])
-        new_mu = treedef.unflatten([o[1] for o in outs])
-        new_nu = treedef.unflatten([o[2] for o in outs])
+        new_moments = [treedef.unflatten([o[i + 1] for o in outs])
+                       for i in range(num_moments)]
         updates = jax.tree.map(lambda n, o: n - o, new_params, params)
-        return updates, FusedAdamState(count=state.count + 1, mu=new_mu, nu=new_nu)
+        return updates, state_cls(state.count + 1, *new_moments)
 
     return optax.GradientTransformation(init, update)
+
+
+def fused_adam(learning_rate=1e-3, b1=0.9, b2=0.999, eps=1e-8,
+               weight_decay=0.0, adam_w_mode=True,
+               bias_correction=True) -> optax.GradientTransformation:
+    """Optax-compatible fused Adam (additive updates = new_p - p)."""
+    def leaf(lr, count, p, g, m, v):
+        return fused_adam_update(p, g, m, v, count, lr=lr, beta1=b1, beta2=b2,
+                                 eps=eps, weight_decay=weight_decay,
+                                 adam_w_mode=adam_w_mode,
+                                 bias_correction=bias_correction)
+
+    return optax_wrap(leaf, FusedAdamState, 2, learning_rate)
 
 
 # ------------------------------------------------------------------ #
 # Lion (reference ⚙: csrc/lion/, deepspeed/ops/lion/)
 # ------------------------------------------------------------------ #
-def _lion_kernel(p_ref, g_ref, m_ref, p_out, m_out, *, lr, beta1, beta2, weight_decay):
+def _lion_kernel(p_ref, g_ref, m_ref, lr_ref, p_out, m_out,
+                 *, beta1, beta2, weight_decay):
     p = p_ref[:].astype(jnp.float32)
     g = g_ref[:].astype(jnp.float32)
     m = m_ref[:].astype(jnp.float32)
+    lr = lr_ref[0, 0]
     update = jnp.sign(beta1 * m + (1.0 - beta1) * g) + weight_decay * p
     p_out[:] = (p - lr * update).astype(p_out.dtype)
     m_out[:] = (beta2 * m + (1.0 - beta2) * g).astype(m_out.dtype)
@@ -162,16 +178,33 @@ def fused_lion_update(p, g, m, lr=1e-4, beta1=0.9, beta2=0.99, weight_decay=0.0)
         return f.reshape(rows, width)
 
     pf, gf, mf = map(flat2d, (p, g, m))
+    lr_arr = jnp.asarray(lr, jnp.float32).reshape(1, 1)
     block_rows = max(min(rows, BLOCK // width), 8)
     spec = pl.BlockSpec((block_rows, width), lambda i: (i, 0))
     p2, m2 = pl.pallas_call(
-        functools.partial(_lion_kernel, lr=lr, beta1=beta1, beta2=beta2,
+        functools.partial(_lion_kernel, beta1=beta1, beta2=beta2,
                           weight_decay=weight_decay),
         grid=(-(-rows // block_rows),),
-        in_specs=[spec, spec, spec],
+        in_specs=[spec, spec, spec,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=[spec, spec],
         out_shape=[jax.ShapeDtypeStruct((rows, width), jnp.float32)] * 2,
         interpret=_interpret(),
-    )(pf, gf, mf)
+    )(pf, gf, mf, lr_arr)
     unflat = lambda x: x.reshape(-1)[:n].reshape(shape)
     return unflat(p2).astype(dtype), unflat(m2)
+
+
+class FusedLionState(NamedTuple):
+    count: jnp.ndarray
+    mu: Any
+
+
+def fused_lion(learning_rate=1e-4, b1=0.9, b2=0.99,
+               weight_decay=0.0) -> optax.GradientTransformation:
+    """Optax-compatible fused Lion (reference deepspeed/ops/lion)."""
+    def leaf(lr, count, p, g, m):
+        return fused_lion_update(p, g, m, lr=lr, beta1=b1, beta2=b2,
+                                 weight_decay=weight_decay)
+
+    return optax_wrap(leaf, FusedLionState, 1, learning_rate)

@@ -1,0 +1,5 @@
+"""Fused LAMB (reference ⚙: csrc/lamb/fused_lamb_cuda.cpp +
+fused_lamb_cuda_kernel.cu, bound via deepspeed/ops/lamb/)."""
+from .fused_lamb import FusedLambState, fused_lamb, fused_lamb_update
+
+__all__ = ["fused_lamb", "fused_lamb_update", "FusedLambState"]

@@ -27,17 +27,19 @@ def metric_seqlen(sample) -> float:
     return float(arr.shape[-1] if arr.ndim else 1)
 
 
-def metric_vocab_rarity(vocab_freq: np.ndarray) -> Callable:
+class metric_vocab_rarity:
     """Built-in metric factory: mean -log frequency of the sample's tokens
-    (reference vocabularyrarity)."""
-    logp = -np.log(np.maximum(vocab_freq / max(vocab_freq.sum(), 1), 1e-12))
+    (reference vocabularyrarity).  A callable CLASS, not a closure, so
+    instances pickle cleanly into spawn-started analyzer workers."""
 
-    def fn(sample) -> float:
+    def __init__(self, vocab_freq: np.ndarray):
+        self.logp = -np.log(np.maximum(
+            vocab_freq / max(vocab_freq.sum(), 1), 1e-12))
+
+    def __call__(self, sample) -> float:
         ids = np.asarray(sample["input_ids"] if isinstance(sample, dict)
                          else sample).reshape(-1)
-        return float(np.mean(logp[ids]))
-
-    return fn
+        return float(np.mean(self.logp[ids]))
 
 
 class DataAnalyzer:

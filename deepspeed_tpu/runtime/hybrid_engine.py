@@ -19,12 +19,56 @@ from ..utils.logging import log_dist
 from .engine import DeepSpeedEngine
 
 
+def fuse_lora(params: Any, lora_alpha: float = 16.0,
+              lora_r: Optional[int] = None) -> Any:
+    """Fold LoRA adapters into their base weights (reference
+    hybrid_engine.py fuse_lora_weight, :117): any subtree shaped like
+    OptimizedLinear's params ({base:{kernel}, lora_A, lora_B}) becomes
+    {base:{kernel + A@B*(alpha/r)}} with ``lora_B`` zeroed — the module's
+    forward keeps working unchanged (its adapter matmul contributes zero),
+    while the fused base carries the full adapter effect.
+
+    ``lora_alpha``/``lora_r`` must match the LoRAConfig the layers were
+    built with (adapter params don't carry the scaling).  Quantized bases
+    ({q, scale}) are left unfused with a warning — folding into int8 would
+    change the base quantization."""
+    def walk(node):
+        if isinstance(node, dict) and "lora_A" in node and "lora_B" in node \
+                and isinstance(node.get("base"), dict):
+            if "kernel" not in node["base"]:
+                log_dist("fuse_lora: skipping int8-quantized base (folding "
+                         "would requantize); adapters stay live", ranks=[0])
+                return node
+            a, b = node["lora_A"], node["lora_B"]
+            r = lora_r or a.shape[-1]
+            w = node["base"]["kernel"]
+            fused = w + (a.astype(w.dtype) @ b.astype(w.dtype)) * \
+                (lora_alpha / r)
+            out = dict(node)
+            out["base"] = {**node["base"], "kernel": fused}
+            out["lora_B"] = jnp.zeros_like(node["lora_B"])
+            return out
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return node
+
+    return walk(params)
+
+
+def unfuse_lora(params: Any, fused_from: Any) -> Any:
+    """Inverse bookkeeping (reference unfuse_lora_weight): training params
+    are never mutated here — fusion happens on the serving COPY — so unfuse
+    simply returns the original adapter-carrying tree (live lora_B)."""
+    return fused_from
+
+
 class DeepSpeedHybridEngine(DeepSpeedEngine):
     def __init__(self, *args, inference_config: Optional[RaggedInferenceEngineConfig] = None,
-                 **kwargs):
+                 lora_alpha: float = 16.0, **kwargs):
         super().__init__(*args, **kwargs)
         self._inference_config = inference_config or RaggedInferenceEngineConfig(
             dtype=self.compute_dtype)
+        self._lora_alpha = lora_alpha
         self._infer_engine: Optional[InferenceEngineV2] = None
         self._infer_params_step = -1
         log_dist("hybrid engine ready (train + generate share weights)", ranks=[0])
@@ -32,11 +76,13 @@ class DeepSpeedHybridEngine(DeepSpeedEngine):
     # ------------------------------------------------------------------ #
     def _refresh_inference_params(self):
         """Re-place current training params for serving (the reference's
-        container-gather, hybrid_engine.py:168 prologue)."""
+        container-gather, hybrid_engine.py:168 prologue); LoRA adapters are
+        fused into the serving copy (reference fuse_lora_weight)."""
         if self._infer_params_step == self.global_steps and self._infer_engine:
             return
+        fused = fuse_lora(self.state.params, lora_alpha=self._lora_alpha)
         cast = jax.tree.map(lambda p: p.astype(self._inference_config.dtype),
-                            self.state.params)
+                            fused)
         if self._infer_engine is None:
             self._infer_engine = InferenceEngineV2(
                 self.module, cast, self._inference_config)

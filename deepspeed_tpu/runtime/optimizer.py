@@ -28,6 +28,7 @@ SUPPORTED_OPTIMIZERS = [
     ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, LION_OPTIMIZER,
     SGD_OPTIMIZER, ADAGRAD_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
     ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER, MUON_OPTIMIZER,
+    "fusedadam", "fusedlamb", "fusedlion",
 ]
 
 ScheduleOrFloat = Union[float, Callable]
@@ -85,6 +86,23 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
             local_step_scaler=params.get("local_step_scaler", 32768),
             local_step_clipper=params.get("local_step_clipper", 16),
             comm_axes=params.get("comm_axes"))
+    if name in ("fusedadam", "fusedlamb", "fusedlion"):
+        # Pallas fused single-pass kernels (reference csrc/{adam,lamb,lion})
+        if name == "fusedadam":
+            from ..ops.adam.fused_adam import fused_adam
+
+            return fused_adam(lr, b1=betas[0], b2=betas[1], eps=eps,
+                              weight_decay=wd,
+                              adam_w_mode=params.get("adam_w_mode", True))
+        if name == "fusedlamb":
+            from ..ops.lamb import fused_lamb
+
+            return fused_lamb(lr, b1=betas[0], b2=betas[1], eps=eps,
+                              weight_decay=wd)
+        from ..ops.adam.fused_adam import fused_lion
+
+        b1, b2 = (betas if len(betas) == 2 else (0.9, 0.99))
+        return fused_lion(lr, b1=b1, b2=b2, weight_decay=wd)
     if name == ADAM_OPTIMIZER:
         adam_w_mode = params.get("adam_w_mode", True)
         if wd and adam_w_mode:
