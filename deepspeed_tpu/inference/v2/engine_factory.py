@@ -40,6 +40,10 @@ def build_hf_engine(path: str, engine_config: Optional[RaggedInferenceEngineConf
         model = from_pretrained_config(hf_cfg, **overrides)
         params = model.init_params(jax.random.PRNGKey(0), dtype=dtype)
     else:
+        if not isinstance(path, str):
+            raise ValueError("loading real weights needs a model dir/name "
+                             "string; config objects only support "
+                             "random_weights=True")
         model, params = load_hf_model(path, dtype=dtype, **overrides)
     cfg = engine_config or RaggedInferenceEngineConfig(
         max_ctx=model.config.max_seq_len, dtype=dtype)

@@ -39,24 +39,28 @@ class ModelImplementation:
         return convert_arch_state_dict(state_dict, model.config, self.family)
 
 
-_IMPLS: Dict[str, ModelImplementation] = {}
+#: per-arch serving notes; arch→family comes from models/hf.py's policy map
+#: (single source of truth) and ragged_native = family in NATIVE_FAMILIES
+_NOTES = {
+    "Qwen2ForCausalLM": "llama + qkv bias",
+    "MixtralForCausalLM": "MoE serving via sparse-slot dispatch",
+    "GPT2LMHeadModel": "learned positions + LN",
+    "OPTForCausalLM": "learned positions offset 2",
+    "BloomForCausalLM": "ALiBi",
+    "FalconForCausalLM": "parallel attn / MQA",
+    "PhiForCausalLM": "partial rotary, parallel attn",
+}
 
 
-def _register(arch, family, ragged_native, notes=""):
-    _IMPLS[arch] = ModelImplementation(arch, family, ragged_native, notes)
+def _build_impls() -> Dict[str, ModelImplementation]:
+    from ....models.hf import _ARCH_POLICIES, NATIVE_FAMILIES
+
+    return {arch: ModelImplementation(
+        arch, fam, fam in NATIVE_FAMILIES, _NOTES.get(arch, ""))
+        for arch, fam in _ARCH_POLICIES.items()}
 
 
-# reference model_implementations/ inventory (16 entries → TPU equivalents)
-_register("LlamaForCausalLM", "llama", True)
-_register("MistralForCausalLM", "llama", True)
-_register("Qwen2ForCausalLM", "qwen2", True, "llama + qkv bias")
-_register("MixtralForCausalLM", "mixtral", True,
-          "MoE serving via sparse-slot dispatch")
-_register("GPT2LMHeadModel", "gpt2", False, "learned positions + LN")
-_register("OPTForCausalLM", "opt", False, "learned positions offset 2")
-_register("BloomForCausalLM", "bloom", False, "ALiBi")
-_register("FalconForCausalLM", "falcon", False, "parallel attn / MQA")
-_register("PhiForCausalLM", "phi", False, "partial rotary, parallel attn")
+_IMPLS: Dict[str, ModelImplementation] = _build_impls()
 
 
 def get_implementation(arch_or_config: Any) -> ModelImplementation:

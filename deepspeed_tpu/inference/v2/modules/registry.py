@@ -12,6 +12,17 @@ INTERFACES = ("attention", "linear", "moe", "embedding", "norm", "unembed")
 
 class DSModuleRegistry:
     _registry: Dict[Tuple[str, str], Callable] = {}
+    _builtins_loaded = False
+
+    @classmethod
+    def _ensure_builtins(cls) -> None:
+        """Built-ins register LAZILY on first lookup: the implementations
+        live across the framework (kernels, MoE, model families) and eager
+        import-time registration would pull all of it in just to import
+        this module."""
+        if not cls._builtins_loaded:
+            cls._builtins_loaded = True
+            _register_builtins()
 
     @classmethod
     def register(cls, interface: str, name: str, impl: Callable) -> None:
@@ -22,6 +33,7 @@ class DSModuleRegistry:
 
     @classmethod
     def get(cls, interface: str, name: str) -> Callable:
+        cls._ensure_builtins()
         key = (interface, name)
         if key not in cls._registry:
             avail = [n for (i, n) in cls._registry if i == interface]
@@ -31,6 +43,7 @@ class DSModuleRegistry:
 
     @classmethod
     def list(cls, interface: str = None):
+        cls._ensure_builtins()
         return sorted(n for (i, n) in cls._registry
                       if interface is None or i == interface)
 
@@ -89,6 +102,3 @@ def _register_builtins():
     DSModuleRegistry.register(
         "unembed", "lm_head",
         lambda h, p: h @ p["kernel"])
-
-
-_register_builtins()

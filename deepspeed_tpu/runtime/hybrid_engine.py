@@ -55,11 +55,12 @@ def fuse_lora(params: Any, lora_alpha: float = 16.0,
     return walk(params)
 
 
-def unfuse_lora(params: Any, fused_from: Any) -> Any:
+def unfuse_lora(original: Any) -> Any:
     """Inverse bookkeeping (reference unfuse_lora_weight): training params
     are never mutated here — fusion happens on the serving COPY — so unfuse
-    simply returns the original adapter-carrying tree (live lora_B)."""
-    return fused_from
+    is the identity on the ORIGINAL adapter-carrying tree.  Single-argument
+    by design: there is nothing to subtract back out."""
+    return original
 
 
 class DeepSpeedHybridEngine(DeepSpeedEngine):

@@ -101,7 +101,9 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
                               weight_decay=wd)
         from ..ops.adam.fused_adam import fused_lion
 
-        b1, b2 = (betas if len(betas) == 2 else (0.9, 0.99))
+        # Lion's default b2 is 0.99, not Adam's 0.999 — only honor betas
+        # the config spells out explicitly
+        b1, b2 = tuple(params.get("betas", (0.9, 0.99)))
         return fused_lion(lr, b1=b1, b2=b2, weight_decay=wd)
     if name == ADAM_OPTIMIZER:
         adam_w_mode = params.get("adam_w_mode", True)
@@ -116,7 +118,7 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
     if name == LAMB_OPTIMIZER:
         return optax.lamb(lr, b1=betas[0], b2=betas[1], eps=eps, weight_decay=wd)
     if name == LION_OPTIMIZER:
-        b1, b2 = (betas if len(betas) == 2 else (0.9, 0.99))
+        b1, b2 = tuple(params.get("betas", (0.9, 0.99)))  # Lion default b2
         return optax.lion(lr, b1=b1, b2=b2, weight_decay=wd)
     if name == SGD_OPTIMIZER:
         momentum = params.get("momentum", 0.0)

@@ -110,5 +110,5 @@ class TestHybridLoRA:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    atol=1e-5, rtol=1e-5)
         # unfuse restores the live-adapter tree
-        restored = unfuse_lora(fused, {"proj": params})
+        restored = unfuse_lora({"proj": params})
         assert np.any(np.asarray(restored["proj"]["lora_B"]) != 0)
