@@ -52,36 +52,48 @@ _NOTES = {
 }
 
 
-def _build_impls() -> Dict[str, ModelImplementation]:
-    from ....models.hf import _ARCH_POLICIES, NATIVE_FAMILIES
+#: families with an end-to-end recipe (config + converter + forward);
+#: gptj is mapped in the policy table but has no ArchConfig recipe yet
+_BUILDABLE_FAMILIES = ("llama", "qwen2", "mixtral", "gpt2", "opt", "bloom",
+                       "falcon", "phi")
 
-    return {arch: ModelImplementation(
-        arch, fam, fam in NATIVE_FAMILIES, _NOTES.get(arch, ""))
-        for arch, fam in _ARCH_POLICIES.items()}
+_IMPLS: Dict[str, ModelImplementation] = {}
 
 
-_IMPLS: Dict[str, ModelImplementation] = _build_impls()
+def _ensure_impls() -> Dict[str, ModelImplementation]:
+    """Built lazily on first lookup (keeps importing this registry from
+    pulling in the whole model stack) and derived from models/hf.py's
+    policy map so the two tables cannot drift."""
+    if not _IMPLS:
+        from ....models.hf import _ARCH_POLICIES, NATIVE_FAMILIES
+
+        _IMPLS.update({arch: ModelImplementation(
+            arch, fam, fam in NATIVE_FAMILIES, _NOTES.get(arch, ""))
+            for arch, fam in _ARCH_POLICIES.items()
+            if fam in _BUILDABLE_FAMILIES})
+    return _IMPLS
 
 
 def get_implementation(arch_or_config: Any) -> ModelImplementation:
     """Resolve by HF architecture name or config object."""
+    impls = _ensure_impls()
     if isinstance(arch_or_config, str):
-        if arch_or_config in _IMPLS:
-            return _IMPLS[arch_or_config]
+        if arch_or_config in impls:
+            return impls[arch_or_config]
         raise KeyError(f"no serving implementation for {arch_or_config!r}; "
-                       f"known: {sorted(_IMPLS)}")
+                       f"known: {sorted(impls)}")
     archs = getattr(arch_or_config, "architectures", None) or []
     for a in archs:
-        if a in _IMPLS:
-            return _IMPLS[a]
+        if a in impls:
+            return impls[a]
     from ....models.hf import policy_for
 
     fam = policy_for(arch_or_config)
-    for impl in _IMPLS.values():
+    for impl in impls.values():
         if impl.family == fam:
             return impl
     raise KeyError(f"no serving implementation for {archs or fam}")
 
 
 def list_implementations():
-    return sorted(_IMPLS)
+    return sorted(_ensure_impls())

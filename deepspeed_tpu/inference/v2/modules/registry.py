@@ -13,19 +13,30 @@ INTERFACES = ("attention", "linear", "moe", "embedding", "norm", "unembed")
 class DSModuleRegistry:
     _registry: Dict[Tuple[str, str], Callable] = {}
     _builtins_loaded = False
+    _loading = False
 
     @classmethod
     def _ensure_builtins(cls) -> None:
-        """Built-ins register LAZILY on first lookup: the implementations
-        live across the framework (kernels, MoE, model families) and eager
+        """Built-ins register LAZILY on first use: the implementations live
+        across the framework (kernels, MoE, model families) and eager
         import-time registration would pull all of it in just to import
-        this module."""
-        if not cls._builtins_loaded:
-            cls._builtins_loaded = True
-            _register_builtins()
+        this module.  The flag latches only on SUCCESS so a transient
+        import failure surfaces again instead of an empty registry; the
+        _loading sentinel lets _register_builtins itself call register()."""
+        if not cls._builtins_loaded and not cls._loading:
+            cls._loading = True
+            try:
+                _register_builtins()
+                cls._builtins_loaded = True
+            finally:
+                cls._loading = False
 
     @classmethod
     def register(cls, interface: str, name: str, impl: Callable) -> None:
+        # builtins load first so a user registration under a builtin name
+        # OVERRIDES it (the pre-lazy behavior) rather than being clobbered
+        # by the deferred builtin load
+        cls._ensure_builtins()
         if interface not in INTERFACES:
             raise ValueError(f"unknown interface {interface!r}; "
                              f"known: {INTERFACES}")

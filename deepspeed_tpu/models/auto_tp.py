@@ -1,0 +1,108 @@
+"""AutoTP — automatic tensor-parallel spec inference for arbitrary models
+(reference: module_inject/auto_tp.py:192 ``AutoTP`` + tp_shard.py helpers).
+
+The reference walks the torch module graph classifying each Linear as
+row/column-parallel and patching it with LinearAllreduce/LinearLayer.  The
+TPU equivalent classifies each weight LEAF of a param pytree and emits a
+``PartitionSpec`` tree — GSPMD then inserts the allreduces the reference
+writes by hand.  Classification mirrors the reference's policy:
+
+  * column-parallel (shard the OUTPUT dim): q/k/v/query/key/value, gate/up,
+    fc1/c_fc/dense_h_to_4h, w1/w3 — producers whose outputs stay sharded
+    until the row-parallel consumer.
+  * row-parallel (shard the INPUT dim): o_proj/out_proj/dense/c_proj,
+    down/fc2/dense_4h_to_h, w2 — a psum follows (GSPMD inserts it).
+  * everything else (norms, biases of row-parallel layers, embeddings by
+    default): replicated.
+
+A weight only shards when the target dim divides ``tp_size`` — the
+reference's tp_shard divisibility checks — otherwise it stays replicated
+with a warning.
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Optional, Tuple
+
+import jax
+from jax.sharding import PartitionSpec as P
+
+from ..runtime.topology import TENSOR
+from ..utils.logging import logger
+
+#: name-pattern policy (reference auto_tp.py's layer-name policies)
+COLUMN_PATTERNS = (
+    r"q_proj", r"k_proj", r"v_proj", r"\bquery\b", r"\bkey\b", r"\bvalue\b",
+    r"query_key_value", r"gate_proj", r"up_proj", r"\bfc1\b", r"c_fc",
+    r"dense_h_to_4h", r"\bw1\b", r"\bw3\b", r"wi\b",
+)
+ROW_PATTERNS = (
+    r"o_proj", r"out_proj", r"down_proj", r"\bfc2\b", r"c_proj",
+    r"dense_4h_to_h", r"\bw2\b", r"wo\b", r"attn[._]dense", r"attention[._]dense",
+)
+
+
+def _classify(path: str) -> Optional[str]:
+    for pat in ROW_PATTERNS:
+        if re.search(pat, path):
+            return "row"
+    for pat in COLUMN_PATTERNS:
+        if re.search(pat, path):
+            return "column"
+    return None
+
+
+def _path_str(path) -> str:
+    return ".".join(str(getattr(k, "key", getattr(k, "idx", k)))
+                    for k in path)
+
+
+def autotp_specs(params: Any, tp_size: int,
+                 stacked_leading_dims: int = 0) -> Any:
+    """Infer a TP ``PartitionSpec`` tree for an arbitrary param pytree.
+
+    ``stacked_leading_dims``: number of leading stacked-layer dims (1 for
+    this repo's [L, ...] layer arrays under "layers.") that must never be
+    sharded by TP.
+    """
+    def leaf_spec(path, x):
+        ndim = getattr(x, "ndim", 0)
+        if ndim < 2 or tp_size <= 1:
+            return P()
+        pstr = _path_str(path)
+        kind = _classify(pstr)
+        if kind is None:
+            return P(*([None] * ndim))
+        lead = stacked_leading_dims if pstr.startswith("layers") else 0
+        # weights [.., in, out]: column shards -1, row shards -2;
+        # 1D-bias-like leaves (after stacking) follow the output dim
+        dim = ndim - 1 if kind == "column" else ndim - 2
+        if dim < lead:
+            return P(*([None] * ndim))
+        if kind == "row" and ndim - lead == 1:
+            return P(*([None] * ndim))   # row-parallel bias: replicated
+        if x.shape[dim] % tp_size != 0:
+            logger.warning(f"AutoTP: {pstr} dim {dim} size {x.shape[dim]} "
+                           f"not divisible by tp={tp_size}; replicating")
+            return P(*([None] * ndim))
+        entries = [None] * ndim
+        entries[dim] = TENSOR
+        return P(*entries)
+
+    return jax.tree_util.tree_map_with_path(leaf_spec, params)
+
+
+def autotp_shard(params: Any, tp_size: int, mesh=None,
+                 stacked_leading_dims: int = 1) -> Tuple[Any, Any]:
+    """Classify + place: returns (sharded params, spec tree).  The runtime
+    analogue of reference ``AutoTP.replace_module`` + tp_shard."""
+    from jax.sharding import NamedSharding
+
+    from ..runtime.topology import get_topology
+
+    mesh = mesh or get_topology().mesh
+    specs = autotp_specs(params, tp_size, stacked_leading_dims)
+    placed = jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params, specs, is_leaf=lambda x: hasattr(x, "ndim"))
+    return placed, specs

@@ -1,0 +1,7 @@
+"""Spatial / diffusers inference ops (reference ⚙: csrc/spatial/ — fused
+NHWC bias-add variants used by the diffusers UNet/VAE wrappers, bound via
+op_builder/spatial_inference.py)."""
+from .ops import bias_add, bias_add_add, bias_geglu, group_norm, nhwc_conv
+
+__all__ = ["bias_add", "bias_add_add", "bias_geglu", "group_norm",
+           "nhwc_conv"]

@@ -1,0 +1,114 @@
+"""BERT-era fused transformer training layer (reference ⚙:
+csrc/transformer/ 12.9k LoC CUDA — ds_transformer_cuda.cpp + gelu/dropout/
+normalize/softmax kernels — bound as ``DeepSpeedTransformerLayer``,
+deepspeed/ops/transformer/transformer.py:296).
+
+TPU stance: the hand-fused CUDA encoder layer exists to beat torch's op
+dispatch; under XLA one traced layer IS one fused program, so this module
+provides the same config surface + layer semantics (pre/post-LN, bias
+dropout residual, bidirectional attention with mask) executing on the
+framework's attention path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ...models.families import layer_norm
+
+
+@dataclasses.dataclass
+class DeepSpeedTransformerConfig:
+    """Reference config fields (transformer.py:40)."""
+    batch_size: int = 1
+    hidden_size: int = 768
+    intermediate_size: int = 3072
+    heads: int = 12
+    attn_dropout_ratio: float = 0.1
+    hidden_dropout_ratio: float = 0.1
+    num_hidden_layers: int = 1
+    initializer_range: float = 0.02
+    layer_norm_eps: float = 1e-12
+    pre_layer_norm: bool = True
+    fp16: bool = False
+    stochastic_mode: bool = False
+
+
+class DeepSpeedTransformerLayer:
+    """One BERT encoder layer with the reference's param surface."""
+
+    def __init__(self, config: DeepSpeedTransformerConfig):
+        self.config = config
+
+    def init_params(self, key: jax.Array, dtype=jnp.float32) -> Dict:
+        c = self.config
+        D, F = c.hidden_size, c.intermediate_size
+        ks = jax.random.split(key, 6)
+        dense = lambda k, shape: (jax.random.normal(k, shape) *
+                                  c.initializer_range).astype(dtype)
+        ln = lambda: {"scale": jnp.ones((D,), dtype),
+                      "bias": jnp.zeros((D,), dtype)}
+        return {
+            "qkv": {"kernel": dense(ks[0], (D, 3 * D)),
+                    "bias": jnp.zeros((3 * D,), dtype)},
+            "attn_out": {"kernel": dense(ks[1], (D, D)),
+                         "bias": jnp.zeros((D,), dtype)},
+            "attn_ln": ln(),
+            "fc1": {"kernel": dense(ks[2], (D, F)),
+                    "bias": jnp.zeros((F,), dtype)},
+            "fc2": {"kernel": dense(ks[3], (F, D)),
+                    "bias": jnp.zeros((D,), dtype)},
+            "out_ln": ln(),
+        }
+
+    def __call__(self, params: Dict, x: jnp.ndarray,
+                 attention_mask: Optional[jnp.ndarray] = None,
+                 rng: Optional[jax.Array] = None,
+                 deterministic: bool = True) -> jnp.ndarray:
+        c = self.config
+        B, S, D = x.shape
+        H = c.heads
+        hd = D // H
+        eps = c.layer_norm_eps
+
+        def dropout(h, r, ratio):
+            if deterministic or ratio == 0 or r is None:
+                return h
+            keep = 1.0 - ratio
+            mask = jax.random.bernoulli(r, keep, h.shape)
+            return jnp.where(mask, h / keep, 0)
+
+        r1 = r2 = r3 = None
+        if rng is not None:
+            r1, r2, r3 = jax.random.split(rng, 3)
+
+        h_in = layer_norm(x, params["attn_ln"]["scale"], params["attn_ln"]["bias"], eps) if c.pre_layer_norm else x
+        qkv = h_in @ params["qkv"]["kernel"] + params["qkv"]["bias"]
+        q, k, v = jnp.split(qkv.reshape(B, S, 3, H, hd), 3, axis=2)
+        q, k, v = (t[:, :, 0] for t in (q, k, v))
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                            k.astype(jnp.float32)) / math.sqrt(hd)
+        if attention_mask is not None:
+            scores = scores + jnp.where(
+                attention_mask[:, None, None, :].astype(bool), 0.0, -1e30)
+        probs = jax.nn.softmax(scores, axis=-1).astype(x.dtype)
+        probs = dropout(probs, r3, c.attn_dropout_ratio)
+        o = jnp.einsum("bhqk,bkhd->bqhd", probs, v).reshape(B, S, D)
+        attn = dropout(o @ params["attn_out"]["kernel"] +
+                       params["attn_out"]["bias"], r1, c.hidden_dropout_ratio)
+        x = x + attn
+        if not c.pre_layer_norm:
+            x = layer_norm(x, params["attn_ln"]["scale"], params["attn_ln"]["bias"], eps)
+
+        h_in = layer_norm(x, params["out_ln"]["scale"], params["out_ln"]["bias"], eps) if c.pre_layer_norm else x
+        h = jax.nn.gelu(h_in @ params["fc1"]["kernel"] + params["fc1"]["bias"])
+        mlp = dropout(h @ params["fc2"]["kernel"] + params["fc2"]["bias"], r2,
+                      c.hidden_dropout_ratio)
+        x = x + mlp
+        if not c.pre_layer_norm:
+            x = layer_norm(x, params["out_ln"]["scale"], params["out_ln"]["bias"], eps)
+        return x

@@ -1,0 +1,89 @@
+"""FP16 unfused optimizer (reference: runtime/fp16/unfused_optimizer.py —
+``FP16_UnfusedOptimizer``: per-parameter fp32 masters + dynamic loss
+scaling, no flat buffers).
+
+In the functional engine, "fused vs unfused" flat-buffer layouts don't
+exist (optax updates are per-leaf by construction), so this class provides
+the reference's USER-FACING loop API for people driving their own steps:
+``backward(loss_fn, params, batch)`` → scaled grads, ``step()`` →
+unscale + clip + update with overflow skip.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from .loss_scaler import create_loss_scaler
+
+
+class FP16_UnfusedOptimizer:
+    def __init__(self, optimizer: optax.GradientTransformation, params: Any,
+                 static_loss_scale: Optional[float] = None,
+                 dynamic_loss_scale: bool = True, clip_grad: float = 0.0):
+        self.optimizer = optimizer
+        #: fp32 masters, per-parameter (no flat buffers — the "unfused" layout)
+        self.params = jax.tree.map(lambda p: jnp.asarray(p, jnp.float32), params)
+        self.opt_state = optimizer.init(self.params)
+
+        class _C:  # minimal fp16-config shim for create_loss_scaler
+            enabled = True
+            loss_scale = 0.0 if dynamic_loss_scale else (static_loss_scale or 1.0)
+            initial_scale_power = 16
+            loss_scale_window = 1000
+            hysteresis = 2
+            min_loss_scale = 1.0
+            consecutive_hysteresis = False
+
+        self.loss_scaler = create_loss_scaler(_C(), jnp.float16)
+        self.scaler_state = self.loss_scaler.init()
+        self.clip_grad = clip_grad
+        self._grads = None
+        self.overflow = False
+        self.skipped_steps = 0
+
+    # ------------------------------------------------------------------ #
+    def backward(self, loss_fn: Callable, *args) -> jnp.ndarray:
+        """Compute scaled grads of ``loss_fn(params, *args)``."""
+        def scaled(p):
+            loss = loss_fn(p, *args)
+            return self.loss_scaler.scale_loss(loss.astype(jnp.float32),
+                                               self.scaler_state), loss
+
+        grads, loss = jax.grad(scaled, has_aux=True)(self.params)
+        self._grads = grads
+        return loss
+
+    def step(self) -> bool:
+        """Unscale, clip, update; returns True when the step applied
+        (False = overflow skipped, scale reduced)."""
+        assert self._grads is not None, "call backward() first"
+        grads = self.loss_scaler.unscale_grads(self._grads, self.scaler_state)
+        finite = all(bool(jnp.isfinite(g).all())
+                     for g in jax.tree.leaves(grads))
+        if not finite:
+            self.overflow = True
+            self.skipped_steps += 1
+            self.scaler_state = self.loss_scaler.update(
+                self.scaler_state, jnp.asarray(True))
+            self._grads = None
+            return False
+        self.overflow = False
+        if self.clip_grad and self.clip_grad > 0:
+            norm = jnp.sqrt(sum(jnp.sum(jnp.square(g))
+                                for g in jax.tree.leaves(grads)))
+            scale = jnp.minimum(1.0, self.clip_grad / (norm + 1e-6))
+            grads = jax.tree.map(lambda g: g * scale, grads)
+        updates, self.opt_state = self.optimizer.update(
+            grads, self.opt_state, self.params)
+        self.params = optax.apply_updates(self.params, updates)
+        self.scaler_state = self.loss_scaler.update(
+            self.scaler_state, jnp.asarray(False))
+        self._grads = None
+        return True
+
+    @property
+    def loss_scale(self) -> float:
+        return float(self.scaler_state.scale)
