@@ -13,8 +13,9 @@ wedge; backend init is therefore probed in a subprocess with a timeout
 a parseable JSON result instead of a crash.
 
 Env knobs: DSTPU_BENCH_LAYERS / HIDDEN / SEQ / BATCH / STEPS,
-DSTPU_BENCH_MODE (train | flash_sweep), DSTPU_BENCH_FORCE_CPU=1,
-DSTPU_BENCH_PROBE_TIMEOUT (seconds, default 300).
+DSTPU_BENCH_MODE (train | flash_sweep | serving), DSTPU_BENCH_FORCE_CPU=1,
+DSTPU_BENCH_PROBE_TIMEOUT (seconds, default 300); serving mode also reads
+DSTPU_BENCH_CTX (context length) and DSTPU_BENCH_CHUNK (splitfuse chunk).
 """
 from __future__ import annotations
 
@@ -182,6 +183,77 @@ def run_train_bench(on_tpu: bool, tpu_reason: str) -> None:
          "tokens/s/chip", round(mfu / 0.50, 4), extra)
 
 
+def run_serving_bench(on_tpu: bool) -> None:
+    """Paged vs gather serving attention throughput (VERDICT item 2's
+    micro-bench): prefill + decode tokens/s at DSTPU_BENCH_CTX context."""
+    import deepspeed_tpu  # noqa: F401
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2,
+        RaggedInferenceEngineConfig,
+    )
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+    from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+    initialize_mesh(TopologyConfig(), force=True)
+    ctx = env_int("DSTPU_BENCH_CTX", 8192 if on_tpu else 512)
+    chunk = env_int("DSTPU_BENCH_CHUNK", 512 if on_tpu else 64)
+    decode_steps = env_int("DSTPU_BENCH_STEPS", 32 if on_tpu else 4)
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=2816,
+            num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=ctx,
+            use_flash=True)
+    else:
+        cfg = TransformerConfig(vocab_size=256, hidden_size=64,
+                                intermediate_size=128, num_layers=2,
+                                num_heads=4, num_kv_heads=2, max_seq_len=ctx,
+                                use_flash=False)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    prompt = rng.integers(1, cfg.vocab_size, size=ctx - decode_steps - 1).tolist()
+
+    results = {}
+    for impl in ("paged", "gather"):
+        try:
+            eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+                max_tokens=chunk, max_seqs=4, max_ctx=ctx, block_size=64,
+                attn_impl=impl))
+            # prefill in splitfuse chunks
+            t0 = time.perf_counter()
+            pos = 0
+            while pos < len(prompt):
+                eng.put([0], [prompt[pos:pos + chunk]])
+                pos += chunk
+            jax.block_until_ready(eng.kv.k)
+            prefill_t = time.perf_counter() - t0
+            # decode
+            t0 = time.perf_counter()
+            tok = prompt[-1]
+            for _ in range(decode_steps):
+                logits = eng.put([0], [[tok]])
+                tok = int(jnp.argmax(logits[0]))
+            jax.block_until_ready(logits)
+            decode_t = time.perf_counter() - t0
+            eng.flush([0])
+            results[impl] = {
+                "prefill_tok_s": round(len(prompt) / prefill_t, 1),
+                "decode_tok_s": round(decode_steps / decode_t, 2),
+            }
+            log(f"{impl}: prefill {results[impl]['prefill_tok_s']} tok/s, "
+                f"decode {results[impl]['decode_tok_s']} tok/s @ctx={ctx}")
+        except Exception as exc:  # noqa: BLE001
+            results[impl] = {"error": str(exc)[-200:]}
+            log(f"{impl}: FAILED {str(exc)[:160]}")
+
+    paged = results.get("paged", {}).get("decode_tok_s", 0.0) or 0.0
+    gather = results.get("gather", {}).get("decode_tok_s", 0.0) or 0.0
+    emit("serving_decode_tokens_per_sec", paged, "tokens/s",
+         round(paged / gather, 3) if gather else 0.0,
+         {"ctx": ctx, "chunk": chunk, "results": results,
+          "backend": jax.default_backend()})
+
+
 def run_flash_sweep(on_tpu: bool) -> None:
     """Sweep flash-attention block sizes; one JSON line with the best config
     and the full table in extra (recorded for kernel tuning)."""
@@ -241,9 +313,10 @@ def main():
         log(f"probe: tpu_ok={tpu_ok} ({reason})")
     if not tpu_ok:
         force_cpu_backend()
-    fail_metric, fail_unit = (
-        ("flash_attention_tflops", "TFLOP/s") if mode == "flash_sweep"
-        else ("zero_train_tokens_per_sec_per_chip", "tokens/s/chip"))
+    fail_metric, fail_unit = {
+        "flash_sweep": ("flash_attention_tflops", "TFLOP/s"),
+        "serving": ("serving_decode_tokens_per_sec", "tokens/s"),
+    }.get(mode, ("zero_train_tokens_per_sec_per_chip", "tokens/s/chip"))
     try:
         backend = jax.default_backend()
     except Exception as exc:  # noqa: BLE001
@@ -256,6 +329,8 @@ def main():
     try:
         if mode == "flash_sweep":
             run_flash_sweep(on_tpu)
+        elif mode == "serving":
+            run_serving_bench(on_tpu)
         else:
             run_train_bench(on_tpu, reason)
     except Exception as exc:  # noqa: BLE001

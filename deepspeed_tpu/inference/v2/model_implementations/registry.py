@@ -62,11 +62,19 @@ _IMPLS: Dict[str, ModelImplementation] = {}
 
 def _ensure_impls() -> Dict[str, ModelImplementation]:
     """Built lazily on first lookup (keeps importing this registry from
-    pulling in the whole model stack) and derived from models/hf.py's
-    policy map so the two tables cannot drift."""
+    pulling in the whole model stack), derived from models/hf.py's policy
+    map; _BUILDABLE_FAMILIES is the one local judgment (which families have
+    end-to-end recipes) and is validated against the policy map so a new
+    family shows up as a loud assertion, not a silent omission."""
     if not _IMPLS:
         from ....models.hf import _ARCH_POLICIES, NATIVE_FAMILIES
 
+        known = set(_ARCH_POLICIES.values())
+        unknown = set(_BUILDABLE_FAMILIES) - known
+        assert not unknown, f"buildable families not in policy map: {unknown}"
+        missing = known - set(_BUILDABLE_FAMILIES) - {"gptj"}
+        assert not missing, (f"families {missing} added to the policy map "
+                             f"but not classified here as buildable/not")
         _IMPLS.update({arch: ModelImplementation(
             arch, fam, fam in NATIVE_FAMILIES, _NOTES.get(arch, ""))
             for arch, fam in _ARCH_POLICIES.items()

@@ -32,15 +32,16 @@ class DSModuleRegistry:
                 cls._loading = False
 
     @classmethod
-    def register(cls, interface: str, name: str, impl: Callable) -> None:
-        # builtins load first so a user registration under a builtin name
-        # OVERRIDES it (the pre-lazy behavior) rather than being clobbered
-        # by the deferred builtin load
-        cls._ensure_builtins()
+    def register(cls, interface: str, name: str, impl: Callable,
+                 _builtin: bool = False) -> None:
         if interface not in INTERFACES:
             raise ValueError(f"unknown interface {interface!r}; "
                              f"known: {INTERFACES}")
-        cls._registry[(interface, name)] = impl
+        if _builtin:
+            # deferred builtin load must never clobber a user registration
+            cls._registry.setdefault((interface, name), impl)
+        else:
+            cls._registry[(interface, name)] = impl
 
     @classmethod
     def get(cls, interface: str, name: str) -> Callable:
@@ -87,29 +88,29 @@ def _register_builtins():
     from ..kernels.ragged_ops import paged_attention
     from ..model_runner import _attend_gather
 
-    DSModuleRegistry.register("attention", "paged", paged_attention)
-    DSModuleRegistry.register("attention", "gather", _attend_gather)
+    DSModuleRegistry.register("attention", "paged", paged_attention, _builtin=True)
+    DSModuleRegistry.register("attention", "gather", _attend_gather, _builtin=True)
 
     DSModuleRegistry.register(
         "linear", "dense",
-        lambda x, p: (x @ p["kernel"]) + p.get("bias", 0))
+        lambda x, p: (x @ p["kernel"]) + p.get("bias", 0), _builtin=True)
 
     from ....moe.sharded_moe import moe_mlp_block
 
-    DSModuleRegistry.register("moe", "sparse", moe_mlp_block)
+    DSModuleRegistry.register("moe", "sparse", moe_mlp_block, _builtin=True)
 
     DSModuleRegistry.register(
         "embedding", "lookup",
-        lambda tokens, p: jnp.take(p["embedding"], tokens, axis=0))
+        lambda tokens, p: jnp.take(p["embedding"], tokens, axis=0), _builtin=True)
 
-    DSModuleRegistry.register("norm", "rmsnorm", rms_norm)
+    DSModuleRegistry.register("norm", "rmsnorm", rms_norm, _builtin=True)
     from ....models.families import layer_norm
 
-    DSModuleRegistry.register("norm", "layernorm", layer_norm)
+    DSModuleRegistry.register("norm", "layernorm", layer_norm, _builtin=True)
 
     DSModuleRegistry.register(
         "unembed", "tied",
-        lambda h, p: h @ p["embedding"].T)
+        lambda h, p: h @ p["embedding"].T, _builtin=True)
     DSModuleRegistry.register(
         "unembed", "lm_head",
-        lambda h, p: h @ p["kernel"])
+        lambda h, p: h @ p["kernel"], _builtin=True)

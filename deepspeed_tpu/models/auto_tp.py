@@ -58,12 +58,13 @@ def _path_str(path) -> str:
 
 
 def autotp_specs(params: Any, tp_size: int,
-                 stacked_leading_dims: int = 0) -> Any:
+                 stacked_leading_dims: int = 1) -> Any:
     """Infer a TP ``PartitionSpec`` tree for an arbitrary param pytree.
 
-    ``stacked_leading_dims``: number of leading stacked-layer dims (1 for
-    this repo's [L, ...] layer arrays under "layers.") that must never be
-    sharded by TP.
+    ``stacked_leading_dims``: number of leading stacked-layer dims under
+    "layers." (1 for this repo's [L, ...] arrays — the default, matching
+    :func:`autotp_shard`) that must never be sharded by TP; pass 0 for
+    flat per-layer trees.
     """
     def leaf_spec(path, x):
         ndim = getattr(x, "ndim", 0)

@@ -242,6 +242,12 @@ class DeepSpeedConfig:
         self.aio_config = AioConfig(**config.get("aio", {}))
         self.data_efficiency = DataEfficiencyConfig(**config.get("data_efficiency", {}))
         self.curriculum_learning = config.get("curriculum_learning", {})
+        # SURVEY §5's explicit TPU ask: a determinism/NaN-check debug mode
+        # (the reference has no in-tree sanitizer; closest is stage3
+        # safe_mode asserts)
+        dbg = config.get("debug", {})
+        self.debug_deterministic: bool = bool(dbg.get("deterministic", False))
+        self.debug_nan_check: bool = bool(dbg.get("nan_check", False))
         self.compression_config = CompressionConfig(**config.get("compression_training", {}))
         self.elasticity = ElasticityConfig(**config.get("elasticity", {}))
         self.autotuning_config = AutotuningConfig(**config.get("autotuning", {}))

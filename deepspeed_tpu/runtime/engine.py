@@ -93,6 +93,18 @@ class DeepSpeedEngine:
             steps_per_output=config.steps_per_print,
             logging_fn=lambda m: log_dist(m, ranks=[0]))
 
+        # ---- debug mode (SURVEY §5 determinism/NaN-check ask) --------- #
+        if getattr(config, "debug_deterministic", False):
+            # bitwise-reproducible runs: pin matmul precision (XLA's TPU
+            # default is already deterministic given fixed precision/seeds)
+            jax.config.update("jax_default_matmul_precision", "highest")
+            log_dist("debug.deterministic: matmul precision pinned to "
+                     "highest; PRNG is counter-based (seed arg)", ranks=[0])
+        if getattr(config, "debug_nan_check", False):
+            # raise at the op producing the first NaN instead of training on
+            jax.config.update("jax_debug_nans", True)
+            log_dist("debug.nan_check: jax_debug_nans enabled", ranks=[0])
+
         self.loss_fn = self._resolve_loss_fn(model)
         self.compute_dtype = config.dtype
         self.zero_stage = config.zero_config.stage
@@ -452,6 +464,12 @@ class DeepSpeedEngine:
         self.tput_timer.stop(sync=loss)
         if self.config.wall_clock_breakdown:
             self._timers("step").stop(sync=loss)
+        if getattr(self.config, "debug_nan_check", False) and \
+                not np.isfinite(float(loss)):
+            raise RuntimeError(
+                f"debug.nan_check: non-finite loss {float(loss)} at step "
+                f"{self.global_steps} (note: fp16 dynamic loss scaling "
+                f"intentionally overflows — use nan_check with bf16)")
         self._post_step_logging(loss, batch)
         return loss
 
