@@ -222,14 +222,15 @@ def run_serving_bench(on_tpu: bool) -> None:
             # prefill in splitfuse chunks
             t0 = time.perf_counter()
             pos = 0
+            logits = None
             while pos < len(prompt):
-                eng.put([0], [prompt[pos:pos + chunk]])
+                logits = eng.put([0], [prompt[pos:pos + chunk]])
                 pos += chunk
             jax.block_until_ready(eng.kv.k)
             prefill_t = time.perf_counter() - t0
-            # decode
+            # decode, seeded by the prefill's predicted next token
             t0 = time.perf_counter()
-            tok = prompt[-1]
+            tok = int(jnp.argmax(logits[0]))
             for _ in range(decode_steps):
                 logits = eng.put([0], [[tok]])
                 tok = int(jnp.argmax(logits[0]))

@@ -8,25 +8,19 @@ ctypes — the op_builder JIT-load pattern, TPU-host flavored.
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 from typing import Optional
 
 import numpy as np
 
-_SRC = os.path.join(os.path.dirname(__file__), "..", "..", "csrc", "aio_engine.cpp")
-_SO = os.path.join(os.path.dirname(__file__), "libdstpu_aio.so")
 _LIB: Optional[ctypes.CDLL] = None
 
 
 def _build() -> str:
-    src = os.path.abspath(_SRC)
-    so = os.path.abspath(_SO)
-    if not os.path.exists(so) or os.path.getmtime(so) < os.path.getmtime(src):
-        cmd = ["g++", "-O3", "-shared", "-fPIC", "-std=c++17", "-pthread",
-               src, "-o", so]
-        subprocess.run(cmd, check=True, capture_output=True)
-    return so
+    """Version-cached build via the op_builder framework (hash-keyed cache;
+    a source edit rebuilds cleanly, unchanged sources load instantly)."""
+    from ..op_builder import AsyncIOBuilder
+
+    return AsyncIOBuilder().jit_load()
 
 
 def _lib() -> ctypes.CDLL:

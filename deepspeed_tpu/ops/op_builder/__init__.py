@@ -1,0 +1,5 @@
+"""Op builder framework (reference: op_builder/builder.py:117 ``OpBuilder``
+ABC + jit_load :542 + all_ops.py registry)."""
+from .builder import ALL_OPS, AsyncIOBuilder, OpBuilder, get_builder
+
+__all__ = ["OpBuilder", "AsyncIOBuilder", "ALL_OPS", "get_builder"]

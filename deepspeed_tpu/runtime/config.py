@@ -244,10 +244,14 @@ class DeepSpeedConfig:
         self.curriculum_learning = config.get("curriculum_learning", {})
         # SURVEY §5's explicit TPU ask: a determinism/NaN-check debug mode
         # (the reference has no in-tree sanitizer; closest is stage3
-        # safe_mode asserts)
-        dbg = config.get("debug", {})
-        self.debug_deterministic: bool = bool(dbg.get("deterministic", False))
-        self.debug_nan_check: bool = bool(dbg.get("nan_check", False))
+        # safe_mode asserts).  Unknown keys raise — a typo silently
+        # disabling a DEBUG mode is the failure it exists to prevent.
+        dbg = dict(config.get("debug", {}))
+        self.debug_deterministic: bool = bool(dbg.pop("deterministic", False))
+        self.debug_nan_check: bool = bool(dbg.pop("nan_check", False))
+        if dbg:
+            raise ValueError(f"unknown debug config keys: {sorted(dbg)}; "
+                             f"known: ['deterministic', 'nan_check']")
         self.compression_config = CompressionConfig(**config.get("compression_training", {}))
         self.elasticity = ElasticityConfig(**config.get("elasticity", {}))
         self.autotuning_config = AutotuningConfig(**config.get("autotuning", {}))

@@ -94,16 +94,21 @@ class DeepSpeedEngine:
             logging_fn=lambda m: log_dist(m, ranks=[0]))
 
         # ---- debug mode (SURVEY §5 determinism/NaN-check ask) --------- #
+        # These toggle PROCESS-GLOBAL jax config (debug modes are process
+        # properties, like the reference's env-driven sanitizers); call
+        # DeepSpeedEngine.reset_debug_mode() to clear them.
         if getattr(config, "debug_deterministic", False):
             # bitwise-reproducible runs: pin matmul precision (XLA's TPU
             # default is already deterministic given fixed precision/seeds)
             jax.config.update("jax_default_matmul_precision", "highest")
             log_dist("debug.deterministic: matmul precision pinned to "
-                     "highest; PRNG is counter-based (seed arg)", ranks=[0])
+                     "highest (process-global); PRNG is counter-based",
+                     ranks=[0])
         if getattr(config, "debug_nan_check", False):
             # raise at the op producing the first NaN instead of training on
             jax.config.update("jax_debug_nans", True)
-            log_dist("debug.nan_check: jax_debug_nans enabled", ranks=[0])
+            log_dist("debug.nan_check: jax_debug_nans enabled "
+                     "(process-global)", ranks=[0])
 
         self.loss_fn = self._resolve_loss_fn(model)
         self.compute_dtype = config.dtype
@@ -516,6 +521,13 @@ class DeepSpeedEngine:
     @property
     def is_compiled(self) -> bool:
         return bool(getattr(self, "_is_compiled", False))
+
+    @staticmethod
+    def reset_debug_mode():
+        """Clear the process-global debug toggles an engine's debug config
+        enabled (deterministic matmul pinning + jax_debug_nans)."""
+        jax.config.update("jax_debug_nans", False)
+        jax.config.update("jax_default_matmul_precision", None)
 
     def no_sync(self):
         """Reference engine.no_sync(): skip grad allreduce between boundaries.

@@ -62,7 +62,17 @@ class TestDebugMode:
 
     def test_nan_check_off_tolerates(self):
         """Without the flag the engine's NaN-safe grad zeroing keeps going
-        (the production behavior the debug mode exists to override)."""
+        (the production behavior the debug mode exists to override) — the
+        SAME poisoned state that raises under nan_check trains on here."""
         eng = _engine({})
         eng.train_batch(_batch())
+        poisoned = jax.tree_util.tree_map_with_path(
+            lambda p, x: jnp.full_like(x, jnp.nan)
+            if "embed" in str(p) else x, eng.state.params)
+        eng.state = eng.state.replace(params=poisoned)
+        eng.train_batch(_batch())   # no raise: tolerated by design
         assert not getattr(eng.config, "debug_nan_check")
+
+    def test_unknown_debug_key_raises(self):
+        with pytest.raises(ValueError, match="unknown debug config"):
+            _engine({"determinstic": True})   # the typo a user would make
