@@ -37,6 +37,25 @@ class DeepSpeedDataSampler:
         self.global_batch_size = micro_batch_size * data_parallel_size * \
             gradient_accumulation_steps
 
+    @classmethod
+    def from_analysis(cls, save_path: str, metric_name: str,
+                      micro_batch_size: int, data_parallel_rank: int,
+                      data_parallel_size: int,
+                      curriculum: Optional[CurriculumScheduler] = None,
+                      **kw) -> "DeepSpeedDataSampler":
+        """Build from a DataAnalyzer run's outputs: the analyzer's
+        ``sample_to_metric`` array becomes the difficulty values (the full
+        offline-curriculum pipeline — analyze once, sample by difficulty)."""
+        from .data_analyzer import CurriculumMetricIndex
+
+        index = CurriculumMetricIndex(save_path, metric_name)
+        return cls(total_samples=len(index.sample_to_metric),
+                   micro_batch_size=micro_batch_size,
+                   data_parallel_rank=data_parallel_rank,
+                   data_parallel_size=data_parallel_size,
+                   curriculum=curriculum,
+                   difficulty_values=index.sample_to_metric, **kw)
+
     def set_epoch(self, epoch: int):
         self.epoch = epoch
 

@@ -59,6 +59,37 @@ class TestDataAnalyzer:
         v2 = np.load(multi / "seqlen_sample_to_metric.npy")
         np.testing.assert_array_equal(v1, v2)
 
+    def test_sampler_from_analysis_end_to_end(self, tmp_path):
+        """The full offline-curriculum pipeline: analyze → reduce → sample
+        by scheduled difficulty (reference DataAnalyzer + DeepSpeedDataSampler)."""
+        from deepspeed_tpu.runtime.data_pipeline.curriculum_scheduler import (
+            CurriculumScheduler,
+        )
+        from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
+            DataAnalyzer,
+            metric_seqlen,
+        )
+        from deepspeed_tpu.runtime.data_pipeline.data_sampler import (
+            DeepSpeedDataSampler,
+        )
+
+        ds = self._dataset()
+        an = DataAnalyzer(ds, str(tmp_path), ["seqlen"], [metric_seqlen])
+        an.run_map()
+        an.run_reduce()
+        sched = CurriculumScheduler({
+            "curriculum_type": "seqlen", "min_difficulty": 6,
+            "max_difficulty": 20, "schedule_type": "fixed_linear",
+            "schedule_config": {"total_curriculum_step": 10,
+                                "difficulty_step": 1}})
+        sampler = DeepSpeedDataSampler.from_analysis(
+            str(tmp_path), "seqlen", micro_batch_size=2,
+            data_parallel_rank=0, data_parallel_size=1, curriculum=sched)
+        first = next(iter(sampler))
+        # the first scheduled step only admits short samples
+        assert all(len(ds[i]["input_ids"]) <= 6 for i in first), \
+            [len(ds[i]["input_ids"]) for i in first]
+
     def test_vocab_rarity_metric(self):
         from deepspeed_tpu.runtime.data_pipeline.data_analyzer import (
             metric_vocab_rarity,
