@@ -13,30 +13,41 @@ from typing import Any, Dict, List, Tuple
 import jax
 import jax.numpy as jnp
 
-from ...ops.quantizer.quantizer import dequantize_int8, quantize_int8
+from ...ops.quantizer.quantizer import (
+    dequantize_int4,
+    dequantize_int8,
+    quantize_int4,
+    quantize_int8,
+)
 
 _MIN_QUANT_SIZE = 1 << 14  # don't quantize tiny tensors (norms, biases)
 
 
 def quantize_params(params: Any, group_size: int = 256,
-                    min_size: int = _MIN_QUANT_SIZE) -> Tuple[Any, Dict]:
+                    min_size: int = _MIN_QUANT_SIZE,
+                    bits: int = 8) -> Tuple[Any, Dict]:
     """→ (quantized pytree, meta). Quantized leaves become
-    {"__q__": int8, "__scale__": f32, "__shape__": ..., "__dtype__": ...}."""
+    {"__q__": int8 (packed pairs for bits=4), "__scale__": f32,
+    "__shape__": ..., "__dtype__": ..., "__bits__": ...}.  ``bits=4``
+    quarters serving weight HBM (the int4 serving path)."""
+    assert bits in (4, 8), bits
+    quant = quantize_int4 if bits == 4 else quantize_int8
     flat, treedef = jax.tree.flatten(params)
     out = []
     quantized = 0
     for leaf in flat:
         if hasattr(leaf, "size") and leaf.size >= min_size and leaf.ndim >= 2 and \
                 jnp.issubdtype(leaf.dtype, jnp.floating):
-            q, s = quantize_int8(leaf, group_size)
+            q, s = quant(leaf, group_size)
             out.append({"__q__": q, "__scale__": s,
                         "__shape__": tuple(leaf.shape),
-                        "__dtype__": str(leaf.dtype)})
+                        "__dtype__": str(leaf.dtype), "__bits__": bits})
             quantized += 1
         else:
             out.append(leaf)
     return jax.tree.unflatten(treedef, out), {"quantized_leaves": quantized,
-                                              "group_size": group_size}
+                                              "group_size": group_size,
+                                              "bits": bits}
 
 
 def dequantize_params(qparams: Any, dtype=jnp.bfloat16) -> Any:
@@ -48,8 +59,10 @@ def dequantize_params(qparams: Any, dtype=jnp.bfloat16) -> Any:
 
     def deq(node):
         if is_q(node):
-            return dequantize_int8(node["__q__"], node["__scale__"],
-                                   shape=node["__shape__"], dtype=dtype)
+            dequant = dequantize_int4 if node.get("__bits__", 8) == 4 \
+                else dequantize_int8
+            return dequant(node["__q__"], node["__scale__"],
+                           shape=node["__shape__"], dtype=dtype)
         return node
 
     return jax.tree.map(deq, qparams, is_leaf=is_q)

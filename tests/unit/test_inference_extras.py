@@ -10,6 +10,29 @@ from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
 
 class TestWeightOnlyQuant:
+    def test_int4_halves_int8_weight_bytes(self):
+        """bits=4 (the int4 serving path) stores packed nibble pairs —
+        half the int8 wire/HBM for the quantized leaves."""
+        import numpy as np
+
+        from deepspeed_tpu.inference.quantization import (
+            dequantize_params,
+            quantize_params,
+            quantized_memory_bytes,
+        )
+
+        rng = np.random.default_rng(0)
+        params = {"w": jnp.asarray(rng.normal(size=(256, 128)), jnp.float32)}
+        q8, m8 = quantize_params(params, min_size=1024, bits=8)
+        q4, m4 = quantize_params(params, min_size=1024, bits=4)
+        assert m8["bits"] == 8 and m4["bits"] == 4
+        assert quantized_memory_bytes(q4) < quantized_memory_bytes(q8) * 0.6
+        for qp, tol in ((q8, 0.03), (q4, 0.35)):
+            dq = dequantize_params(qp, dtype=jnp.float32)
+            rel = float(jnp.max(jnp.abs(dq["w"] - params["w"])) /
+                        jnp.max(jnp.abs(params["w"])))
+            assert rel < tol, rel
+
     def test_quant_dequant_forward_close(self):
         from deepspeed_tpu.inference.quantization import (
             dequantize_params,
