@@ -1,10 +1,10 @@
 """Weight-only quantization for serving (reference: deepspeed/inference/
 quantization/ — layers.py wraps Linear in quantized versions).
 
-Functional version: quantize a parameter pytree's matmul kernels to int8
-groupwise (Pallas kernels), keep a spec of quantized leaves, and dequantize
-on-the-fly inside the forward.  Halves serving HBM for the weights; the
-dequant fuses into the matmul prologue under XLA.
+Functional version: quantize a parameter pytree's matmul kernels groupwise
+(Pallas kernels, int8 or packed int4), keep a spec of quantized leaves, and
+dequantize on-the-fly inside the forward.  int8 halves / int4 quarters the
+serving weight HBM; the dequant fuses into the matmul prologue under XLA.
 """
 from __future__ import annotations
 
@@ -13,12 +13,7 @@ from typing import Any, Dict, List, Tuple
 import jax
 import jax.numpy as jnp
 
-from ...ops.quantizer.quantizer import (
-    dequantize_int4,
-    dequantize_int8,
-    quantize_int4,
-    quantize_int8,
-)
+from ...ops.quantizer.quantizer import get_quant_fns
 
 _MIN_QUANT_SIZE = 1 << 14  # don't quantize tiny tensors (norms, biases)
 
@@ -30,8 +25,7 @@ def quantize_params(params: Any, group_size: int = 256,
     {"__q__": int8 (packed pairs for bits=4), "__scale__": f32,
     "__shape__": ..., "__dtype__": ..., "__bits__": ...}.  ``bits=4``
     quarters serving weight HBM (the int4 serving path)."""
-    assert bits in (4, 8), bits
-    quant = quantize_int4 if bits == 4 else quantize_int8
+    quant, _ = get_quant_fns(bits)
     flat, treedef = jax.tree.flatten(params)
     out = []
     quantized = 0
@@ -59,8 +53,7 @@ def dequantize_params(qparams: Any, dtype=jnp.bfloat16) -> Any:
 
     def deq(node):
         if is_q(node):
-            dequant = dequantize_int4 if node.get("__bits__", 8) == 4 \
-                else dequantize_int8
+            dequant = get_quant_fns(node.get("__bits__", 8))[1]
             return dequant(node["__q__"], node["__scale__"],
                            shape=node["__shape__"], dtype=dtype)
         return node

@@ -120,6 +120,16 @@ def dequantize_int4(packed: jnp.ndarray, scales: jnp.ndarray, shape=None,
     return flat.astype(dtype)
 
 
+def get_quant_fns(bits: int):
+    """(quantize, dequantize) pair for a bit width — the ONE dispatch table
+    (used by ZeRO++ comm, weight-only serving, and the Quantizer class)."""
+    if bits == 4:
+        return quantize_int4, dequantize_int4
+    if bits == 8:
+        return quantize_int8, dequantize_int8
+    raise ValueError(f"bits must be 4 or 8, got {bits}")
+
+
 class Quantizer:
     """Reference binding-class shape (deepspeed/ops/quantizer/quantizer.py)."""
 
@@ -129,9 +139,7 @@ class Quantizer:
         self.group_size = group_size
 
     def quantize(self, x):
-        fn = quantize_int8 if self.q_bits == 8 else quantize_int4
-        return fn(x, self.group_size)
+        return get_quant_fns(self.q_bits)[0](x, self.group_size)
 
     def dequantize(self, q, scales, shape=None, dtype=jnp.float32):
-        fn = dequantize_int8 if self.q_bits == 8 else dequantize_int4
-        return fn(q, scales, shape, dtype)
+        return get_quant_fns(self.q_bits)[1](q, scales, shape, dtype)

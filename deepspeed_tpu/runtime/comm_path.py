@@ -30,20 +30,9 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
-from ..ops.quantizer.quantizer import (
-    dequantize_int4,
-    dequantize_int8,
-    quantize_int4,
-    quantize_int8,
-)
+from ..ops.quantizer.quantizer import get_quant_fns
 from .sparse_tensor import SparseTensor, sparse_allreduce
 from .topology import DATA, DATA_OUTER
-
-
-def _quant_fns(bits: int):
-    if bits == 4:
-        return quantize_int4, dequantize_int4
-    return quantize_int8, dequantize_int8
 
 
 def dp_axes_info(topology):
@@ -85,7 +74,7 @@ def quantized_allreduce(grad: jnp.ndarray, axes, bits: int = 8,
     n = jax.lax.psum(1, axes)
     if n <= 1:
         return grad, error, server_error
-    quant, dequant = _quant_fns(bits)
+    quant, dequant = get_quant_fns(bits)
     flat = grad.reshape(-1).astype(jnp.float32)
     if error is not None:
         flat = flat + error.reshape(-1)
@@ -134,7 +123,7 @@ def quantized_all_gather_shard(shard: jnp.ndarray, axes, dim: int,
     n = jax.lax.psum(1, axes)
     if n <= 1:
         return shard.astype(out_dtype)
-    quant, dequant = _quant_fns(bits)
+    quant, dequant = get_quant_fns(bits)
     flat = shard.reshape(-1)
     q, s = quant(flat, group_size)
     q_all = jax.lax.all_gather(q, axes, axis=0, tiled=False)     # [n, g, w]
