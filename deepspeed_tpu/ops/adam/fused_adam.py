@@ -27,6 +27,30 @@ def _interpret() -> bool:
     return jax.default_backend() != "tpu"
 
 
+def _tile_plan(shape):
+    """Shared (rows, width, pad, flat2d, unflat, spec, grid) tiling for the
+    streaming optimizer kernels — ONE copy of the flatten-to-(rows, 128)
+    scaffolding used by adam/lion/adagrad (and ops/lamb)."""
+    n = int(np.prod(shape)) if shape else 1
+    width = 128
+    rows = -(-n // width)
+    pad = rows * width - n
+
+    def flat2d(x):
+        f = x.reshape(-1).astype(jnp.float32)
+        if pad:
+            f = jnp.pad(f, (0, pad))
+        return f.reshape(rows, width)
+
+    def unflat(x):
+        return x.reshape(-1)[:n].reshape(shape)
+
+    block_rows = max(min(rows, BLOCK // width), 8)
+    spec = pl.BlockSpec((block_rows, width), lambda i: (i, 0))
+    grid = (-(-rows // block_rows),)
+    return rows, width, flat2d, unflat, spec, grid
+
+
 def _adam_kernel(p_ref, g_ref, m_ref, v_ref, bc1_ref, bc2_ref, lr_ref,
                  p_out, m_out, v_out,
                  *, beta1, beta2, eps, weight_decay, adam_w_mode):
@@ -59,27 +83,13 @@ def fused_adam_update(p, g, m, v, step, lr=1e-3, beta1=0.9, beta2=0.999,
                       bias_correction=True):
     """Single-array fused Adam step; returns (p', m', v')."""
     shape, dtype = p.shape, p.dtype
-    n = int(np.prod(shape)) if shape else 1
-    # pad to a TPU-friendly 2D tile
-    width = 128
-    rows = -(-n // width)
-    pad = rows * width - n
-
-    def flat2d(x):
-        f = x.reshape(-1).astype(jnp.float32)
-        if pad:
-            f = jnp.pad(f, (0, pad))
-        return f.reshape(rows, width)
-
+    rows, width, flat2d, unflat, spec, grid = _tile_plan(shape)
     pf, gf, mf, vf = map(flat2d, (p, g, m, v))
     t = step.astype(jnp.float32) + 1.0
     bc1 = (1.0 - beta1 ** t if bias_correction else jnp.float32(1.0)).reshape(1, 1)
     bc2 = (1.0 - beta2 ** t if bias_correction else jnp.float32(1.0)).reshape(1, 1)
     lr_arr = jnp.asarray(lr, jnp.float32).reshape(1, 1)
 
-    block_rows = max(min(rows, BLOCK // width), 8)
-    grid = (-(-rows // block_rows),)
-    spec = pl.BlockSpec((block_rows, width), lambda i: (i, 0))
     kernel = functools.partial(
         _adam_kernel, beta1=beta1, beta2=beta2, eps=eps,
         weight_decay=weight_decay, adam_w_mode=adam_w_mode)
@@ -95,7 +105,6 @@ def fused_adam_update(p, g, m, v, step, lr=1e-3, beta1=0.9, beta2=0.999,
         interpret=_interpret(),
     )(pf, gf, mf, vf, bc1, bc2, lr_arr)
 
-    unflat = lambda x: x.reshape(-1)[:n].reshape(shape)
     return unflat(p2).astype(dtype), unflat(m2), unflat(v2)
 
 
@@ -166,33 +175,69 @@ def _lion_kernel(p_ref, g_ref, m_ref, lr_ref, p_out, m_out,
 
 def fused_lion_update(p, g, m, lr=1e-4, beta1=0.9, beta2=0.99, weight_decay=0.0):
     shape, dtype = p.shape, p.dtype
-    n = int(np.prod(shape)) if shape else 1
-    width = 128
-    rows = -(-n // width)
-    pad = rows * width - n
-
-    def flat2d(x):
-        f = x.reshape(-1).astype(jnp.float32)
-        if pad:
-            f = jnp.pad(f, (0, pad))
-        return f.reshape(rows, width)
-
+    rows, width, flat2d, unflat, spec, grid = _tile_plan(shape)
     pf, gf, mf = map(flat2d, (p, g, m))
     lr_arr = jnp.asarray(lr, jnp.float32).reshape(1, 1)
-    block_rows = max(min(rows, BLOCK // width), 8)
-    spec = pl.BlockSpec((block_rows, width), lambda i: (i, 0))
     p2, m2 = pl.pallas_call(
         functools.partial(_lion_kernel, beta1=beta1, beta2=beta2,
                           weight_decay=weight_decay),
-        grid=(-(-rows // block_rows),),
+        grid=grid,
         in_specs=[spec, spec, spec,
                   pl.BlockSpec(memory_space=pltpu.SMEM)],
         out_specs=[spec, spec],
         out_shape=[jax.ShapeDtypeStruct((rows, width), jnp.float32)] * 2,
         interpret=_interpret(),
     )(pf, gf, mf, lr_arr)
-    unflat = lambda x: x.reshape(-1)[:n].reshape(shape)
     return unflat(p2).astype(dtype), unflat(m2)
+
+
+# ------------------------------------------------------------------ #
+# Adagrad (reference ⚙: csrc/adagrad/cpu_adagrad.cpp)
+# ------------------------------------------------------------------ #
+def _adagrad_kernel(p_ref, g_ref, a_ref, lr_ref, p_out, a_out,
+                    *, eps, weight_decay):
+    p = p_ref[:].astype(jnp.float32)
+    g = g_ref[:].astype(jnp.float32)
+    a = a_ref[:].astype(jnp.float32)
+    lr = lr_ref[0, 0]
+    if weight_decay:
+        g = g + weight_decay * p
+    a_new = a + g * g
+    p_out[:] = (p - lr * g / (jnp.sqrt(a_new) + eps)).astype(p_out.dtype)
+    a_out[:] = a_new
+
+
+def fused_adagrad_update(p, g, a, lr=1e-2, eps=1e-10, weight_decay=0.0):
+    """Single-array fused Adagrad step → (p', accumulator')."""
+    shape, dtype = p.shape, p.dtype
+    rows, width, flat2d, unflat, spec, grid = _tile_plan(shape)
+    pf, gf, af = map(flat2d, (p, g, a))
+    lr_arr = jnp.asarray(lr, jnp.float32).reshape(1, 1)
+    p2, a2 = pl.pallas_call(
+        functools.partial(_adagrad_kernel, eps=eps, weight_decay=weight_decay),
+        grid=grid,
+        in_specs=[spec, spec, spec,
+                  pl.BlockSpec(memory_space=pltpu.SMEM)],
+        out_specs=[spec, spec],
+        out_shape=[jax.ShapeDtypeStruct((rows, width), jnp.float32)] * 2,
+        interpret=_interpret(),
+    )(pf, gf, af, lr_arr)
+    return unflat(p2).astype(dtype), unflat(a2)
+
+
+class FusedAdagradState(NamedTuple):
+    count: jnp.ndarray
+    acc: Any
+
+
+def fused_adagrad(learning_rate=1e-2, eps=1e-10,
+                  weight_decay=0.0) -> optax.GradientTransformation:
+    """Optax-compatible fused Adagrad (reference ops/adagrad)."""
+    def leaf(lr, count, p, g, a):
+        return fused_adagrad_update(p, g, a, lr=lr, eps=eps,
+                                    weight_decay=weight_decay)
+
+    return optax_wrap(leaf, FusedAdagradState, 1, learning_rate)
 
 
 class FusedLionState(NamedTuple):

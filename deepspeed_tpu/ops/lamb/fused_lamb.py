@@ -44,29 +44,19 @@ def fused_lamb_update(p, g, m, v, step, lr=1e-3, beta1=0.9, beta2=0.999,
                       eps=1e-6, weight_decay=0.0,
                       min_trust: float = 0.01, max_trust: float = 10.0):
     """Single-array fused LAMB step → (p', m', v')."""
+    from ..adam.fused_adam import _tile_plan
+
     shape, dtype = p.shape, p.dtype
-    n = int(np.prod(shape)) if shape else 1
-    width = 128
-    rows = -(-n // width)
-    pad = rows * width - n
-
-    def flat2d(x):
-        f = x.reshape(-1).astype(jnp.float32)
-        if pad:
-            f = jnp.pad(f, (0, pad))
-        return f.reshape(rows, width)
-
+    rows, width, flat2d, unflat, spec, grid = _tile_plan(shape)
     pf, gf, mf, vf = map(flat2d, (p, g, m, v))
     t = step.astype(jnp.float32) + 1.0
     bc1 = (1.0 - beta1 ** t).reshape(1, 1)
     bc2 = (1.0 - beta2 ** t).reshape(1, 1)
 
-    block_rows = max(min(rows, BLOCK // width), 8)
-    spec = pl.BlockSpec((block_rows, width), lambda i: (i, 0))
     u, m2, v2 = pl.pallas_call(
         functools.partial(_lamb_raw_kernel, beta1=beta1, beta2=beta2, eps=eps,
                           weight_decay=weight_decay),
-        grid=(-(-rows // block_rows),),
+        grid=grid,
         in_specs=[spec, spec, spec, spec,
                   pl.BlockSpec(memory_space=pltpu.SMEM),
                   pl.BlockSpec(memory_space=pltpu.SMEM)],
@@ -75,7 +65,6 @@ def fused_lamb_update(p, g, m, v, step, lr=1e-3, beta1=0.9, beta2=0.999,
         interpret=_interpret(),
     )(pf, gf, mf, vf, bc1, bc2)
 
-    unflat = lambda x: x.reshape(-1)[:n].reshape(shape)
     u, m2, v2 = unflat(u), unflat(m2), unflat(v2)
 
     p_norm = jnp.sqrt(jnp.sum(jnp.square(p.astype(jnp.float32))))

@@ -1,0 +1,147 @@
+"""Pallas block-sparse attention kernel (reference ⚙: the Triton
+block-sparse matmul/softmax under deepspeed/ops/sparse_attention/).
+
+The layout classes (sparsity_config.py) produce a per-head [nq, nk] block
+layout; round 1 expanded it to a token mask over DENSE attention (correct,
+but pays full O(S²) compute + HBM).  This kernel makes the sparsity real:
+
+  * compute runs only where ``layout[h, iq, ik]`` is set (``pl.when``);
+  * a precomputed FETCH TABLE (static per layout) clamps each masked grid
+    step's kv index map to the previously fetched block — Pallas skips the
+    DMA for an unchanged block, so masked blocks cost neither bandwidth nor
+    MXU work (the same trick as the causal/paged kernels).
+
+Forward-only: training through sparse attention keeps the masked-dense path
+(whose backward is exact); serving/inference takes this kernel.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+_NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    return jax.default_backend() != "tpu"
+
+
+def build_fetch_table(layout: np.ndarray) -> np.ndarray:
+    """[H, nq, nk] layout → same-shape table of kv block indices to fetch at
+    each grid step: the block itself when active, else the last active block
+    of the row (no new DMA).  Rows with no active block fetch block 0."""
+    H, nq, nk = layout.shape
+    table = np.zeros((H, nq, nk), np.int32)
+    for h in range(H):
+        for i in range(nq):
+            row = np.nonzero(layout[h, i])[0]
+            last = int(row[0]) if len(row) else 0
+            for j in range(nk):
+                if layout[h, i, j]:
+                    last = j
+                table[h, i, j] = last
+    return table
+
+
+def _bs_kernel(layout_ref, table_ref, q_ref, k_ref, v_ref, o_ref,
+               acc, m_scr, l_scr, *, scale, block, seq_len):
+    h, iq, ik = pl.program_id(1), pl.program_id(2), pl.program_id(3)
+    nk = pl.num_programs(3)
+
+    @pl.when(ik == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    @pl.when(layout_ref[h, iq, ik] > 0)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        k_pos = ik * block + jax.lax.broadcasted_iota(
+            jnp.int32, (block, block), 1)
+        s = jnp.where(k_pos < seq_len, s, _NEG_INF)
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc[:] = acc[:] * alpha + jnp.dot(p, v,
+                                          preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(ik == nk - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / l_safe).astype(o_ref.dtype)
+
+
+def block_sparse_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                           layout: np.ndarray, block: int,
+                           scale: Optional[float] = None,
+                           table: Optional[np.ndarray] = None) -> jnp.ndarray:
+    """Block-sparse attention over [B, H, S, hd] with a static per-head
+    [H, nq, nk] block layout (forward only).  Pass a cached ``table`` from
+    :func:`build_fetch_table` to skip the O(H·n²) host rebuild per call."""
+    B, H, S, hd = q.shape
+    layout = np.asarray(layout)
+    if layout.ndim == 2:
+        layout = layout[None]
+    if layout.shape[0] != H:
+        assert layout.shape[0] == 1, \
+            f"layout heads {layout.shape[0]} != tensor heads {H}"
+        layout = np.broadcast_to(layout, (H,) + layout.shape[1:])
+    nq, nk = layout.shape[1:]
+    assert nq * block >= S and nk * block >= S, (layout.shape, block, S)
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+
+    def pad_to(x, blocks):
+        return jnp.pad(x, ((0, 0), (0, 0), (0, blocks * block - S), (0, 0)))
+
+    qp = pad_to(q, nq)
+    kp, vp = pad_to(k, nk), pad_to(v, nk)
+    if table is None:
+        table = build_fetch_table(layout)
+    elif table.shape[0] != H:
+        assert table.shape[0] == 1, table.shape
+        table = np.broadcast_to(table, (H,) + table.shape[1:])
+
+    out = pl.pallas_call(
+        functools.partial(_bs_kernel, scale=scale, block=block, seq_len=S),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(B, H, nq, nk),
+            in_specs=[
+                pl.BlockSpec((1, 1, block, hd),
+                             lambda b, h, i, j, lay, tab: (b, h, i, 0)),
+                pl.BlockSpec((1, 1, block, hd),
+                             lambda b, h, i, j, lay, tab: (b, h, tab[h, i, j], 0)),
+                pl.BlockSpec((1, 1, block, hd),
+                             lambda b, h, i, j, lay, tab: (b, h, tab[h, i, j], 0)),
+            ],
+            out_specs=pl.BlockSpec((1, 1, block, hd),
+                                   lambda b, h, i, j, lay, tab: (b, h, i, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((block, hd), jnp.float32),
+                pltpu.VMEM((block, 128), jnp.float32),
+                pltpu.VMEM((block, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((B, H, nq * block, hd), q.dtype),
+        interpret=_interpret(),
+    )(jnp.asarray(layout, jnp.int32), jnp.asarray(table, jnp.int32),
+      qp, kp, vp)
+    return out[:, :, :S]

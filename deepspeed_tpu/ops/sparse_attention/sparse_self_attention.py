@@ -36,9 +36,33 @@ class SparseSelfAttention:
         return self._mask_cache[seq_len]
 
     def __call__(self, query, key, value, rpe=None, key_padding_mask=None,
-                 attn_mask=None):
-        """q/k/v: [B, H, S, hd] (reference layout). Returns [B, H, S, hd]."""
+                 attn_mask=None, use_kernel: bool = False):
+        """q/k/v: [B, H, S, hd] (reference layout). Returns [B, H, S, hd].
+
+        ``use_kernel=True`` takes the Pallas block-sparse kernel (masked
+        blocks skip both compute and DMA) — forward-only and without
+        rpe/padding/attn-mask extras, i.e. the serving fast path; training
+        and the extras keep the masked-dense path below."""
         B, H, S, hd = query.shape
+        if use_kernel:
+            assert rpe is None and key_padding_mask is None and \
+                attn_mask is None, "kernel path takes the plain layout only"
+            from .block_sparse_kernel import (
+                block_sparse_attention,
+                build_fetch_table,
+            )
+
+            # layout + fetch table are static per (config, seq_len): cache
+            # like the dense path's token mask (the table rebuild is O(H·n²)
+            # host work the serving fast path must not repeat per call)
+            if ("layout", S) not in self._mask_cache:
+                layout = np.asarray(self.sparsity_config.make_layout(S))
+                self._mask_cache[("layout", S)] = (layout,
+                                                   build_fetch_table(layout))
+            layout, table = self._mask_cache[("layout", S)]
+            return block_sparse_attention(query, key, value, layout,
+                                          self.sparsity_config.block,
+                                          table=table)
         mask = self.token_mask(S)                                # [Hl, S, S]
         if mask.shape[0] == 1:
             mask = jnp.broadcast_to(mask, (H, S, S))

@@ -28,7 +28,7 @@ SUPPORTED_OPTIMIZERS = [
     ADAM_OPTIMIZER, ADAMW_OPTIMIZER, LAMB_OPTIMIZER, LION_OPTIMIZER,
     SGD_OPTIMIZER, ADAGRAD_OPTIMIZER, ONEBIT_ADAM_OPTIMIZER,
     ONEBIT_LAMB_OPTIMIZER, ZERO_ONE_ADAM_OPTIMIZER, MUON_OPTIMIZER,
-    "fusedadam", "fusedlamb", "fusedlion",
+    "fusedadam", "fusedlamb", "fusedlion", "fusedadagrad",
 ]
 
 ScheduleOrFloat = Union[float, Callable]
@@ -86,6 +86,10 @@ def build_optimizer(opt_type: str, params: Dict[str, Any],
             local_step_scaler=params.get("local_step_scaler", 32768),
             local_step_clipper=params.get("local_step_clipper", 16),
             comm_axes=params.get("comm_axes"))
+    if name == "fusedadagrad":
+        from ..ops.adam.fused_adam import fused_adagrad
+
+        return fused_adagrad(lr, eps=params.get("eps", 1e-10), weight_decay=wd)
     if name in ("fusedadam", "fusedlamb", "fusedlion"):
         # Pallas fused single-pass kernels (reference csrc/{adam,lamb,lion})
         if name == "fusedadam":

@@ -56,8 +56,30 @@ class TestFusedLion:
                                        atol=2e-5, rtol=2e-5)
 
 
+class TestFusedAdagrad:
+    def test_matches_optax_adagrad(self):
+        import optax
+
+        from deepspeed_tpu.ops.adam.fused_adam import fused_adagrad
+
+        params, grads = _tree()
+        ours = fused_adagrad(1e-2, eps=1e-10)
+        ref = optax.adagrad(1e-2, initial_accumulator_value=0.0, eps=1e-10)
+        s1, s2 = ours.init(params), ref.init(params)
+        p1, p2 = params, params
+        for _ in range(3):
+            u1, s1 = ours.update(grads, s1, p1)
+            p1 = optax.apply_updates(p1, u1)
+            u2, s2 = ref.update(grads, s2, p2)
+            p2 = optax.apply_updates(p2, u2)
+        for k in params:
+            np.testing.assert_allclose(np.asarray(p1[k]), np.asarray(p2[k]),
+                                       atol=2e-5, rtol=2e-5)
+
+
 class TestTracedLR:
-    @pytest.mark.parametrize("name", ["fusedadam", "fusedlion", "fusedlamb"])
+    @pytest.mark.parametrize("name", ["fusedadam", "fusedlion", "fusedlamb",
+                                      "fusedadagrad"])
     def test_schedule_lr_under_jit(self, name):
         """lr from a schedule is a TRACER inside the engine's jitted step —
         the kernels must take it as an operand, not a closure constant."""
