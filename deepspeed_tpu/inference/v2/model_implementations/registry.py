@@ -52,10 +52,9 @@ _NOTES = {
 }
 
 
-#: families with an end-to-end recipe (config + converter + forward);
-#: gptj is mapped in the policy table but has no ArchConfig recipe yet
+#: families with an end-to-end recipe (config + converter + forward)
 _BUILDABLE_FAMILIES = ("llama", "qwen2", "mixtral", "gpt2", "opt", "bloom",
-                       "falcon", "phi")
+                       "falcon", "phi", "gptj")
 
 _IMPLS: Dict[str, ModelImplementation] = {}
 
@@ -72,7 +71,7 @@ def _ensure_impls() -> Dict[str, ModelImplementation]:
         known = set(_ARCH_POLICIES.values())
         unknown = set(_BUILDABLE_FAMILIES) - known
         assert not unknown, f"buildable families not in policy map: {unknown}"
-        missing = known - set(_BUILDABLE_FAMILIES) - {"gptj"}
+        missing = known - set(_BUILDABLE_FAMILIES)
         assert not missing, (f"families {missing} added to the policy map "
                              f"but not classified here as buildable/not")
         _IMPLS.update({arch: ModelImplementation(

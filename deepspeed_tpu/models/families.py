@@ -40,6 +40,9 @@ class ArchConfig:
     pos_offset: int = 0             # OPT stores positions at index pos+2
     rope_theta: float = 10000.0
     rope_pct: float = 1.0           # phi: rotary on a fraction of head_dim
+    #: "neox" (half-split halves, llama/falcon/phi) | "gptj" (interleaved
+    #: pairs, rotate_every_two)
+    rope_style: str = "neox"
     #: "layernorm" | "rmsnorm"
     norm: str = "layernorm"
     norm_eps: float = 1e-5
@@ -49,9 +52,11 @@ class ArchConfig:
     parallel_attn: bool = False     # falcon/phi: attn + mlp from the same input
     dual_ln: bool = False           # falcon new-arch: separate ln_attn/ln_mlp
     qkv_bias: bool = True
-    out_bias: bool = True           # o_proj + mlp biases
+    out_bias: bool = True           # o_proj bias
+    mlp_bias: Optional[bool] = None  # fc biases (None → follow out_bias)
     embed_layernorm: bool = False   # bloom
     tie_embeddings: bool = True
+    lm_head_bias: bool = False      # gptj/phi carry an lm-head bias
 
     @property
     def head_dim(self) -> int:
@@ -93,13 +98,22 @@ def alibi_slopes(num_heads: int) -> np.ndarray:
     return np.asarray(slopes, np.float32)
 
 
-def _rope_partial(x, cos, sin, rotary_dim):
-    """NeoX-style rope on the first ``rotary_dim`` features of each head."""
+def _rope_partial(x, cos, sin, rotary_dim, style="neox"):
+    """Rope on the first ``rotary_dim`` features of each head.
+
+    "neox": rotate split halves (llama/falcon/phi).  "gptj": rotate
+    interleaved even/odd pairs (rotate_every_two)."""
     rot, passthrough = x[..., :rotary_dim], x[..., rotary_dim:]
-    x1, x2 = jnp.split(rot, 2, axis=-1)
     c = cos[None, :, None, :].astype(x.dtype)
     s = sin[None, :, None, :].astype(x.dtype)
-    rot = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    if style == "gptj":
+        x1, x2 = rot[..., 0::2], rot[..., 1::2]
+        r1 = x1 * c - x2 * s
+        r2 = x1 * s + x2 * c
+        rot = jnp.stack([r1, r2], axis=-1).reshape(rot.shape)
+    else:
+        x1, x2 = jnp.split(rot, 2, axis=-1)
+        rot = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
     return jnp.concatenate([rot, passthrough], axis=-1) \
         if rotary_dim < x.shape[-1] else rot
 
@@ -166,8 +180,8 @@ def universal_forward(params: Dict, tokens: jnp.ndarray,
         k = _proj(h_attn_in, lp["k_proj"]).reshape(B, S, KV, hd)
         v = _proj(h_attn_in, lp["v_proj"]).reshape(B, S, KV, hd)
         if cfg.pos == "rope":
-            q = _rope_partial(q, cos, sin, cfg.rotary_dim)
-            k = _rope_partial(k, cos, sin, cfg.rotary_dim)
+            q = _rope_partial(q, cos, sin, cfg.rotary_dim, cfg.rope_style)
+            k = _rope_partial(k, cos, sin, cfg.rotary_dim, cfg.rope_style)
         o = _attention(q, k, v, cfg, alibi).reshape(B, S, H * hd)
         attn_out = _proj(o, lp["o_proj"])
 
@@ -236,14 +250,14 @@ def init_universal_params(cfg: ArchConfig, key: jax.Array,
     }
     if not (cfg.parallel_attn and not cfg.dual_ln):
         layers["ln2"] = ln()
+    mlp_bias = cfg.out_bias if cfg.mlp_bias is None else cfg.mlp_bias
     if cfg.mlp == "silu_glu":
         layers["gate_proj"] = dense((L, D, F), D)
         layers["up_proj"] = dense((L, D, F), D)
         layers["down_proj"] = dense((L, F, D), F)
     else:
-        fb = (L, F) if cfg.out_bias else None
-        layers["fc1"] = dense((L, D, F), D, fb)
-        layers["fc2"] = dense((L, F, D), F, ob)
+        layers["fc1"] = dense((L, D, F), D, (L, F) if mlp_bias else None)
+        layers["fc2"] = dense((L, F, D), F, (L, D) if mlp_bias else None)
 
     params = {
         "embed": {"embedding": (jax.random.normal(next(ks),
@@ -262,7 +276,9 @@ def init_universal_params(cfg: ArchConfig, key: jax.Array,
         params["embed_ln"] = {"scale": jnp.ones((D,), dtype),
                               "bias": jnp.zeros((D,), dtype)}
     if not cfg.tie_embeddings:
-        params["lm_head"] = dense((D, cfg.vocab_size), D)
+        params["lm_head"] = dense((D, cfg.vocab_size), D,
+                                  (cfg.vocab_size,) if cfg.lm_head_bias
+                                  else None)
     return params
 
 

@@ -48,7 +48,7 @@ def policy_for(hf_config: Any) -> str:
     for name, fam in (("llama", "llama"), ("mistral", "llama"),
                       ("qwen2", "qwen2"), ("gpt2", "gpt2"), ("opt", "opt"),
                       ("bloom", "bloom"), ("falcon", "falcon"), ("phi", "phi"),
-                      ("mixtral", "mixtral")):
+                      ("gptj", "gptj"), ("mixtral", "mixtral")):
         if mt == name:
             return fam
     raise ValueError(f"unsupported HF architecture: {archs or mt}")
@@ -139,6 +139,13 @@ def arch_config_from_hf(hf_config: Any, **overrides):
                     out_bias=bool(g("bias", default=False)),
                     rope_theta=g("rope_theta", default=10000.0),
                     intermediate_size=4 * hidden)
+    elif fam == "gptj":
+        base.update(pos="rope", rope_style="gptj", norm="layernorm",
+                    mlp="gelu", parallel_attn=True, dual_ln=False,
+                    qkv_bias=False, out_bias=False, mlp_bias=True,
+                    rope_pct=(g("rotary_dim", default=hidden // heads) /
+                              (hidden // heads)),
+                    tie_embeddings=False, lm_head_bias=True)
     elif fam == "phi":
         base.update(pos="rope", norm="layernorm", mlp="gelu",
                     parallel_attn=True, dual_ln=False,
@@ -146,7 +153,7 @@ def arch_config_from_hf(hf_config: Any, **overrides):
                     rope_pct=float(g("partial_rotary_factor", default=0.5)),
                     rope_theta=g("rope_theta", default=10000.0),
                     num_kv_heads=g("num_key_value_heads", default=heads),
-                    tie_embeddings=False)
+                    tie_embeddings=False, lm_head_bias=True)
     else:
         raise ValueError(f"no exact ArchConfig recipe for family {fam!r}")
     base.update(overrides)
@@ -386,6 +393,25 @@ def convert_arch_state_dict(sd: Dict[str, Any], cfg, fam: str) -> Dict:
             "layers": layers,
             "norm_f": {"scale": jnp.asarray(t("transformer.ln_f.weight")),
                        "bias": jnp.asarray(t("transformer.ln_f.bias"))},
+        }
+
+    if fam == "gptj":
+        p = "transformer.h.{}"
+        return {
+            "embed": {"embedding": jnp.asarray(t("transformer.wte.weight"))},
+            "layers": {
+                "ln1": ln(p + ".ln_1.weight", p + ".ln_1.bias"),
+                "q_proj": lin(p + ".attn.q_proj.weight"),
+                "k_proj": lin(p + ".attn.k_proj.weight"),
+                "v_proj": lin(p + ".attn.v_proj.weight"),
+                "o_proj": lin(p + ".attn.out_proj.weight"),
+                "fc1": lin(p + ".mlp.fc_in.weight", p + ".mlp.fc_in.bias"),
+                "fc2": lin(p + ".mlp.fc_out.weight", p + ".mlp.fc_out.bias"),
+            },
+            "norm_f": {"scale": jnp.asarray(t("transformer.ln_f.weight")),
+                       "bias": jnp.asarray(t("transformer.ln_f.bias"))},
+            "lm_head": {"kernel": jnp.asarray(t("lm_head.weight").T),
+                        "bias": jnp.asarray(t("lm_head.bias"))},
         }
 
     if fam == "phi":

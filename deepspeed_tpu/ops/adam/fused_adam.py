@@ -28,7 +28,7 @@ def _interpret() -> bool:
 
 
 def _tile_plan(shape):
-    """Shared (rows, width, pad, flat2d, unflat, spec, grid) tiling for the
+    """Shared (rows, width, flat2d, unflat, spec, grid) tiling for the
     streaming optimizer kernels — ONE copy of the flatten-to-(rows, 128)
     scaffolding used by adam/lion/adagrad (and ops/lamb)."""
     n = int(np.prod(shape)) if shape else 1

@@ -14,12 +14,11 @@ from typing import Any, NamedTuple
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 import optax
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
-from ..adam.fused_adam import BLOCK, _interpret
+from ..adam.fused_adam import _interpret, _tile_plan
 
 
 def _lamb_raw_kernel(p_ref, g_ref, m_ref, v_ref, bc1_ref, bc2_ref,
@@ -44,8 +43,6 @@ def fused_lamb_update(p, g, m, v, step, lr=1e-3, beta1=0.9, beta2=0.999,
                       eps=1e-6, weight_decay=0.0,
                       min_trust: float = 0.01, max_trust: float = 10.0):
     """Single-array fused LAMB step → (p', m', v')."""
-    from ..adam.fused_adam import _tile_plan
-
     shape, dtype = p.shape, p.dtype
     rows, width, flat2d, unflat, spec, grid = _tile_plan(shape)
     pf, gf, mf, vf = map(flat2d, (p, g, m, v))

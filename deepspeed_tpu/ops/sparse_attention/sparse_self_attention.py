@@ -2,10 +2,10 @@
 sparse_self_attention.py + bert_sparse_self_attention.py — Triton block-sparse
 matmul/softmax).
 
-TPU implementation: the block layout expands to a token-level mask consumed by
-masked attention.  XLA's fusion makes the masked path competitive at moderate
-sparsity; a Pallas kernel that *skips* masked blocks (grid over layout-true
-blocks via scalar prefetch) is the planned upgrade for long sequences.
+TPU implementation: two paths share the layout classes.  Training uses the
+token-level mask over dense attention (exact backward); serving opts into
+the Pallas block-sparse kernel (block_sparse_kernel.py, use_kernel=True)
+where masked blocks skip both compute and DMA.
 """
 from __future__ import annotations
 

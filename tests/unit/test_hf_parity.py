@@ -144,6 +144,14 @@ class TestArchParity:
         torch.manual_seed(0)
         _parity(Qwen2ForCausalLM(cfg), cfg)
 
+    def test_gptj(self):
+        from transformers import GPTJConfig, GPTJForCausalLM
+
+        cfg = GPTJConfig(vocab_size=128, n_embd=64, n_layer=2, n_head=4,
+                         n_inner=128, rotary_dim=8, n_positions=64)
+        torch.manual_seed(0)
+        _parity(GPTJForCausalLM(cfg), cfg)
+
     def test_llama(self):
         from transformers import LlamaConfig, LlamaForCausalLM
 

@@ -47,7 +47,8 @@ class TestModelImplementations:
         archs = list_implementations()
         for a in ("LlamaForCausalLM", "MistralForCausalLM", "MixtralForCausalLM",
                   "Qwen2ForCausalLM", "FalconForCausalLM", "OPTForCausalLM",
-                  "PhiForCausalLM", "BloomForCausalLM", "GPT2LMHeadModel"):
+                  "PhiForCausalLM", "BloomForCausalLM", "GPT2LMHeadModel",
+                  "GPTJForCausalLM"):
             assert a in archs
             impl = get_implementation(a)
             assert impl.family
