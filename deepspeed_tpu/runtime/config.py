@@ -68,6 +68,11 @@ class CommsLoggerConfig(DeepSpeedConfigModel):
     prof_all: bool = True
     debug: bool = False
     prof_ops: List[str] = Field(default_factory=list)
+    #: write an xprof device trace for this step (device-time attribution —
+    #: the TPU analogue of the reference's CUDA-event timing); open the
+    #: directory with xprof/tensorboard-profile
+    xprof_step: int = -1
+    xprof_dir: str = "xprof_traces"
 
 
 class FlopsProfilerConfig(DeepSpeedConfigModel):

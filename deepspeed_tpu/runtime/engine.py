@@ -462,10 +462,30 @@ class DeepSpeedEngine:
                 lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch)
         if "train_batch" not in self._compiled:
             self._compiled["train_batch"] = self._build_train_batch_fn()
+        # Device-time attribution (reference: CUDA-event comms timing;
+        # comms_logger.xprof_step): wrap ONE step in an xprof trace — per-op
+        # device durations, collectives included.  A wrapper, not a separate
+        # path: timers, NaN checks, and logging run as normal, and the
+        # fired flag keeps an fp16 overflow-skipped step from re-tracing.
+        cl = self.config.comms_logger
+        trace_now = (cl.enabled and cl.xprof_step >= 0 and
+                     not getattr(self, "_xprof_fired", False) and
+                     cl.xprof_step == self.global_steps)
+        import contextlib
+
+        ctx = jax.profiler.trace(cl.xprof_dir) if trace_now \
+            else contextlib.nullcontext()
         self.tput_timer.start()
         if self.config.wall_clock_breakdown:
             self._timers("step").start()
-        self.state, loss = self._compiled["train_batch"](self.state, batch)
+        with ctx:
+            self.state, loss = self._compiled["train_batch"](self.state, batch)
+            if trace_now:
+                jax.block_until_ready(loss)
+        if trace_now:
+            self._xprof_fired = True
+            log_dist(f"comms_logger: xprof trace for step {cl.xprof_step} "
+                     f"→ {cl.xprof_dir}", ranks=[0])
         self.tput_timer.stop(sync=loss)
         if self.config.wall_clock_breakdown:
             self._timers("step").stop(sync=loss)

@@ -73,6 +73,36 @@ class TestDebugMode:
         eng.train_batch(_batch())   # no raise: tolerated by design
         assert not getattr(eng.config, "debug_nan_check")
 
+    def test_xprof_trace_step(self, tmp_path):
+        """comms_logger.xprof_step writes a device trace for that step
+        (device-time attribution; reference CUDA-event comms timing)."""
+        import glob
+        import os
+
+        import deepspeed_tpu
+        from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+        from deepspeed_tpu.runtime.topology import (
+            TopologyConfig,
+            initialize_mesh,
+        )
+
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        cfg = TransformerConfig.tiny(use_flash=False)
+        model = CausalLM(cfg)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=model.init_params(jax.random.PRNGKey(0)),
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 1},
+                    "bf16": {"enabled": True},
+                    "comms_logger": {"enabled": True, "xprof_step": 1,
+                                     "xprof_dir": str(tmp_path)}},
+            topology=topo)
+        for _ in range(3):
+            eng.train_batch(_batch())
+        assert glob.glob(os.path.join(str(tmp_path), "**", "*"),
+                         recursive=True), "no xprof trace written"
+
     def test_unknown_debug_key_raises(self):
         with pytest.raises(ValueError, match="unknown debug config"):
             _engine({"determinstic": True})   # the typo a user would make
