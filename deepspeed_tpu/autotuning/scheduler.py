@@ -5,6 +5,7 @@ so interrupted searches resume and results survive for inspection).
 """
 from __future__ import annotations
 
+import hashlib
 import json
 import os
 import time
@@ -29,7 +30,13 @@ class ExperimentScheduler:
         os.makedirs(results_dir, exist_ok=True)
 
     def _trial_dir(self, exp: Experiment) -> str:
-        return os.path.join(self.results_dir, exp.name)
+        # keyed by name + config hash: resuming after the search space
+        # changed must not return a metric recorded for a DIFFERENT
+        # config_patch that happened to share the experiment name
+        digest = hashlib.sha256(
+            json.dumps(exp.config_patch, sort_keys=True).encode()
+        ).hexdigest()[:10]
+        return os.path.join(self.results_dir, f"{exp.name}-{digest}")
 
     def _load_cached(self, exp: Experiment) -> bool:
         path = os.path.join(self._trial_dir(exp), "metrics.json")

@@ -130,10 +130,31 @@ def apply_layer_reduction(params: Any, lr_cfg: Dict[str, Any]) -> Any:
         keep = list(range(int(lr_cfg.get("keep_number", 1))))
     keep = np.asarray(keep, np.int32)
 
+    # The layer axis is identified, not guessed: every stacked-layer leaf
+    # shares dim0 == num_layers, so slice ONLY leaves matching that count
+    # (an arbitrary dim0 > max(keep) could be a head or channel axis).
+    num_layers = lr_cfg.get("num_layers")
+    if num_layers is None:
+        dims = []
+
+        def collect(path, w):
+            if hasattr(w, "ndim") and w.ndim >= 1 and "layers" in path:
+                dims.append(int(w.shape[0]))
+            return w
+
+        _map_with_paths(params, collect)
+        if not dims:
+            return params
+        num_layers = max(set(dims), key=dims.count)
+    if keep.max() >= num_layers:
+        raise ValueError(
+            f"layer_reduction teacher_layer {keep.tolist()} out of range for "
+            f"a {num_layers}-layer model")
+
     def maybe_slice(path, w):
         if not hasattr(w, "ndim") or w.ndim < 1:
             return w
-        if "layers" in path and w.shape[0] > keep.max():
+        if "layers" in path and w.shape[0] == num_layers:
             return w[keep]
         return w
 

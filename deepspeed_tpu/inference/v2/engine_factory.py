@@ -26,14 +26,7 @@ def build_hf_engine(path: str, engine_config: Optional[RaggedInferenceEngineConf
     from .model_implementations import get_implementation, list_implementations
 
     hf_cfg = AutoConfig.from_pretrained(path) if isinstance(path, str) else path
-    impl = get_implementation(hf_cfg)
-    if not impl.ragged_native:
-        native = [a for a in list_implementations()
-                  if get_implementation(a).ragged_native]
-        raise NotImplementedError(
-            f"{impl.arch} ({impl.notes}) serves on the UniversalCausalLM "
-            f"compat forward — call model(params, tokens) directly; the "
-            f"ragged paged-KV engine covers: {native}")
+    impl = get_implementation(hf_cfg)   # raises for unknown architectures
     if random_weights:
         import jax
 

@@ -48,6 +48,10 @@ class RaggedInferenceEngineConfig:
     max_ctx: int = 2048
     block_size: int = 64
     num_blocks: Optional[int] = None  # default: enough for max_seqs * max_ctx
+    #: query tokens per attention atom (the atom_builder granularity): the
+    #: paged kernel's MXU row tile is G·atom_size, so decode sequences cost
+    #: one atom — not a max_tokens-padded tile
+    atom_size: int = 16
     dtype: object = jnp.bfloat16
     #: "paged" = Pallas paged-attention kernel (blocked_flash equivalent);
     #: "gather" = dense slot-gather reference path (numerics oracle).
@@ -57,15 +61,15 @@ class RaggedInferenceEngineConfig:
 class InferenceEngineV2:
     def __init__(self, model: CausalLM, params,
                  config: Optional[RaggedInferenceEngineConfig] = None):
+        from ...models.families import ArchConfig
+
         self.model = model
         self.cfg = model.config
-        if not isinstance(self.cfg, TransformerConfig):
+        if not isinstance(self.cfg, (TransformerConfig, ArchConfig)):
             raise NotImplementedError(
-                f"ragged serving covers the native CausalLM families "
-                f"(llama/mistral/qwen2/mixtral); got a "
-                f"{type(self.cfg).__name__} model — universal compat "
-                f"families (gpt2/opt/bloom/falcon/phi) serve via "
-                f"model(params, tokens) directly")
+                f"ragged serving needs a TransformerConfig (native llama "
+                f"families) or ArchConfig (universal gpt2/gptj/opt/bloom/"
+                f"falcon/phi families) model; got {type(self.cfg).__name__}")
         self.config = config or RaggedInferenceEngineConfig()
         c = self.config
         num_blocks = c.num_blocks or (c.max_seqs * -(-c.max_ctx // c.block_size))
@@ -84,12 +88,16 @@ class InferenceEngineV2:
             return jnp.asarray(x, c.dtype)
 
         self.params = jax.tree_util.tree_map_with_path(_cast, params)
-        self._step = build_ragged_step(self.cfg, max_q=c.max_tokens,
-                                       block_size=c.block_size,
-                                       attn_impl=c.attn_impl)
+        atom = min(c.atom_size, c.max_tokens)
         self._wrapper = RaggedBatchWrapper(c.max_tokens, c.max_seqs, c.max_ctx,
                                            c.block_size,
-                                           trash_slot=self.kv.config.trash_slot)
+                                           trash_slot=self.kv.config.trash_slot,
+                                           atom_size=atom)
+        self._step = build_ragged_step(self.cfg, max_q=c.max_tokens,
+                                       block_size=c.block_size,
+                                       attn_impl=c.attn_impl, atom_size=atom,
+                                       max_seqs=c.max_seqs,
+                                       max_blocks=self._wrapper.max_blocks)
         log_dist(f"InferenceEngineV2: blocks={num_blocks}×{c.block_size} "
                  f"budget={c.max_tokens}tok/{c.max_seqs}seq "
                  f"kv={self.kv.mem_bytes()/1e6:.0f}MB", ranks=[0])
@@ -136,7 +144,10 @@ class InferenceEngineV2:
             assert ok, "allocator raced"  # can_schedule checked
             self._wrapper.insert_sequence(seq, list(toks))
         batch = self._wrapper.finalize()
-        dev = batch.to_device()
+        # ONE metadata transfer per forward: over the TPU relay link the
+        # per-array H2D latency dominates decode steps (measured 3 tok/s with
+        # ~15 arrays vs one packed buffer)
+        dev = jnp.asarray(batch.pack())
         logits, new_k, new_v = self._step(self.params, self.kv.k, self.kv.v, dev)
         self.kv.update(new_k, new_v)
         for uid in batch.uids:
@@ -186,16 +197,19 @@ class InferenceEngineV2:
                 break
             batch = self.schedule(active)
             logits = self.put([u for u, _ in batch], [t for _, t in batch])
-            logits_np = np.asarray(logits)
+            # select on device, pull ONE small int vector (not [S, vocab]
+            # logits — a 2MB D2H per decode step over the relay link)
+            if temperature > 0:
+                rng, sub = jax.random.split(rng)
+                toks = np.asarray(
+                    jax.random.categorical(sub, logits / temperature, axis=-1))
+            else:
+                toks = np.asarray(jnp.argmax(logits, axis=-1))
             for row, (uid, chunk) in enumerate(batch):
                 pending[uid] = pending[uid][len(chunk):]
                 if pending[uid]:
                     continue  # mid-prompt chunk; its logits are discarded
-                if temperature > 0:
-                    rng, sub = jax.random.split(rng)
-                    tok = int(jax.random.categorical(sub, logits[row] / temperature))
-                else:
-                    tok = int(np.argmax(logits_np[row]))
+                tok = int(toks[row])
                 produced[uid].append(tok)
                 if (eos_token_id is not None and tok == eos_token_id) or \
                         len(produced[uid]) >= max_new_tokens:

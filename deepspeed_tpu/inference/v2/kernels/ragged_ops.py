@@ -130,7 +130,8 @@ def paged_attention(q: jnp.ndarray, kcache: jnp.ndarray, vcache: jnp.ndarray,
     # [S, MQ, H, hd] -> [S, KV, G*MQ, hd]; row r = g*MQ + m, head = kv*G + g.
     q_r = q.transpose(0, 2, 1, 3).reshape(S, KV, G, MQ, hd) \
            .reshape(S, KV, G * MQ, hd)
-    rows = max(8, ((G * MQ + 7) // 8) * 8)          # f32 sublane alignment
+    mult = _sublane_mult(q.dtype)                   # dtype-correct sublane tile
+    rows = max(mult, _cdiv(G * MQ, mult) * mult)
     if rows != G * MQ:
         q_r = jnp.pad(q_r, ((0, 0), (0, 0), (0, rows - G * MQ), (0, 0)))
 
@@ -171,6 +172,195 @@ def paged_attention(q: jnp.ndarray, kcache: jnp.ndarray, vcache: jnp.ndarray,
 
     out = out[:, :, :G * MQ].reshape(S, KV, G, MQ, hd) \
              .reshape(S, KV * G, MQ, hd).transpose(0, 2, 1, 3)
+    return out
+
+
+# ===================================================================== #
+# Atom-packed ragged attention (the atom_builder + blocked_flash pairing)
+# ===================================================================== #
+def _sublane_mult(dtype) -> int:
+    """Mosaic sublane tile for a dtype: (8,128) f32, (16,128) bf16,
+    (32,128) int8/fp8."""
+    if dtype == jnp.bfloat16 or dtype == jnp.float16:
+        return 16
+    if jnp.dtype(dtype).itemsize == 1:
+        return 32
+    return 8
+
+
+def _atom_attn_kernel(bt_ref, aseq_ref, aqs_ref, anq_ref, ql_ref, cl_ref,
+                      q_ref, k_ref, v_ref, o_ref,
+                      acc, m_scr, l_scr, *,
+                      scale, block_size, atom_size, group, rows,
+                      alibi=None, alibi_scaled=False):
+    a_i = pl.program_id(0)
+    h_kv = pl.program_id(1)     # read at top level: program_id inside a
+    ib = pl.program_id(2)       # pl.when body fails interpret-mode lowering
+    nb = pl.num_programs(2)
+
+    @pl.when(ib == 0)
+    def _init():
+        acc[:] = jnp.zeros_like(acc)
+        m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+
+    s_i = aseq_ref[a_i]
+    nq = anq_ref[a_i]
+    qs = aqs_ref[a_i]
+    ql = ql_ref[s_i]
+    cl = cl_ref[s_i]
+    # one past the atom's LAST query position: early atoms of a prefill
+    # chunk walk fewer kv blocks (the causal skip falls out of atom packing)
+    end_pos = cl - ql + qs + nq
+    needed = _cdiv(jnp.maximum(end_pos, 1), block_size)
+
+    @pl.when(jnp.logical_and(ib < needed, nq > 0))
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)                 # [rows, hd]
+        k = k_ref[0, 0].astype(jnp.float32)                 # [bs, hd]
+        v = v_ref[0, 0].astype(jnp.float32)                 # [bs, hd]
+        s_mat = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+        r = jax.lax.broadcasted_iota(jnp.int32, (rows, block_size), 0)
+        k_pos = ib * block_size + \
+            jax.lax.broadcasted_iota(jnp.int32, (rows, block_size), 1)
+        t = r % atom_size                                   # query idx in atom
+        q_pos = cl - ql + qs + t                            # absolute position
+        if alibi is not None:
+            # per-row slope: row r holds query head kv*G + r//atom_size.
+            # alibi is a host-side constant; the lookup is a fully static
+            # unrolled select over (kv grid index, g) — no in-kernel gather.
+            n_kv = len(alibi) // group
+            slope = jnp.zeros((rows, block_size), jnp.float32)
+            for g in range(group):
+                s_g = jnp.float32(0.0)
+                for kv in range(n_kv):
+                    s_g = jnp.where(h_kv == kv,
+                                    jnp.float32(alibi[kv * group + g]), s_g)
+                slope = jnp.where(r // atom_size == g, s_g, slope)
+            if alibi_scaled:
+                # falcon: bias = bf16(slope·pos), added pre-1/sqrt(hd)
+                bias = (slope.astype(jnp.bfloat16) *
+                        k_pos.astype(jnp.bfloat16)).astype(jnp.float32) * scale
+            else:                       # bloom: unscaled f32 bias post-scale
+                bias = slope * k_pos.astype(jnp.float32)
+            s_mat = s_mat + bias
+        mask = (k_pos <= q_pos) & (k_pos < cl) & (t < nq) & \
+            (r < group * atom_size)
+        s_mat = jnp.where(mask, s_mat, _NEG_INF)
+
+        m_prev = m_scr[:, :1]
+        m_new = jnp.maximum(m_prev, jnp.max(s_mat, axis=1, keepdims=True))
+        alpha = jnp.exp(m_prev - m_new)
+        p = jnp.exp(s_mat - m_new)
+        l_scr[:] = jnp.broadcast_to(
+            alpha * l_scr[:, :1] + jnp.sum(p, axis=1, keepdims=True),
+            l_scr.shape)
+        acc[:] = acc[:] * alpha + jnp.dot(p, v, preferred_element_type=jnp.float32)
+        m_scr[:] = jnp.broadcast_to(m_new, m_scr.shape)
+
+    @pl.when(ib == nb - 1)
+    def _finalize():
+        l = l_scr[:, :1]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc[:] / l_safe).astype(o_ref.dtype)
+
+
+def atom_paged_attention(q_atoms: jnp.ndarray, kcache: jnp.ndarray,
+                         vcache: jnp.ndarray, block_table: jnp.ndarray,
+                         atom_seq: jnp.ndarray, atom_qstart: jnp.ndarray,
+                         atom_nq: jnp.ndarray, q_len: jnp.ndarray,
+                         ctx_len: jnp.ndarray, *, block_size: int,
+                         scale: Optional[float] = None,
+                         alibi=None, alibi_scaled: bool = False,
+                         interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Ragged attention over token-packed query ATOMS (kills the per-sequence
+    [S, max_tokens] query padding: a decode row costs G·A MXU rows, not
+    G·max_tokens).
+
+    Reference analogue: the atom_builder + blocked_flash pairing
+    (``deepspeed/inference/v2/kernels/ragged_ops/atom_builder/atom_builder.cu``,
+    ``blocked_flash/flash_fwd_kernel.h``) — atoms there bound work per CTA;
+    here they bound the MXU row tile per grid step.
+
+    Args:
+      q_atoms:     [NA, A, H, hd] query tokens packed per-sequence into
+                   fixed-size atoms (A = atom size; pad atoms have nq=0).
+      kcache/vcache: [KV, n_slots, hd] paged cache, block-major slots.
+      block_table: [S, NB] physical block ids per sequence.
+      atom_seq:    [NA] owning sequence row of each atom.
+      atom_qstart: [NA] index of the atom's first query within its
+                   sequence's query span this forward.
+      atom_nq:     [NA] real query tokens in the atom (0 = pad atom).
+      q_len/ctx_len: [S] per-sequence query count / total context span.
+    Returns [NA, A, H, hd].
+    """
+    NA, A, H, hd = q_atoms.shape
+    KV = kcache.shape[0]
+    assert H % KV == 0, "query heads must be a multiple of kv heads"
+    G = H // KV
+    NB = block_table.shape[1]
+    n_slots = kcache.shape[1]
+    assert n_slots % block_size == 0, "cache slots must be block-aligned"
+    nb_tot = n_slots // block_size
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+
+    # [NA, A, H, hd] -> [NA, KV, G*A, hd]; row r = g*A + t, head = kv*G + g.
+    q_r = q_atoms.transpose(0, 2, 1, 3).reshape(NA, KV, G, A, hd) \
+                 .reshape(NA, KV, G * A, hd)
+    mult = _sublane_mult(q_atoms.dtype)
+    rows = max(mult, _cdiv(G * A, mult) * mult)
+    if rows != G * A:
+        q_r = jnp.pad(q_r, ((0, 0), (0, 0), (0, rows - G * A), (0, 0)))
+
+    k_view = kcache.reshape(KV, nb_tot, block_size, hd)
+    v_view = vcache.reshape(KV, nb_tot, block_size, hd)
+
+    def kv_index(a, h, ib, bt, aseq, aqs, anq, ql, cl):
+        s = aseq[a]
+        end_pos = cl[s] - ql[s] + aqs[a] + anq[a]
+        needed = _cdiv(jnp.maximum(end_pos, 1), block_size)
+        clamped = jnp.minimum(ib, needed - 1)
+        return (h, bt[s, clamped], 0, 0)
+
+    if alibi is not None:
+        import numpy as np
+
+        alibi = tuple(np.asarray(alibi, np.float32).tolist())   # static const
+        assert len(alibi) == H, "alibi slopes must be per query head"
+    kernel = functools.partial(
+        _atom_attn_kernel, scale=scale, block_size=block_size,
+        atom_size=A, group=G, rows=rows, alibi=alibi,
+        alibi_scaled=alibi_scaled)
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=6,
+            grid=(NA, KV, NB),
+            in_specs=[
+                pl.BlockSpec((1, 1, rows, hd),
+                             lambda a, h, ib, *_: (a, h, 0, 0)),
+                pl.BlockSpec((1, 1, block_size, hd), kv_index),
+                pl.BlockSpec((1, 1, block_size, hd), kv_index),
+            ],
+            out_specs=pl.BlockSpec((1, 1, rows, hd),
+                                   lambda a, h, ib, *_: (a, h, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((rows, hd), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+                pltpu.VMEM((rows, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((NA, KV, rows, hd), q_atoms.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(block_table.astype(jnp.int32), atom_seq.astype(jnp.int32),
+      atom_qstart.astype(jnp.int32), atom_nq.astype(jnp.int32),
+      q_len.astype(jnp.int32), ctx_len.astype(jnp.int32),
+      q_r, k_view, v_view)
+
+    out = out[:, :, :G * A].reshape(NA, KV, G, A, hd) \
+             .transpose(0, 3, 1, 2, 4).reshape(NA, A, H, hd)
     return out
 
 

@@ -13,8 +13,10 @@ class ModelImplementation:
     """Policy for serving one HF architecture.
 
     ``family``: models/hf.py policy name; ``ragged_native``: True when the
-    paged-KV ragged engine serves it (CausalLM recipe), False when it runs
-    on the UniversalCausalLM compat forward (dense batch serving only).
+    paged-KV ragged engine serves it — since the universal ragged runner
+    (model_runner.ragged_forward_universal) landed, that is EVERY buildable
+    family (native CausalLM recipes ride ragged_forward, ArchConfig
+    recipes ride the universal runner; both share the atom kernel).
     """
     arch: str
     family: str
@@ -74,8 +76,9 @@ def _ensure_impls() -> Dict[str, ModelImplementation]:
         missing = known - set(_BUILDABLE_FAMILIES)
         assert not missing, (f"families {missing} added to the policy map "
                              f"but not classified here as buildable/not")
+        del NATIVE_FAMILIES  # all buildable families serve ragged now
         _IMPLS.update({arch: ModelImplementation(
-            arch, fam, fam in NATIVE_FAMILIES, _NOTES.get(arch, ""))
+            arch, fam, True, _NOTES.get(arch, ""))
             for arch, fam in _ARCH_POLICIES.items()
             if fam in _BUILDABLE_FAMILIES})
     return _IMPLS

@@ -27,30 +27,45 @@ import jax
 import jax.numpy as jnp
 
 from ...models.transformer import TransformerConfig, rms_norm
-from .kernels.ragged_ops import paged_attention, paged_kv_append
+from .kernels.ragged_ops import atom_paged_attention, paged_kv_append
+from .ragged.ragged_wrapper import pack_layout
 
 
-def _rope_at(pos, head_dim, theta):
-    """cos/sin tables gathered at arbitrary positions [T] → [T, hd/2]."""
-    inv = 1.0 / (theta ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+def _rope_at(pos, rotary_dim, theta):
+    """cos/sin tables gathered at arbitrary positions [T] → [T, rd/2]."""
+    inv = 1.0 / (theta ** (jnp.arange(0, rotary_dim, 2, dtype=jnp.float32)
+                           / rotary_dim))
     freqs = pos.astype(jnp.float32)[:, None] * inv[None, :]
     return jnp.cos(freqs), jnp.sin(freqs)
 
 
-def _apply_rope_flat(x, cos, sin):
-    """x [T, H, hd] with per-token tables [T, hd/2]."""
-    x1, x2 = jnp.split(x, 2, axis=-1)
+def _apply_rope_flat(x, cos, sin, rotary_dim=None, style="neox"):
+    """x [T, H, hd] with per-token tables [T, rd/2]; partial rotary (phi)
+    and interleaved-pair style (gptj) supported, mirroring
+    families._rope_partial for the flat serving token axis."""
+    hd = x.shape[-1]
+    rd = hd if rotary_dim is None else rotary_dim
+    rot, passthrough = x[..., :rd], x[..., rd:]
     c = cos[:, None, :].astype(x.dtype)
     s = sin[:, None, :].astype(x.dtype)
-    return jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    if style == "gptj":
+        x1, x2 = rot[..., 0::2], rot[..., 1::2]
+        rot = jnp.stack([x1 * c - x2 * s, x1 * s + x2 * c],
+                        axis=-1).reshape(rot.shape)
+    else:
+        x1, x2 = jnp.split(rot, 2, axis=-1)
+        rot = jnp.concatenate([x1 * c - x2 * s, x2 * c + x1 * s], axis=-1)
+    return jnp.concatenate([rot, passthrough], axis=-1) if rd < hd else rot
 
 
 def _attend_gather(q_seq, layer_k, layer_v, block_table, q_len, ctx_len,
-                   block_size, scale):
+                   block_size, scale, alibi=None, alibi_scaled=False):
     """Dense-gather reference attention (the round-1 path).
 
     Derives the flat slot map from the block table on device, gathers the
     full padded context per sequence, and runs masked softmax attention.
+    ``alibi`` ([H] slopes) adds the position bias (bloom semantics; the
+    falcon ``alibi_scaled`` variant computes bf16(slope·pos) pre-scaling).
     """
     S, mq, H, hd = q_seq.shape
     KV = layer_k.shape[0]
@@ -76,16 +91,79 @@ def _attend_gather(q_seq, layer_k, layer_v, block_table, q_len, ctx_len,
 
     scores = jnp.einsum("sqhd,schd->shqc", q_seq.astype(jnp.float32),
                         k_ctx.astype(jnp.float32)) * scale
+    if alibi is not None:
+        slopes = jnp.asarray(alibi, jnp.float32)              # [H]
+        if alibi_scaled:
+            bias = (slopes[:, None].astype(jnp.bfloat16) *
+                    ctx_pos[None, :].astype(jnp.bfloat16)
+                    ).astype(jnp.float32) * scale             # [H, C]
+        else:
+            bias = slopes[:, None] * ctx_pos[None, :].astype(jnp.float32)
+        scores = scores + bias[None, :, None, :]
     scores = jnp.where(attn_mask[:, None, :, :], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)
     return jnp.einsum("shqc,schd->sqhd", probs, v_ctx.astype(jnp.float32))
 
 
+def _unpack_batch(batch, max_q, max_seqs, max_blocks, atom_size):
+    """Packed int32 metadata vector → field dict via static on-device
+    slices (one H2D transfer per forward; see ragged_wrapper.pack_layout)."""
+    layout = pack_layout(max_q, max_seqs, max_blocks,
+                         -(-max_q // atom_size) + max_seqs)
+    packed = batch
+    batch = {}
+    for name, (off, shape) in layout.items():
+        if name == "_total":
+            continue
+        n = 1
+        for d in shape:
+            n *= d
+        batch[name] = packed[off:off + n].reshape(shape)
+    return batch
+
+
+def _ragged_attend(q, layer_k, layer_v, batch, *, attn_impl, atom_size,
+                   max_q, block_size, scale, alibi=None, alibi_scaled=False):
+    """Shared ragged attention dispatch: token-packed atoms through the
+    Pallas paged kernel, or the dense-gather oracle.  q: [T, H, hd] →
+    [T, H*hd]."""
+    T, H, hd = q.shape
+    q_len, ctx_len = batch["q_len"], batch["ctx_len"]
+    block_table = batch["block_table"]
+    if attn_impl == "paged":
+        atom_q_idx = jnp.clip(
+            batch["atom_tok"][:, None] + jnp.arange(atom_size)[None, :],
+            0, T - 1)
+        q_atoms = jnp.take(q.reshape(T, -1), atom_q_idx.reshape(-1), axis=0
+                           ).reshape(-1, atom_size, H, hd)   # [NA, A, H, hd]
+        o_atoms = atom_paged_attention(
+            q_atoms, layer_k, layer_v, block_table,
+            batch["atom_seq"], batch["atom_qstart"], batch["atom_nq"],
+            q_len, ctx_len, block_size=block_size, scale=scale,
+            alibi=alibi, alibi_scaled=alibi_scaled)
+        return o_atoms[batch["token_atom"], batch["token_within"]] \
+            .reshape(T, H * hd)
+    q_idx = jnp.clip(batch["q_offset"][:, None] + jnp.arange(max_q)[None, :],
+                     0, T - 1)
+    q_seq = jnp.take(q.reshape(T, -1), q_idx.reshape(-1), axis=0
+                     ).reshape(-1, max_q, H, hd)             # [S, mq, H, hd]
+    o_seq = _attend_gather(q_seq, layer_k, layer_v, block_table,
+                           q_len, ctx_len, block_size, scale,
+                           alibi=alibi, alibi_scaled=alibi_scaled
+                           ).astype(q.dtype)
+    within = jnp.clip(
+        jnp.arange(T) - jnp.take(batch["q_offset"], batch["seq_of_token"]),
+        0, max_q - 1)
+    return o_seq[batch["seq_of_token"], within].reshape(T, H * hd)
+
+
 def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
-                   batch: Dict[str, jnp.ndarray], cfg: TransformerConfig,
-                   max_q: int, block_size: int,
-                   attn_impl: str = "paged") -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+                   batch, cfg: TransformerConfig,
+                   max_q: int, block_size: int, attn_impl: str = "paged",
+                   atom_size: int = 16, max_seqs: int = 0,
+                   max_blocks: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """→ (last-token logits [max_seqs, V], new kcache, new vcache)."""
+    batch = _unpack_batch(batch, max_q, max_seqs, max_blocks, atom_size)
     tokens = batch["tokens"]              # [T]
     kv_slot = batch["kv_slot"]            # [T]
     pos = batch["pos_of_token"]           # [T]
@@ -104,8 +182,6 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
     x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(dtype)  # [T, D]
     cos, sin = _rope_at(pos, hd, cfg.rope_theta)
 
-    # per-seq gather indices for queries: [S, max_q]
-    q_idx = jnp.clip(q_offset[:, None] + jnp.arange(max_q)[None, :], 0, T - 1)
     # ragged-padding mask: padded tokens write into the trailing trash block
     batch_valid = kv_slot < (kcache.shape[2] - block_size)
 
@@ -127,20 +203,10 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
         k = _apply_rope_flat(k, cos, sin)
         layer_k, layer_v = paged_kv_append(layer_k, layer_v, k, v, kv_slot)
 
-        q_seq = jnp.take(q.reshape(T, -1), q_idx.reshape(-1), axis=0
-                         ).reshape(-1, max_q, H, hd)           # [S, mq, H, hd]
-        if attn_impl == "paged":
-            o_seq = paged_attention(q_seq, layer_k, layer_v, block_table,
-                                    q_len, ctx_len, block_size=block_size,
-                                    scale=scale)
-        else:
-            o_seq = _attend_gather(q_seq, layer_k, layer_v, block_table,
-                                   q_len, ctx_len, block_size, scale)
-        o_seq = o_seq.astype(dtype)
-        # scatter back to flat tokens: out[t] = o_seq[seq_of[t], t - q_offset[seq_of[t]]]
-        within = jnp.arange(T) - jnp.take(q_offset, seq_of)
-        within = jnp.clip(within, 0, max_q - 1)
-        o_flat = o_seq[seq_of, within].reshape(T, H * hd)
+        o_flat = _ragged_attend(q, layer_k, layer_v, batch,
+                                attn_impl=attn_impl, atom_size=atom_size,
+                                max_q=max_q, block_size=block_size,
+                                scale=scale).astype(dtype)
         x = x + o_flat @ lp["o_proj"]["kernel"]
         h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
         if cfg.num_experts > 1:
@@ -172,12 +238,129 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
     return logits.astype(jnp.float32), new_k, new_v
 
 
-def build_ragged_step(cfg: TransformerConfig, max_q: int, block_size: int,
-                      attn_impl: str = "paged"):
+def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
+                             vcache: jnp.ndarray, batch, cfg,
+                             max_q: int, block_size: int,
+                             attn_impl: str = "paged", atom_size: int = 16,
+                             max_seqs: int = 0, max_blocks: int = 0
+                             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Paged ragged serving for the universal (ArchConfig) families —
+    gpt2/gptj/opt/bloom/falcon/phi serve through the SAME put/query/flush
+    engine and Pallas atom kernel as the native families (reference:
+    inference/v2/model_implementations/{falcon,phi,opt}/ per-arch ragged
+    models).  Arch knobs handled on the flat token axis: learned positions
+    (+opt's offset), ALiBi inside the kernel (bloom + falcon-scaled
+    variants), partial/interleaved rotary, parallel-attn, dual-LN,
+    LayerNorm-with-bias, gelu/relu/glu MLPs, lm-head bias."""
+    from ...models.families import ArchConfig, alibi_slopes, layer_norm
+
+    assert isinstance(cfg, ArchConfig)
+    batch = _unpack_batch(batch, max_q, max_seqs, max_blocks, atom_size)
+    tokens = batch["tokens"]
+    kv_slot = batch["kv_slot"]
+    pos = batch["pos_of_token"]
+    logit_idx = batch["logit_idx"]
+
+    T = tokens.shape[0]
+    H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    dtype = params["layers"]["q_proj"]["kernel"].dtype
+    scale = 1.0 / math.sqrt(hd)
+
+    def norm(x, p):
+        if cfg.norm == "rmsnorm":
+            return rms_norm(x, p["scale"], cfg.norm_eps)
+        return layer_norm(x, p["scale"], p["bias"], cfg.norm_eps)
+
+    def proj(h, p, n):
+        y = h @ p["kernel"]
+        if "bias" in p:
+            y = y + p["bias"]
+        return y.reshape(T, n, hd)
+
+    x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(dtype)
+    if cfg.pos == "learned":
+        x = x + jnp.take(params["pos_embed"]["embedding"],
+                         pos + cfg.pos_offset, axis=0).astype(dtype)
+    if cfg.embed_layernorm:
+        x = norm(x, params["embed_ln"])
+
+    cos = sin = None
+    if cfg.pos == "rope":
+        cos, sin = _rope_at(pos, cfg.rotary_dim, cfg.rope_theta)
+    alibi = alibi_slopes(H) if cfg.pos == "alibi" else None
+
+    def layer_step(carry, inputs):
+        x, = carry
+        lp, layer_k, layer_v = inputs
+        h_attn_in = norm(x, lp["ln1"])
+        q = proj(h_attn_in, lp["q_proj"], H)
+        k = proj(h_attn_in, lp["k_proj"], KV)
+        v = proj(h_attn_in, lp["v_proj"], KV)
+        if cfg.pos == "rope":
+            q = _apply_rope_flat(q, cos, sin, cfg.rotary_dim, cfg.rope_style)
+            k = _apply_rope_flat(k, cos, sin, cfg.rotary_dim, cfg.rope_style)
+        layer_k, layer_v = paged_kv_append(layer_k, layer_v, k, v, kv_slot)
+
+        o_flat = _ragged_attend(q, layer_k, layer_v, batch,
+                                attn_impl=attn_impl, atom_size=atom_size,
+                                max_q=max_q, block_size=block_size,
+                                scale=scale, alibi=alibi,
+                                alibi_scaled=cfg.alibi_scaled).astype(dtype)
+        attn_out = o_flat @ lp["o_proj"]["kernel"]
+        if "bias" in lp["o_proj"]:
+            attn_out = attn_out + lp["o_proj"]["bias"]
+
+        if cfg.parallel_attn:
+            h_mlp_in = norm(x, lp["ln2"]) if cfg.dual_ln else h_attn_in
+        else:
+            x = x + attn_out
+            h_mlp_in = norm(x, lp["ln2"])
+
+        if cfg.mlp == "silu_glu":
+            gate = jax.nn.silu(h_mlp_in @ lp["gate_proj"]["kernel"])
+            up = h_mlp_in @ lp["up_proj"]["kernel"]
+            mlp_out = (gate * up) @ lp["down_proj"]["kernel"]
+        else:
+            act = (lambda y: jax.nn.gelu(y, approximate=not cfg.gelu_exact)) \
+                if cfg.mlp == "gelu" else jax.nn.relu
+            h1 = h_mlp_in @ lp["fc1"]["kernel"]
+            if "bias" in lp["fc1"]:
+                h1 = h1 + lp["fc1"]["bias"]
+            mlp_out = act(h1) @ lp["fc2"]["kernel"]
+            if "bias" in lp["fc2"]:
+                mlp_out = mlp_out + lp["fc2"]["bias"]
+
+        x = x + attn_out + mlp_out if cfg.parallel_attn else x + mlp_out
+        return (x,), (layer_k, layer_v)
+
+    (x,), (new_k, new_v) = jax.lax.scan(
+        layer_step, (x,), (params["layers"], kcache, vcache))
+
+    x = norm(x, params["norm_f"])
+    last = jnp.take(x, logit_idx, axis=0)
+    if cfg.tie_embeddings:
+        logits = last @ params["embed"]["embedding"].T
+    else:
+        logits = last @ params["lm_head"]["kernel"]
+        if "bias" in params["lm_head"]:
+            logits = logits + params["lm_head"]["bias"]
+    return logits.astype(jnp.float32), new_k, new_v
+
+
+def build_ragged_step(cfg, max_q: int, block_size: int,
+                      attn_impl: str = "paged", atom_size: int = 16,
+                      max_seqs: int = 0, max_blocks: int = 0):
     """Jitted step with donated caches (the CUDA-graph analogue: one compiled
-    program reused for every batch; reference engine.py:494 _create_cuda_graph)."""
+    program reused for every batch; reference engine.py:494 _create_cuda_graph).
+    Dispatches on the config type: TransformerConfig → native llama-family
+    runner; ArchConfig → universal per-arch runner."""
+    from ...models.families import ArchConfig
+
     assert attn_impl in ("paged", "gather"), \
         f"attn_impl must be 'paged' or 'gather', got {attn_impl!r}"
-    fn = partial(ragged_forward, cfg=cfg, max_q=max_q, block_size=block_size,
-                 attn_impl=attn_impl)
+    body = ragged_forward_universal if isinstance(cfg, ArchConfig) \
+        else ragged_forward
+    fn = partial(body, cfg=cfg, max_q=max_q, block_size=block_size,
+                 attn_impl=attn_impl, atom_size=atom_size, max_seqs=max_seqs,
+                 max_blocks=max_blocks)
     return jax.jit(fn, donate_argnums=(1, 2))

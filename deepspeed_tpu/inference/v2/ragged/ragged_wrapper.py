@@ -31,6 +31,41 @@ import numpy as np
 from .sequence_descriptor import DSSequenceDescriptor
 
 
+def pack_layout(max_tokens: int, max_seqs: int, max_blocks: int,
+                n_atoms: int) -> Dict[str, Tuple[int, Tuple[int, ...]]]:
+    """Static (offset, shape) layout of the single packed int32 metadata
+    vector shipped host→device per forward.  One transfer instead of ~15:
+    over a remote-relay link the per-array H2D latency dominates decode
+    steps, so all batch metadata rides one buffer and is sliced on-device
+    (the csrc fast host-to-device batch-metadata path of the reference,
+    re-motivated by link latency rather than kernel-launch count)."""
+    fields = [
+        ("tokens", (max_tokens,)),
+        ("kv_slot", (max_tokens,)),
+        ("seq_of_token", (max_tokens,)),
+        ("pos_of_token", (max_tokens,)),
+        ("token_atom", (max_tokens,)),
+        ("token_within", (max_tokens,)),
+        ("q_offset", (max_seqs,)),
+        ("q_len", (max_seqs,)),
+        ("ctx_len", (max_seqs,)),
+        ("logit_idx", (max_seqs,)),
+        ("block_table", (max_seqs, max_blocks)),
+        ("atom_seq", (n_atoms,)),
+        ("atom_tok", (n_atoms,)),
+        ("atom_qstart", (n_atoms,)),
+        ("atom_nq", (n_atoms,)),
+    ]
+    layout = {}
+    off = 0
+    for name, shape in fields:
+        n = int(np.prod(shape))
+        layout[name] = (off, shape)
+        off += n
+    layout["_total"] = (off, ())
+    return layout
+
+
 @dataclasses.dataclass
 class RaggedBatch:
     tokens: np.ndarray
@@ -42,29 +77,33 @@ class RaggedBatch:
     ctx_len: np.ndarray
     block_table: np.ndarray
     logit_idx: np.ndarray
+    # Atom metadata (reference atom_builder.cu analogue): fixed-size query
+    # spans, each covering ≤ atom_size consecutive query tokens of ONE
+    # sequence.  The paged kernel grids over atoms, so a decode sequence
+    # costs one atom of rows — not a max_tokens-padded tile.
+    atom_seq: np.ndarray        # [NA] owning sequence row (pad → max_seqs-1)
+    atom_tok: np.ndarray        # [NA] flat token index of the atom's first query
+    atom_qstart: np.ndarray     # [NA] query index within the seq's span
+    atom_nq: np.ndarray         # [NA] real query tokens (0 = pad atom)
+    token_atom: np.ndarray      # [max_tokens] atom of each flat token
+    token_within: np.ndarray    # [max_tokens] row of each token inside its atom
     n_tokens: int
     n_seqs: int
     uids: List[int]
 
-    def to_device(self) -> Dict[str, Any]:
-        import jax.numpy as jnp
-
-        return {
-            "tokens": jnp.asarray(self.tokens, jnp.int32),
-            "kv_slot": jnp.asarray(self.kv_slot, jnp.int32),
-            "seq_of_token": jnp.asarray(self.seq_of_token, jnp.int32),
-            "pos_of_token": jnp.asarray(self.pos_of_token, jnp.int32),
-            "q_offset": jnp.asarray(self.q_offset, jnp.int32),
-            "q_len": jnp.asarray(self.q_len, jnp.int32),
-            "ctx_len": jnp.asarray(self.ctx_len, jnp.int32),
-            "block_table": jnp.asarray(self.block_table, jnp.int32),
-            "logit_idx": jnp.asarray(self.logit_idx, jnp.int32),
-        }
+    def pack(self) -> np.ndarray:
+        """Flatten all metadata into ONE int32 vector (see pack_layout)."""
+        return np.concatenate([
+            self.tokens, self.kv_slot, self.seq_of_token, self.pos_of_token,
+            self.token_atom, self.token_within, self.q_offset, self.q_len,
+            self.ctx_len, self.logit_idx, self.block_table.reshape(-1),
+            self.atom_seq, self.atom_tok, self.atom_qstart, self.atom_nq,
+        ]).astype(np.int32)
 
 
 class RaggedBatchWrapper:
     def __init__(self, max_tokens: int, max_seqs: int, max_ctx: int,
-                 block_size: int, trash_slot: int = 0):
+                 block_size: int, trash_slot: int = 0, atom_size: int = 16):
         self.max_tokens = max_tokens
         self.max_seqs = max_seqs
         self.max_ctx = max_ctx
@@ -73,6 +112,9 @@ class RaggedBatchWrapper:
         #: cache slot that padded tokens write into (must be inside the
         #: cache's dedicated trash block, or they would corrupt block 0)
         self.trash_slot = trash_slot
+        self.atom_size = min(atom_size, max_tokens)
+        #: static atom budget: sum_s ceil(q_len_s / A) ≤ ceil(T/A) + S
+        self.n_atoms = -(-max_tokens // self.atom_size) + max_seqs
         self.clear()
 
     def clear(self):
@@ -110,8 +152,16 @@ class RaggedBatchWrapper:
         ctx_len = np.zeros(ms, np.int32)
         block_table = np.zeros((ms, self.max_blocks), np.int32)
         logit_idx = np.zeros(ms, np.int32)
+        na, A = self.n_atoms, self.atom_size
+        atom_seq = np.full(na, ms - 1, np.int32)
+        atom_tok = np.zeros(na, np.int32)
+        atom_qstart = np.zeros(na, np.int32)
+        atom_nq = np.zeros(na, np.int32)
+        token_atom = np.zeros(mt, np.int32)
+        token_within = np.zeros(mt, np.int32)
         uids = []
 
+        atom_cursor = 0
         cursor = 0
         for row, (seq, new_toks) in enumerate(self._entries):
             n = len(new_toks)
@@ -132,10 +182,23 @@ class RaggedBatchWrapper:
             ctx_len[row] = total
             block_table[row, :len(blocks)] = blocks.astype(np.int32)
             logit_idx[row] = cursor + n - 1
+            # tile this sequence's query span into atoms of ≤ A tokens
+            for qs in range(0, n, A):
+                nq = min(A, n - qs)
+                atom_seq[atom_cursor] = row
+                atom_tok[atom_cursor] = cursor + qs
+                atom_qstart[atom_cursor] = qs
+                atom_nq[atom_cursor] = nq
+                token_atom[cursor + qs:cursor + qs + nq] = atom_cursor
+                token_within[cursor + qs:cursor + qs + nq] = np.arange(nq)
+                atom_cursor += 1
             cursor += n
 
         return RaggedBatch(tokens=tokens, kv_slot=kv_slot, seq_of_token=seq_of,
                            pos_of_token=pos_of, q_offset=q_offset, q_len=q_len,
                            ctx_len=ctx_len, block_table=block_table,
-                           logit_idx=logit_idx, n_tokens=cursor,
+                           logit_idx=logit_idx, atom_seq=atom_seq,
+                           atom_tok=atom_tok, atom_qstart=atom_qstart,
+                           atom_nq=atom_nq, token_atom=token_atom,
+                           token_within=token_within, n_tokens=cursor,
                            n_seqs=len(self._entries), uids=uids)

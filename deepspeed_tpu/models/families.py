@@ -50,6 +50,9 @@ class ArchConfig:
     mlp: str = "gelu"
     gelu_exact: bool = False        # falcon uses erf-gelu; gpt2/bloom/phi tanh
     parallel_attn: bool = False     # falcon/phi: attn + mlp from the same input
+    #: falcon-style ALiBi: bias added before 1/sqrt(hd) scaling, slope*pos in
+    #: bf16 (bloom adds the unscaled f32 bias after scaling)
+    alibi_scaled: bool = False
     dual_ln: bool = False           # falcon new-arch: separate ln_attn/ln_mlp
     qkv_bias: bool = True
     out_bias: bool = True           # o_proj bias
@@ -135,8 +138,16 @@ def _attention(q, k, v, cfg: ArchConfig, alibi: Optional[jnp.ndarray]):
     if alibi is not None:
         # ALiBi (bloom build_alibi_tensor): slope_h * k_pos — equivalent to
         # slope*(k_pos - q_pos) under softmax's per-row shift invariance.
-        scores = scores + alibi[None, :, None, None] * \
-            jnp.arange(S, dtype=jnp.float32)[None, None, None, :]
+        if cfg.alibi_scaled:
+            # falcon variant (modeling_falcon.py:397-398): the bias is added
+            # BEFORE the 1/sqrt(hd) scaling and slope*pos is computed in bf16
+            bias = (alibi.astype(jnp.bfloat16)[None, :, None, None] *
+                    jnp.arange(S, dtype=jnp.bfloat16)[None, None, None, :]
+                    ).astype(jnp.float32) / math.sqrt(hd)
+        else:
+            bias = alibi[None, :, None, None] * \
+                jnp.arange(S, dtype=jnp.float32)[None, None, None, :]
+        scores = scores + bias
     mask = jnp.arange(S)[:, None] >= jnp.arange(S)[None, :]
     scores = jnp.where(mask[None, None], scores, -1e30)
     probs = jax.nn.softmax(scores, axis=-1)

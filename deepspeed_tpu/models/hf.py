@@ -132,7 +132,12 @@ def arch_config_from_hf(hf_config: Any, **overrides):
         new_arch = bool(g("new_decoder_architecture", default=False))
         kv = g("num_kv_heads", default=None) if new_arch else \
             (1 if g("multi_query", default=True) else heads)
-        base.update(pos="rope", norm="layernorm", mlp="gelu", gelu_exact=True,
+        # falcon-rw checkpoints (modeling_falcon.py FalconConfig): alibi=True
+        # replaces rotary; parallel_attn=False is the sequential residual
+        # (needs ln2 from post_attention_layernorm — see the converter)
+        base.update(pos="alibi" if g("alibi", default=False) else "rope",
+                    alibi_scaled=bool(g("alibi", default=False)),
+                    norm="layernorm", mlp="gelu", gelu_exact=True,
                     parallel_attn=bool(g("parallel_attn", default=True)),
                     dual_ln=new_arch, num_kv_heads=kv or heads,
                     qkv_bias=bool(g("bias", default=False)),
@@ -388,6 +393,10 @@ def convert_arch_state_dict(sd: Dict[str, Any], cfg, fam: str) -> Dict:
         else:
             layers["ln1"] = ln(p + ".input_layernorm.weight",
                                p + ".input_layernorm.bias")
+            if not cfg.parallel_attn:
+                # sequential residual (falcon-rw): the model consumes ln2
+                layers["ln2"] = ln(p + ".post_attention_layernorm.weight",
+                                   p + ".post_attention_layernorm.bias")
         return {
             "embed": {"embedding": jnp.asarray(t("transformer.word_embeddings.weight"))},
             "layers": layers,

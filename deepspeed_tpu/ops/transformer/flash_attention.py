@@ -25,6 +25,12 @@ from jax.experimental.pallas import tpu as pltpu
 DEFAULT_BLOCK_Q = 256
 DEFAULT_BLOCK_K = 256
 _NEG_INF = -1e30
+#: Row-stats arrays (lse, delta) carry a trailing lane dim so their block
+#: shape satisfies Mosaic's (sublane, lane) tiling rule — a rank-3 [B, H, S]
+#: block of (1, 1, block_q) fails lowering on real TPUs.  8 lanes (== the
+#: array dim, which Mosaic accepts) keeps the residual 16x smaller than the
+#: canonical 128-lane layout.
+_STATS_LANES = 8
 
 
 def _interpret() -> bool:
@@ -51,18 +57,16 @@ def _causal_kv_index(causal: bool, block_q: int, block_k: int):
     return index
 
 
-def _causal_q_index(causal: bool, block_q: int, block_k: int, rank3: bool):
+def _causal_q_index(causal: bool, block_q: int, block_k: int):
     """Q-side index map for the dkv grid (b, h, ik, iq): below-diagonal q
     blocks clamp UP to the first needed one (same DMA-skip trick)."""
     if not causal:
-        if rank3:
-            return lambda b, h, j, i: (b, h, i)
         return lambda b, h, j, i: (b, h, i, 0)
 
     def index(b, h, j, i):
         first_needed = (j * block_k) // block_q
         i_eff = jnp.maximum(i, first_needed)
-        return (b, h, i_eff) if rank3 else (b, h, i_eff, 0)
+        return (b, h, i_eff, 0)
 
     return index
 
@@ -115,7 +119,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc, m_scr, l_scr, *,
         l = l_scr[:, :1]
         l_safe = jnp.where(l == 0.0, 1.0, l)
         o_ref[0, 0] = (acc[:] / l_safe).astype(o_ref.dtype)
-        lse_ref[0, 0] = (m_scr[:, :1] + jnp.log(l_safe))[:, 0]
+        lse_ref[0, 0] = jnp.broadcast_to(m_scr[:, :1] + jnp.log(l_safe),
+                                         lse_ref.shape[2:])
 
 
 def _fwd(q, k, v, scale, causal, block_q, block_k):
@@ -143,11 +148,12 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0)),
-            pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i)),
+            pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                         lambda b, h, i, j: (b, h, i, 0)),
         ],
         out_shape=[
             jax.ShapeDtypeStruct((B, H, Sq, hd), q.dtype),
-            jax.ShapeDtypeStruct((B, H, Sq), jnp.float32),
+            jax.ShapeDtypeStruct((B, H, Sq, _STATS_LANES), jnp.float32),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_q, hd), jnp.float32),
@@ -156,7 +162,7 @@ def _fwd(q, k, v, scale, causal, block_q, block_k):
         ],
         interpret=_interpret(),
     )(qp, kp, vp)
-    return out[:, :, :S], lse[:, :, :S]
+    return out[:, :, :S], lse[:, :, :S, 0]
 
 
 # ===================================================================== #
@@ -181,8 +187,8 @@ def _bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         q_pos = q_first + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         k_pos = k_first + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -220,8 +226,8 @@ def _bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         k = k_ref[0, 0].astype(jnp.float32)
         v = v_ref[0, 0].astype(jnp.float32)
         do = do_ref[0, 0].astype(jnp.float32)
-        lse = lse_ref[0, 0][:, None]
-        delta = delta_ref[0, 0][:, None]
+        lse = lse_ref[0, 0][:, :1]
+        delta = delta_ref[0, 0][:, :1]
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         q_pos = q_first + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
         k_pos = k_first + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
@@ -251,13 +257,17 @@ def _bwd(scale, causal, block_q, block_k, res, g):
     pad_q = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, Sq - S), (0, 0)))
     pad_k = lambda x: jnp.pad(x, ((0, 0), (0, 0), (0, Sk - S), (0, 0)))
     qp, kp, vp, dop = pad_q(q), pad_k(k), pad_k(v), pad_q(do)
-    lsep = jnp.pad(lse, ((0, 0), (0, 0), (0, Sq - S)))
-    deltap = jnp.pad(delta, ((0, 0), (0, 0), (0, Sq - S)))
+    pad_r = lambda x: jnp.broadcast_to(
+        jnp.pad(x, ((0, 0), (0, 0), (0, Sq - S)))[..., None],
+        (B, H, Sq, _STATS_LANES))
+    lsep = pad_r(lse)
+    deltap = pad_r(delta)
 
     q_spec = pl.BlockSpec((1, 1, block_q, hd), lambda b, h, i, j: (b, h, i, 0))
     k_spec = pl.BlockSpec((1, 1, block_k, hd),
                           _causal_kv_index(causal, block_q, block_k))
-    r_spec = pl.BlockSpec((1, 1, block_q), lambda b, h, i, j: (b, h, i))
+    r_spec = pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                          lambda b, h, i, j: (b, h, i, 0))
 
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
@@ -273,10 +283,10 @@ def _bwd(scale, causal, block_q, block_k, res, g):
     # dkv: kv-blocks outer, q-blocks inner; below-diagonal q blocks are the
     # masked ones here, so the q index map clamps UP to the first needed one
     q_spec2 = pl.BlockSpec((1, 1, block_q, hd),
-                           _causal_q_index(causal, block_q, block_k, False))
+                           _causal_q_index(causal, block_q, block_k))
     k_spec2 = pl.BlockSpec((1, 1, block_k, hd), lambda b, h, j, i: (b, h, j, 0))
-    r_spec2 = pl.BlockSpec((1, 1, block_q),
-                           _causal_q_index(causal, block_q, block_k, True))
+    r_spec2 = pl.BlockSpec((1, 1, block_q, _STATS_LANES),
+                           _causal_q_index(causal, block_q, block_k))
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           block_q=block_q, block_k=block_k, seq_len=S),

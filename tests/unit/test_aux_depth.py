@@ -122,7 +122,9 @@ class TestExperimentScheduler:
         assert calls == [0, 1, 2]
         best = sched.best()
         assert best["best"] == "t2" and best["best_metric"] == 20.0
-        assert os.path.exists(tmp_path / "t1" / "metrics.json")
+        t1_dirs = [d for d in os.listdir(tmp_path) if d.startswith("t1-")]
+        assert len(t1_dirs) == 1  # trial dir keyed name-confighash
+        assert os.path.exists(tmp_path / t1_dirs[0] / "metrics.json")
 
         # resume: successful trials cached, the FAILED one retries (errors
         # are often transient — busy TPU runtime)
@@ -133,6 +135,14 @@ class TestExperimentScheduler:
         sched2.run(exps2, run_fn)
         assert calls == [1]
         assert exps2[2].metric_value == 20.0
+
+        # changed search space under the SAME experiment name must re-run,
+        # not return the stale metric recorded for a different config_patch
+        calls.clear()
+        exps3 = [Experiment(name="t2", config_patch={"x": 7})]
+        sched3 = ExperimentScheduler(str(tmp_path))
+        sched3.run(exps3, run_fn)
+        assert calls == [7] and exps3[0].metric_value == 70.0
 
         # cache_errors=True: nothing re-runs at all
         calls.clear()
