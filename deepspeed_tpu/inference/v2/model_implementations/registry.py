@@ -42,7 +42,7 @@ class ModelImplementation:
 
 
 #: per-arch serving notes; arch→family comes from models/hf.py's policy map
-#: (single source of truth) and ragged_native = family in NATIVE_FAMILIES
+#: (single source of truth); every buildable family serves ragged
 _NOTES = {
     "Qwen2ForCausalLM": "llama + qkv bias",
     "MixtralForCausalLM": "MoE serving via sparse-slot dispatch",
@@ -68,7 +68,7 @@ def _ensure_impls() -> Dict[str, ModelImplementation]:
     end-to-end recipes) and is validated against the policy map so a new
     family shows up as a loud assertion, not a silent omission."""
     if not _IMPLS:
-        from ....models.hf import _ARCH_POLICIES, NATIVE_FAMILIES
+        from ....models.hf import _ARCH_POLICIES
 
         known = set(_ARCH_POLICIES.values())
         unknown = set(_BUILDABLE_FAMILIES) - known
@@ -76,7 +76,6 @@ def _ensure_impls() -> Dict[str, ModelImplementation]:
         missing = known - set(_BUILDABLE_FAMILIES)
         assert not missing, (f"families {missing} added to the policy map "
                              f"but not classified here as buildable/not")
-        del NATIVE_FAMILIES  # all buildable families serve ragged now
         _IMPLS.update({arch: ModelImplementation(
             arch, fam, True, _NOTES.get(arch, ""))
             for arch, fam in _ARCH_POLICIES.items()

@@ -13,7 +13,7 @@ import json
 from enum import Enum
 from typing import Any, Dict, List, Optional, Union
 
-from pydantic import Field
+from pydantic import Field, model_validator
 
 from ..utils.logging import logger
 from .config_utils import DeepSpeedConfigModel
@@ -112,6 +112,24 @@ class PipelineConfig(DeepSpeedConfigModel):
     activation_checkpoint_interval: int = 0
     pipe_partitioned: bool = True
     grad_partitioned: bool = True
+    #: "1f1b" (reference TrainSchedule semantics: fwd/bwd interleaved in one
+    #: lockstep loop, in-flight activations bounded by O(pp) not O(micro));
+    #: "gpipe" (fill-drain forward, autodiff backward).
+    schedule: str = "1f1b"
+    #: Megatron virtual-pipeline chunks per rank (interleaved 1F1B): the
+    #: fill/drain bubble shrinks to (pp-1)/V stage-times.  Needs
+    #: num_micro % pp == 0 and V | layers-per-rank.
+    virtual_stages: int = 1
+
+    @model_validator(mode="after")
+    def _check_schedule(self):
+        if self.schedule not in ("1f1b", "gpipe"):
+            raise ValueError(f"pipeline.schedule must be '1f1b' or 'gpipe', "
+                             f"got {self.schedule!r}")
+        if self.virtual_stages > 1 and self.schedule != "1f1b":
+            raise ValueError("pipeline.virtual_stages > 1 requires the "
+                             "'1f1b' schedule")
+        return self
 
 
 class CheckpointConfig(DeepSpeedConfigModel):

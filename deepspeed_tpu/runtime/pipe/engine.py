@@ -38,6 +38,48 @@ def _tp_psum(x, tp: int):
     return jax.lax.psum(x, TENSOR) if tp > 1 else x
 
 
+def _tp_g_op(x, tp: int):
+    """Megatron "g" operator for the hand-written 1F1B backward: forward
+    all-reduce over TENSOR, backward identity.
+
+    The 1F1B loop differentiates the PER-RANK program, so the Megatron f/g
+    conjugate pair (megatron/core/tensor_parallel/mappings.py semantics)
+    makes every per-rank cotangent carry the TRUE magnitude: g passes the
+    (replicated) output cotangent straight to each rank's sharded branch,
+    and f (below) all-reduces the partial input cotangents back to
+    replicated-true.  Result: every param grad — sharded or replicated —
+    is already complete on its own rank, and the 1F1B grad reduction never
+    psums over TENSOR.  GPipe keeps the plain psum: shard_map autodiff
+    inserts its own transposes there.
+    """
+    if tp == 1:
+        return x
+
+    @jax.custom_vjp
+    def g_op(y):
+        return jax.lax.psum(y, TENSOR)
+
+    g_op.defvjp(lambda y: (jax.lax.psum(y, TENSOR), None),
+                lambda _, ct: (ct,))
+    return g_op(x)
+
+
+def _tp_f_op(x, tp: int):
+    """Megatron "f" operator: forward identity, backward all-reduce over
+    TENSOR — placed where a replicated activation enters a tensor-sharded
+    branch (see _tp_g_op)."""
+    if tp == 1:
+        return x
+
+    @jax.custom_vjp
+    def f_op(y):
+        return y
+
+    f_op.defvjp(lambda y: (y, None),
+                lambda _, ct: (jax.lax.psum(ct, TENSOR),))
+    return f_op(x)
+
+
 def pipeline_lm_loss(params: Dict, batch: Any, cfg, topo, rng,
                      num_micro: int) -> jnp.ndarray:
     """GPipe fill-drain loss over the pipe axis (jit-compatible).
@@ -48,6 +90,43 @@ def pipeline_lm_loss(params: Dict, batch: Any, cfg, topo, rng,
     cannot compose Ulysses with its Python-dispatch pipeline — the all-to-all
     inside a ppermute tick is TPU-native headroom).
     """
+    return _pipeline_lm(params, batch, cfg, topo, rng, num_micro,
+                        schedule="gpipe")
+
+
+def pipeline_lm_loss_1f1b(params: Dict, batch: Any, cfg, topo, rng,
+                          num_micro: int, loss_scale=1.0,
+                          virtual_stages: int = 1):
+    """1F1B pipeline step → ``(loss, grads)`` (reference ``TrainSchedule``,
+    runtime/pipe/schedule.py:189).
+
+    Unlike the GPipe path (forward scan + autodiff replay, which keeps every
+    microbatch's boundary activation alive), each lockstep tick here runs ONE
+    forward slot and ONE backward slot: stage s forwards microbatch ``t-s``
+    while back-propagating microbatch ``t-(2·pp-2-s)`` whose output-grad just
+    arrived on the reverse ring.  In-flight state is a circular buffer of
+    2·pp-1 stage INPUTS — O(pp), independent of num_micro — and the backward
+    slot recomputes its stage forward from the saved input (per-stage
+    activation checkpointing, the reference's default for pipe training).
+    Activation ppermute (forward ring) and grad ppermute (reverse ring) both
+    issue at tick end, so XLA overlaps them with the next tick's compute —
+    the double-buffered p2p of the reference's separate CUDA streams.
+
+    ``virtual_stages`` V > 1 runs the INTERLEAVED schedule (reference
+    ``TrainSchedule`` with Megatron virtual-pipeline chunks): rank s holds
+    layer chunks {s, s+pp, ...} of a V·pp virtual ring riding the SAME
+    physical ppermute — chunk c of rank pp-1 hands to chunk c+1 of rank 0
+    on the next tick with no extra hop.  Ticks shrink to 1/V of a stage, so
+    the fill/drain bubble costs (pp-1)/V stage-times instead of pp-1.
+    Requires num_micro % pp == 0 (microbatches flow in groups of pp).
+    """
+    return _pipeline_lm(params, batch, cfg, topo, rng, num_micro,
+                        schedule="1f1b", loss_scale=loss_scale,
+                        virtual_stages=virtual_stages)
+
+
+def _pipeline_lm(params: Dict, batch: Any, cfg, topo, rng, num_micro: int,
+                 schedule: str, loss_scale=1.0, virtual_stages: int = 1):
     from ...models.transformer import apply_rope, lm_loss, rms_norm, rope_tables
 
     pp = topo.dims[PIPE]
@@ -55,6 +134,7 @@ def pipeline_lm_loss(params: Dict, batch: Any, cfg, topo, rng,
     sp = topo.dims[SEQ]
     tokens = batch["input_ids"] if isinstance(batch, dict) else batch
     if pp == 1:
+        assert schedule == "gpipe", "1f1b needs pipe>1 (engine guards this)"
         return lm_loss(params, {"input_ids": tokens}, cfg, rng)
     if sp > 1 and (cfg.num_heads // tp) % sp != 0:
         raise ValueError(f"SP×PP needs local heads ({cfg.num_heads}//{tp}) "
@@ -82,10 +162,9 @@ def pipeline_lm_loss(params: Dict, batch: Any, cfg, topo, rng,
             sin = jax.lax.dynamic_slice_in_dim(sin_all, seq_idx * S_loc, S_loc)
         else:
             cos, sin = cos_all, sin_all
-        layers = params["layers"]          # local slice [L/pp, ...]
         H_loc = cfg.num_heads // tp
         KV_loc = max(cfg.num_kv_heads // tp, 1)
-        dtype = layers["q_proj"]["kernel"].dtype
+        dtype = params["layers"]["q_proj"]["kernel"].dtype
 
         def attend(q, k, v):
             from ...models.transformer import _xla_attention
@@ -100,8 +179,14 @@ def pipeline_lm_loss(params: Dict, batch: Any, cfg, topo, rng,
             o = _xla_attention(q, k, v, causal=True)
             return _seq_all_to_all(o, scatter_heads=False)
 
+        if schedule == "1f1b":
+            tp_reduce, tp_enter = _tp_g_op, _tp_f_op
+        else:
+            tp_reduce, tp_enter = _tp_psum, lambda x, _: x
+
         def one_layer(x, lp):
             h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
+            h = tp_enter(h, tp)
             q = (h @ lp["q_proj"]["kernel"]).reshape(mb, S_loc, H_loc, cfg.head_dim)
             k = (h @ lp["k_proj"]["kernel"]).reshape(mb, S_loc, KV_loc, cfg.head_dim)
             v = (h @ lp["v_proj"]["kernel"]).reshape(mb, S_loc, KV_loc, cfg.head_dim)
@@ -114,17 +199,18 @@ def pipeline_lm_loss(params: Dict, batch: Any, cfg, topo, rng,
                 k = jnp.repeat(k, H_loc // KV_loc, axis=2)
                 v = jnp.repeat(v, H_loc // KV_loc, axis=2)
             o = attend(q, k, v)
-            x = x + _tp_psum(o.reshape(mb, S_loc, -1) @ lp["o_proj"]["kernel"], tp)
+            x = x + tp_reduce(o.reshape(mb, S_loc, -1) @ lp["o_proj"]["kernel"], tp)
             h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
+            h = tp_enter(h, tp)
             gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
             up = h @ lp["up_proj"]["kernel"]
-            x = x + _tp_psum((gate * up) @ lp["down_proj"]["kernel"], tp)
+            x = x + tp_reduce((gate * up) @ lp["down_proj"]["kernel"], tp)
             return x, None
 
         layer_fn = jax.checkpoint(one_layer) if cfg.remat else one_layer
 
-        def stage_fn(x):
-            x, _ = jax.lax.scan(layer_fn, x, layers)
+        def stage_fn(p, x):
+            x, _ = jax.lax.scan(layer_fn, x, p["layers"])
             return x
 
         # Labels for every microbatch, computed BEFORE the pipeline loop:
@@ -142,13 +228,13 @@ def pipeline_lm_loss(params: Dict, batch: Any, cfg, topo, rng,
             label_mb = jnp.pad(tmb[:, :, 1:], ((0, 0), (0, 0), (0, 1)),
                                constant_values=-100)
 
-        def loss_of(h, labels):
+        def loss_of(p, h, labels):
             """Per-shard (sum, count) over this rank's label slice."""
-            h = rms_norm(h, params["norm_f"]["scale"], cfg.norm_eps)
+            h = rms_norm(h, p["norm_f"]["scale"], cfg.norm_eps)
             if cfg.tie_embeddings:
-                logits = h @ params["embed"]["embedding"].T
+                logits = h @ p["embed"]["embedding"].T
             else:
-                logits = h @ params["lm_head"]["kernel"]
+                logits = h @ p["lm_head"]["kernel"]
             logits = logits.astype(jnp.float32)
             logp = jax.nn.log_softmax(logits, axis=-1)
             valid = labels >= 0
@@ -158,40 +244,231 @@ def pipeline_lm_loss(params: Dict, batch: Any, cfg, topo, rng,
 
         D = cfg.hidden_size
         perm = [(i, (i + 1) % pp) for i in range(pp)]
-        T = num_micro + pp - 1
+        sum_axes = (PIPE,) + ((SEQ,) if sp > 1 else ()) + (batch_axes or ())
 
-        def tick(carry, t):
-            buf, loss_acc, count_acc = carry
-            in_idx = jnp.clip(t, 0, num_micro - 1)
-            toks_in = jax.lax.dynamic_index_in_dim(tmb, in_idx, 0, keepdims=False)
-            x_embed = jnp.take(params["embed"]["embedding"], toks_in, axis=0
+        def f_tick(p, toks_in, buf, labels, emit):
+            """One stage slot: embed-or-receive, stage layers, (masked) loss.
+            Parameters are explicit args so the 1F1B backward slot can
+            jax.vjp through it."""
+            x_embed = jnp.take(p["embed"]["embedding"], toks_in, axis=0
                                ).astype(dtype)
             x = jnp.where(stage == 0, x_embed, buf)
-            h = stage_fn(x)
-            out_idx = jnp.clip(t - (pp - 1), 0, num_micro - 1)
-            labels_out = jax.lax.dynamic_index_in_dim(label_mb, out_idx, 0,
-                                                      keepdims=False)
-            is_emit = jnp.logical_and(stage == pp - 1, t >= pp - 1)
-            mb_loss, mb_count = jax.lax.cond(
-                is_emit, lambda: loss_of(h, labels_out),
+            h = stage_fn(p, x)
+            sl, cn = jax.lax.cond(
+                emit, lambda: loss_of(p, h, labels),
                 lambda: (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)))
-            buf_next = jax.lax.ppermute(h, PIPE, perm)
-            return (buf_next, loss_acc + mb_loss, count_acc + mb_count), None
+            return h, sl, cn
 
+        if schedule == "gpipe":
+            T = num_micro + pp - 1
+
+            def tick(carry, t):
+                buf, loss_acc, count_acc = carry
+                in_idx = jnp.clip(t, 0, num_micro - 1)
+                toks_in = jax.lax.dynamic_index_in_dim(tmb, in_idx, 0,
+                                                       keepdims=False)
+                out_idx = jnp.clip(t - (pp - 1), 0, num_micro - 1)
+                labels_out = jax.lax.dynamic_index_in_dim(label_mb, out_idx, 0,
+                                                          keepdims=False)
+                is_emit = jnp.logical_and(stage == pp - 1, t >= pp - 1)
+                h, mb_loss, mb_count = f_tick(params, toks_in, buf,
+                                              labels_out, is_emit)
+                buf_next = jax.lax.ppermute(h, PIPE, perm)
+                return (buf_next, loss_acc + mb_loss, count_acc + mb_count), None
+
+            buf0 = jnp.zeros((mb, S_loc, D), dtype)
+            (_, loss_acc, count_acc), _ = jax.lax.scan(
+                tick, (buf0, jnp.zeros((), jnp.float32),
+                       jnp.zeros((), jnp.float32)), jnp.arange(T))
+            # Token-weighted mean over pipe stages (only the last stage
+            # emitted), seq shards, and data ranks; the returned scalar must
+            # be identical on every shard (out_spec is replicated).
+            loss = jax.lax.psum(loss_acc, sum_axes) / \
+                jnp.maximum(jax.lax.psum(count_acc, sum_axes), 1.0)
+            return loss
+
+        # ---------------- 1F1B schedule (V virtual stages/rank) ------- #
+        # Virtual stage vs = c·pp + s rides the physical ring: chunk c of
+        # rank pp-1 hands to chunk c+1 of rank 0 next tick.  Microbatch
+        # m = G·pp + j has offset off(m) = G·V·pp + j; it forwards through
+        # vs at tick off+vs and backwards at tick off + 2(V·pp-1) - vs.
+        # V = 1 reduces to plain 1F1B (off(m) = m).  The last virtual
+        # stage's B slot is the same tick as its F slot (immediate loss
+        # backward — the 1F1B signature).  The input ring holds 2·V·pp - 1
+        # slots: a saved input lives 2(V·pp-1-vs) ticks.
+        V = virtual_stages
+        if V > 1 and num_micro % pp != 0:
+            raise ValueError(f"interleaved 1F1B (virtual_stages={V}) needs "
+                             f"num_micro ({num_micro}) % pp ({pp}) == 0")
+        L_loc = params["layers"]["q_proj"]["kernel"].shape[0]
+        if L_loc % V != 0:
+            raise ValueError(f"virtual_stages={V} must divide the per-rank "
+                             f"layer count {L_loc}")
+        vpp = V * pp
+        rev_perm = [(i, (i - 1) % pp) for i in range(pp)]
+        R = 2 * vpp - 1
+        off_max = num_micro - 1 if V == 1 else \
+            (num_micro // pp - 1) * vpp + pp - 1
+        T = off_max + 2 * (vpp - 1) + 1
+        f32z = jnp.zeros((), jnp.float32)
+
+        def slot_f(t):
+            """F slot of this rank at tick t → (m, chunk, valid)."""
+            q = t - stage
+            if V == 1:
+                return q, jnp.zeros((), q.dtype), \
+                    jnp.logical_and(q >= 0, q < num_micro)
+            c = jnp.mod(q // pp, V)
+            m = (q // vpp) * pp + jnp.mod(q, pp)
+            return m, c, jnp.logical_and(q >= 0, m < num_micro)
+
+        def slot_b(t):
+            """B slot: the unique chunk c whose off = t - 2(vpp-1) + c·pp +
+            stage lands on a group boundary residue (< pp)."""
+            if V == 1:
+                m = t - (2 * pp - 2 - stage)
+                return m, jnp.zeros((), m.dtype), \
+                    jnp.logical_and(m >= 0, m < num_micro)
+            m_sel = jnp.zeros((), t.dtype)
+            c_sel = jnp.zeros((), t.dtype)
+            ok = jnp.zeros((), jnp.bool_)
+            for c in range(V):
+                off = t - 2 * (vpp - 1) + c * pp + stage
+                j = jnp.mod(off, vpp)
+                m = (off // vpp) * pp + j
+                valid = (off >= 0) & (j < pp) & (m < num_micro)
+                m_sel = jnp.where(valid, m, m_sel)
+                c_sel = jnp.where(valid, c, c_sel)
+                ok = jnp.logical_or(ok, valid)
+            return m_sel, c_sel, ok
+
+        Lc = L_loc // V
+
+        def f_tick_v(p, toks_in, buf, labels, chunk):
+            """One VIRTUAL stage slot: embed at vs 0, chunk layers, loss at
+            vs V·pp-1.  Differentiable in (p, buf)."""
+            is_first_vs = jnp.logical_and(stage == 0, chunk == 0)
+            is_last_vs = jnp.logical_and(stage == pp - 1, chunk == V - 1)
+            x_embed = jnp.take(p["embed"]["embedding"], toks_in, axis=0
+                               ).astype(dtype)
+            x = jnp.where(is_first_vs, x_embed, buf)
+            chunk_layers = jax.tree.map(
+                lambda a: jax.lax.dynamic_slice_in_dim(a, chunk * Lc, Lc, 0),
+                p["layers"])
+            h = stage_fn({**p, "layers": chunk_layers}, x)
+            sl, cn = jax.lax.cond(
+                is_last_vs, lambda: loss_of(p, h, labels),
+                lambda: (f32z, f32z))
+            return h, sl, cn
+
+        def tick(carry, t):
+            ring, abuf, gbuf, grad_acc, loss_acc, count_acc = carry
+            # ---- forward slot ----
+            m_f, c_f, f_valid = slot_f(t)
+            idx_f = jnp.clip(m_f, 0, num_micro - 1)
+            toks_f = jax.lax.dynamic_index_in_dim(tmb, idx_f, 0, keepdims=False)
+            labels_f = jax.lax.dynamic_index_in_dim(label_mb, idx_f, 0,
+                                                    keepdims=False)
+            ring = jax.lax.dynamic_update_index_in_dim(
+                ring, abuf, jnp.mod(t, R), 0)
+            h, sl, cn = f_tick_v(params, toks_f, abuf, labels_f, c_f)
+            emit = jnp.logical_and(
+                jnp.logical_and(stage == pp - 1, c_f == V - 1), f_valid)
+            loss_acc = loss_acc + jnp.where(emit, sl, 0.0)
+            count_acc = count_acc + jnp.where(emit, cn, 0.0)
+
+            # ---- backward slot ----
+            m_b, c_b, b_valid = slot_b(t)
+            idx_b = jnp.clip(m_b, 0, num_micro - 1)
+            toks_b = jax.lax.dynamic_index_in_dim(tmb, idx_b, 0, keepdims=False)
+            labels_b = jax.lax.dynamic_index_in_dim(label_mb, idx_b, 0,
+                                                    keepdims=False)
+            vs_b = c_b * pp + stage
+            x_saved = jax.lax.dynamic_index_in_dim(
+                ring, jnp.mod(t - 2 * (vpp - 1) + 2 * vs_b, R), 0,
+                keepdims=False)
+            _, vjp_fn = jax.vjp(
+                lambda p, bf: f_tick_v(p, toks_b, bf, labels_b, c_b)[:2],
+                params, x_saved)
+            # Zero cotangents on invalid slots make dp/dbuf exactly zero
+            # (vjp is linear) — the fill/drain garbage never touches grads.
+            b_is_last = jnp.logical_and(stage == pp - 1, c_b == V - 1)
+            g_h = jnp.where(jnp.logical_and(b_valid, ~b_is_last), 1.0, 0.0) \
+                * gbuf
+            g_sl = jnp.where(jnp.logical_and(b_valid, b_is_last),
+                             jnp.asarray(loss_scale, jnp.float32), 0.0)
+            dp, dbuf = vjp_fn((g_h.astype(dtype), g_sl))
+            grad_acc = jax.tree.map(
+                lambda a, g: a + g.astype(jnp.float32), grad_acc, dp)
+
+            # both rings issue together at tick end: XLA overlaps the
+            # forward-act and reverse-grad permutes with the next tick
+            abuf_next = jax.lax.ppermute(h, PIPE, perm)
+            gbuf_next = jax.lax.ppermute(dbuf.astype(dtype), PIPE, rev_perm)
+            return (ring, abuf_next, gbuf_next, grad_acc, loss_acc,
+                    count_acc), None
+
+        ring0 = jnp.zeros((R, mb, S_loc, D), dtype)
         buf0 = jnp.zeros((mb, S_loc, D), dtype)
-        (_, loss_acc, count_acc), _ = jax.lax.scan(
-            tick, (buf0, jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
-            jnp.arange(T))
-        # Token-weighted mean over pipe stages (only the last stage emitted),
-        # seq shards, and data ranks; the returned scalar must be identical
-        # on every shard (out_spec is replicated).
-        sum_axes = (PIPE,) + ((SEQ,) if sp > 1 else ()) + (batch_axes or ())
-        loss = jax.lax.psum(loss_acc, sum_axes) / \
-            jnp.maximum(jax.lax.psum(count_acc, sum_axes), 1.0)
-        return loss
+        grad0 = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
+        (_, _, _, grads, loss_acc, count_acc), _ = jax.lax.scan(
+            tick, (ring0, buf0, buf0, grad0, f32z, f32z), jnp.arange(T))
 
-    return jax.shard_map(body, mesh=mesh, in_specs=(spec_tree, tok_spec),
-                         out_specs=P(), check_vma=False)(params, tokens)
+        total_count = jnp.maximum(jax.lax.psum(count_acc, sum_axes), 1.0)
+        loss = jax.lax.psum(loss_acc, sum_axes) / total_count
+        # Grad normalization matches the loss: each microbatch's loss_of
+        # returns a SUM over tokens, so divide by the global token count.
+        # Cross-shard reduction rule: a leaf's grad is partial on every mesh
+        # axis its partition spec does NOT mention (data/seq always; pipe for
+        # the replicated embed/norm/head leaves) — with the exception of
+        # TENSOR: the Megatron f/g operators inside the layer already leave
+        # every per-rank grad complete w.r.t. the tensor axis (see _tp_g_op).
+        def reduce_leaf(g, spec):
+            mentioned = set()
+            for entry in spec:
+                if entry is None:
+                    continue
+                mentioned.update(entry if isinstance(entry, (tuple, list))
+                                 else (entry,))
+            axes = tuple(a for a in (PIPE, DATA_OUTER, DATA, EXPERT, SEQ)
+                         if topo.dims[a] > 1 and a not in mentioned)
+            g = g / total_count
+            return jax.lax.psum(g, axes) if axes else g
+
+        grads = jax.tree.map(reduce_leaf, grads, spec_tree,
+                             is_leaf=lambda x: isinstance(x, P))
+        return loss, grads
+
+    if schedule == "gpipe":
+        return jax.shard_map(body, mesh=mesh, in_specs=(spec_tree, tok_spec),
+                             out_specs=P(), check_vma=False)(params, tokens)
+
+    if virtual_stages > 1:
+        # Interleaved layer placement: virtual stage vs = c·pp + s means
+        # rank s owns global layer chunks {s, s+pp, ..., s+(V-1)·pp}, local
+        # chunk order c = 0..V-1 — but the contiguous PIPE shard gives rank
+        # s rows [s·L/pp, ...).  Permute the stacked layer axis so the
+        # contiguous shard IS the interleaved assignment (and un-permute
+        # the returned grads).
+        L = cfg.num_layers
+        Lc_g = L // (pp * virtual_stages)
+        if L % (pp * virtual_stages) != 0:
+            raise ValueError(f"virtual_stages={virtual_stages} × pipe={pp} "
+                             f"must divide num_layers={L}")
+        order = np.concatenate([
+            np.arange((c * pp + s) * Lc_g, (c * pp + s + 1) * Lc_g)
+            for s in range(pp) for c in range(virtual_stages)])
+        inv = np.argsort(order)
+        params = {**params, "layers": jax.tree.map(
+            lambda a: jnp.take(a, order, axis=0), params["layers"])}
+
+    loss, grads = jax.shard_map(
+        body, mesh=mesh, in_specs=(spec_tree, tok_spec),
+        out_specs=(P(), spec_tree), check_vma=False)(params, tokens)
+    if virtual_stages > 1:
+        grads = {**grads, "layers": jax.tree.map(
+            lambda a: jnp.take(a, inv, axis=0), grads["layers"])}
+    return loss, grads
 
 
 def pipeline_module_loss(module, params: Dict, batch: Any, rng,
@@ -342,12 +619,35 @@ class PipelineEngine(DeepSpeedEngine):
 
         return fn
 
+    def _use_1f1b(self) -> bool:
+        from .module import PipelineModule
+
+        topo = self.topology or get_topology()
+        return (self.config.pipeline.schedule == "1f1b"
+                and topo.get_pipe_parallel_world_size() > 1
+                and not isinstance(self._pipe_model, PipelineModule))
+
     # The pipeline loop consumes all microbatches in one jitted call, so the
     # outer engine runs with gas=1 semantics.
     def _build_train_batch_fn(self):
+        use_1f1b = self._use_1f1b()
+        topo = self.topology or get_topology()
+
         def step_fn(state, batch):
             rng, sub = jax.random.split(state.rng)
-            loss, grads = self._loss_and_grads(state.params, batch, sub, state.scaler)
+            if use_1f1b:
+                # the 1F1B loop produces grads itself (fwd/bwd interleaved
+                # per tick) — no autodiff over the pipeline scan
+                p = jax.tree.map(lambda x: x.astype(self.compute_dtype),
+                                 state.params)
+                loss, grads = pipeline_lm_loss_1f1b(
+                    p, batch, self._pipe_model.config, topo, sub,
+                    self.num_micro, loss_scale=state.scaler.scale,
+                    virtual_stages=self.config.pipeline.virtual_stages)
+                grads = self._constrain_grads(grads)
+            else:
+                loss, grads = self._loss_and_grads(state.params, batch, sub,
+                                                   state.scaler)
             new_state = self._apply_update(state, grads)
             return new_state.replace(
                 micro_step=state.micro_step + self.num_micro, rng=rng), loss

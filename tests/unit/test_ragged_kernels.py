@@ -188,7 +188,7 @@ class TestAtomPackedAttention:
 
         flops = {}
         for A in (8, MT):
-            NA = S if A == MT else S                  # 1 atom per decode seq
+            NA = S                                    # 1 atom per decode seq
             q_atoms = jnp.asarray(rng.normal(size=(NA, A, H, hd)), jnp.float32)
             aseq = jnp.arange(S, dtype=jnp.int32)
             aqs = jnp.zeros(S, jnp.int32)
