@@ -18,9 +18,13 @@ when any of them is enabled the loss/grad computation runs under
       runtime/sparse_tensor.py:13 + engine.sparse_allreduce_bucket
       engine.py:2636).
 
-Constraints: this path covers DP/ZeRO meshes (tensor = seq = pipe = expert
-= 1); model-parallel composition stays on the fused path where XLA owns the
-collectives.
+Model-parallel composition (reference runs ZeRO++ under Megatron TP,
+docs/_tutorials/zeropp.md:13): the step is a PARTIAL-manual ``shard_map`` —
+manual over the ZeRO/data axes only (``axis_names=data_axes``), while
+tensor/seq/expert stay Auto so the per-shard loss compute remains a global
+GSPMD program and XLA keeps inserting the model-parallel collectives exactly
+as on the fused path.  Only the pipe axis is rejected (pipeline training has
+its own engine and grad exchange).
 """
 from __future__ import annotations
 
@@ -184,19 +188,30 @@ def build_explicit_comm_step(engine):
                        "so a token-indexed sparse exchange would drop mass")
         sparse = False
 
-    for ax in ("tensor", "seq", "pipe", "expert"):
-        if topo.dims.get(ax, 1) > 1:
-            raise ValueError(
-                f"explicit-comm path (zero_quantized_*/sparse_gradients) "
-                f"supports DP/ZeRO meshes only; axis {ax!r} has size "
-                f"{topo.dims[ax]} — use the fused path for model parallelism")
+    if topo.dims.get("pipe", 1) > 1:
+        raise ValueError(
+            "explicit-comm path (zero_quantized_*/sparse_gradients) does not "
+            "compose with pipeline parallelism — the pipeline engine owns its "
+            "own gradient exchange; use the fused path with pipe>1")
     data_axes, _, dp_axes_entry = dp_axes_info(topo)
+    manual = set(data_axes)
     gas = engine.gradient_accumulation_steps()
 
     params_t = engine.state.params
     stage3 = engine.zero_stage >= 3
     param_specs = engine.plan.param_specs(params_t)
     zero_axes = engine.plan.zero_axes
+    if stage3 and not set(zero_axes) <= manual:
+        # ZeRO-3 shards params over the full DP×SP group (data, expert, seq);
+        # the explicit gather wire runs over MANUAL axes, but seq/expert must
+        # stay Auto so the loss compute remains a global GSPMD program
+        # (attention needs the full sequence; MoE routing the expert axis).
+        # An all_gather over an Auto axis is ill-formed — so stage 3 quantized
+        # wires require the ZeRO group to be pure data axes.
+        raise ValueError(
+            f"explicit-comm at ZeRO stage 3 requires params sharded over "
+            f"data axes only, got zero_axes={zero_axes} (mesh has seq/expert "
+            f"> 1); use stage<=2 wires or the fused path on this mesh")
     shard_dims = jax.tree.map(lambda s: _sharded_dim(s, zero_axes), param_specs,
                               is_leaf=lambda x: isinstance(x, P))
 
@@ -302,13 +317,29 @@ def build_explicit_comm_step(engine):
     mesh = topo.mesh
     batch_dim = 0 if gas == 1 else 1
 
+    def restrict_spec(spec):
+        """Keep only manual (data) axes of a spec.  Partial-manual shard_map
+        in/out specs may only name manual axes; the model-parallel sharding
+        (tensor/seq/expert entries) rides in on each array's own
+        NamedSharding and stays under GSPMD inside the body."""
+        if spec is None:
+            return P()
+        out = []
+        for entry in spec:
+            entries = entry if isinstance(entry, (tuple, list)) else (entry,)
+            kept = tuple(a for a in entries if a in manual)
+            out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        return P(*out)
+
     def batch_spec(x):
         spec = [None] * x.ndim
         if data_axes:
             spec[batch_dim] = dp_axes_entry
         return P(*spec)
 
-    param_in = param_specs if stage3 else P()
+    param_in = jax.tree.map(restrict_spec, param_specs,
+                            is_leaf=lambda x: isinstance(x, P)) \
+        if stage3 else P()
     err_spec = P(dp_axes_entry) if loco else None
 
     def step_fn(state, batch):
@@ -326,8 +357,15 @@ def build_explicit_comm_step(engine):
                 loss, grads, _ = local_step(p, b, r, sc, None)
                 return loss, grads
 
-        fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
-                           out_specs=out_specs, check_vma=False)
+        if data_axes:
+            fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
+                               out_specs=out_specs, axis_names=manual,
+                               check_vma=False)
+        else:
+            # dp=1: every wire is a no-op; run the body as a plain GSPMD
+            # program (axis_names={} would mean ALL axes manual — wrong for
+            # a pure model-parallel mesh).
+            fn = body
         res = fn(*args)
         loss, grads = res[0], res[1]
         new_error = res[2] if loco else None

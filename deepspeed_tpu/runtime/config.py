@@ -126,6 +126,9 @@ class PipelineConfig(DeepSpeedConfigModel):
         if self.schedule not in ("1f1b", "gpipe"):
             raise ValueError(f"pipeline.schedule must be '1f1b' or 'gpipe', "
                              f"got {self.schedule!r}")
+        if self.virtual_stages < 1:
+            raise ValueError(f"pipeline.virtual_stages must be >= 1, got "
+                             f"{self.virtual_stages}")
         if self.virtual_stages > 1 and self.schedule != "1f1b":
             raise ValueError("pipeline.virtual_stages > 1 requires the "
                              "'1f1b' schedule")

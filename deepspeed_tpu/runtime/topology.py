@@ -264,6 +264,27 @@ class MeshTopology:
         return f"MeshTopology({self.dims})"
 
 
+def shard_map_context(topo: "MeshTopology"):
+    """(mesh, already_manual_axes) for building a possibly-nested shard_map.
+
+    Inside an enclosing partial-manual region (e.g. the explicit-comm train
+    step, manual over the data axes) jax requires nested shard_maps to be
+    built against the *context* abstract mesh — its axis_types record which
+    axes are already Manual — and to name only still-Auto axes.  At top
+    level the concrete mesh is the right thing.
+    """
+    try:
+        am = jax.sharding.get_abstract_mesh()
+        types = getattr(am, "axis_types", None)
+        if types is not None and any(str(t) == "Manual" for t in types):
+            already = {n for n, t in zip(am.axis_names, types)
+                       if str(t) == "Manual"}
+            return am, already
+    except Exception:  # noqa: BLE001 - introspection is best-effort
+        pass
+    return topo.mesh, set()
+
+
 _TOPOLOGY: Optional[MeshTopology] = None
 
 

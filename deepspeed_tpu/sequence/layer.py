@@ -24,26 +24,6 @@ from jax.sharding import PartitionSpec as P
 from ..runtime.topology import DATA, DATA_OUTER, EXPERT, SEQ, get_topology
 
 
-def _attn_io_spec(x, topo, sp_axis: str):
-    """[B, S, H, hd] spec: shard batch over the data axes when divisible,
-    sequence over the SP axis.  Committed inputs keep their own spec."""
-    from jax.sharding import NamedSharding
-
-    sharding = getattr(x, "sharding", None)
-    if isinstance(sharding, NamedSharding) and sharding.spec and \
-            any(e is not None for e in sharding.spec):
-        spec = list(sharding.spec) + [None] * (x.ndim - len(sharding.spec))
-        spec[1] = sp_axis
-        return P(*spec)
-    batch_axes = tuple(a for a in (DATA_OUTER, DATA, EXPERT) if topo.dims[a] > 1)
-    dp = 1
-    for a in batch_axes:
-        dp *= topo.dims[a]
-    if not batch_axes or x.shape[0] % dp != 0:
-        batch_axes = None
-    return P(batch_axes, sp_axis, None, None)
-
-
 def _seq_all_to_all(x, scatter_heads: bool):
     """[B, s, H, hd] -> [B, S, H/sp, hd] (scatter_heads) or inverse."""
     if scatter_heads:
@@ -80,8 +60,7 @@ class DistributedAttention:
                 f"Ulysses requires heads ({n_heads}) divisible by sp ({sp}); "
                 f"uneven-head support: pad heads or use ring attention")
 
-        mesh = topo.mesh
-        io_spec = _attn_io_spec(query, topo, self.sp_axis)
+        from ..runtime.topology import shard_map_context
 
         def body(q, k, v):
             q = _seq_all_to_all(q, scatter_heads=True)
@@ -90,9 +69,19 @@ class DistributedAttention:
             out = self.local_attn(q, k, v, *args, **kwargs)
             return _seq_all_to_all(out, scatter_heads=False)
 
+        mesh, already_manual = shard_map_context(topo)
+        if self.sp_axis in already_manual:
+            # Enclosing shard_map is already manual over the seq axis (e.g.
+            # the pipeline engine's tick loop): collectives resolve there.
+            return body(query, key, value)
+        # PARTIAL-manual over the seq axis only: batch/data sharding rides
+        # GSPMD, so this nests inside manual-over-data regions (explicit-comm
+        # train step) and composes with any outer jit.
+        io_spec = P(None, self.sp_axis, None, None)
         return jax.shard_map(
             body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
-            out_specs=io_spec, check_vma=False)(query, key, value)
+            out_specs=io_spec, axis_names={self.sp_axis},
+            check_vma=False)(query, key, value)
 
 
 class UlyssesAttention(DistributedAttention):

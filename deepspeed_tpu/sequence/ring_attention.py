@@ -79,10 +79,8 @@ def ring_attention(query, key, value, causal: bool = True,
                                 if causal else None)
         return (out / jnp.maximum(l, 1e-30)[..., None]).astype(query.dtype)
 
-    mesh = topo.mesh
-    from .layer import _attn_io_spec
+    from ..runtime.topology import shard_map_context
 
-    io_spec = _attn_io_spec(query, topo, sp_axis)
     perm = [(i, (i + 1) % sp) for i in range(sp)]  # kv moves to next rank
 
     def body(q, k, v):
@@ -116,8 +114,15 @@ def ring_attention(query, key, value, causal: bool = True,
         out = acc / jnp.maximum(l_acc, 1e-30)[..., None]
         return out.astype(q.dtype)
 
+    mesh, already_manual = shard_map_context(topo)
+    if sp_axis in already_manual:
+        return body(query, key, value)
+    # Partial-manual over the ring axis only (see layer.py): data/batch
+    # sharding stays GSPMD so the ring nests inside manual-over-data regions.
+    io_spec = P(None, sp_axis, None, None)
     return jax.shard_map(body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
-                         out_specs=io_spec, check_vma=False)(query, key, value)
+                         out_specs=io_spec, axis_names={sp_axis},
+                         check_vma=False)(query, key, value)
 
 
 def _local_causal_mask(sq, sk):
