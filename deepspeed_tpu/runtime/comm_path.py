@@ -167,74 +167,114 @@ def _sharded_dim(spec, zero_axes) -> Optional[int]:
     return None
 
 
-def build_explicit_comm_step(engine):
-    """Build the shard_map'd train-batch step for the explicit-comm config
-    surface.  Mirrors engine._build_train_batch_fn's semantics (micro-step
-    scan, loss scaling, clipping, overflow skip) with hand-written wires."""
-    cfg = engine.config
-    topo = engine.topology
-    zc = cfg.zero_config
-    qwz = bool(zc.zero_quantized_weights)
-    qgz = bool(zc.zero_quantized_gradients)
-    loco = bool(getattr(zc, "zeropp_loco", False))
-    sparse = bool(getattr(cfg, "sparse_gradients_enabled", False))
-    grad_bits = 4   # qgZ wire (reference quant_reduce.cu uses int4)
-    if sparse and bool(getattr(getattr(engine.module, "config", None),
-                               "tie_embeddings", False)):
-        from ..utils.logging import logger
+class _WireContext:
+    """Shared machinery for the explicit-comm step builders (fused
+    train_batch and imperative backward()/step()): config parsing, mesh
+    gating, the qwZ param gather, and the per-leaf gradient wire."""
 
-        logger.warning("sparse_gradients disabled: tied embeddings make the "
-                       "embedding grad dense over the vocab (lm-head rows), "
-                       "so a token-indexed sparse exchange would drop mass")
-        sparse = False
+    def __init__(self, engine):
+        cfg = engine.config
+        self.engine = engine
+        self.topo = topo = engine.topology
+        zc = cfg.zero_config
+        self.qwz = bool(zc.zero_quantized_weights)
+        self.qgz = bool(zc.zero_quantized_gradients)
+        self.loco = bool(getattr(zc, "zeropp_loco", False))
+        self.sparse = bool(getattr(cfg, "sparse_gradients_enabled", False))
+        self.grad_bits = 4   # qgZ wire (reference quant_reduce.cu uses int4)
+        if self.sparse and bool(getattr(getattr(engine.module, "config", None),
+                                        "tie_embeddings", False)):
+            from ..utils.logging import logger
 
-    if topo.dims.get("pipe", 1) > 1:
-        raise ValueError(
-            "explicit-comm path (zero_quantized_*/sparse_gradients) does not "
-            "compose with pipeline parallelism — the pipeline engine owns its "
-            "own gradient exchange; use the fused path with pipe>1")
-    data_axes, _, dp_axes_entry = dp_axes_info(topo)
-    manual = set(data_axes)
-    gas = engine.gradient_accumulation_steps()
+            logger.warning(
+                "sparse_gradients disabled: tied embeddings make the "
+                "embedding grad dense over the vocab (lm-head rows), "
+                "so a token-indexed sparse exchange would drop mass")
+            self.sparse = False
 
-    params_t = engine.state.params
-    stage3 = engine.zero_stage >= 3
-    param_specs = engine.plan.param_specs(params_t)
-    zero_axes = engine.plan.zero_axes
-    if stage3 and not set(zero_axes) <= manual:
-        # ZeRO-3 shards params over the full DP×SP group (data, expert, seq);
-        # the explicit gather wire runs over MANUAL axes, but seq/expert must
-        # stay Auto so the loss compute remains a global GSPMD program
-        # (attention needs the full sequence; MoE routing the expert axis).
-        # An all_gather over an Auto axis is ill-formed — so stage 3 quantized
-        # wires require the ZeRO group to be pure data axes.
-        raise ValueError(
-            f"explicit-comm at ZeRO stage 3 requires params sharded over "
-            f"data axes only, got zero_axes={zero_axes} (mesh has seq/expert "
-            f"> 1); use stage<=2 wires or the fused path on this mesh")
-    shard_dims = jax.tree.map(lambda s: _sharded_dim(s, zero_axes), param_specs,
-                              is_leaf=lambda x: isinstance(x, P))
+        if topo.dims.get("pipe", 1) > 1:
+            raise ValueError(
+                "explicit-comm path (zero_quantized_*/sparse_gradients) does "
+                "not compose with pipeline parallelism — the pipeline engine "
+                "owns its own gradient exchange; use the fused path with "
+                "pipe>1")
+        self.data_axes, self.n_dp, self.dp_axes_entry = dp_axes_info(topo)
+        self.manual = set(self.data_axes)
+        self.gas = engine.gradient_accumulation_steps()
 
-    def gather_full(params_local):
+        self.params_t = engine.state.params
+        self.stage3 = engine.zero_stage >= 3
+        param_specs = engine.plan.param_specs(self.params_t)
+        zero_axes = engine.plan.zero_axes
+        self._check_stage3_axes(zero_axes)
+        self.zero_axes = zero_axes
+        self.shard_dims = jax.tree.map(
+            lambda s: _sharded_dim(s, zero_axes), param_specs,
+            is_leaf=lambda x: isinstance(x, P))
+        self.param_in = jax.tree.map(self.restrict_spec, param_specs,
+                                     is_leaf=lambda x: isinstance(x, P)) \
+            if self.stage3 else P()
+        self.err_spec = P(self.dp_axes_entry) if self.loco else None
+
+    # ------------------------------------------------------------------ #
+    def restrict_spec(self, spec):
+        """Keep only manual (data) axes of a spec.  Partial-manual shard_map
+        in/out specs may only name manual axes; the model-parallel sharding
+        (tensor/seq/expert entries) rides in on each array's own
+        NamedSharding and stays under GSPMD inside the body."""
+        if spec is None:
+            return P()
+        out = []
+        for entry in spec:
+            entries = entry if isinstance(entry, (tuple, list)) else (entry,)
+            kept = tuple(a for a in entries if a in self.manual)
+            out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
+        return P(*out)
+
+    def batch_spec_fn(self, batch_dim):
+        def batch_spec(x):
+            spec = [None] * x.ndim
+            if self.data_axes:
+                spec[batch_dim] = self.dp_axes_entry
+            return P(*spec)
+
+        return batch_spec
+
+    def shard_mapped(self, body, in_specs, out_specs):
+        """Partial-manual shard_map over the data axes (plain GSPMD body
+        when dp=1: axis_names={} would mean ALL axes manual — wrong for a
+        pure model-parallel mesh)."""
+        if not self.data_axes:
+            return body
+        return jax.shard_map(body, mesh=self.topo.mesh,
+                             in_specs=tuple(in_specs), out_specs=out_specs,
+                             axis_names=self.manual, check_vma=False)
+
+    def gather_full(self, params_local):
         """Local shards → full compute-dtype params (qwZ wire if enabled)."""
+        engine = self.engine
+
         def leaf(x, d):
             if d is None:
                 return x.astype(engine.compute_dtype)
             xb = x.astype(engine.compute_dtype)
-            if qwz:
+            if self.qwz:
                 return quantized_all_gather_shard(
-                    xb, zero_axes, d, bits=8, out_dtype=engine.compute_dtype)
-            return jax.lax.all_gather(xb, zero_axes, axis=d, tiled=True)
-        return jax.tree.map(leaf, params_local, shard_dims)
+                    xb, self.zero_axes, d, bits=8,
+                    out_dtype=engine.compute_dtype)
+            return jax.lax.all_gather(xb, self.zero_axes, axis=d, tiled=True)
 
-    def exchange_grads(grads, batch, comm_error):
+        return jax.tree.map(leaf, params_local, self.shard_dims)
+
+    def exchange_grads(self, grads, batch, comm_error):
         """Per-leaf wire selection: sparse rows for embeddings, quantized
         allreduce for the rest (or plain psum-mean when qgZ is off).
 
         LoCo error leaves carry a leading per-device axis of size 1 inside
         shard_map (stored sharded over the data axes outside)."""
+        data_axes, loco = self.data_axes, self.loco
         ids = None
-        if sparse and isinstance(batch, dict):
+        if self.sparse and isinstance(batch, dict):
             ids = batch.get("input_ids")
         n = jax.lax.psum(1, data_axes) if data_axes else 1
 
@@ -245,13 +285,13 @@ def build_explicit_comm_step(engine):
         for (path, g), e in zip(flat, err_flat):
             is_embed = any("embed" in str(getattr(k, "key", "")).lower()
                            for k in path)
-            if sparse and is_embed and ids is not None and g.ndim == 2 \
+            if self.sparse and is_embed and ids is not None and g.ndim == 2 \
                     and data_axes:
                 outs.append(sparse_embedding_allreduce(g, ids, data_axes))
                 errs.append(e)
-            elif qgz and data_axes:
+            elif self.qgz and data_axes:
                 out, new_w, new_s = quantized_allreduce(
-                    g, data_axes, bits=grad_bits,
+                    g, data_axes, bits=self.grad_bits,
                     error=e["worker"][0] if loco else None,
                     server_error=e["server"][0] if loco else None)
                 outs.append(out)
@@ -266,14 +306,18 @@ def build_explicit_comm_step(engine):
         new_error = treedef.unflatten(errs) if loco else None
         return treedef.unflatten(outs), new_error
 
-    def local_loss_and_grads(params_full, batch, rng, scaler_state):
-        """LOCAL full-shape grads (no cross-device reduction).
+    def local_loss_and_grads(self, params_full, batch, rng, scaler_state):
+        """LOCAL full-shape grads (no cross-device reduction over the manual
+        data axes; Auto-axis reductions — tensor partials, seq shards — are
+        inserted by XLA inside the body).
 
         Differentiates w.r.t. the GATHERED params — autodiff must not flow
         through the quantize→round→dequantize wire (round has zero
         gradient), and full-shape grads are what the exchange and the
         (logically full, sharded-layout) optimizer update both expect.
         """
+        engine = self.engine
+
         def scaled_loss(p):
             out = engine.loss_fn(p, batch, rng)
             loss = out[0] if isinstance(out, tuple) else out
@@ -284,18 +328,62 @@ def build_explicit_comm_step(engine):
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
         return loss, grads
 
+    def _check_stage3_axes(self, zero_axes):
+        # ZeRO-3 shards params over the full DP×SP group (data, expert, seq);
+        # the explicit gather wire runs over MANUAL axes, but seq/expert must
+        # stay Auto so the loss compute remains a global GSPMD program
+        # (attention needs the full sequence; MoE routing the expert axis).
+        # An all_gather over an Auto axis is ill-formed — so stage 3 quantized
+        # wires require the ZeRO group to be pure data axes.
+        if self.stage3 and not set(zero_axes) <= self.manual:
+            raise ValueError(
+                f"explicit-comm at ZeRO stage 3 requires params sharded over "
+                f"data axes only, got zero_axes={zero_axes} (mesh has "
+                f"seq/expert > 1); use stage<=2 wires or the fused path on "
+                f"this mesh")
+
+    def guard_loco_errors(self, new_error, old_error, grads):
+        """A skipped (overflow) step must not commit inf/nan residuals —
+        they would poison every subsequent corrected gradient."""
+        engine = self.engine
+        overflow = engine.loss_scaler.check_overflow(grads) \
+            if engine.loss_scaler.dynamic else jnp.zeros((), bool)
+        return jax.tree.map(
+            lambda new, old: jnp.where(overflow, old, new),
+            new_error, old_error)
+
+
+def _wire_ctx(engine) -> _WireContext:
+    """One _WireContext per engine, shared by the three step builders (the
+    parsing/spec trees are identical and the tied-embeddings warning should
+    fire once)."""
+    ctx = getattr(engine, "_wire_ctx_cache", None)
+    if ctx is None or ctx.engine is not engine:
+        ctx = _WireContext(engine)
+        engine._wire_ctx_cache = ctx
+    return ctx
+
+
+def build_explicit_comm_step(engine):
+    """Build the shard_map'd train-batch step for the explicit-comm config
+    surface.  Mirrors engine._build_train_batch_fn's semantics (micro-step
+    scan, loss scaling, clipping, overflow skip) with hand-written wires."""
+    ctx = _wire_ctx(engine)
+    gas, data_axes, loco = ctx.gas, ctx.data_axes, ctx.loco
+    params_t = ctx.params_t
+
     def local_step(params_local, batch, rng, scaler_state, comm_error):
-        params_full = gather_full(jax.lax.stop_gradient(params_local))
+        params_full = ctx.gather_full(jax.lax.stop_gradient(params_local))
         if gas == 1:
-            loss, grads = local_loss_and_grads(params_full, batch, rng,
-                                               scaler_state)
+            loss, grads = ctx.local_loss_and_grads(params_full, batch, rng,
+                                                   scaler_state)
             mean_loss = loss
         else:
             def micro(carry, mb):
                 acc, r = carry
                 r, r2 = jax.random.split(r)
-                loss, g = local_loss_and_grads(params_full, mb, r2,
-                                               scaler_state)
+                loss, g = ctx.local_loss_and_grads(params_full, mb, r2,
+                                                   scaler_state)
                 return (jax.tree.map(jnp.add, acc, g), r), loss
 
             zeros = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32),
@@ -310,77 +398,143 @@ def build_explicit_comm_step(engine):
         grads = engine.loss_scaler.unscale_grads(grads, scaler_state)
         flat_batch = batch if gas == 1 else \
             jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), batch)
-        grads, new_error = exchange_grads(grads, flat_batch, comm_error)
+        grads, new_error = ctx.exchange_grads(grads, flat_batch, comm_error)
         mean_loss = jax.lax.pmean(mean_loss, data_axes) if data_axes else mean_loss
         return mean_loss, grads, new_error
 
-    mesh = topo.mesh
-    batch_dim = 0 if gas == 1 else 1
-
-    def restrict_spec(spec):
-        """Keep only manual (data) axes of a spec.  Partial-manual shard_map
-        in/out specs may only name manual axes; the model-parallel sharding
-        (tensor/seq/expert entries) rides in on each array's own
-        NamedSharding and stays under GSPMD inside the body."""
-        if spec is None:
-            return P()
-        out = []
-        for entry in spec:
-            entries = entry if isinstance(entry, (tuple, list)) else (entry,)
-            kept = tuple(a for a in entries if a in manual)
-            out.append(kept if len(kept) > 1 else (kept[0] if kept else None))
-        return P(*out)
-
-    def batch_spec(x):
-        spec = [None] * x.ndim
-        if data_axes:
-            spec[batch_dim] = dp_axes_entry
-        return P(*spec)
-
-    param_in = jax.tree.map(restrict_spec, param_specs,
-                            is_leaf=lambda x: isinstance(x, P)) \
-        if stage3 else P()
-    err_spec = P(dp_axes_entry) if loco else None
+    batch_spec = ctx.batch_spec_fn(batch_dim=0 if gas == 1 else 1)
 
     def step_fn(state, batch):
         rng, sub = jax.random.split(state.rng)
         args = [state.params, batch, sub, state.scaler]
-        in_specs = [param_in, jax.tree.map(batch_spec, batch), P(), P()]
-        out_specs = (P(), P(), err_spec) if loco else (P(), P())
+        in_specs = [ctx.param_in, jax.tree.map(batch_spec, batch), P(), P()]
+        out_specs = (P(), P(), ctx.err_spec) if loco else (P(), P())
 
         if loco:
             body = local_step
             args.append(state.comm_error)
-            in_specs.append(err_spec)
+            in_specs.append(ctx.err_spec)
         else:
             def body(p, b, r, sc):
                 loss, grads, _ = local_step(p, b, r, sc, None)
                 return loss, grads
 
-        if data_axes:
-            fn = jax.shard_map(body, mesh=mesh, in_specs=tuple(in_specs),
-                               out_specs=out_specs, axis_names=manual,
-                               check_vma=False)
-        else:
-            # dp=1: every wire is a no-op; run the body as a plain GSPMD
-            # program (axis_names={} would mean ALL axes manual — wrong for
-            # a pure model-parallel mesh).
-            fn = body
-        res = fn(*args)
+        res = ctx.shard_mapped(body, in_specs, out_specs)(*args)
         loss, grads = res[0], res[1]
         new_error = res[2] if loco else None
         grads = engine._constrain_grads(grads)
         new_state = engine._apply_update(state, grads, unscale=False)
         if loco:
-            # A skipped (overflow) step must not commit inf/nan residuals —
-            # they would poison every subsequent corrected gradient.
-            overflow = engine.loss_scaler.check_overflow(grads) \
-                if engine.loss_scaler.dynamic else jnp.zeros((), bool)
-            new_error = jax.tree.map(
-                lambda new, old: jnp.where(overflow, old, new),
-                new_error, state.comm_error)
+            new_error = ctx.guard_loco_errors(new_error, state.comm_error,
+                                              grads)
         new_state = new_state.replace(micro_step=state.micro_step + gas,
                                       rng=rng, comm_error=new_error)
         return new_state, loss
+
+    return jax.jit(step_fn, donate_argnums=(0,))
+
+
+# --------------------------------------------------------------------- #
+# Imperative path (backward()/step() wire parity — reference
+# engine.py:2048-2085 allreduce_gradients at the accumulation boundary)
+# --------------------------------------------------------------------- #
+def make_explicit_grad_acc(engine):
+    """Per-rank gradient accumulator for the imperative explicit-comm path.
+
+    backward() accumulates LOCAL (per data-shard) grads; the wire exchange
+    happens once at the step() boundary — matching the reference, which
+    accumulates locally and allreduces in allreduce_gradients().  A
+    per-rank-different value can't live outside the manual region as a
+    replicated array, so leaves carry a leading [n_dp] axis sharded over
+    the data axes (each device holds its own [1, ...] slice)."""
+    from jax.sharding import NamedSharding
+
+    _, n_dp, dp_entry = dp_axes_info(engine.topology)
+    params = engine.state.params
+
+    def mk(x):
+        return jnp.zeros((max(n_dp, 1),) + x.shape, jnp.float32)
+
+    sharding = NamedSharding(engine.topology.mesh, P(dp_entry))
+    return jax.jit(lambda p: jax.tree.map(mk, p),
+                   out_shardings=sharding)(params)
+
+
+def build_explicit_micro_fn(engine):
+    """backward() under explicit comm: accumulate SCALED local grads into
+    the per-rank accumulator; no cross-data-axis communication here (the
+    qwZ param gather still runs — stage 3 needs full params to compute)."""
+    ctx = _wire_ctx(engine)
+    acc_spec = P(ctx.dp_axes_entry)
+
+    def body(params_local, acc, batch, rng, scaler_state):
+        params_full = ctx.gather_full(jax.lax.stop_gradient(params_local))
+        loss, grads = ctx.local_loss_and_grads(params_full, batch, rng,
+                                               scaler_state)
+        new_acc = jax.tree.map(lambda a, g: a + g[None].astype(a.dtype),
+                               acc, grads)
+        if ctx.data_axes:
+            loss = jax.lax.pmean(loss, ctx.data_axes)
+        return loss, new_acc
+
+    batch_spec = ctx.batch_spec_fn(batch_dim=0)
+
+    def micro_fn(state, batch):
+        rng, sub = jax.random.split(state.rng)
+        fn = ctx.shard_mapped(
+            body,
+            in_specs=[ctx.param_in, acc_spec,
+                      jax.tree.map(batch_spec, batch), P(), P()],
+            out_specs=(P(), acc_spec))
+        loss, new_acc = fn(state.params, state.grad_acc, batch, sub,
+                           state.scaler)
+        return state.replace(grad_acc=new_acc,
+                             micro_step=state.micro_step + 1, rng=rng), loss
+
+    return jax.jit(micro_fn, donate_argnums=(0,))
+
+
+def build_explicit_step_fn(engine):
+    """step() under explicit comm: unscale + mean the accumulated local
+    grads, run the quantized wire exchange once, then the optimizer update.
+
+    The sparse embedding wire is a train_batch()-only optimization — it
+    needs the batch's token ids, which the boundary no longer has; under
+    the imperative API embedding grads ride the dense (quantized) wire."""
+    ctx = _wire_ctx(engine)
+    gas, loco = ctx.gas, ctx.loco
+    acc_spec = P(ctx.dp_axes_entry)
+
+    def body(acc, scaler_state, comm_error):
+        grads = jax.tree.map(lambda a: a[0], acc)
+        grads = engine.loss_scaler.unscale_grads(grads, scaler_state)
+        grads = jax.tree.map(lambda g: g / gas, grads)
+        grads, new_error = ctx.exchange_grads(grads, None, comm_error)
+        if loco:
+            return grads, new_error
+        return grads
+
+    def step_fn(state):
+        args = [state.grad_acc, state.scaler]
+        in_specs = [acc_spec, P()]
+        out_specs = (P(), ctx.err_spec) if loco else P()
+        if loco:
+            args.append(state.comm_error)
+            in_specs.append(ctx.err_spec)
+        else:
+            def no_err_body(acc, sc):
+                return body(acc, sc, None)
+        res = ctx.shard_mapped(body if loco else no_err_body,
+                               in_specs, out_specs)(*args)
+        grads = res[0] if loco else res
+        new_error = res[1] if loco else None
+        grads = engine._constrain_grads(grads)
+        new_state = engine._apply_update(state, grads, unscale=False)
+        if loco:
+            new_error = ctx.guard_loco_errors(new_error, state.comm_error,
+                                              grads)
+            new_state = new_state.replace(comm_error=new_error)
+        zeros = jax.tree.map(jnp.zeros_like, state.grad_acc)
+        return new_state.replace(grad_acc=zeros)
 
     return jax.jit(step_fn, donate_argnums=(0,))

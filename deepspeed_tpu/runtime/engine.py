@@ -577,10 +577,9 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ #
     def _build_micro_fn(self):
         if self._explicit_comm:
-            logger.warning(
-                "explicit-comm wire formats (zero_quantized_*/"
-                "sparse_gradients) apply to train_batch(); the imperative "
-                "backward()/step() path uses the fused XLA collectives")
+            from .comm_path import build_explicit_micro_fn
+
+            return build_explicit_micro_fn(self)
 
         def micro_fn(state: EngineState, batch):
             rng, sub = jax.random.split(state.rng)
@@ -594,6 +593,10 @@ class DeepSpeedEngine:
         return jax.jit(micro_fn, donate_argnums=(0,))
 
     def _build_step_fn(self):
+        if self._explicit_comm:
+            from .comm_path import build_explicit_step_fn
+
+            return build_explicit_step_fn(self)
         gas = self.gradient_accumulation_steps()
 
         def step_fn(state: EngineState):
@@ -625,12 +628,24 @@ class DeepSpeedEngine:
         ``forward``), JAX differentiates the loss *function*, so backward takes
         the micro-batch. Returns the micro-batch loss.
         """
-        if self.state.grad_acc is None and self.gradient_accumulation_steps() > 1:
+        if self.state.grad_acc is None and self.gradient_accumulation_steps() > 1 \
+                and not self._explicit_comm:
             raise RuntimeError("grad accumulation buffer missing")
-        if self.state.grad_acc is None:
-            # allocate lazily for gas==1 imperative use
-            self.state = self.state.replace(
-                grad_acc=_tree_zeros_like(self.state.params))
+        if self.state.grad_acc is None or (
+                self._explicit_comm and
+                jax.tree.leaves(self.state.grad_acc)[0].ndim ==
+                jax.tree.leaves(self.state.params)[0].ndim):
+            # Allocate lazily for imperative use.  Explicit comm accumulates
+            # LOCAL per-data-shard grads (leading [n_dp] axis, exchange at
+            # the step() boundary); the fused path accumulates the already
+            # XLA-reduced grads in param shape.
+            if self._explicit_comm:
+                from .comm_path import make_explicit_grad_acc
+
+                acc = make_explicit_grad_acc(self)
+            else:
+                acc = _tree_zeros_like(self.state.params)
+            self.state = self.state.replace(grad_acc=acc)
             self._compiled.pop("micro", None)
         if "micro" not in self._compiled:
             self._compiled["micro"] = self._build_micro_fn()

@@ -273,12 +273,15 @@ def shard_map_context(topo: "MeshTopology"):
     axes are already Manual — and to name only still-Auto axes.  At top
     level the concrete mesh is the right thing.
     """
+    import jax
+
     try:
+        manual_t = jax.sharding.AxisType.Manual
         am = jax.sharding.get_abstract_mesh()
         types = getattr(am, "axis_types", None)
-        if types is not None and any(str(t) == "Manual" for t in types):
+        if types is not None and any(t == manual_t for t in types):
             already = {n for n, t in zip(am.axis_names, types)
-                       if str(t) == "Manual"}
+                       if t == manual_t}
             return am, already
     except Exception:  # noqa: BLE001 - introspection is best-effort
         pass

@@ -76,12 +76,15 @@ class DistributedAttention:
             return body(query, key, value)
         # PARTIAL-manual over the seq axis only: batch/data sharding rides
         # GSPMD, so this nests inside manual-over-data regions (explicit-comm
-        # train step) and composes with any outer jit.
+        # train step) and composes with any outer jit.  The jit wrapper keeps
+        # the eager call path working (partial-manual shard_map requires a
+        # tracing context on this jax version); inside an enclosing jit it
+        # simply inlines.
         io_spec = P(None, self.sp_axis, None, None)
-        return jax.shard_map(
+        fn = jax.shard_map(
             body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
-            out_specs=io_spec, axis_names={self.sp_axis},
-            check_vma=False)(query, key, value)
+            out_specs=io_spec, axis_names={self.sp_axis}, check_vma=False)
+        return jax.jit(fn)(query, key, value)
 
 
 class UlyssesAttention(DistributedAttention):

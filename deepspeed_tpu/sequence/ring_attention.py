@@ -119,10 +119,12 @@ def ring_attention(query, key, value, causal: bool = True,
         return body(query, key, value)
     # Partial-manual over the ring axis only (see layer.py): data/batch
     # sharding stays GSPMD so the ring nests inside manual-over-data regions.
+    # jit keeps the eager call path working (inlines under an enclosing jit).
     io_spec = P(None, sp_axis, None, None)
-    return jax.shard_map(body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
-                         out_specs=io_spec, axis_names={sp_axis},
-                         check_vma=False)(query, key, value)
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
+                       out_specs=io_spec, axis_names={sp_axis},
+                       check_vma=False)
+    return jax.jit(fn)(query, key, value)
 
 
 def _local_causal_mask(sq, sk):
