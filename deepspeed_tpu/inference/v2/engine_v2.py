@@ -93,6 +93,8 @@ class InferenceEngineV2:
                                            c.block_size,
                                            trash_slot=self.kv.config.trash_slot,
                                            atom_size=atom)
+        self._decode_loops: Dict = {}
+        self._rng = jax.random.PRNGKey(0)
         self._step = build_ragged_step(self.cfg, max_q=c.max_tokens,
                                        block_size=c.block_size,
                                        attn_impl=c.attn_impl, atom_size=atom,
@@ -159,6 +161,61 @@ class InferenceEngineV2:
             self.state_manager.flush_sequence(uid)
 
     # ------------------------------------------------------------------ #
+    # Fused multi-step decode (device-resident loop; the CUDA-graph-decode
+    # analogue — kills the host round trip per generated token)
+    # ------------------------------------------------------------------ #
+    def decode_batch(self, uids: Sequence[int],
+                     seed_tokens: Sequence[int], steps: int,
+                     temperature: float = 0.0,
+                     rng: Optional[jax.Array] = None) -> np.ndarray:
+        """Run ``steps`` decode iterations for ``uids`` entirely on device.
+
+        Each sequence starts from its ``seed_tokens[i]`` (the next input
+        token, e.g. the argmax of its prefill logits) and greedily/sampled
+        decodes ``steps`` tokens with NO host synchronisation between steps:
+        KV blocks for the whole window are allocated up front so the block
+        table is static, and the packed metadata advances on device.
+
+        Returns the generated tokens [steps, n_seqs] (host numpy); the last
+        generated token is NOT appended to the cache (matching put()
+        semantics — it is the next call's seed).
+        """
+        c = self.config
+        verdict = self.can_schedule(uids, [steps] * len(uids))
+        if verdict != SchedulingResult.Success:
+            raise RuntimeError(f"cannot schedule decode window: {verdict}")
+        self._wrapper.clear()
+        for uid, tok in zip(uids, seed_tokens):
+            seq = self.state_manager.get_or_create_sequence(uid)
+            ok = self.state_manager.maybe_allocate_kv(seq, steps)
+            assert ok, "allocator raced"
+            self._wrapper.insert_sequence(seq, [int(tok)])
+        batch = self._wrapper.finalize()
+
+        key = (steps, float(temperature))
+        if key not in self._decode_loops:
+            from .model_runner import build_decode_loop
+
+            self._decode_loops[key] = build_decode_loop(
+                self.cfg, max_q=c.max_tokens, max_seqs=c.max_seqs,
+                max_blocks=self._wrapper.max_blocks, block_size=c.block_size,
+                trash_slot=self.kv.config.trash_slot, attn_impl=c.attn_impl,
+                atom_size=min(c.atom_size, c.max_tokens), steps=steps,
+                temperature=temperature)
+        if rng is None:
+            # persistent engine key: re-seeding each window with a constant
+            # would repeat the identical sample stream every call
+            self._rng, rng = jax.random.split(self._rng)
+        toks, new_k, new_v = self._decode_loops[key](
+            self.params, self.kv.k, self.kv.v, jnp.asarray(batch.pack()), rng)
+        self.kv.update(new_k, new_v)
+        for uid in batch.uids:
+            seq = self.state_manager.get_sequence(uid)
+            seq.in_flight_tokens = steps
+            seq.post_forward()
+        return np.asarray(toks[:, :batch.n_seqs])
+
+    # ------------------------------------------------------------------ #
     # Dynamic SplitFuse scheduling (MII-layer policy, host-only logic)
     # ------------------------------------------------------------------ #
     def schedule(self, pending: Dict[int, List[int]]) -> List[Tuple[int, List[int]]]:
@@ -195,6 +252,34 @@ class InferenceEngineV2:
             active = {u: t for u, t in pending.items() if not done[u] and t}
             if not active:
                 break
+            # Pure-decode fast path: every active sequence is one token from
+            # its next forward → run the whole remaining window as ONE fused
+            # on-device loop (no host round trip per token).  With eos the
+            # host must inspect every token, so stay on the step loop.
+            if (eos_token_id is None and
+                    all(len(t) == 1 for t in active.values()) and
+                    len(active) <= self.config.max_seqs):
+                au = list(active.keys())
+                steps = min(max_new_tokens - len(produced[u]) for u in au)
+                # quantize to a power of two: staggered sequences otherwise
+                # reach this point with a different `steps` every round and
+                # each distinct value compiles its own fused loop
+                if steps > 2:
+                    steps = 1 << (steps.bit_length() - 1)
+                if steps > 1:
+                    if temperature > 0:
+                        rng, sub = jax.random.split(rng)
+                    else:
+                        sub = None
+                    toks = self.decode_batch(au, [active[u][0] for u in au],
+                                             steps, temperature, sub)
+                    for col, u in enumerate(au):
+                        produced[u].extend(int(t) for t in toks[:, col])
+                        if len(produced[u]) >= max_new_tokens:
+                            done[u], pending[u] = True, []
+                        else:
+                            pending[u] = [produced[u][-1]]
+                    continue
             batch = self.schedule(active)
             logits = self.put([u for u, _ in batch], [t for _, t in batch])
             # select on device, pull ONE small int vector (not [S, vocab]

@@ -188,8 +188,8 @@ def _sublane_mult(dtype) -> int:
     return 8
 
 
-def _atom_attn_kernel(bt_ref, aseq_ref, aqs_ref, anq_ref, ql_ref, cl_ref,
-                      q_ref, k_ref, v_ref, o_ref,
+def _atom_attn_kernel(lyr_ref, bt_ref, aseq_ref, aqs_ref, anq_ref, ql_ref,
+                      cl_ref, q_ref, k_ref, v_ref, o_ref,
                       acc, m_scr, l_scr, *,
                       scale, block_size, atom_size, group, rows,
                       alibi=None, alibi_scaled=False):
@@ -217,8 +217,8 @@ def _atom_attn_kernel(bt_ref, aseq_ref, aqs_ref, anq_ref, ql_ref, cl_ref,
     @pl.when(jnp.logical_and(ib < needed, nq > 0))
     def _compute():
         q = q_ref[0, 0].astype(jnp.float32)                 # [rows, hd]
-        k = k_ref[0, 0].astype(jnp.float32)                 # [bs, hd]
-        v = v_ref[0, 0].astype(jnp.float32)                 # [bs, hd]
+        k = k_ref[0, 0, 0].astype(jnp.float32)              # [bs, hd]
+        v = v_ref[0, 0, 0].astype(jnp.float32)              # [bs, hd]
         s_mat = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
 
         r = jax.lax.broadcasted_iota(jnp.int32, (rows, block_size), 0)
@@ -273,6 +273,7 @@ def atom_paged_attention(q_atoms: jnp.ndarray, kcache: jnp.ndarray,
                          ctx_len: jnp.ndarray, *, block_size: int,
                          scale: Optional[float] = None,
                          alibi=None, alibi_scaled: bool = False,
+                         layer: Optional[jnp.ndarray] = None,
                          interpret: Optional[bool] = None) -> jnp.ndarray:
     """Ragged attention over token-packed query ATOMS (kills the per-sequence
     [S, max_tokens] query padding: a decode row costs G·A MXU rows, not
@@ -286,7 +287,13 @@ def atom_paged_attention(q_atoms: jnp.ndarray, kcache: jnp.ndarray,
     Args:
       q_atoms:     [NA, A, H, hd] query tokens packed per-sequence into
                    fixed-size atoms (A = atom size; pad atoms have nq=0).
-      kcache/vcache: [KV, n_slots, hd] paged cache, block-major slots.
+      kcache/vcache: [KV, n_slots, hd] per-layer cache, OR the full stacked
+                   [L, KV, n_slots, hd] cache with ``layer`` a traced scalar
+                   index.  Passing the stacked cache keeps the operand the
+                   ORIGINAL HBM buffer inside a layer scan — a per-layer
+                   dynamic-slice operand would materialize a full-layer copy
+                   per call, turning decode bandwidth O(cache) instead of
+                   O(blocks actually read).
       block_table: [S, NB] physical block ids per sequence.
       atom_seq:    [NA] owning sequence row of each atom.
       atom_qstart: [NA] index of the atom's first query within its
@@ -296,11 +303,18 @@ def atom_paged_attention(q_atoms: jnp.ndarray, kcache: jnp.ndarray,
     Returns [NA, A, H, hd].
     """
     NA, A, H, hd = q_atoms.shape
-    KV = kcache.shape[0]
+    stacked = kcache.ndim == 4
+    if stacked:
+        assert layer is not None, "stacked cache needs a layer index"
+        L, KV = kcache.shape[0], kcache.shape[1]
+        n_slots = kcache.shape[2]
+    else:
+        L, KV = 1, kcache.shape[0]
+        n_slots = kcache.shape[1]
+        layer = jnp.zeros((), jnp.int32)
     assert H % KV == 0, "query heads must be a multiple of kv heads"
     G = H // KV
     NB = block_table.shape[1]
-    n_slots = kcache.shape[1]
     assert n_slots % block_size == 0, "cache slots must be block-aligned"
     nb_tot = n_slots // block_size
     if scale is None:
@@ -314,15 +328,15 @@ def atom_paged_attention(q_atoms: jnp.ndarray, kcache: jnp.ndarray,
     if rows != G * A:
         q_r = jnp.pad(q_r, ((0, 0), (0, 0), (0, rows - G * A), (0, 0)))
 
-    k_view = kcache.reshape(KV, nb_tot, block_size, hd)
-    v_view = vcache.reshape(KV, nb_tot, block_size, hd)
+    k_view = kcache.reshape(L, KV, nb_tot, block_size, hd)
+    v_view = vcache.reshape(L, KV, nb_tot, block_size, hd)
 
-    def kv_index(a, h, ib, bt, aseq, aqs, anq, ql, cl):
+    def kv_index(a, h, ib, lyr, bt, aseq, aqs, anq, ql, cl):
         s = aseq[a]
         end_pos = cl[s] - ql[s] + aqs[a] + anq[a]
         needed = _cdiv(jnp.maximum(end_pos, 1), block_size)
         clamped = jnp.minimum(ib, needed - 1)
-        return (h, bt[s, clamped], 0, 0)
+        return (lyr[0], h, bt[s, clamped], 0, 0)
 
     if alibi is not None:
         import numpy as np
@@ -336,13 +350,13 @@ def atom_paged_attention(q_atoms: jnp.ndarray, kcache: jnp.ndarray,
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
-            num_scalar_prefetch=6,
+            num_scalar_prefetch=7,
             grid=(NA, KV, NB),
             in_specs=[
                 pl.BlockSpec((1, 1, rows, hd),
                              lambda a, h, ib, *_: (a, h, 0, 0)),
-                pl.BlockSpec((1, 1, block_size, hd), kv_index),
-                pl.BlockSpec((1, 1, block_size, hd), kv_index),
+                pl.BlockSpec((1, 1, 1, block_size, hd), kv_index),
+                pl.BlockSpec((1, 1, 1, block_size, hd), kv_index),
             ],
             out_specs=pl.BlockSpec((1, 1, rows, hd),
                                    lambda a, h, ib, *_: (a, h, 0, 0)),
@@ -354,7 +368,8 @@ def atom_paged_attention(q_atoms: jnp.ndarray, kcache: jnp.ndarray,
         ),
         out_shape=jax.ShapeDtypeStruct((NA, KV, rows, hd), q_atoms.dtype),
         interpret=_interpret() if interpret is None else interpret,
-    )(block_table.astype(jnp.int32), atom_seq.astype(jnp.int32),
+    )(jnp.reshape(layer, (1,)).astype(jnp.int32),
+      block_table.astype(jnp.int32), atom_seq.astype(jnp.int32),
       atom_qstart.astype(jnp.int32), atom_nq.astype(jnp.int32),
       q_len.astype(jnp.int32), ctx_len.astype(jnp.int32),
       q_r, k_view, v_view)
@@ -369,14 +384,24 @@ def atom_paged_attention(q_atoms: jnp.ndarray, kcache: jnp.ndarray,
 # ===================================================================== #
 def paged_kv_append(kcache: jnp.ndarray, vcache: jnp.ndarray,
                     k: jnp.ndarray, v: jnp.ndarray,
-                    kv_slot: jnp.ndarray):
+                    kv_slot: jnp.ndarray, layer=None):
     """Scatter new K/V rows into their cache slots.
 
-    kcache/vcache: [KV, n_slots, hd]; k/v: [T, KV, hd]; kv_slot: [T] flat
-    slot ids (padded tokens target the trash block).  A row scatter into a
-    donated buffer lowers to an in-place dynamic-update on TPU — the
+    kcache/vcache: [KV, n_slots, hd] (or stacked [L, KV, n_slots, hd] with
+    ``layer`` a traced index); k/v: [T, KV, hd]; kv_slot: [T] flat slot ids
+    (padded tokens target the trash block).  A row scatter into a donated /
+    loop-carried buffer lowers to an in-place dynamic-update on TPU — the
     idiomatic equivalent of the reference's pointer-chasing CUDA append.
+    The stacked form writes only the T new rows of one layer, so carrying
+    the whole cache through a layer scan costs O(T) HBM per layer, not a
+    restack of the full cache.
     """
+    if kcache.ndim == 4:
+        assert layer is not None, "stacked cache needs a layer index"
+        # mixed scalar/slice/array indexing puts the advanced axes first:
+        # [layer, :, kv_slot] selects [T, KV, hd] — k/v's native layout
+        return (kcache.at[layer, :, kv_slot].set(k.astype(kcache.dtype)),
+                vcache.at[layer, :, kv_slot].set(v.astype(vcache.dtype)))
     kcache = kcache.at[:, kv_slot].set(k.transpose(1, 0, 2).astype(kcache.dtype))
     vcache = vcache.at[:, kv_slot].set(v.transpose(1, 0, 2).astype(vcache.dtype))
     return kcache, vcache

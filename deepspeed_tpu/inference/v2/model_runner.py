@@ -122,11 +122,15 @@ def _unpack_batch(batch, max_q, max_seqs, max_blocks, atom_size):
     return batch
 
 
-def _ragged_attend(q, layer_k, layer_v, batch, *, attn_impl, atom_size,
-                   max_q, block_size, scale, alibi=None, alibi_scaled=False):
+def _ragged_attend(q, kcache, vcache, batch, *, attn_impl, atom_size,
+                   max_q, block_size, scale, alibi=None, alibi_scaled=False,
+                   layer=None):
     """Shared ragged attention dispatch: token-packed atoms through the
     Pallas paged kernel, or the dense-gather oracle.  q: [T, H, hd] →
-    [T, H*hd]."""
+    [T, H*hd].  ``kcache/vcache`` may be the full STACKED [L, KV, slots, hd]
+    cache with a traced ``layer`` index — the paged kernel then reads the
+    blocks it needs straight from the stacked buffer (no per-layer slice
+    materialization; see atom_paged_attention)."""
     T, H, hd = q.shape
     q_len, ctx_len = batch["q_len"], batch["ctx_len"]
     block_table = batch["block_table"]
@@ -137,17 +141,20 @@ def _ragged_attend(q, layer_k, layer_v, batch, *, attn_impl, atom_size,
         q_atoms = jnp.take(q.reshape(T, -1), atom_q_idx.reshape(-1), axis=0
                            ).reshape(-1, atom_size, H, hd)   # [NA, A, H, hd]
         o_atoms = atom_paged_attention(
-            q_atoms, layer_k, layer_v, block_table,
+            q_atoms, kcache, vcache, block_table,
             batch["atom_seq"], batch["atom_qstart"], batch["atom_nq"],
             q_len, ctx_len, block_size=block_size, scale=scale,
-            alibi=alibi, alibi_scaled=alibi_scaled)
+            alibi=alibi, alibi_scaled=alibi_scaled, layer=layer)
         return o_atoms[batch["token_atom"], batch["token_within"]] \
             .reshape(T, H * hd)
+    if kcache.ndim == 4:        # gather oracle works on the layer slice
+        kcache = jax.lax.dynamic_index_in_dim(kcache, layer, 0, keepdims=False)
+        vcache = jax.lax.dynamic_index_in_dim(vcache, layer, 0, keepdims=False)
     q_idx = jnp.clip(batch["q_offset"][:, None] + jnp.arange(max_q)[None, :],
                      0, T - 1)
     q_seq = jnp.take(q.reshape(T, -1), q_idx.reshape(-1), axis=0
                      ).reshape(-1, max_q, H, hd)             # [S, mq, H, hd]
-    o_seq = _attend_gather(q_seq, layer_k, layer_v, block_table,
+    o_seq = _attend_gather(q_seq, kcache, vcache, block_table,
                            q_len, ctx_len, block_size, scale,
                            alibi=alibi, alibi_scaled=alibi_scaled
                            ).astype(q.dtype)
@@ -186,8 +193,13 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
     batch_valid = kv_slot < (kcache.shape[2] - block_size)
 
     def layer_step(carry, inputs):
-        x, = carry
-        lp, layer_k, layer_v = inputs
+        # The FULL stacked KV cache rides the carry: the append is an
+        # in-place scatter of T rows and the paged kernel reads blocks
+        # straight from the stacked buffer.  Scanning the cache as xs/ys
+        # instead would slice-copy one full layer per iteration AND restack
+        # the whole cache per forward — O(cache) HBM per decode step.
+        x, kcache, vcache = carry
+        lp, l_idx = inputs
         h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
 
         def proj(p, n):
@@ -201,12 +213,13 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
         v = proj(lp["v_proj"], KV)
         q = _apply_rope_flat(q, cos, sin)
         k = _apply_rope_flat(k, cos, sin)
-        layer_k, layer_v = paged_kv_append(layer_k, layer_v, k, v, kv_slot)
+        kcache, vcache = paged_kv_append(kcache, vcache, k, v, kv_slot,
+                                         layer=l_idx)
 
-        o_flat = _ragged_attend(q, layer_k, layer_v, batch,
+        o_flat = _ragged_attend(q, kcache, vcache, batch,
                                 attn_impl=attn_impl, atom_size=atom_size,
                                 max_q=max_q, block_size=block_size,
-                                scale=scale).astype(dtype)
+                                scale=scale, layer=l_idx).astype(dtype)
         x = x + o_flat @ lp["o_proj"]["kernel"]
         h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
         if cfg.num_experts > 1:
@@ -224,10 +237,11 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
             gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
             up = h @ lp["up_proj"]["kernel"]
             x = x + (gate * up) @ lp["down_proj"]["kernel"]
-        return (x,), (layer_k, layer_v)
+        return (x, kcache, vcache), None
 
-    (x,), (new_k, new_v) = jax.lax.scan(
-        layer_step, (x,), (params["layers"], kcache, vcache))
+    (x, new_k, new_v), _ = jax.lax.scan(
+        layer_step, (x, kcache, vcache),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
 
     x = rms_norm(x, params["norm_f"]["scale"], cfg.norm_eps)
     last = jnp.take(x, logit_idx, axis=0)                          # [S, D]
@@ -290,8 +304,9 @@ def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
     alibi = alibi_slopes(H) if cfg.pos == "alibi" else None
 
     def layer_step(carry, inputs):
-        x, = carry
-        lp, layer_k, layer_v = inputs
+        # stacked-cache carry: see ragged_forward.layer_step
+        x, kcache, vcache = carry
+        lp, l_idx = inputs
         h_attn_in = norm(x, lp["ln1"])
         q = proj(h_attn_in, lp["q_proj"], H)
         k = proj(h_attn_in, lp["k_proj"], KV)
@@ -299,12 +314,13 @@ def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
         if cfg.pos == "rope":
             q = _apply_rope_flat(q, cos, sin, cfg.rotary_dim, cfg.rope_style)
             k = _apply_rope_flat(k, cos, sin, cfg.rotary_dim, cfg.rope_style)
-        layer_k, layer_v = paged_kv_append(layer_k, layer_v, k, v, kv_slot)
+        kcache, vcache = paged_kv_append(kcache, vcache, k, v, kv_slot,
+                                         layer=l_idx)
 
-        o_flat = _ragged_attend(q, layer_k, layer_v, batch,
+        o_flat = _ragged_attend(q, kcache, vcache, batch,
                                 attn_impl=attn_impl, atom_size=atom_size,
                                 max_q=max_q, block_size=block_size,
-                                scale=scale, alibi=alibi,
+                                scale=scale, alibi=alibi, layer=l_idx,
                                 alibi_scaled=cfg.alibi_scaled).astype(dtype)
         attn_out = o_flat @ lp["o_proj"]["kernel"]
         if "bias" in lp["o_proj"]:
@@ -331,10 +347,11 @@ def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
                 mlp_out = mlp_out + lp["fc2"]["bias"]
 
         x = x + attn_out + mlp_out if cfg.parallel_attn else x + mlp_out
-        return (x,), (layer_k, layer_v)
+        return (x, kcache, vcache), None
 
-    (x,), (new_k, new_v) = jax.lax.scan(
-        layer_step, (x,), (params["layers"], kcache, vcache))
+    (x, new_k, new_v), _ = jax.lax.scan(
+        layer_step, (x, kcache, vcache),
+        (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
 
     x = norm(x, params["norm_f"])
     last = jnp.take(x, logit_idx, axis=0)
@@ -349,11 +366,13 @@ def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
 
 def build_ragged_step(cfg, max_q: int, block_size: int,
                       attn_impl: str = "paged", atom_size: int = 16,
-                      max_seqs: int = 0, max_blocks: int = 0):
+                      max_seqs: int = 0, max_blocks: int = 0,
+                      jit: bool = True):
     """Jitted step with donated caches (the CUDA-graph analogue: one compiled
     program reused for every batch; reference engine.py:494 _create_cuda_graph).
     Dispatches on the config type: TransformerConfig → native llama-family
-    runner; ArchConfig → universal per-arch runner."""
+    runner; ArchConfig → universal per-arch runner.  ``jit=False`` returns
+    the raw traceable fn (for embedding in the fused decode loop)."""
     from ...models.families import ArchConfig
 
     assert attn_impl in ("paged", "gather"), \
@@ -363,4 +382,80 @@ def build_ragged_step(cfg, max_q: int, block_size: int,
     fn = partial(body, cfg=cfg, max_q=max_q, block_size=block_size,
                  attn_impl=attn_impl, atom_size=atom_size, max_seqs=max_seqs,
                  max_blocks=max_blocks)
-    return jax.jit(fn, donate_argnums=(1, 2))
+    return jax.jit(fn, donate_argnums=(1, 2)) if jit else fn
+
+
+def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
+                      block_size: int, trash_slot: int, attn_impl: str,
+                      atom_size: int, steps: int, temperature: float = 0.0):
+    """Fused multi-step greedy/sampling decode: ``steps`` forward+select
+    iterations in ONE compiled program (lax.scan), with the batch metadata
+    advanced on device between iterations.
+
+    Why: the host-driven put()/argmax loop pays a host↔device round trip per
+    token — over a remote TPU link that latency (not compute) caps decode
+    throughput; even colocated it is the kernel-launch overhead the reference
+    kills with CUDA graphs (engine.py:494).  Here the whole decode window is
+    device-resident: token i+1's embedding lookup consumes the argmax of
+    step i without ever leaving HBM.
+
+    Requires a DECODE-ONLY batch laid out row-major (sequence i's single
+    query token at flat index i — what RaggedBatchWrapper.finalize produces
+    for 1-token-per-seq batches), with KV blocks pre-allocated for the full
+    window so the block table is static across the loop; only tokens /
+    kv_slot / positions / ctx lengths advance, and those are recomputed from
+    the block table on device.
+
+    Returns jitted (params, k, v, packed_meta, rng) →
+    (tokens [steps, max_seqs] int32, k, v)."""
+    step_fn = build_ragged_step(cfg, max_q=max_q, block_size=block_size,
+                                attn_impl=attn_impl, atom_size=atom_size,
+                                max_seqs=max_seqs, max_blocks=max_blocks,
+                                jit=False)
+    layout = pack_layout(max_q, max_seqs, max_blocks,
+                         -(-max_q // atom_size) + max_seqs)
+    S, NB, bs = max_seqs, max_blocks, block_size
+
+    def field(meta, name, n):
+        off = layout[name][0]
+        return jax.lax.dynamic_slice_in_dim(meta, off, n)
+
+    def set_field(meta, name, vals):
+        off = layout[name][0]
+        return jax.lax.dynamic_update_slice_in_dim(meta, vals, off, axis=0)
+
+    def advance(meta, new_toks):
+        """Next step's metadata: row i's token advances to position pos+1;
+        its cache slot is re-derived from the (static) block table."""
+        q_len = field(meta, "q_len", S)
+        active = (q_len > 0).astype(jnp.int32)            # [S]
+        pos = field(meta, "pos_of_token", S) + active
+        ctx = field(meta, "ctx_len", S) + active
+        bt = field(meta, "block_table", S * NB).reshape(S, NB)
+        blk = jnp.take_along_axis(bt, (pos // bs)[:, None], axis=1)[:, 0]
+        slot = jnp.where(active == 1, blk * bs + pos % bs, trash_slot)
+        tok = jnp.where(active == 1, new_toks[:S], 0)
+        meta = set_field(meta, "tokens", tok)
+        meta = set_field(meta, "kv_slot", slot)
+        meta = set_field(meta, "pos_of_token", pos)
+        meta = set_field(meta, "ctx_len", ctx)
+        return meta
+
+    def loop(params, kcache, vcache, meta, rng):
+        def body(carry, _):
+            k, v, meta, rng = carry
+            logits, k, v = step_fn(params, k, v, meta)
+            if temperature > 0:
+                rng, sub = jax.random.split(rng)
+                toks = jax.random.categorical(sub, logits / temperature,
+                                              axis=-1).astype(jnp.int32)
+            else:
+                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            meta = advance(meta, toks)
+            return (k, v, meta, rng), toks
+
+        (kcache, vcache, _, _), toks = jax.lax.scan(
+            body, (kcache, vcache, meta, rng), None, length=steps)
+        return toks, kcache, vcache
+
+    return jax.jit(loop, donate_argnums=(1, 2))

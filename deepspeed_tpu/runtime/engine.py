@@ -142,10 +142,22 @@ class DeepSpeedEngine:
             jax.eval_shape(self.optimizer.init, params), params)
         # ZeRO-Offload: optimizer state lives in pinned host memory; XLA
         # streams it through the update (reference: cpu-Adam on host,
-        # offload_config 'device: cpu'). Ratio<1 keeps a device-resident
-        # fraction (Twin-Flow) — approximated as all-or-nothing per leaf.
+        # offload_config 'device: cpu').  ratio<1 = Twin-Flow (Offload++):
+        # each state leaf is SPLIT along dim 0 — the leading (1-ratio)
+        # fraction stays in HBM, the trailing ratio streams from pinned
+        # host at step time (zero/twin_flow.py).
+        self._twin_flow_bytes = None
         if config.zero_config.offload_optimizer_device() == "cpu":
-            opt_shardings = jax.tree.map(self._to_host_memory, opt_shardings)
+            ratio = float(config.zero_config.offload_optimizer.ratio)
+            if 0.0 < ratio < 1.0:
+                from .zero.twin_flow import build_twin_flow
+
+                self.optimizer, opt_shardings, self._twin_flow_bytes = \
+                    build_twin_flow(self.optimizer, ratio, params, self.plan,
+                                    self.mesh)
+            else:
+                opt_shardings = jax.tree.map(self._to_host_memory,
+                                             opt_shardings)
         opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
 
         gas = config.gradient_accumulation_steps

@@ -46,8 +46,12 @@ class DeepSpeedZeroOffloadOptimizerConfig(DeepSpeedConfigModel):
     pipeline_read: bool = False
     pipeline_write: bool = False
     fast_init: bool = False
-    #: Fraction of optimizer state kept on device (Twin-Flow / Offload++
-    #: ``ratio``, reference offload_config.py).  1.0 = everything offloaded.
+    #: Fraction of optimizer-state BYTES offloaded to pinned host memory
+    #: (Twin-Flow / Offload++ ``ratio``, reference offload_config.py +
+    #: blogs/deepspeed-offloadpp).  1.0 = everything offloaded (classic
+    #: ZeRO-Offload); 0 < ratio < 1 splits each state leaf along dim 0 —
+    #: the leading (1-ratio) stays in HBM, the trailing ratio streams from
+    #: host at step time.
     ratio: float = 1.0
 
 

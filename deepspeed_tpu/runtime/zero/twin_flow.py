@@ -1,0 +1,187 @@
+"""Twin-Flow fractional optimizer-state offload (Offload++).
+
+Reference: ``deepspeed/runtime/zero/offload_config.py`` (``ratio``) and
+``blogs/deepspeed-offloadpp/README.md`` — partial optimizer offload where a
+``ratio`` fraction of the state lives on the host and the rest stays in
+device HBM, so the optimizer step overlaps a small host stream with the
+device-resident update instead of paying the full PCIe round trip.
+
+TPU design: every optimizer-state leaf is split along dim 0 —
+``[:n_dev]`` stays in HBM, ``[n_dev:]`` is placed in ``pinned_host`` memory.
+The wrapped optimizer joins the two halves inside the jitted step (XLA turns
+the host→HBM placement change into a DMA it can overlap with compute),
+runs the inner optax update on the joined state, and splits the result back.
+No separate host-optimizer kernel is needed — the "CPU Adam" of the
+reference is replaced by XLA host streaming (SURVEY §2: cpu-Adam analogue).
+
+The split index is rounded to the leaf's dim-0 shard count so both halves
+keep the ZeRO sharding layout; scalars and 1-row leaves stay fully on
+device (they are bytes-irrelevant).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, NamedTuple, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+class TwinFlowState(NamedTuple):
+    """dev/host trees have the inner state's treedef; each leaf is the
+    leading/trailing dim-0 slice of the corresponding inner leaf (possibly
+    0 rows)."""
+
+    dev: Any
+    host: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class _LeafPlan:
+    axis: int           # split axis (the leaf's largest dim)
+    n_dev: int          # rows of `axis` staying in HBM
+    n_host: int         # rows of `axis` on pinned host
+    scalar: bool        # shape () — never split
+
+
+def _axis_shards(sharding, axis: int) -> int:
+    spec = getattr(sharding, "spec", None)
+    if not spec or len(spec) <= axis or spec[axis] is None:
+        return 1
+    entries = spec[axis] if isinstance(spec[axis], (tuple, list)) \
+        else (spec[axis],)
+    n = 1
+    for a in entries:
+        n *= sharding.mesh.shape[a]
+    return n
+
+
+def _plan_leaf(shape: Tuple[int, ...], ratio: float, sharding) -> _LeafPlan:
+    if not shape:
+        return _LeafPlan(0, 0, 0, True)
+    # Split along the LARGEST dim: stacked-layer moments carry a tiny
+    # leading [num_layers] axis where a dim-0 split can only hit multiples
+    # of 1/L — the widest axis gives the finest approximation of ratio.
+    axis = max(range(len(shape)), key=lambda d: shape[d])
+    rows = shape[axis]
+    granule = _axis_shards(sharding, axis)  # halves stay shard-divisible
+    n_host = int(round(rows * ratio / granule)) * granule
+    # keep BOTH halves non-empty: a 0-row dev half would reintroduce the
+    # zero-size-leaf problem (orbax refuses them) the host placeholder
+    # avoids — at ratio→1 rounding may otherwise consume the whole leaf
+    n_host = min(max(n_host, 0), rows - granule)
+    if n_host <= 0 or (rows - n_host) % granule:
+        n_host = 0  # cannot split cleanly; keep on device
+    return _LeafPlan(axis, rows - n_host, n_host, False)
+
+
+def build_twin_flow(inner: optax.GradientTransformation, ratio: float,
+                    params: Any, plan, mesh):
+    """Wrap ``inner`` with fractional host offload.
+
+    Returns ``(optimizer, init_shardings, byte_split)``: the wrapped
+    transformation (state = TwinFlowState), the matching sharding pytree for
+    ``jax.jit(optimizer.init, out_shardings=...)``, and a
+    ``() -> (device_bytes, host_bytes)`` accounting fn.
+    """
+    on_tpu = jax.default_backend() == "tpu"
+    inner_shapes = jax.eval_shape(inner.init, params)
+    inner_shardings = plan.opt_state_shardings(inner_shapes, params)
+
+    flat_shapes, treedef = jax.tree_util.tree_flatten(inner_shapes)
+    flat_shardings = treedef.flatten_up_to(inner_shardings)
+    leaf_plans = tuple(
+        _plan_leaf(tuple(s.shape), ratio, sh)
+        for s, sh in zip(flat_shapes, flat_shardings))
+
+    def _host(sharding):
+        if not on_tpu:
+            return sharding  # CPU backend has no pinned_host memory space
+        try:
+            return sharding.with_memory_kind("pinned_host")
+        except Exception:  # noqa: BLE001
+            return sharding
+
+    def _dev(sharding):
+        if not on_tpu:
+            return sharding
+        try:
+            return sharding.with_memory_kind("device")
+        except Exception:  # noqa: BLE001
+            return sharding
+
+    def split(full_tree):
+        """Inner state → TwinFlowState (host halves re-placed per step)."""
+        flat = treedef.flatten_up_to(full_tree)
+        dev, host = [], []
+        for leaf, lp, sh in zip(flat, leaf_plans, flat_shardings):
+            if lp.scalar or lp.n_host == 0:
+                dev.append(leaf)
+                # scalar placeholder, not a 0-size array (orbax refuses to
+                # serialize zero-size leaves); join() keys off lp.n_host
+                host.append(jnp.zeros((), jnp.result_type(leaf)))
+                continue
+            d = jax.lax.slice_in_dim(leaf, 0, lp.n_dev, axis=lp.axis)
+            h = jax.lax.slice_in_dim(leaf, lp.n_dev, lp.n_dev + lp.n_host,
+                                     axis=lp.axis)
+            if on_tpu:
+                h = jax.device_put(h, _host(sh))
+            dev.append(d)
+            host.append(h)
+        return TwinFlowState(dev=treedef.unflatten(dev),
+                             host=treedef.unflatten(host))
+
+    def join(state: TwinFlowState):
+        """TwinFlowState → inner state, host halves streamed to HBM."""
+        dflat = treedef.flatten_up_to(state.dev)
+        hflat = treedef.flatten_up_to(state.host)
+        full = []
+        for d, h, lp, sh in zip(dflat, hflat, leaf_plans, flat_shardings):
+            if lp.scalar or lp.n_host == 0:
+                full.append(d)
+                continue
+            if on_tpu:
+                h = jax.device_put(h, _dev(sh))
+            full.append(jnp.concatenate([d, h], axis=lp.axis))
+        return treedef.unflatten(full)
+
+    def init(p):
+        return split(inner.init(p))
+
+    def update(grads, state: TwinFlowState, p=None):
+        updates, new_inner = inner.update(grads, join(state), p)
+        return updates, split(new_inner)
+
+    def init_shardings():
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        replicated = NamedSharding(mesh, PartitionSpec())
+        dev_sh, host_sh = [], []
+        for sh, lp in zip(flat_shardings, leaf_plans):
+            dev_sh.append(sh)
+            # scalar placeholders (unsplit leaves) must be replicated — a
+            # sharded spec on a 0-d array is ill-formed
+            host_sh.append(_host(sh) if lp.n_host else replicated)
+        return TwinFlowState(dev=treedef.unflatten(dev_sh),
+                             host=treedef.unflatten(host_sh))
+
+    def byte_split():
+        """(device_bytes, host_bytes) of the planned placement — for tests
+        and the memory estimator."""
+        dev_b = host_b = 0
+        for s, lp in zip(flat_shapes, leaf_plans):
+            if lp.scalar:
+                dev_b += s.dtype.itemsize
+                continue
+            row = s.dtype.itemsize
+            for d, n in enumerate(s.shape):
+                if d != lp.axis:
+                    row *= n
+            dev_b += lp.n_dev * row
+            host_b += lp.n_host * row
+        return dev_b, host_b
+
+    return optax.GradientTransformation(init, update), init_shardings(), \
+        byte_split

@@ -47,6 +47,7 @@ class DistributedAttention:
         self.sp_axis = sp_axis
         self.scatter_idx = scatter_idx
         self.gather_idx = gather_idx
+        self._jit_cache = {}
 
     def __call__(self, query, key, value, *args, **kwargs):
         topo = get_topology()
@@ -81,10 +82,21 @@ class DistributedAttention:
         # tracing context on this jax version); inside an enclosing jit it
         # simply inlines.
         io_spec = P(None, self.sp_axis, None, None)
-        fn = jax.shard_map(
-            body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
-            out_specs=io_spec, axis_names={self.sp_axis}, check_vma=False)
-        return jax.jit(fn)(query, key, value)
+        # cache the jitted wrapper: a fresh closure per call would defeat
+        # jit's identity-keyed cache and recompile every eager invocation
+        try:
+            cache_key = (mesh, tuple(args), tuple(sorted(kwargs.items())))
+            fn = self._jit_cache.get(cache_key)
+        except TypeError:           # unhashable extra args: don't cache
+            cache_key, fn = None, None
+        if fn is None:
+            fn = jax.jit(jax.shard_map(
+                body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
+                out_specs=io_spec, axis_names={self.sp_axis},
+                check_vma=False))
+            if cache_key is not None:
+                self._jit_cache[cache_key] = fn
+        return fn(query, key, value)
 
 
 class UlyssesAttention(DistributedAttention):

@@ -28,6 +28,7 @@ from jax.sharding import PartitionSpec as P
 from ..runtime.topology import DATA, EXPERT, SEQ, get_topology
 
 _NEG_INF = -1e30
+_ring_jit_cache: dict = {}
 
 
 def _chunk_attn(q, k, v, scale, mask):
@@ -119,12 +120,17 @@ def ring_attention(query, key, value, causal: bool = True,
         return body(query, key, value)
     # Partial-manual over the ring axis only (see layer.py): data/batch
     # sharding stays GSPMD so the ring nests inside manual-over-data regions.
-    # jit keeps the eager call path working (inlines under an enclosing jit).
+    # jit keeps the eager call path working (inlines under an enclosing jit);
+    # the wrapper is cached so eager loops don't recompile per call.
     io_spec = P(None, sp_axis, None, None)
-    fn = jax.shard_map(body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
-                       out_specs=io_spec, axis_names={sp_axis},
-                       check_vma=False)
-    return jax.jit(fn)(query, key, value)
+    cache_key = (mesh, sp_axis, causal, float(scale), sp)
+    fn = _ring_jit_cache.get(cache_key)
+    if fn is None:
+        fn = jax.jit(jax.shard_map(
+            body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
+            out_specs=io_spec, axis_names={sp_axis}, check_vma=False))
+        _ring_jit_cache[cache_key] = fn
+    return fn(query, key, value)
 
 
 def _local_causal_mask(sq, sk):
