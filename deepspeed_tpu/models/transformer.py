@@ -47,6 +47,10 @@ class TransformerConfig:
     #: bias on q/k/v projections (qwen2-family); o_proj stays bias-free
     attn_bias: bool = False
     remat: bool = False
+    #: jax.checkpoint_policies name: "nothing_saveable" = full recompute
+    #: (min memory); "dots_with_no_batch_dims_saveable" keeps matmul outputs
+    #: (≈no recompute flops — the MFU-vs-memory dial)
+    remat_policy: str = "nothing_saveable"
     use_flash: bool = True          # pallas flash attention on TPU
     attn_impl: str = "auto"         # auto | flash | xla | ring | ulysses
     # MoE (Mixtral-family): >1 experts replaces the dense MLP with a
@@ -308,7 +312,14 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
 
     layer_fn = layer
     if cfg.remat:
-        layer_fn = jax.checkpoint(layer, policy=jax.checkpoint_policies.nothing_saveable)
+        policy = getattr(jax.checkpoint_policies, cfg.remat_policy, None)
+        if not callable(policy):
+            valid = [n for n in dir(jax.checkpoint_policies)
+                     if not n.startswith("_")]
+            raise ValueError(
+                f"remat_policy={cfg.remat_policy!r} is not a "
+                f"jax.checkpoint_policies member; valid: {valid}")
+        layer_fn = jax.checkpoint(layer, policy=policy)
 
     (x, aux_loss), _ = jax.lax.scan(layer_fn, (x, jnp.zeros((), jnp.float32)),
                                     params["layers"])

@@ -2,10 +2,12 @@
 sparse_self_attention.py + bert_sparse_self_attention.py — Triton block-sparse
 matmul/softmax).
 
-TPU implementation: two paths share the layout classes.  Training uses the
-token-level mask over dense attention (exact backward); serving opts into
-the Pallas block-sparse kernel (block_sparse_kernel.py, use_kernel=True)
-where masked blocks skip both compute and DMA.
+TPU implementation: two paths share the layout classes.  The Pallas
+block-sparse kernel (block_sparse_kernel.py, use_kernel=True) skips both
+compute and DMA for masked blocks and is fully differentiable (custom_vjp
+dq/dkv kernels reuse the layout gating) — training and serving both take
+it; the masked-dense path remains for the rpe/padding/attn-mask extras and
+as the numerics oracle.
 """
 from __future__ import annotations
 
@@ -40,9 +42,9 @@ class SparseSelfAttention:
         """q/k/v: [B, H, S, hd] (reference layout). Returns [B, H, S, hd].
 
         ``use_kernel=True`` takes the Pallas block-sparse kernel (masked
-        blocks skip both compute and DMA) — forward-only and without
-        rpe/padding/attn-mask extras, i.e. the serving fast path; training
-        and the extras keep the masked-dense path below."""
+        blocks skip both compute and DMA; differentiable — the custom_vjp
+        dq/dkv kernels walk the same layout) but not the
+        rpe/padding/attn-mask extras; those keep the masked-dense path."""
         B, H, S, hd = query.shape
         if use_kernel:
             assert rpe is None and key_padding_mask is None and \

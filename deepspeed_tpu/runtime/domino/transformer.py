@@ -11,6 +11,16 @@ independent.  Domino's contribution is exactly that graph shape: splitting the
 batch into two halves creates two independent chains whose psum of half A
 overlaps half B's GEMMs.  This module reproduces that structure; the async
 streams/handles of the reference are XLA's scheduler.
+
+Measurement status (honest): the OVERLAP itself only materializes under
+XLA:TPU's latency-hiding scheduler on a real tp>1 mesh — the CPU simulator
+lowers all-reduce synchronously (no -start/-done pairs), and a single TPU
+chip has no tensor-axis collective at all, so this environment cannot
+observe it.  What IS machine-checked here: the μ-batch INDEPENDENCE that
+the overlap requires (test_longcontext_domino: zero cross-μ-batch
+jacobian), i.e. the scheduler is free to overlap.  On a multi-chip
+deployment run :func:`overlap_evidence` once — it compiles the layer for
+the attached mesh and reports the async collective pairs in the schedule.
 """
 from __future__ import annotations
 
@@ -96,3 +106,30 @@ class DominoTransformer:
 
         out, _ = jax.lax.scan(body, x, layers_params)
         return out
+
+
+def overlap_evidence(cfg, lp, x, micro_splits: int = 2, lp_specs=None):
+    """Compile one Domino layer for the ATTACHED mesh and report the async
+    collective pairs in the optimized schedule — the one-call overlap
+    artifact for a real tp>1 TPU deployment (on CPU or a single chip this
+    reports zero pairs: see module docstring).
+
+    Returns ``{"all_reduce_start": n, "all_reduce_done": n, "hlo": text}``.
+    """
+    import re
+
+    from jax.sharding import PartitionSpec as P
+
+    from ..topology import get_topology
+
+    topo = get_topology()
+    layer = DominoTransformerLayer(cfg, micro_splits)
+    if lp_specs is None:
+        lp_specs = P()   # caller passes the Megatron specs for sharded lp
+    fn = jax.jit(jax.shard_map(
+        lambda lp, x: layer(lp, x), mesh=topo.mesh,
+        in_specs=(lp_specs, P()), out_specs=P(), check_vma=False))
+    txt = fn.lower(lp, x).compile().as_text()
+    return {"all_reduce_start": len(re.findall(r"all-reduce-start", txt)),
+            "all_reduce_done": len(re.findall(r"all-reduce-done", txt)),
+            "hlo": txt}

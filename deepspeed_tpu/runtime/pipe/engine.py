@@ -94,9 +94,24 @@ def pipeline_lm_loss(params: Dict, batch: Any, cfg, topo, rng,
                         schedule="gpipe")
 
 
+def interleave_order(num_layers: int, pp: int, virtual_stages: int):
+    """(order, inverse) permutations of the stacked layer axis mapping the
+    canonical [L] order to the interleaved virtual-stage placement: rank s's
+    contiguous PIPE shard holds global chunks {s, s+pp, ..., s+(V-1)·pp}."""
+    Lc_g = num_layers // (pp * virtual_stages)
+    if num_layers % (pp * virtual_stages) != 0:
+        raise ValueError(f"virtual_stages={virtual_stages} × pipe={pp} "
+                         f"must divide num_layers={num_layers}")
+    order = np.concatenate([
+        np.arange((c * pp + s) * Lc_g, (c * pp + s + 1) * Lc_g)
+        for s in range(pp) for c in range(virtual_stages)])
+    return order, np.argsort(order)
+
+
 def pipeline_lm_loss_1f1b(params: Dict, batch: Any, cfg, topo, rng,
                           num_micro: int, loss_scale=1.0,
-                          virtual_stages: int = 1):
+                          virtual_stages: int = 1,
+                          layers_prepermuted: bool = False):
     """1F1B pipeline step → ``(loss, grads)`` (reference ``TrainSchedule``,
     runtime/pipe/schedule.py:189).
 
@@ -119,14 +134,22 @@ def pipeline_lm_loss_1f1b(params: Dict, batch: Any, cfg, topo, rng,
     on the next tick with no extra hop.  Ticks shrink to 1/V of a stage, so
     the fill/drain bubble costs (pp-1)/V stage-times instead of pp-1.
     Requires num_micro % pp == 0 (microbatches flow in groups of pp).
+
+    ``layers_prepermuted=True`` means ``params["layers"]`` already sits in
+    :func:`interleave_order` layout (the PipelineEngine keeps its state that
+    way): the per-step permute — a cross-pipe collective moving the whole
+    weight tree twice per step — is skipped, and grads return in the SAME
+    interleaved layout.
     """
     return _pipeline_lm(params, batch, cfg, topo, rng, num_micro,
                         schedule="1f1b", loss_scale=loss_scale,
-                        virtual_stages=virtual_stages)
+                        virtual_stages=virtual_stages,
+                        layers_prepermuted=layers_prepermuted)
 
 
 def _pipeline_lm(params: Dict, batch: Any, cfg, topo, rng, num_micro: int,
-                 schedule: str, loss_scale=1.0, virtual_stages: int = 1):
+                 schedule: str, loss_scale=1.0, virtual_stages: int = 1,
+                 layers_prepermuted: bool = False):
     from ...models.transformer import apply_rope, lm_loss, rms_norm, rope_tables
 
     pp = topo.dims[PIPE]
@@ -443,29 +466,25 @@ def _pipeline_lm(params: Dict, batch: Any, cfg, topo, rng, num_micro: int,
         return jax.shard_map(body, mesh=mesh, in_specs=(spec_tree, tok_spec),
                              out_specs=P(), check_vma=False)(params, tokens)
 
-    if virtual_stages > 1:
+    if virtual_stages > 1 and not layers_prepermuted:
         # Interleaved layer placement: virtual stage vs = c·pp + s means
         # rank s owns global layer chunks {s, s+pp, ..., s+(V-1)·pp}, local
         # chunk order c = 0..V-1 — but the contiguous PIPE shard gives rank
         # s rows [s·L/pp, ...).  Permute the stacked layer axis so the
-        # contiguous shard IS the interleaved assignment (and un-permute
-        # the returned grads).
-        L = cfg.num_layers
-        Lc_g = L // (pp * virtual_stages)
-        if L % (pp * virtual_stages) != 0:
-            raise ValueError(f"virtual_stages={virtual_stages} × pipe={pp} "
-                             f"must divide num_layers={L}")
-        order = np.concatenate([
-            np.arange((c * pp + s) * Lc_g, (c * pp + s + 1) * Lc_g)
-            for s in range(pp) for c in range(virtual_stages)])
-        inv = np.argsort(order)
+        # contiguous shard IS the interleaved assignment (and un-permute the
+        # returned grads).  The PipelineEngine keeps its state prepermuted
+        # so the train step never pays this cross-pipe collective; this
+        # branch serves direct/functional callers.
+        order, inv = interleave_order(cfg.num_layers, pp, virtual_stages)
         params = {**params, "layers": jax.tree.map(
             lambda a: jnp.take(a, order, axis=0), params["layers"])}
+    elif virtual_stages > 1:
+        interleave_order(cfg.num_layers, pp, virtual_stages)  # validates
 
     loss, grads = jax.shard_map(
         body, mesh=mesh, in_specs=(spec_tree, tok_spec),
         out_specs=(P(), spec_tree), check_vma=False)(params, tokens)
-    if virtual_stages > 1:
+    if virtual_stages > 1 and not layers_prepermuted:
         grads = {**grads, "layers": jax.tree.map(
             lambda a: jnp.take(a, inv, axis=0), grads["layers"])}
     return loss, grads
@@ -596,8 +615,84 @@ class PipelineEngine(DeepSpeedEngine):
         self._pipe_model = model
         super().__init__(model=model, config=config, topology=topology, **kwargs)
         self.is_pipe_parallel = topology.get_pipe_parallel_world_size() > 1
+        # Interleaved virtual stages: keep state.params["layers"] PERMANENTLY
+        # in interleave_order layout so the hot step never pays the
+        # cross-pipe permute collective (twice per step for weights+grads);
+        # checkpoints and the eval path convert back to canonical [L] order.
+        self._vs_order = self._vs_inv = None
+        V = config.pipeline.virtual_stages
+        if self._use_1f1b() and V > 1:
+            pp = topology.get_pipe_parallel_world_size()
+            self._vs_order, self._vs_inv = interleave_order(
+                model.config.num_layers, pp, V)
+            self.state = self.state.replace(
+                params=self._permute_layers(self.state.params, self._vs_order))
         log_dist(f"pipeline engine: stages={topology.get_pipe_parallel_world_size()} "
                  f"micro_batches={self.num_micro}", ranks=[0])
+
+    # ---------------- interleaved-layout plumbing --------------------- #
+    def _permute_layers(self, params, order):
+        """Permute the stacked layer axis of a params-shaped tree, keeping
+        each leaf's sharding (one collective at init/ckpt time — not per
+        step)."""
+        shardings = self.param_shardings["layers"]
+        idx = jnp.asarray(order)
+        layers = jax.tree.map(
+            lambda a, s: jax.device_put(jnp.take(a, idx, axis=0), s),
+            params["layers"], shardings)
+        return {**params, "layers": layers}
+
+    def _convert_state_layout(self, state, order):
+        """Apply the layer permutation to every params-shaped component of
+        an EngineState (params + optimizer moments + grad accumulator)."""
+        param_struct = jax.tree_util.tree_structure(state.params)
+        param_leaves = jax.tree.leaves(state.params)
+
+        def mirrors(node):
+            if jax.tree_util.tree_structure(node) != param_struct:
+                return False
+            return all(getattr(l, "shape", None) == p.shape
+                       for l, p in zip(jax.tree.leaves(node), param_leaves))
+
+        def fix(node):
+            return self._permute_layers(node, order) if mirrors(node) else node
+
+        new_opt = jax.tree.map(fix, state.opt_state, is_leaf=mirrors)
+        new_acc = self._permute_layers(state.grad_acc, order) \
+            if state.grad_acc is not None and mirrors(state.grad_acc) \
+            else state.grad_acc
+        return state.replace(params=self._permute_layers(state.params, order),
+                             opt_state=new_opt, grad_acc=new_acc)
+
+    def save_checkpoint(self, save_dir, tag=None, **kw):
+        """Checkpoints always hold the CANONICAL [L] layer order so they
+        reload under any (pp, virtual_stages, schedule) config."""
+        if self._vs_inv is None:
+            return super().save_checkpoint(save_dir, tag=tag, **kw)
+        live = self.state
+        self.state = self._convert_state_layout(live, self._vs_inv)
+        try:
+            return super().save_checkpoint(save_dir, tag=tag, **kw)
+        finally:
+            self.state = live
+
+    def load_checkpoint(self, load_dir, tag=None, **kw):
+        out = super().load_checkpoint(load_dir, tag=tag, **kw)
+        if self._vs_order is not None and out[0] is not None:
+            # re-interleave ONLY what the base load actually replaced with
+            # canonical-order data: a missing checkpoint leaves the live
+            # (already interleaved) state untouched, and a params-only load
+            # must not re-permute the untouched optimizer moments
+            params_only = kw.get("load_module_only") or \
+                not kw.get("load_optimizer_states", True)
+            if params_only:
+                self.state = self.state.replace(
+                    params=self._permute_layers(self.state.params,
+                                                self._vs_order))
+            else:
+                self.state = self._convert_state_layout(self.state,
+                                                        self._vs_order)
+        return out
 
     def _resolve_loss_fn(self, model):
         from .module import PipelineModule
@@ -614,6 +709,12 @@ class PipelineEngine(DeepSpeedEngine):
         cfg = model.config
 
         def fn(params, batch, rng):
+            inv = getattr(self, "_vs_inv", None)
+            if inv is not None:
+                # eval path: engine state lives in interleaved layout; the
+                # GPipe forward expects canonical order (cold path — the
+                # permute collective is acceptable here)
+                params = self._permute_layers(params, inv)
             return pipeline_lm_loss(params, batch, cfg, self.topology or get_topology(),
                                     rng, self.num_micro)
 
@@ -643,7 +744,8 @@ class PipelineEngine(DeepSpeedEngine):
                 loss, grads = pipeline_lm_loss_1f1b(
                     p, batch, self._pipe_model.config, topo, sub,
                     self.num_micro, loss_scale=state.scaler.scale,
-                    virtual_stages=self.config.pipeline.virtual_stages)
+                    virtual_stages=self.config.pipeline.virtual_stages,
+                    layers_prepermuted=self._vs_order is not None)
                 grads = self._constrain_grads(grads)
             else:
                 loss, grads = self._loss_and_grads(state.params, batch, sub,

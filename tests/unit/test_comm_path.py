@@ -48,6 +48,7 @@ def _step_hlo(eng, batch):
 
 
 class TestQuantizedGradients:
+    @pytest.mark.slow
     def test_convergence_close_to_baseline(self):
         batch = _batch()
         base = _losses(_engine(2), batch)
@@ -83,6 +84,7 @@ class TestQuantizedWeights:
     _ZC = {"zero_quantized_weights": True,
            "stage3_param_persistence_threshold": 0}
 
+    @pytest.mark.slow
     def test_stage3_qwz_trains(self):
         batch = _batch()
         base = _losses(_engine(3, {"stage3_param_persistence_threshold": 0}),
@@ -99,6 +101,7 @@ class TestQuantizedWeights:
 
 
 class TestSparseGradients:
+    @pytest.mark.slow
     def test_matches_dense_exchange(self):
         """Sparse (indices, values) embedding exchange is exact: every
         touched row is covered by the batch's token ids."""
@@ -168,6 +171,7 @@ class TestExplicitCommModelParallel:
         losses = [float(eng.train_batch(batch)) for _ in range(3)]
         assert losses[-1] < losses[0]
 
+    @pytest.mark.slow
     def test_qgz_composes_with_sequence_parallelism(self):
         """seq stays Auto: XLA reduces grads over the seq shards inside the
         body at full precision; the quantized wire covers the data hop."""
@@ -190,6 +194,7 @@ class TestExplicitCommModelParallel:
         with pytest.raises(ValueError, match="pipeline"):
             eng.train_batch(_batch(n=8))
 
+    @pytest.mark.slow
     def test_gas_accumulation_under_explicit_comm(self):
         topo = initialize_mesh(TopologyConfig(), force=True)
         cfg = TransformerConfig.tiny(use_flash=False)
@@ -255,6 +260,7 @@ class TestImperativeWireParity:
         assert not int8(mtxt), "backward() must not exchange grads"
         assert int8(stxt), "step() boundary must carry the int8 wire"
 
+    @pytest.mark.slow
     def test_loco_errors_update_on_imperative_step(self):
         eng, _ = self._run({"zero_quantized_gradients": True,
                             "zeropp_loco": True}, steps=2)

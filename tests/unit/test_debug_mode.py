@@ -32,6 +32,7 @@ def _batch():
 
 
 class TestDebugMode:
+    @pytest.mark.slow
     def test_deterministic_runs_bitwise_identical(self):
         try:
             losses = []
@@ -73,6 +74,7 @@ class TestDebugMode:
         eng.train_batch(_batch())   # no raise: tolerated by design
         assert not getattr(eng.config, "debug_nan_check")
 
+    @pytest.mark.slow
     def test_xprof_trace_step(self, tmp_path):
         """comms_logger.xprof_step writes a device trace for that step
         (device-time attribution; reference CUDA-event comms timing)."""

@@ -53,6 +53,7 @@ class TestModelImplementations:
             impl = get_implementation(a)
             assert impl.family
 
+    @pytest.mark.slow
     def test_build_and_convert_roundtrip(self):
         from transformers import LlamaConfig, LlamaForCausalLM
         import torch

@@ -22,10 +22,19 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 BACKLOG = [
     ("train_mfu", {"DSTPU_BENCH_MODE": "train"}),
+    # MFU tuning ladder: keep-dots remat (no recompute flops), bigger batch
+    ("train_mfu_dots", {"DSTPU_BENCH_MODE": "train",
+                        "DSTPU_BENCH_REMAT_POLICY":
+                            "dots_with_no_batch_dims_saveable"}),
+    ("train_mfu_dots_b16", {"DSTPU_BENCH_MODE": "train",
+                            "DSTPU_BENCH_BATCH": "16",
+                            "DSTPU_BENCH_REMAT_POLICY":
+                                "dots_with_no_batch_dims_saveable"}),
     ("flash_sweep", {"DSTPU_BENCH_MODE": "flash_sweep"}),
     ("serving_8k", {"DSTPU_BENCH_MODE": "serving", "DSTPU_BENCH_CTX": "8192"}),
     ("serving_32k", {"DSTPU_BENCH_MODE": "serving", "DSTPU_BENCH_CTX": "32768",
                      "DSTPU_BENCH_CHUNK": "1024"}),
+    ("offload_step", {"DSTPU_BENCH_MODE": "offload"}),
 ]
 
 
