@@ -232,7 +232,7 @@ def run_serving_bench(on_tpu: bool) -> None:
             while pos < len(prompt):
                 logits = eng.put([0], [prompt[pos:pos + chunk]])
                 pos += chunk
-            jax.block_until_ready(eng.kv.k)
+            jax.block_until_ready(eng.kv.pages)
             prefill_t = time.perf_counter() - t0
             # decode, seeded by the prefill's predicted next token: the
             # FUSED on-device loop (one compiled program for the whole

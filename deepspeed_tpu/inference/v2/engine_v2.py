@@ -48,14 +48,14 @@ class RaggedInferenceEngineConfig:
     max_ctx: int = 2048
     block_size: int = 64
     num_blocks: Optional[int] = None  # default: enough for max_seqs * max_ctx
-    #: query tokens per attention atom (the atom_builder granularity): the
-    #: paged kernel's MXU row tile is G·atom_size, so decode sequences cost
-    #: one atom — not a max_tokens-padded tile
-    atom_size: int = 16
     dtype: object = jnp.bfloat16
     #: "paged" = Pallas paged-attention kernel (blocked_flash equivalent);
-    #: "gather" = dense slot-gather reference path (numerics oracle).
+    #: "gather" = dense page-gather reference path (numerics oracle).
     attn_impl: str = "paged"
+    #: paged-kernel tuning: flat-token query tile and KV pages fetched per
+    #: double-buffered DMA chunk (see kernels/ragged_ops.py)
+    block_q: int = 128
+    pages_per_chunk: int = 8
 
 
 class InferenceEngineV2:
@@ -88,18 +88,19 @@ class InferenceEngineV2:
             return jnp.asarray(x, c.dtype)
 
         self.params = jax.tree_util.tree_map_with_path(_cast, params)
-        atom = min(c.atom_size, c.max_tokens)
         self._wrapper = RaggedBatchWrapper(c.max_tokens, c.max_seqs, c.max_ctx,
                                            c.block_size,
-                                           trash_slot=self.kv.config.trash_slot,
-                                           atom_size=atom)
+                                           pad_page=self.kv.config.pad_page_flag)
         self._decode_loops: Dict = {}
         self._rng = jax.random.PRNGKey(0)
         self._step = build_ragged_step(self.cfg, max_q=c.max_tokens,
-                                       block_size=c.block_size,
-                                       attn_impl=c.attn_impl, atom_size=atom,
+                                       num_blocks=num_blocks,
+                                       attn_impl=c.attn_impl,
                                        max_seqs=c.max_seqs,
-                                       max_blocks=self._wrapper.max_blocks)
+                                       max_blocks=self._wrapper.max_blocks,
+                                       block_q=c.block_q,
+                                       pages_per_chunk=c.pages_per_chunk)
+        self._num_blocks = num_blocks
         log_dist(f"InferenceEngineV2: blocks={num_blocks}×{c.block_size} "
                  f"budget={c.max_tokens}tok/{c.max_seqs}seq "
                  f"kv={self.kv.mem_bytes()/1e6:.0f}MB", ranks=[0])
@@ -150,8 +151,8 @@ class InferenceEngineV2:
         # per-array H2D latency dominates decode steps (measured 3 tok/s with
         # ~15 arrays vs one packed buffer)
         dev = jnp.asarray(batch.pack())
-        logits, new_k, new_v = self._step(self.params, self.kv.k, self.kv.v, dev)
-        self.kv.update(new_k, new_v)
+        logits, new_pages = self._step(self.params, self.kv.pages, dev)
+        self.kv.update(new_pages)
         for uid in batch.uids:
             self.state_manager.get_sequence(uid).post_forward()
         return logits[:batch.n_seqs]
@@ -199,16 +200,16 @@ class InferenceEngineV2:
             self._decode_loops[key] = build_decode_loop(
                 self.cfg, max_q=c.max_tokens, max_seqs=c.max_seqs,
                 max_blocks=self._wrapper.max_blocks, block_size=c.block_size,
-                trash_slot=self.kv.config.trash_slot, attn_impl=c.attn_impl,
-                atom_size=min(c.atom_size, c.max_tokens), steps=steps,
-                temperature=temperature)
+                num_blocks=self._num_blocks, attn_impl=c.attn_impl,
+                steps=steps, temperature=temperature, block_q=c.block_q,
+                pages_per_chunk=c.pages_per_chunk)
         if rng is None:
             # persistent engine key: re-seeding each window with a constant
             # would repeat the identical sample stream every call
             self._rng, rng = jax.random.split(self._rng)
-        toks, new_k, new_v = self._decode_loops[key](
-            self.params, self.kv.k, self.kv.v, jnp.asarray(batch.pack()), rng)
-        self.kv.update(new_k, new_v)
+        toks, new_pages = self._decode_loops[key](
+            self.params, self.kv.pages, jnp.asarray(batch.pack()), rng)
+        self.kv.update(new_pages)
         for uid in batch.uids:
             seq = self.state_manager.get_sequence(uid)
             seq.in_flight_tokens = steps

@@ -83,12 +83,22 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
         return cu_ref[jnp.minimum(i, S)]
 
     def seq_valid(s):
-        """Sequence s exists, has query tokens, and starts inside/before
-        this block's token span (sequences are flat-token-ordered, so the
-        walk stops at the first sequence starting at/after blk_end)."""
+        """Sequence s exists, has query tokens, and overlaps this block's
+        token span."""
         s_c = jnp.minimum(s, S - 1)
         return (s < S) & (cu(s_c + 1) > cu(s_c)) & (cu(s_c) < blk_end) & \
             (cu(s_c + 1) > blk_start)
+
+    def next_valid(s):
+        """First sequence >= s that overlaps this block.  Zero-q-len rows
+        (cu(s+1) == cu(s)) are SKIPPED, not treated as terminators, so an
+        interior empty row cannot hide later sequences; the walk still
+        terminates at the first sequence starting at/after blk_end (the
+        wrapper keeps sequences flat-token-ordered)."""
+        return jax.lax.while_loop(
+            lambda t: (t < S) & (cu(jnp.minimum(t, S - 1)) < blk_end)
+            & ~seq_valid(t),
+            lambda t: t + 1, s)
 
     def page_needed(s, page_idx):
         return page_idx * ps < kvl_ref[jnp.minimum(s, S - 1)]
@@ -117,9 +127,7 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
     l_scr[:] = jnp.zeros_like(l_scr)
 
     # ---- find the first sequence overlapping this block ----------------- #
-    s0 = jax.lax.while_loop(
-        lambda s: (s < S) & (cu(s + 1) <= blk_start),
-        lambda s: s + 1, jnp.int32(0))
+    s0 = next_valid(jnp.int32(0))
 
     @pl.when(seq_valid(s0))
     def _warmup():
@@ -184,7 +192,7 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
         s, c, slot = state
         nch = _cdiv(kvl_ref[jnp.minimum(s, S - 1)], CH)
         has_next = c + 1 < nch
-        s_next = jnp.where(has_next, s, s + 1)
+        s_next = jnp.where(has_next, s, next_valid(s + 1))
         c_next = jnp.where(has_next, c + 1, 0)
 
         @pl.when(seq_valid(s_next))
@@ -245,6 +253,33 @@ def ragged_paged_attention(q: jnp.ndarray, kv_pages: jnp.ndarray,
         q = jnp.pad(q, ((0, T_pad - T), (0, 0), (0, 0)))
     # never walk chunks past the page-table budget
     P = min(pages_per_chunk, NB)
+
+    # ---- VMEM budget: scratch must fit alongside the q/o blocks --------- #
+    # kv_bufs double-buffer 2*P pages of [ps, 2KV, hd]; softmax state is
+    # f32 [KV, BQ*G, hd|128] x3; q/o blocks are [BQ, H, hd].  Mosaic fails
+    # with an opaque error past ~16MB, so shrink P first (fewer pages per
+    # chunk costs DMA overlap, not correctness), then fail loudly.
+    VMEM_BUDGET = 12 * 1024 * 1024
+    kv_itemsize = jnp.dtype(kv_pages.dtype).itemsize
+
+    def _vmem_bytes(p):
+        kv_bufs = 2 * p * ps * ckv * hd * kv_itemsize
+        softmax = KV * (BQ * G) * (hd + 2 * 128) * 4
+        # Pallas double-buffers the streamed q/o blocks across grid steps
+        qo = 2 * 2 * BQ * H * hd * jnp.dtype(q.dtype).itemsize
+        # live f32 temporaries per compute step scale with the chunk width:
+        # s_mat/p_mat [rows, P*ps] plus mask/iota registers of the same shape
+        temps = 3 * (BQ * G) * (p * ps) * 4
+        return kv_bufs + softmax + qo + temps
+
+    while P > 1 and _vmem_bytes(P) > VMEM_BUDGET:
+        P //= 2
+    if _vmem_bytes(P) > VMEM_BUDGET:
+        raise ValueError(
+            f"ragged_paged_attention VMEM budget exceeded even at "
+            f"pages_per_chunk=1: {_vmem_bytes(P)/2**20:.1f}MB > "
+            f"{VMEM_BUDGET/2**20:.0f}MB — reduce block_q ({block_q}), "
+            f"page_size ({ps}), or kv heads x head_dim ({KV}x{hd})")
 
     if alibi is not None:
         import numpy as np

@@ -16,7 +16,7 @@ class ModelImplementation:
     paged-KV ragged engine serves it — since the universal ragged runner
     (model_runner.ragged_forward_universal) landed, that is EVERY buildable
     family (native CausalLM recipes ride ragged_forward, ArchConfig
-    recipes ride the universal runner; both share the atom kernel).
+    recipes ride the universal runner; both share the flat-token paged kernel).
     """
     arch: str
     family: str

@@ -6,28 +6,35 @@ One jitted step serves ANY mix of prefill and decode under fixed budgets
 (max_tokens/max_seqs/max_blocks), with the paged KV cache donated through the
 call so the update is in-place in HBM.
 
+Cache layout (see ragged/kv_cache.py): ONE flat page pool
+``[L*num_blocks + 1, page_size, 2*KV, hd]`` shared by all layers — layer l's
+page table is ``block_table + l*num_blocks`` (plain metadata arithmetic, no
+in-kernel layer index), and the final page is the shared trash page padded
+tokens write into.
+
 Pipeline per layer over the flat token axis [T]:
   rmsnorm → qkv proj → RoPE (per-token absolute positions) → paged KV append
-  → Pallas paged attention over the sequence's block table → o proj → MLP.
+  → Pallas paged attention over the sequence's page table → o proj → MLP.
 Logits are computed only for each sequence's last token (logits_gather).
 
 Two attention impls:
-  "paged"  — Pallas paged-attention kernel (kernels/ragged_ops.py); HBM
-             traffic O(cached tokens), serves 32k+ contexts.
-  "gather" — dense slot-gather reference path (round-1 semantics, O(S·C)
-             HBM per layer); kept as the numerics oracle for kernel tests.
+  "paged"  — Pallas ragged paged-attention kernel (kernels/ragged_ops.py);
+             flat-token grid, in-kernel context walk, double-buffered page
+             DMA; HBM traffic O(cached tokens).
+  "gather" — dense page-gather reference path (O(S·C) HBM per layer); kept
+             as the numerics oracle for kernel tests.
 """
 from __future__ import annotations
 
 import math
 from functools import partial
-from typing import Any, Dict, Tuple
+from typing import Dict, Tuple
 
 import jax
 import jax.numpy as jnp
 
 from ...models.transformer import TransformerConfig, rms_norm
-from .kernels.ragged_ops import atom_paged_attention, paged_kv_append
+from .kernels.ragged_ops import paged_kv_append, ragged_paged_attention
 from .ragged.ragged_wrapper import pack_layout
 
 
@@ -58,28 +65,31 @@ def _apply_rope_flat(x, cos, sin, rotary_dim=None, style="neox"):
     return jnp.concatenate([rot, passthrough], axis=-1) if rd < hd else rot
 
 
-def _attend_gather(q_seq, layer_k, layer_v, block_table, q_len, ctx_len,
-                   block_size, scale, alibi=None, alibi_scaled=False):
-    """Dense-gather reference attention (the round-1 path).
+def _attend_gather(q_seq, kv_pages, page_table, q_len, ctx_len,
+                   scale, alibi=None, alibi_scaled=False):
+    """Dense page-gather reference attention (the numerics oracle).
 
-    Derives the flat slot map from the block table on device, gathers the
-    full padded context per sequence, and runs masked softmax attention.
-    ``alibi`` ([H] slopes) adds the position bias (bloom semantics; the
-    falcon ``alibi_scaled`` variant computes bf16(slope·pos) pre-scaling).
+    Gathers the full padded context per sequence straight from the page pool
+    (``page_table`` rows are ABSOLUTE physical page ids — for a multi-layer
+    pool pass ``block_table + layer*num_blocks``) and runs masked softmax
+    attention.  ``alibi`` ([H] slopes) adds the position bias (bloom
+    semantics; the falcon ``alibi_scaled`` variant computes bf16(slope·pos)
+    pre-scaling).
+
+    q_seq: [S, mq, H, hd]; kv_pages: [NP_total, ps, 2KV, hd];
+    page_table: [S, NB] → output [S, mq, H, hd] (f32).
     """
     S, mq, H, hd = q_seq.shape
-    KV = layer_k.shape[0]
-    NB = block_table.shape[1]
-    C = NB * block_size
+    _, ps, ckv, _ = kv_pages.shape
+    KV = ckv // 2
+    NB = page_table.shape[1]
+    C = NB * ps
     ctx_pos = jnp.arange(C, dtype=jnp.int32)
-    kv_gather = jnp.take_along_axis(
-        block_table, (ctx_pos // block_size)[None, :].repeat(S, 0), axis=1
-    ) * block_size + (ctx_pos % block_size)[None, :]          # [S, C]
-
-    k_ctx = jnp.take(layer_k, kv_gather.reshape(-1), axis=1) \
-        .reshape(KV, S, C, hd).transpose(1, 2, 0, 3)          # [S, C, KV, hd]
-    v_ctx = jnp.take(layer_v, kv_gather.reshape(-1), axis=1) \
-        .reshape(KV, S, C, hd).transpose(1, 2, 0, 3)
+    pg = jnp.take_along_axis(
+        page_table, (ctx_pos // ps)[None, :].repeat(S, 0), axis=1)   # [S, C]
+    off = jnp.broadcast_to((ctx_pos % ps)[None, :], (S, C))
+    ctx = kv_pages[pg, off]                           # [S, C, 2KV, hd]
+    k_ctx, v_ctx = ctx[..., :KV, :], ctx[..., KV:, :]
     if KV != H:
         k_ctx = jnp.repeat(k_ctx, H // KV, axis=2)
         v_ctx = jnp.repeat(v_ctx, H // KV, axis=2)
@@ -105,11 +115,10 @@ def _attend_gather(q_seq, layer_k, layer_v, block_table, q_len, ctx_len,
     return jnp.einsum("shqc,schd->sqhd", probs, v_ctx.astype(jnp.float32))
 
 
-def _unpack_batch(batch, max_q, max_seqs, max_blocks, atom_size):
+def _unpack_batch(batch, max_q, max_seqs, max_blocks):
     """Packed int32 metadata vector → field dict via static on-device
     slices (one H2D transfer per forward; see ragged_wrapper.pack_layout)."""
-    layout = pack_layout(max_q, max_seqs, max_blocks,
-                         -(-max_q // atom_size) + max_seqs)
+    layout = pack_layout(max_q, max_seqs, max_blocks)
     packed = batch
     batch = {}
     for name, (off, shape) in layout.items():
@@ -122,40 +131,32 @@ def _unpack_batch(batch, max_q, max_seqs, max_blocks, atom_size):
     return batch
 
 
-def _ragged_attend(q, kcache, vcache, batch, *, attn_impl, atom_size,
-                   max_q, block_size, scale, alibi=None, alibi_scaled=False,
-                   layer=None):
-    """Shared ragged attention dispatch: token-packed atoms through the
-    Pallas paged kernel, or the dense-gather oracle.  q: [T, H, hd] →
-    [T, H*hd].  ``kcache/vcache`` may be the full STACKED [L, KV, slots, hd]
-    cache with a traced ``layer`` index — the paged kernel then reads the
-    blocks it needs straight from the stacked buffer (no per-layer slice
-    materialization; see atom_paged_attention)."""
+def _ragged_attend(q, kv_pages, batch, *, attn_impl, layer, num_blocks,
+                   max_q, scale, alibi=None, alibi_scaled=False,
+                   block_q=128, pages_per_chunk=8):
+    """Shared ragged attention dispatch: the flat-token Pallas paged kernel,
+    or the dense page-gather oracle.  q: [T, H, hd] → [T, H*hd].
+
+    ``kv_pages`` is the FULL multi-layer page pool; ``layer`` (traced) picks
+    this layer's pages via table arithmetic — no per-layer slice
+    materialization.
+    """
     T, H, hd = q.shape
+    KV = kv_pages.shape[2] // 2
     q_len, ctx_len = batch["q_len"], batch["ctx_len"]
-    block_table = batch["block_table"]
+    pt_l = batch["block_table"] + layer * num_blocks          # [S, NB]
     if attn_impl == "paged":
-        atom_q_idx = jnp.clip(
-            batch["atom_tok"][:, None] + jnp.arange(atom_size)[None, :],
-            0, T - 1)
-        q_atoms = jnp.take(q.reshape(T, -1), atom_q_idx.reshape(-1), axis=0
-                           ).reshape(-1, atom_size, H, hd)   # [NA, A, H, hd]
-        o_atoms = atom_paged_attention(
-            q_atoms, kcache, vcache, block_table,
-            batch["atom_seq"], batch["atom_qstart"], batch["atom_nq"],
-            q_len, ctx_len, block_size=block_size, scale=scale,
-            alibi=alibi, alibi_scaled=alibi_scaled, layer=layer)
-        return o_atoms[batch["token_atom"], batch["token_within"]] \
-            .reshape(T, H * hd)
-    if kcache.ndim == 4:        # gather oracle works on the layer slice
-        kcache = jax.lax.dynamic_index_in_dim(kcache, layer, 0, keepdims=False)
-        vcache = jax.lax.dynamic_index_in_dim(vcache, layer, 0, keepdims=False)
+        out = ragged_paged_attention(
+            q, kv_pages, ctx_len, pt_l, batch["cu_q_lens"],
+            num_kv_heads=KV, scale=scale, alibi=alibi,
+            alibi_scaled=alibi_scaled, block_q=block_q,
+            pages_per_chunk=pages_per_chunk)
+        return out.reshape(T, H * hd)
     q_idx = jnp.clip(batch["q_offset"][:, None] + jnp.arange(max_q)[None, :],
                      0, T - 1)
     q_seq = jnp.take(q.reshape(T, -1), q_idx.reshape(-1), axis=0
                      ).reshape(-1, max_q, H, hd)             # [S, mq, H, hd]
-    o_seq = _attend_gather(q_seq, kcache, vcache, block_table,
-                           q_len, ctx_len, block_size, scale,
+    o_seq = _attend_gather(q_seq, kv_pages, pt_l, q_len, ctx_len, scale,
                            alibi=alibi, alibi_scaled=alibi_scaled
                            ).astype(q.dtype)
     within = jnp.clip(
@@ -164,41 +165,46 @@ def _ragged_attend(q, kcache, vcache, batch, *, attn_impl, atom_size,
     return o_seq[batch["seq_of_token"], within].reshape(T, H * hd)
 
 
-def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
-                   batch, cfg: TransformerConfig,
-                   max_q: int, block_size: int, attn_impl: str = "paged",
-                   atom_size: int = 16, max_seqs: int = 0,
-                   max_blocks: int = 0) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
-    """→ (last-token logits [max_seqs, V], new kcache, new vcache)."""
-    batch = _unpack_batch(batch, max_q, max_seqs, max_blocks, atom_size)
+def _layer_pages(page_of_token, layer, num_blocks, trash_page):
+    """Layer-relative token pages → absolute pool pages; the wrapper's
+    pad sentinel (>= num_blocks) routes to the shared trash page."""
+    return jnp.where(page_of_token < num_blocks,
+                     page_of_token + layer * num_blocks, trash_page)
+
+
+def ragged_forward(params: Dict, kv_pages: jnp.ndarray, batch,
+                   cfg: TransformerConfig, max_q: int, num_blocks: int,
+                   attn_impl: str = "paged", max_seqs: int = 0,
+                   max_blocks: int = 0, block_q: int = 128,
+                   pages_per_chunk: int = 8
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """→ (last-token logits [max_seqs, V], new kv_pages)."""
+    batch = _unpack_batch(batch, max_q, max_seqs, max_blocks)
     tokens = batch["tokens"]              # [T]
-    kv_slot = batch["kv_slot"]            # [T]
+    page_of = batch["page_of_token"]      # [T] layer-relative
+    off_of = batch["off_of_token"]        # [T]
     pos = batch["pos_of_token"]           # [T]
-    seq_of = batch["seq_of_token"]        # [T]
-    q_offset = batch["q_offset"]          # [S]
-    q_len = batch["q_len"]                # [S]
-    ctx_len = batch["ctx_len"]            # [S]
-    block_table = batch["block_table"]    # [S, NB]
     logit_idx = batch["logit_idx"]        # [S]
 
     T = tokens.shape[0]
     H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     dtype = params["layers"]["q_proj"]["kernel"].dtype
     scale = 1.0 / math.sqrt(hd)
+    trash_page = kv_pages.shape[0] - 1
 
     x = jnp.take(params["embed"]["embedding"], tokens, axis=0).astype(dtype)  # [T, D]
     cos, sin = _rope_at(pos, hd, cfg.rope_theta)
 
-    # ragged-padding mask: padded tokens write into the trailing trash block
-    batch_valid = kv_slot < (kcache.shape[2] - block_size)
+    # ragged-padding mask: padded tokens carry the pad-page sentinel
+    batch_valid = page_of < num_blocks
 
     def layer_step(carry, inputs):
-        # The FULL stacked KV cache rides the carry: the append is an
-        # in-place scatter of T rows and the paged kernel reads blocks
-        # straight from the stacked buffer.  Scanning the cache as xs/ys
-        # instead would slice-copy one full layer per iteration AND restack
-        # the whole cache per forward — O(cache) HBM per decode step.
-        x, kcache, vcache = carry
+        # The FULL page pool rides the carry: the append is an in-place
+        # scatter of T rows and the paged kernel reads pages straight from
+        # the pool.  Scanning the cache as xs/ys instead would slice-copy
+        # one full layer per iteration AND restack the whole cache per
+        # forward — O(cache) HBM per decode step.
+        x, kv_pages = carry
         lp, l_idx = inputs
         h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
 
@@ -213,19 +219,20 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
         v = proj(lp["v_proj"], KV)
         q = _apply_rope_flat(q, cos, sin)
         k = _apply_rope_flat(k, cos, sin)
-        kcache, vcache = paged_kv_append(kcache, vcache, k, v, kv_slot,
-                                         layer=l_idx)
+        kv_pages = paged_kv_append(
+            kv_pages, k, v,
+            _layer_pages(page_of, l_idx, num_blocks, trash_page), off_of)
 
-        o_flat = _ragged_attend(q, kcache, vcache, batch,
-                                attn_impl=attn_impl, atom_size=atom_size,
-                                max_q=max_q, block_size=block_size,
-                                scale=scale, layer=l_idx).astype(dtype)
+        o_flat = _ragged_attend(q, kv_pages, batch, attn_impl=attn_impl,
+                                layer=l_idx, num_blocks=num_blocks,
+                                max_q=max_q, scale=scale, block_q=block_q,
+                                pages_per_chunk=pages_per_chunk).astype(dtype)
         x = x + o_flat @ lp["o_proj"]["kernel"]
         h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
         if cfg.num_experts > 1:
             # MoE serving (moe_gather/moe_scatter analogue): sparse-slot
-            # dispatch over flat ragged tokens; padded tokens (kv_slot in
-            # the trash block) are excluded from expert capacity.
+            # dispatch over flat ragged tokens; padded tokens (pad-page
+            # sentinel) are excluded from expert capacity.
             from ...moe.sharded_moe import moe_mlp_block
 
             mlp_out, _ = moe_mlp_block(
@@ -237,10 +244,10 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
             gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
             up = h @ lp["up_proj"]["kernel"]
             x = x + (gate * up) @ lp["down_proj"]["kernel"]
-        return (x, kcache, vcache), None
+        return (x, kv_pages), None
 
-    (x, new_k, new_v), _ = jax.lax.scan(
-        layer_step, (x, kcache, vcache),
+    (x, new_pages), _ = jax.lax.scan(
+        layer_step, (x, kv_pages),
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
 
     x = rms_norm(x, params["norm_f"]["scale"], cfg.norm_eps)
@@ -249,18 +256,18 @@ def ragged_forward(params: Dict, kcache: jnp.ndarray, vcache: jnp.ndarray,
         logits = last @ params["embed"]["embedding"].T
     else:
         logits = last @ params["lm_head"]["kernel"]
-    return logits.astype(jnp.float32), new_k, new_v
+    return logits.astype(jnp.float32), new_pages
 
 
-def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
-                             vcache: jnp.ndarray, batch, cfg,
-                             max_q: int, block_size: int,
-                             attn_impl: str = "paged", atom_size: int = 16,
-                             max_seqs: int = 0, max_blocks: int = 0
-                             ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+def ragged_forward_universal(params: Dict, kv_pages: jnp.ndarray, batch, cfg,
+                             max_q: int, num_blocks: int,
+                             attn_impl: str = "paged", max_seqs: int = 0,
+                             max_blocks: int = 0, block_q: int = 128,
+                             pages_per_chunk: int = 8
+                             ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Paged ragged serving for the universal (ArchConfig) families —
     gpt2/gptj/opt/bloom/falcon/phi serve through the SAME put/query/flush
-    engine and Pallas atom kernel as the native families (reference:
+    engine and Pallas paged kernel as the native families (reference:
     inference/v2/model_implementations/{falcon,phi,opt}/ per-arch ragged
     models).  Arch knobs handled on the flat token axis: learned positions
     (+opt's offset), ALiBi inside the kernel (bloom + falcon-scaled
@@ -269,9 +276,10 @@ def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
     from ...models.families import ArchConfig, alibi_slopes, layer_norm
 
     assert isinstance(cfg, ArchConfig)
-    batch = _unpack_batch(batch, max_q, max_seqs, max_blocks, atom_size)
+    batch = _unpack_batch(batch, max_q, max_seqs, max_blocks)
     tokens = batch["tokens"]
-    kv_slot = batch["kv_slot"]
+    page_of = batch["page_of_token"]
+    off_of = batch["off_of_token"]
     pos = batch["pos_of_token"]
     logit_idx = batch["logit_idx"]
 
@@ -279,6 +287,7 @@ def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
     H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     dtype = params["layers"]["q_proj"]["kernel"].dtype
     scale = 1.0 / math.sqrt(hd)
+    trash_page = kv_pages.shape[0] - 1
 
     def norm(x, p):
         if cfg.norm == "rmsnorm":
@@ -304,8 +313,8 @@ def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
     alibi = alibi_slopes(H) if cfg.pos == "alibi" else None
 
     def layer_step(carry, inputs):
-        # stacked-cache carry: see ragged_forward.layer_step
-        x, kcache, vcache = carry
+        # page-pool carry: see ragged_forward.layer_step
+        x, kv_pages = carry
         lp, l_idx = inputs
         h_attn_in = norm(x, lp["ln1"])
         q = proj(h_attn_in, lp["q_proj"], H)
@@ -314,14 +323,16 @@ def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
         if cfg.pos == "rope":
             q = _apply_rope_flat(q, cos, sin, cfg.rotary_dim, cfg.rope_style)
             k = _apply_rope_flat(k, cos, sin, cfg.rotary_dim, cfg.rope_style)
-        kcache, vcache = paged_kv_append(kcache, vcache, k, v, kv_slot,
-                                         layer=l_idx)
+        kv_pages = paged_kv_append(
+            kv_pages, k, v,
+            _layer_pages(page_of, l_idx, num_blocks, trash_page), off_of)
 
-        o_flat = _ragged_attend(q, kcache, vcache, batch,
-                                attn_impl=attn_impl, atom_size=atom_size,
-                                max_q=max_q, block_size=block_size,
-                                scale=scale, alibi=alibi, layer=l_idx,
-                                alibi_scaled=cfg.alibi_scaled).astype(dtype)
+        o_flat = _ragged_attend(q, kv_pages, batch, attn_impl=attn_impl,
+                                layer=l_idx, num_blocks=num_blocks,
+                                max_q=max_q, scale=scale, alibi=alibi,
+                                alibi_scaled=cfg.alibi_scaled,
+                                block_q=block_q,
+                                pages_per_chunk=pages_per_chunk).astype(dtype)
         attn_out = o_flat @ lp["o_proj"]["kernel"]
         if "bias" in lp["o_proj"]:
             attn_out = attn_out + lp["o_proj"]["bias"]
@@ -347,10 +358,10 @@ def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
                 mlp_out = mlp_out + lp["fc2"]["bias"]
 
         x = x + attn_out + mlp_out if cfg.parallel_attn else x + mlp_out
-        return (x, kcache, vcache), None
+        return (x, kv_pages), None
 
-    (x, new_k, new_v), _ = jax.lax.scan(
-        layer_step, (x, kcache, vcache),
+    (x, new_pages), _ = jax.lax.scan(
+        layer_step, (x, kv_pages),
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
 
     x = norm(x, params["norm_f"])
@@ -361,33 +372,36 @@ def ragged_forward_universal(params: Dict, kcache: jnp.ndarray,
         logits = last @ params["lm_head"]["kernel"]
         if "bias" in params["lm_head"]:
             logits = logits + params["lm_head"]["bias"]
-    return logits.astype(jnp.float32), new_k, new_v
+    return logits.astype(jnp.float32), new_pages
 
 
-def build_ragged_step(cfg, max_q: int, block_size: int,
-                      attn_impl: str = "paged", atom_size: int = 16,
-                      max_seqs: int = 0, max_blocks: int = 0,
-                      jit: bool = True):
-    """Jitted step with donated caches (the CUDA-graph analogue: one compiled
-    program reused for every batch; reference engine.py:494 _create_cuda_graph).
-    Dispatches on the config type: TransformerConfig → native llama-family
-    runner; ArchConfig → universal per-arch runner.  ``jit=False`` returns
-    the raw traceable fn (for embedding in the fused decode loop)."""
+def build_ragged_step(cfg, max_q: int, num_blocks: int,
+                      attn_impl: str = "paged", max_seqs: int = 0,
+                      max_blocks: int = 0, block_q: int = 128,
+                      pages_per_chunk: int = 8, jit: bool = True):
+    """Jitted step with a donated page pool (the CUDA-graph analogue: one
+    compiled program reused for every batch; reference engine.py:494
+    _create_cuda_graph).  Dispatches on the config type: TransformerConfig →
+    native llama-family runner; ArchConfig → universal per-arch runner.
+    ``jit=False`` returns the raw traceable fn (for embedding in the fused
+    decode loop)."""
     from ...models.families import ArchConfig
 
     assert attn_impl in ("paged", "gather"), \
         f"attn_impl must be 'paged' or 'gather', got {attn_impl!r}"
     body = ragged_forward_universal if isinstance(cfg, ArchConfig) \
         else ragged_forward
-    fn = partial(body, cfg=cfg, max_q=max_q, block_size=block_size,
-                 attn_impl=attn_impl, atom_size=atom_size, max_seqs=max_seqs,
-                 max_blocks=max_blocks)
-    return jax.jit(fn, donate_argnums=(1, 2)) if jit else fn
+    fn = partial(body, cfg=cfg, max_q=max_q, num_blocks=num_blocks,
+                 attn_impl=attn_impl, max_seqs=max_seqs,
+                 max_blocks=max_blocks, block_q=block_q,
+                 pages_per_chunk=pages_per_chunk)
+    return jax.jit(fn, donate_argnums=(1,)) if jit else fn
 
 
 def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
-                      block_size: int, trash_slot: int, attn_impl: str,
-                      atom_size: int, steps: int, temperature: float = 0.0):
+                      block_size: int, num_blocks: int, attn_impl: str,
+                      steps: int, temperature: float = 0.0,
+                      block_q: int = 128, pages_per_chunk: int = 8):
     """Fused multi-step greedy/sampling decode: ``steps`` forward+select
     iterations in ONE compiled program (lax.scan), with the batch metadata
     advanced on device between iterations.
@@ -401,20 +415,27 @@ def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
 
     Requires a DECODE-ONLY batch laid out row-major (sequence i's single
     query token at flat index i — what RaggedBatchWrapper.finalize produces
-    for 1-token-per-seq batches), with KV blocks pre-allocated for the full
+    for 1-token-per-seq batches), with KV pages pre-allocated for the full
     window so the block table is static across the loop; only tokens /
-    kv_slot / positions / ctx lengths advance, and those are recomputed from
-    the block table on device.
+    page_of / off_of / positions / ctx lengths advance, and those are
+    recomputed from the block table on device.
 
-    Returns jitted (params, k, v, packed_meta, rng) →
-    (tokens [steps, max_seqs] int32, k, v)."""
-    step_fn = build_ragged_step(cfg, max_q=max_q, block_size=block_size,
-                                attn_impl=attn_impl, atom_size=atom_size,
-                                max_seqs=max_seqs, max_blocks=max_blocks,
-                                jit=False)
-    layout = pack_layout(max_q, max_seqs, max_blocks,
-                         -(-max_q // atom_size) + max_seqs)
-    S, NB, bs = max_seqs, max_blocks, block_size
+    Returns jitted (params, kv_pages, packed_meta, rng) →
+    (tokens [steps, max_seqs] int32, kv_pages)."""
+    step_fn = build_ragged_step(cfg, max_q=max_q, num_blocks=num_blocks,
+                                attn_impl=attn_impl, max_seqs=max_seqs,
+                                max_blocks=max_blocks, block_q=block_q,
+                                pages_per_chunk=pages_per_chunk, jit=False)
+    layout = pack_layout(max_q, max_seqs, max_blocks)
+    NB, bs = max_blocks, block_size
+    S = max_seqs
+    # A decode row costs one flat token, so at most min(max_seqs, max_q)
+    # rows can be live — and the per-token fields are only max_q long.
+    # Writing S values past a shorter field would silently corrupt the
+    # adjacent packed metadata (rows >= SW can never be admitted: the
+    # wrapper's can_fit caps tokens at max_q).
+    SW = min(S, max_q)
+    pad_page = num_blocks                       # wrapper's pad sentinel
 
     def field(meta, name, n):
         off = layout[name][0]
@@ -426,25 +447,27 @@ def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
 
     def advance(meta, new_toks):
         """Next step's metadata: row i's token advances to position pos+1;
-        its cache slot is re-derived from the (static) block table."""
-        q_len = field(meta, "q_len", S)
-        active = (q_len > 0).astype(jnp.int32)            # [S]
-        pos = field(meta, "pos_of_token", S) + active
-        ctx = field(meta, "ctx_len", S) + active
-        bt = field(meta, "block_table", S * NB).reshape(S, NB)
+        its cache page/offset are re-derived from the (static) block table."""
+        q_len = field(meta, "q_len", SW)
+        active = (q_len > 0).astype(jnp.int32)            # [SW]
+        pos = field(meta, "pos_of_token", SW) + active
+        ctx = field(meta, "ctx_len", SW) + active
+        bt = field(meta, "block_table", S * NB).reshape(S, NB)[:SW]
         blk = jnp.take_along_axis(bt, (pos // bs)[:, None], axis=1)[:, 0]
-        slot = jnp.where(active == 1, blk * bs + pos % bs, trash_slot)
-        tok = jnp.where(active == 1, new_toks[:S], 0)
+        page = jnp.where(active == 1, blk, pad_page)
+        off = jnp.where(active == 1, pos % bs, 0)
+        tok = jnp.where(active == 1, new_toks[:SW], 0)
         meta = set_field(meta, "tokens", tok)
-        meta = set_field(meta, "kv_slot", slot)
+        meta = set_field(meta, "page_of_token", page)
+        meta = set_field(meta, "off_of_token", off)
         meta = set_field(meta, "pos_of_token", pos)
         meta = set_field(meta, "ctx_len", ctx)
         return meta
 
-    def loop(params, kcache, vcache, meta, rng):
+    def loop(params, kv_pages, meta, rng):
         def body(carry, _):
-            k, v, meta, rng = carry
-            logits, k, v = step_fn(params, k, v, meta)
+            pages, meta, rng = carry
+            logits, pages = step_fn(params, pages, meta)
             if temperature > 0:
                 rng, sub = jax.random.split(rng)
                 toks = jax.random.categorical(sub, logits / temperature,
@@ -452,10 +475,10 @@ def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
             else:
                 toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
             meta = advance(meta, toks)
-            return (k, v, meta, rng), toks
+            return (pages, meta, rng), toks
 
-        (kcache, vcache, _, _), toks = jax.lax.scan(
-            body, (kcache, vcache, meta, rng), None, length=steps)
-        return toks, kcache, vcache
+        (kv_pages, _, _), toks = jax.lax.scan(
+            body, (kv_pages, meta, rng), None, length=steps)
+        return toks, kv_pages
 
-    return jax.jit(loop, donate_argnums=(1, 2))
+    return jax.jit(loop, donate_argnums=(1,))

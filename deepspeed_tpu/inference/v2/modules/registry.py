@@ -85,10 +85,11 @@ def _register_builtins():
     import jax.numpy as jnp
 
     from ....models.transformer import rms_norm
-    from ..kernels.ragged_ops import paged_attention
+    from ..kernels.ragged_ops import ragged_paged_attention
     from ..model_runner import _attend_gather
 
-    DSModuleRegistry.register("attention", "paged", paged_attention, _builtin=True)
+    DSModuleRegistry.register("attention", "paged", ragged_paged_attention,
+                              _builtin=True)
     DSModuleRegistry.register("attention", "gather", _attend_gather, _builtin=True)
 
     DSModuleRegistry.register(

@@ -1,61 +1,61 @@
 """Blocked (paged) KV cache on TPU HBM (reference: inference/v2/ragged/kv_cache.py:40).
 
-Storage is kv-head-major with a flat, block-contiguous slot dimension:
-``[layers, kv_heads, (num_blocks+1)*block_size, head_dim]`` for K and V.
-Block tables index physical blocks; slot = block*block_size + offset.  The
-FINAL block is a trash block that padded tokens write into, keeping the
-append a single dense scatter (no predication).  Head-major layout lets the
-paged-attention kernel view the cache as ``[KV, blocks, block_size, hd]``
-with lane/sublane-aligned (block_size, hd) tiles.
+Storage is ONE flat page pool shared by every layer:
+``[num_layers * num_blocks + 1, block_size, 2 * kv_heads, head_dim]`` —
+K heads at ``[..., :KV, :]``, V heads at ``[..., KV:, :]``.  Layer ``l``'s
+view of logical page ``p`` is physical page ``l * num_blocks + p``, so a
+per-layer page table is plain metadata arithmetic (``table + l * num_blocks``)
+and the paged-attention kernel needs no in-kernel layer index.  One page
+fetch carries K AND V for every kv head — a single contiguous DMA feeds all
+heads' compute (see kernels/ragged_ops.py).
+
+The FINAL page (index ``num_layers * num_blocks``) is a shared trash page
+that padded tokens write into, keeping the append a single dense scatter
+(no predication).
 """
 from __future__ import annotations
 
 import dataclasses
-from typing import Tuple
 
-import jax
 import jax.numpy as jnp
 
 
 @dataclasses.dataclass
 class KVCacheConfig:
     num_layers: int
-    num_blocks: int
-    block_size: int
+    num_blocks: int              # logical pages per layer
+    block_size: int              # tokens per page
     num_kv_heads: int
     head_dim: int
     dtype: object = jnp.bfloat16
 
     @property
-    def num_slots(self) -> int:
-        """Addressable (non-trash) slots."""
-        return self.num_blocks * self.block_size
+    def total_pages(self) -> int:
+        """Physical pages including the trailing shared trash page."""
+        return self.num_layers * self.num_blocks + 1
 
     @property
-    def total_slots(self) -> int:
-        """Including the trailing trash block."""
-        return (self.num_blocks + 1) * self.block_size
+    def trash_page(self) -> int:
+        """Physical index of the shared trash page."""
+        return self.num_layers * self.num_blocks
 
     @property
-    def trash_slot(self) -> int:
-        """First slot of the trash block (any slot in it is safe)."""
-        return self.num_slots
+    def pad_page_flag(self) -> int:
+        """Layer-relative sentinel the batch wrapper marks padded tokens
+        with (any value >= num_blocks routes to the trash page on device)."""
+        return self.num_blocks
 
 
 class BlockedKVCache:
     def __init__(self, config: KVCacheConfig):
         self.config = config
-        shape = (config.num_layers, config.num_kv_heads,
-                 config.total_slots, config.head_dim)
-        self.k = jnp.zeros(shape, config.dtype)
-        self.v = jnp.zeros(shape, config.dtype)
+        c = config
+        self.pages = jnp.zeros(
+            (c.total_pages, c.block_size, 2 * c.num_kv_heads, c.head_dim),
+            c.dtype)
 
-    @property
-    def arrays(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
-        return self.k, self.v
-
-    def update(self, k, v) -> None:
-        self.k, self.v = k, v
+    def update(self, pages) -> None:
+        self.pages = pages
 
     def mem_bytes(self) -> int:
-        return 2 * self.k.size * self.k.dtype.itemsize
+        return self.pages.size * self.pages.dtype.itemsize

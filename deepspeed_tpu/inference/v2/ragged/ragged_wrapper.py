@@ -6,16 +6,29 @@ XLA's static-shape constraint: every array is padded to the engine's
 compile-time budgets (``max_tokens``, ``max_seqs``, ``max_blocks_per_seq``),
 so the same compiled program serves every batch composition.
 
-Device views produced:
+Device views produced (all flat-token layout; sequence s's query tokens sit
+contiguously at flat indices [cu_q_lens[s], cu_q_lens[s+1])):
   tokens        [max_tokens]              flat input ids (padded 0)
-  kv_slot       [max_tokens]              flat cache slot per token (block*bs+off; pad → trash block)
-  seq_of_token  [max_tokens]              owning sequence row (pad → max_seqs-1 dummy)
+  page_of_token [max_tokens]              LAYER-RELATIVE cache page per token
+                                          (pad -> num_blocks sentinel; the
+                                          runner adds layer*num_blocks and
+                                          routes the sentinel to the shared
+                                          trash page)
+  off_of_token  [max_tokens]              row within the page
+  seq_of_token  [max_tokens]              owning sequence row (pad -> max_seqs-1)
   pos_of_token  [max_tokens]              absolute position in its sequence
   q_offset      [max_seqs]                first flat index of each seq's queries
   q_len         [max_seqs]                query tokens this forward
-  ctx_len       [max_seqs]                seen + in-flight tokens (attention span)
-  block_table   [max_seqs, max_blocks]    physical KV block ids per sequence
+  ctx_len       [max_seqs]                seen + in-flight tokens (= kv_lens)
+  cu_q_lens     [max_seqs+1]              exclusive prefix sum of q_len; rows
+                                          past n_seqs repeat the total, so the
+                                          kernel's sequence walk terminates
+  block_table   [max_seqs, max_blocks]    layer-relative KV page ids per seq
   logit_idx     [max_seqs]                flat index of each seq's last token
+
+INVARIANT (consumed by kernels/ragged_ops.py): cu_q_lens has no interior
+zero-length entries — every scheduled sequence contributes >= 1 query token
+and padded rows are strictly trailing.  ``insert_sequence`` enforces it.
 
 The block table is O(max_ctx / block_size) per sequence — long contexts
 (32k+) cost a few hundred ints of metadata, not a dense slot map; the paged
@@ -24,37 +37,33 @@ attention kernel dereferences it on-chip (SMEM scalar prefetch).
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Dict, List, Tuple
+from typing import Dict, List, Tuple
 
 import numpy as np
 
 from .sequence_descriptor import DSSequenceDescriptor
 
 
-def pack_layout(max_tokens: int, max_seqs: int, max_blocks: int,
-                n_atoms: int) -> Dict[str, Tuple[int, Tuple[int, ...]]]:
+def pack_layout(max_tokens: int, max_seqs: int,
+                max_blocks: int) -> Dict[str, Tuple[int, Tuple[int, ...]]]:
     """Static (offset, shape) layout of the single packed int32 metadata
-    vector shipped host→device per forward.  One transfer instead of ~15:
+    vector shipped host→device per forward.  One transfer instead of ~12:
     over a remote-relay link the per-array H2D latency dominates decode
     steps, so all batch metadata rides one buffer and is sliced on-device
     (the csrc fast host-to-device batch-metadata path of the reference,
     re-motivated by link latency rather than kernel-launch count)."""
     fields = [
         ("tokens", (max_tokens,)),
-        ("kv_slot", (max_tokens,)),
+        ("page_of_token", (max_tokens,)),
+        ("off_of_token", (max_tokens,)),
         ("seq_of_token", (max_tokens,)),
         ("pos_of_token", (max_tokens,)),
-        ("token_atom", (max_tokens,)),
-        ("token_within", (max_tokens,)),
         ("q_offset", (max_seqs,)),
         ("q_len", (max_seqs,)),
         ("ctx_len", (max_seqs,)),
         ("logit_idx", (max_seqs,)),
+        ("cu_q_lens", (max_seqs + 1,)),
         ("block_table", (max_seqs, max_blocks)),
-        ("atom_seq", (n_atoms,)),
-        ("atom_tok", (n_atoms,)),
-        ("atom_qstart", (n_atoms,)),
-        ("atom_nq", (n_atoms,)),
     ]
     layout = {}
     off = 0
@@ -69,24 +78,16 @@ def pack_layout(max_tokens: int, max_seqs: int, max_blocks: int,
 @dataclasses.dataclass
 class RaggedBatch:
     tokens: np.ndarray
-    kv_slot: np.ndarray
+    page_of_token: np.ndarray
+    off_of_token: np.ndarray
     seq_of_token: np.ndarray
     pos_of_token: np.ndarray
     q_offset: np.ndarray
     q_len: np.ndarray
     ctx_len: np.ndarray
-    block_table: np.ndarray
     logit_idx: np.ndarray
-    # Atom metadata (reference atom_builder.cu analogue): fixed-size query
-    # spans, each covering ≤ atom_size consecutive query tokens of ONE
-    # sequence.  The paged kernel grids over atoms, so a decode sequence
-    # costs one atom of rows — not a max_tokens-padded tile.
-    atom_seq: np.ndarray        # [NA] owning sequence row (pad → max_seqs-1)
-    atom_tok: np.ndarray        # [NA] flat token index of the atom's first query
-    atom_qstart: np.ndarray     # [NA] query index within the seq's span
-    atom_nq: np.ndarray         # [NA] real query tokens (0 = pad atom)
-    token_atom: np.ndarray      # [max_tokens] atom of each flat token
-    token_within: np.ndarray    # [max_tokens] row of each token inside its atom
+    cu_q_lens: np.ndarray
+    block_table: np.ndarray
     n_tokens: int
     n_seqs: int
     uids: List[int]
@@ -94,27 +95,24 @@ class RaggedBatch:
     def pack(self) -> np.ndarray:
         """Flatten all metadata into ONE int32 vector (see pack_layout)."""
         return np.concatenate([
-            self.tokens, self.kv_slot, self.seq_of_token, self.pos_of_token,
-            self.token_atom, self.token_within, self.q_offset, self.q_len,
-            self.ctx_len, self.logit_idx, self.block_table.reshape(-1),
-            self.atom_seq, self.atom_tok, self.atom_qstart, self.atom_nq,
+            self.tokens, self.page_of_token, self.off_of_token,
+            self.seq_of_token, self.pos_of_token, self.q_offset, self.q_len,
+            self.ctx_len, self.logit_idx, self.cu_q_lens,
+            self.block_table.reshape(-1),
         ]).astype(np.int32)
 
 
 class RaggedBatchWrapper:
     def __init__(self, max_tokens: int, max_seqs: int, max_ctx: int,
-                 block_size: int, trash_slot: int = 0, atom_size: int = 16):
+                 block_size: int, pad_page: int = 1 << 30):
         self.max_tokens = max_tokens
         self.max_seqs = max_seqs
         self.max_ctx = max_ctx
         self.block_size = block_size
         self.max_blocks = -(-max_ctx // block_size)
-        #: cache slot that padded tokens write into (must be inside the
-        #: cache's dedicated trash block, or they would corrupt block 0)
-        self.trash_slot = trash_slot
-        self.atom_size = min(atom_size, max_tokens)
-        #: static atom budget: sum_s ceil(q_len_s / A) ≤ ceil(T/A) + S
-        self.n_atoms = -(-max_tokens // self.atom_size) + max_seqs
+        #: layer-relative page sentinel padded tokens carry (= pool
+        #: num_blocks; the runner maps it to the shared trash page)
+        self.pad_page = pad_page
         self.clear()
 
     def clear(self):
@@ -134,6 +132,9 @@ class RaggedBatchWrapper:
                 len(self._entries) < self.max_seqs)
 
     def insert_sequence(self, seq: DSSequenceDescriptor, new_tokens: List[int]):
+        if not new_tokens:
+            # the no-interior-zero cu_q_lens invariant (see module docstring)
+            raise ValueError("every scheduled sequence needs >= 1 token")
         if not self.can_fit(len(new_tokens)):
             raise ValueError("batch budget exceeded")
         seq.in_flight_tokens = len(new_tokens)
@@ -144,7 +145,8 @@ class RaggedBatchWrapper:
         """Build padded arrays (the [HOST→DEVICE boundary] of the reference)."""
         mt, ms, bs = self.max_tokens, self.max_seqs, self.block_size
         tokens = np.zeros(mt, np.int32)
-        kv_slot = np.full(mt, self.trash_slot, np.int32)
+        page_of = np.full(mt, self.pad_page, np.int32)
+        off_of = np.zeros(mt, np.int32)
         seq_of = np.full(mt, ms - 1, np.int32)
         pos_of = np.zeros(mt, np.int32)
         q_offset = np.zeros(ms, np.int32)
@@ -152,16 +154,9 @@ class RaggedBatchWrapper:
         ctx_len = np.zeros(ms, np.int32)
         block_table = np.zeros((ms, self.max_blocks), np.int32)
         logit_idx = np.zeros(ms, np.int32)
-        na, A = self.n_atoms, self.atom_size
-        atom_seq = np.full(na, ms - 1, np.int32)
-        atom_tok = np.zeros(na, np.int32)
-        atom_qstart = np.zeros(na, np.int32)
-        atom_nq = np.zeros(na, np.int32)
-        token_atom = np.zeros(mt, np.int32)
-        token_within = np.zeros(mt, np.int32)
+        cu = np.zeros(ms + 1, np.int32)
         uids = []
 
-        atom_cursor = 0
         cursor = 0
         for row, (seq, new_toks) in enumerate(self._entries):
             n = len(new_toks)
@@ -175,30 +170,21 @@ class RaggedBatchWrapper:
             positions = np.arange(seq.seen_tokens, total, dtype=np.int32)
             pos_of[cursor:cursor + n] = positions
             blocks = np.asarray(seq.blocks, np.int64)
-            kv_slot[cursor:cursor + n] = (blocks[positions // bs] * bs +
-                                          positions % bs).astype(np.int32)
+            page_of[cursor:cursor + n] = blocks[positions // bs].astype(np.int32)
+            off_of[cursor:cursor + n] = (positions % bs).astype(np.int32)
             q_offset[row] = cursor
             q_len[row] = n
             ctx_len[row] = total
             block_table[row, :len(blocks)] = blocks.astype(np.int32)
             logit_idx[row] = cursor + n - 1
-            # tile this sequence's query span into atoms of ≤ A tokens
-            for qs in range(0, n, A):
-                nq = min(A, n - qs)
-                atom_seq[atom_cursor] = row
-                atom_tok[atom_cursor] = cursor + qs
-                atom_qstart[atom_cursor] = qs
-                atom_nq[atom_cursor] = nq
-                token_atom[cursor + qs:cursor + qs + nq] = atom_cursor
-                token_within[cursor + qs:cursor + qs + nq] = np.arange(nq)
-                atom_cursor += 1
             cursor += n
+            cu[row + 1] = cursor
+        cu[len(self._entries) + 1:] = cursor    # trailing rows repeat total
 
-        return RaggedBatch(tokens=tokens, kv_slot=kv_slot, seq_of_token=seq_of,
+        return RaggedBatch(tokens=tokens, page_of_token=page_of,
+                           off_of_token=off_of, seq_of_token=seq_of,
                            pos_of_token=pos_of, q_offset=q_offset, q_len=q_len,
                            ctx_len=ctx_len, block_table=block_table,
-                           logit_idx=logit_idx, atom_seq=atom_seq,
-                           atom_tok=atom_tok, atom_qstart=atom_qstart,
-                           atom_nq=atom_nq, token_atom=token_atom,
-                           token_within=token_within, n_tokens=cursor,
-                           n_seqs=len(self._entries), uids=uids)
+                           logit_idx=logit_idx, cu_q_lens=cu,
+                           n_tokens=cursor, n_seqs=len(self._entries),
+                           uids=uids)

@@ -93,10 +93,12 @@ class TestRaggedWrapper:
         np.testing.assert_array_equal(b.ctx_len[:2], [5, 4])
         assert b.pos_of_token[5] == 3  # decode token at abs position 3
         assert b.logit_idx[0] == 4 and b.logit_idx[1] == 5
-        # kv slots of seq1 = its blocks expanded
+        # pages/offsets of seq1 = its blocks expanded
         blocks = np.asarray(s1.blocks)
-        expect = blocks[np.arange(5) // 4] * 4 + np.arange(5) % 4
-        np.testing.assert_array_equal(b.kv_slot[:5], expect)
+        np.testing.assert_array_equal(b.page_of_token[:5],
+                                      blocks[np.arange(5) // 4])
+        np.testing.assert_array_equal(b.off_of_token[:5], np.arange(5) % 4)
+        np.testing.assert_array_equal(b.cu_q_lens, [0, 5, 6, 6, 6])
 
 
 @pytest.fixture(scope="module")

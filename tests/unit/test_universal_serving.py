@@ -68,7 +68,7 @@ def test_ragged_matches_compat_forward(family, impl):
 
     eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
         max_tokens=8, max_seqs=2, max_ctx=64, block_size=8,
-        dtype=jnp.float32, attn_impl=impl, atom_size=4))
+        dtype=jnp.float32, attn_impl=impl))
     # serve the prompt in splitfuse chunks of 8, then 2 decode steps
     logits = None
     for i in range(0, len(prompt), 8):
@@ -90,7 +90,7 @@ def test_two_universal_sequences_batched():
     model, params = _make(FAMILY_CASES["gpt2"])
     eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
         max_tokens=12, max_seqs=2, max_ctx=64, block_size=8,
-        dtype=jnp.float32, attn_impl="paged", atom_size=4))
+        dtype=jnp.float32, attn_impl="paged"))
     p0 = [3, 5, 7, 11, 13]
     p1 = [17, 19, 23]
     logits = eng.put([0, 1], [p0, p1])
