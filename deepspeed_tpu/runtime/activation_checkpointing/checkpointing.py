@@ -64,8 +64,33 @@ def is_configured() -> bool:
     return True
 
 
+#: ``jax.ad_checkpoint.checkpoint_name`` tags the models place on their
+#: per-layer residual streams — the values the save/offload policies below
+#: select by name (models/transformer.py layer()).
+RESIDUAL_NAMES = ("attn_residual", "mlp_residual")
+
+
+def active() -> bool:
+    """True when the DS config asked for a policy beyond plain recompute —
+    the signal for model code to route its remat policy through
+    :func:`get_policy` instead of its own ``cfg.remat_policy``."""
+    return bool(_CONFIG["partition_activations"] or
+                _CONFIG["cpu_checkpointing"])
+
+
 def get_policy(policy_name: Optional[str] = None):
-    """Map config → jax.checkpoint policy."""
+    """Map config → jax.checkpoint policy.
+
+    - ``cpu_checkpointing`` → offload the named residuals to pinned host
+      memory during the forward, fetch them back for the backward
+      (reference :948's checkpoint-in-cpu, as an XLA memory-space move
+      instead of an explicit D2H copy).
+    - ``partition_activations`` → SAVE the named residuals instead of
+      recomputing; the model constrains them sharded over the mesh's
+      data/seq axes, so each device holds only its shard (the reference's
+      TP-partitioned saved activations, expressed as sharding).
+    - otherwise full recompute (``nothing_saveable``).
+    """
     policies = jax.checkpoint_policies
     if policy_name:
         return getattr(policies, policy_name)
@@ -73,11 +98,13 @@ def get_policy(policy_name: Optional[str] = None):
         try:
             return policies.save_and_offload_only_these_names(
                 names_which_can_be_saved=[],
-                names_which_can_be_offloaded=[],
+                names_which_can_be_offloaded=list(RESIDUAL_NAMES),
                 offload_src="device", offload_dst="pinned_host")
         except Exception:  # older jax
             logger.warning("offload remat policy unavailable; saving on device")
-            return policies.nothing_saveable
+            return policies.save_only_these_names(*RESIDUAL_NAMES)
+    if _CONFIG["partition_activations"]:
+        return policies.save_only_these_names(*RESIDUAL_NAMES)
     return policies.nothing_saveable
 
 

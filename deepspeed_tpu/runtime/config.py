@@ -255,6 +255,13 @@ class DeepSpeedConfig:
         self.scheduler = SchedulerConfig(**config["scheduler"]) if "scheduler" in config else None
         self.activation_checkpointing = ActivationCheckpointingConfig(
             **config.get("activation_checkpointing", {}))
+        #: engines only push the block into the process-global remat policy
+        #: when the user actually wrote one — an engine WITHOUT the block
+        #: must not reset another engine's (or a manual configure() call's)
+        #: policy (the module-global is reference semantics:
+        #: deepspeed.checkpointing.configure is module state there too)
+        self.activation_checkpointing_explicit = \
+            "activation_checkpointing" in config
         self.comms_logger = CommsLoggerConfig(**config.get("comms_logger", {}))
         self.flops_profiler = FlopsProfilerConfig(**config.get("flops_profiler", {}))
         self.tensorboard = MonitorWriterConfig(**config.get("tensorboard", {}))

@@ -218,6 +218,18 @@ class DeepSpeedEngine:
         if training_data is not None:
             self.training_dataloader = self.deepspeed_io(training_data, collate_fn=collate_fn)
 
+        # ---- activation checkpointing policy (config → model remat) --- #
+        # configure() sets the module-level policy the models' remat sites
+        # consult at trace time, so a DS-JSON activation_checkpointing
+        # block changes the compiled program (save-sharded / offload-to-
+        # host residuals instead of full recompute).  Only an EXPLICIT
+        # block applies — engines without one must not clobber another
+        # engine's or a manual configure() call's policy.
+        if getattr(config, "activation_checkpointing_explicit", False):
+            from .activation_checkpointing import checkpointing as _act_ckpt
+
+            _act_ckpt.configure(deepspeed_config=config)
+
         # ---- compiled steps ------------------------------------------ #
         self._compiled: Dict[str, Any] = {}
         self._losses: list = []

@@ -3,10 +3,13 @@ impossible number may reach a round artifact, and a down relay can't erase
 cached silicon evidence."""
 import io
 import json
+import os
 import sys
 from contextlib import redirect_stdout
 
-sys.path.insert(0, ".")  # bench.py lives at the repo root
+# bench.py lives at the repo root, two levels up from this file
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))))
 import bench  # noqa: E402
 
 

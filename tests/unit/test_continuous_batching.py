@@ -1,0 +1,120 @@
+"""Continuous-batching scheduler at the operating point (VERDICT r3 #7):
+64-sequence churn (admission, eviction, block recycling) and O(batch)
+scheduling cost independent of queue depth.
+
+Reference analogue: the MII scheduling layer over
+deepspeed/inference/v2/engine_v2.py:158-242 budget primitives.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (
+    ContinuousBatcher,
+    InferenceEngineV2,
+    RaggedInferenceEngineConfig,
+)
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    initialize_mesh(TopologyConfig(), force=True)
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def _engine(model, params, **kw):
+    defaults = dict(max_tokens=16, max_seqs=4, max_ctx=64, block_size=8,
+                    dtype=jnp.float32, attn_impl="gather")
+    defaults.update(kw)
+    return InferenceEngineV2(model, params,
+                             RaggedInferenceEngineConfig(**defaults))
+
+
+class TestChurn:
+    def test_64_stream_churn_with_tight_kv(self, tiny):
+        """64 staggered requests through a cache that holds only ~4 live
+        sequences: the batcher must admit in waves, evict at completion,
+        recycle every block, and complete ALL streams."""
+        model, params = tiny
+        # 16 blocks x 8 = 128 slots; each request reserves
+        # ceil((prompt + max_new)/8) blocks -> ~3-4 concurrent residents
+        eng = _engine(model, params, num_blocks=16)
+        b = ContinuousBatcher(eng, max_new_tokens=6)
+        rng = np.random.default_rng(0)
+        for u in range(64):
+            b.add_request(u, rng.integers(1, 255, size=int(rng.integers(
+                3, 20))).tolist())
+        steps = 0
+        while b.pending:
+            b.step()
+            steps += 1
+            assert steps < 2000, "churn did not converge"
+        assert len(b.finished) == 64
+        assert all(len(v) == 6 for v in b.finished.values())
+        # every block back in the pool; no tracked-sequence leak
+        assert eng.state_manager.free_blocks == 16
+        assert eng.state_manager.n_tracked_sequences == 0
+
+    def test_matches_generate_output(self, tiny):
+        """Batcher-driven serving produces the same greedy tokens as the
+        one-shot generate loop (same engine semantics underneath)."""
+        model, params = tiny
+        prompts = [[3, 5, 7, 11, 13], [17, 19], [23, 29, 31]]
+        eng1 = _engine(model, params)
+        ref = eng1.generate(prompts, max_new_tokens=8)
+        eng2 = _engine(model, params)
+        b = ContinuousBatcher(eng2, max_new_tokens=8)
+        for u, p in enumerate(prompts):
+            b.add_request(u, p)
+        out = b.run()
+        assert [out[u] for u in range(3)] == ref
+
+    def test_eos_and_rejection(self, tiny):
+        model, params = tiny
+        eng = _engine(model, params)
+        b = ContinuousBatcher(eng, max_new_tokens=8, eos_token_id=1)
+        b.add_request(0, [3, 5])
+        b.add_request(1, list(range(1, 200)))     # > max_ctx: rejected
+        b.add_request(2, [])                      # empty: finished at once
+        out = b.run()
+        assert out[1] == [] and out[2] == []
+        assert 1 <= len(out[0]) <= 8
+        assert eng.state_manager.free_blocks == eng.kv.config.num_blocks
+
+
+class TestSchedulingCost:
+    def test_next_batch_touch_count_independent_of_queue_depth(self, tiny):
+        """Scheduling examines O(batch) uids regardless of how many requests
+        are queued — the kill-the-rescan criterion, pinned structurally
+        (touched-uid count), not by wall clock."""
+        model, params = tiny
+        touched = {}
+        for depth in (100, 5000):
+            eng = _engine(model, params, num_blocks=16)
+            b = ContinuousBatcher(eng, max_new_tokens=4)
+            for u in range(depth):
+                b.add_request(u, [3, 5, 7])
+            b.step()
+            touched[depth] = b.touched
+        assert touched[5000] == touched[100], touched
+        assert touched[5000] <= 4 + 4      # max_seqs decodes + admissions
+
+    def test_steady_state_touch_bound(self, tiny):
+        """Mid-churn (mixed decodes + prefills + deep queue) the per-step
+        touch count stays within the batch budget bound."""
+        model, params = tiny
+        eng = _engine(model, params, num_blocks=16)
+        b = ContinuousBatcher(eng, max_new_tokens=4)
+        for u in range(500):
+            b.add_request(u, [3, 5, 7, 11, 13])
+        cap = eng.config.max_seqs * 2 + 1
+        for _ in range(25):
+            if not b.pending:
+                break
+            b.step()
+            assert b.touched <= cap, (b.touched, cap)

@@ -21,19 +21,44 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 BACKLOG = [
-    ("train_mfu", {"DSTPU_BENCH_MODE": "train"}),
-    # MFU tuning ladder: keep-dots remat (no recompute flops), bigger batch
-    ("train_mfu_dots", {"DSTPU_BENCH_MODE": "train",
-                        "DSTPU_BENCH_REMAT_POLICY":
-                            "dots_with_no_batch_dims_saveable"}),
-    ("train_mfu_dots_b16", {"DSTPU_BENCH_MODE": "train",
-                            "DSTPU_BENCH_BATCH": "16",
-                            "DSTPU_BENCH_REMAT_POLICY":
-                                "dots_with_no_batch_dims_saveable"}),
+    # MFU ladder (VERDICT r3 #3): tuned 0.7B first (fast signal), then the
+    # ≥2B-class configs that need Twin-Flow pinned-host optimizer streaming
+    # to fit a 16GB chip — which is also the first silicon exercise of the
+    # offload path (VERDICT r3 #6).
+    ("train_mfu", {"DSTPU_BENCH_MODE": "train",
+                   "DSTPU_BENCH_REMAT_POLICY":
+                       "dots_with_no_batch_dims_saveable"}),
+    ("train_mfu_b16", {"DSTPU_BENCH_MODE": "train",
+                       "DSTPU_BENCH_BATCH": "16",
+                       "DSTPU_BENCH_REMAT_POLICY":
+                           "dots_with_no_batch_dims_saveable"}),
+    ("train_mfu_2b", {"DSTPU_BENCH_MODE": "train",
+                      "DSTPU_BENCH_HIDDEN": "2560",
+                      "DSTPU_BENCH_LAYERS": "24",
+                      "DSTPU_BENCH_BATCH": "8",
+                      "DSTPU_BENCH_OFFLOAD": "1.0",
+                      "DSTPU_BENCH_ZERO_STAGE": "2",
+                      "DSTPU_BENCH_REMAT_POLICY": "nothing_saveable"}),
+    ("train_mfu_2b_twin07", {"DSTPU_BENCH_MODE": "train",
+                             "DSTPU_BENCH_HIDDEN": "2560",
+                             "DSTPU_BENCH_LAYERS": "24",
+                             "DSTPU_BENCH_BATCH": "8",
+                             "DSTPU_BENCH_OFFLOAD": "0.7",
+                             "DSTPU_BENCH_ZERO_STAGE": "2",
+                             "DSTPU_BENCH_REMAT_POLICY": "nothing_saveable"}),
     ("flash_sweep", {"DSTPU_BENCH_MODE": "flash_sweep"}),
+    # serving micro-bench (paged vs gather oracle) at 8k/32k with the
+    # round-5 flat-token kernel
     ("serving_8k", {"DSTPU_BENCH_MODE": "serving", "DSTPU_BENCH_CTX": "8192"}),
     ("serving_32k", {"DSTPU_BENCH_MODE": "serving", "DSTPU_BENCH_CTX": "32768",
                      "DSTPU_BENCH_CHUNK": "1024"}),
+    # FastGen load curve (VERDICT r3 #2): req/s + TTFT at 16/32/64 streams
+    ("serving_load_16", {"DSTPU_BENCH_MODE": "serving_load",
+                         "DSTPU_BENCH_CONC": "16"}),
+    ("serving_load_32", {"DSTPU_BENCH_MODE": "serving_load",
+                         "DSTPU_BENCH_CONC": "32"}),
+    ("serving_load_64", {"DSTPU_BENCH_MODE": "serving_load",
+                         "DSTPU_BENCH_CONC": "64"}),
     ("offload_step", {"DSTPU_BENCH_MODE": "offload"}),
 ]
 
