@@ -248,20 +248,27 @@ class InferenceEngineV2:
         SplitFuse prefill chunks + fused on-device decode windows, with KV
         backpressure (prompts queue instead of raising when the cache is
         full) and O(batch) scheduling cost per step."""
+        pool = self.kv.config.num_blocks
         for p in prompts:
             # preserve the hard-error contract for impossible requests (the
             # batcher API rejects gracefully; generate() callers expect the
             # old put()-style RuntimeError).  With eos an early stop can
             # keep prompt+max_new under the cap, so only the eos-less case
             # is deterministically impossible.
-            over = len(p) > self.config.max_ctx or (
-                eos_token_id is None and
-                len(p) + max_new_tokens > self.config.max_ctx)
-            if over:
+            if len(p) > self.config.max_ctx or (
+                    eos_token_id is None and
+                    len(p) + max_new_tokens > self.config.max_ctx):
                 raise RuntimeError(
                     f"cannot schedule batch: {SchedulingResult.SequenceTooLong}"
                     f" (prompt {len(p)} + {max_new_tokens} new > max_ctx "
                     f"{self.config.max_ctx})")
+            need = min(len(p) + max_new_tokens, self.config.max_ctx)
+            if -(-need // self.config.block_size) > pool:
+                raise RuntimeError(
+                    f"cannot schedule batch: "
+                    f"{SchedulingResult.KVCacheLimitExceeded} (request needs "
+                    f"{need} tokens; pool holds "
+                    f"{pool * self.config.block_size})")
         batcher = ContinuousBatcher(self, max_new_tokens=max_new_tokens,
                                     temperature=temperature,
                                     eos_token_id=eos_token_id, rng=rng)

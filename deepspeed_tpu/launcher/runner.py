@@ -40,7 +40,10 @@ def parse_args(args=None):
     parser.add_argument("--master_addr", type=str, default="")
     parser.add_argument("--launcher", type=str, default="ssh",
                         choices=["ssh", "pdsh", "openmpi", "mpich", "slurm",
-                                 "local"])
+                                 "local", "popen"])
+    parser.add_argument("--num_procs", type=int, default=2,
+                        help="popen launcher: local process count (pod "
+                             "rehearsal — one process per simulated host)")
     parser.add_argument("--force_multi", action="store_true")
     parser.add_argument("user_script", type=str)
     parser.add_argument("user_args", nargs=argparse.REMAINDER)
@@ -113,6 +116,30 @@ def build_launch_env(rank: int, world_size: int, master_addr: str,
 def main(args=None):
     args = parse_args(args)
     resource_pool = fetch_hostfile(args.hostfile)
+
+    if args.launcher == "popen":
+        # Localhost pod rehearsal (VERDICT r3 #10): N distinct processes +
+        # a real jax.distributed coordinator on 127.0.0.1 — the same
+        # per-rank env contract a physical pod launch uses, so a real
+        # slice becomes a hostfile change, not new code.  One process per
+        # simulated host (the TPU one-proc-per-host model).
+        world_size = args.num_procs
+        master_addr = args.master_addr or "127.0.0.1"
+        procs: List[subprocess.Popen] = []
+        for rank in range(world_size):
+            # local children inherit the full env (same-host semantics);
+            # build_launch_env supplies the per-rank rendezvous contract
+            env = dict(os.environ)
+            env.update(build_launch_env(rank, world_size, master_addr,
+                                        args.master_port))
+            cmd = [sys.executable, args.user_script] + args.user_args
+            logger.info(f"rank {rank}: {' '.join(map(shlex.quote, cmd))}")
+            procs.append(subprocess.Popen(cmd, env=env))
+        rc = 0
+        for p in procs:
+            p.wait()
+            rc = rc or p.returncode
+        sys.exit(rc)
 
     if not resource_pool or args.launcher == "local":
         # single host: exec in place (reference single-node path :529)
