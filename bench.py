@@ -604,22 +604,7 @@ def run_pipeline_bench(on_tpu: bool) -> None:
         intermediate_size=256, num_heads=4, num_kv_heads=4, max_seq_len=seq)
     rng = np.random.default_rng(0)
 
-    def scan_lengths(fn, *args):
-        """All lax.scan trip counts in fn's jaxpr (recursive)."""
-        found = []
-
-        def walk(jx):
-            for eqn in jx.eqns:
-                if eqn.primitive.name == "scan":
-                    found.append(int(eqn.params["length"]))
-                for v in eqn.params.values():
-                    if hasattr(v, "jaxpr"):
-                        walk(v.jaxpr)
-                    elif hasattr(v, "eqns"):
-                        walk(v)
-
-        walk(jax.make_jaxpr(fn)(*args).jaxpr)
-        return found
+    from deepspeed_tpu.utils.jaxpr_utils import scan_lengths
 
     results = {}
     for name, sched_cfg, v in (("gpipe", {"schedule": "gpipe"}, 1),
@@ -649,14 +634,18 @@ def run_pipeline_bench(on_tpu: bool) -> None:
             lambda b: eng._build_train_batch_fn()(eng.state, b), batch)
         vpp = v * pp
         if name == "gpipe":
-            T_model = M + pp - 1         # fwd scan (bwd replays reversed)
-            ideal = M
+            # single fwd scan of M+pp-1 ticks (bwd replays it reversed)
+            expect = [M + pp - 1]
+            bubble = (pp - 1) / (M + pp - 1)
         else:
+            # round-5 phase-split: warmup (vpp-1 F-only) + steady
+            # (off_max+1 F+B) + drain (vpp-1 B-only); fill/drain ticks
+            # cost half, so bubble time = (pp-1)/V full ticks over
+            # M + (pp-1)/V  ->  fraction (pp-1)/(M*V + pp - 1)
             off_max = (M // pp - 1) * vpp + pp - 1 if v > 1 else M - 1
-            T_model = off_max + 2 * (vpp - 1) + 1
-            ideal = M * v                # tick does 1/v of a microbatch
-        found = T_model in lens
-        bubble = 1.0 - ideal / T_model
+            expect = [vpp - 1, off_max + 1]
+            bubble = (pp - 1) / (M * v + pp - 1)
+        found = all(x in lens for x in expect)
         # ---- secondary: wall clock (CPU-sim; runtime overhead dominates
         # the constant term, recorded for trend only) ------------------- #
         wall = None
@@ -669,13 +658,13 @@ def run_pipeline_bench(on_tpu: bool) -> None:
             jax.block_until_ready(loss)
             wall = (time.perf_counter() - t0) / steps
         results[name] = {
-            "tick_scan_length_model": T_model,
-            "tick_scan_found_in_program": found,
+            "tick_scan_lengths_model": expect,
+            "tick_scans_found_in_program": found,
             "all_scan_lengths": sorted(set(lens)),
             "bubble_fraction": round(bubble, 4),
             "wall_ms_per_step": round(wall * 1e3, 1) if wall else None,
         }
-        log(f"{name}: T={T_model} (found={found}) bubble={bubble:.3f}")
+        log(f"{name}: scans={expect} (found={found}) bubble={bubble:.3f}")
     emit("pipeline_bubble_fraction",
          results["1f1b"]["bubble_fraction"], "fraction",
          round(results["1f1b_v2"]["bubble_fraction"] /

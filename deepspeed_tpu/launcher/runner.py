@@ -16,6 +16,7 @@ import os
 import shlex
 import subprocess
 import sys
+import time
 from collections import OrderedDict
 from typing import Dict, List, Optional, Tuple
 
@@ -135,10 +136,24 @@ def main(args=None):
             cmd = [sys.executable, args.user_script] + args.user_args
             logger.info(f"rank {rank}: {' '.join(map(shlex.quote, cmd))}")
             procs.append(subprocess.Popen(cmd, env=env))
+        # fail fast: one dead rank would leave the others blocked in a
+        # collective until the distributed timeout — terminate peers on the
+        # first nonzero exit (reference runner.py sigkill_handler semantics)
         rc = 0
-        for p in procs:
-            p.wait()
-            rc = rc or p.returncode
+        live = list(procs)
+        while live:
+            time.sleep(0.2)
+            for p in list(live):
+                ret = p.poll()
+                if ret is None:
+                    continue
+                live.remove(p)
+                if ret != 0 and rc == 0:
+                    rc = ret
+                    logger.error(f"a rank exited rc={ret}; "
+                                 f"terminating {len(live)} peer(s)")
+                    for q in live:
+                        q.terminate()
         sys.exit(rc)
 
     if not resource_pool or args.launcher == "local":

@@ -386,7 +386,14 @@ def _pipeline_lm(params: Dict, batch: Any, cfg, topo, rng, num_micro: int,
 
         def tick(carry, t):
             ring, abuf, gbuf, grad_acc, loss_acc, count_acc = carry
-            # ---- forward slot ----
+            ring, abuf, loss_acc, count_acc = _f_half(
+                ring, abuf, loss_acc, count_acc, t)
+            gbuf, grad_acc = _b_half(ring, gbuf, grad_acc, t)
+            return (ring, abuf, gbuf, grad_acc, loss_acc, count_acc), None
+
+        def _f_half(ring, abuf, loss_acc, count_acc, t):
+            """Forward slot: save input to the ring, run the chunk, emit
+            loss at the last virtual stage, permute the activation."""
             m_f, c_f, f_valid = slot_f(t)
             idx_f = jnp.clip(m_f, 0, num_micro - 1)
             toks_f = jax.lax.dynamic_index_in_dim(tmb, idx_f, 0, keepdims=False)
@@ -399,8 +406,12 @@ def _pipeline_lm(params: Dict, batch: Any, cfg, topo, rng, num_micro: int,
                 jnp.logical_and(stage == pp - 1, c_f == V - 1), f_valid)
             loss_acc = loss_acc + jnp.where(emit, sl, 0.0)
             count_acc = count_acc + jnp.where(emit, cn, 0.0)
+            abuf_next = jax.lax.ppermute(h, PIPE, perm)
+            return ring, abuf_next, loss_acc, count_acc
 
-            # ---- backward slot ----
+        def _b_half(ring, gbuf, grad_acc, t):
+            """Backward slot: vjp of the saved-input chunk, accumulate
+            grads, permute the cotangent down the reverse ring."""
             m_b, c_b, b_valid = slot_b(t)
             idx_b = jnp.clip(m_b, 0, num_micro - 1)
             toks_b = jax.lax.dynamic_index_in_dim(tmb, idx_b, 0, keepdims=False)
@@ -423,19 +434,43 @@ def _pipeline_lm(params: Dict, batch: Any, cfg, topo, rng, num_micro: int,
             dp, dbuf = vjp_fn((g_h.astype(dtype), g_sl))
             grad_acc = jax.tree.map(
                 lambda a, g: a + g.astype(jnp.float32), grad_acc, dp)
-
-            # both rings issue together at tick end: XLA overlaps the
-            # forward-act and reverse-grad permutes with the next tick
-            abuf_next = jax.lax.ppermute(h, PIPE, perm)
             gbuf_next = jax.lax.ppermute(dbuf.astype(dtype), PIPE, rev_perm)
-            return (ring, abuf_next, gbuf_next, grad_acc, loss_acc,
-                    count_acc), None
+            return gbuf_next, grad_acc
 
+        # Phase-split schedule (round-5 bubble fix): for the first vpp-1
+        # ticks NO rank has a valid backward slot (the earliest B is the
+        # immediate loss-backward of microbatch 0's last virtual stage at
+        # t = vpp-1), and for the last vpp-1 ticks no rank has a valid
+        # forward slot (the last F is at off_max + vpp - 1).  A single
+        # uniform scan pays the full F+B body on those ticks anyway —
+        # masked-out compute, but real time — which is exactly why the
+        # measured bubble was (vpp+pp-2)/... and did NOT shrink with V.
+        # Splitting into warmup (F-only body), steady (F+B), and drain
+        # (B-only) scans keeps the slot formulas and dataflow identical
+        # while the fill/drain ticks cost only half a tick, restoring the
+        # textbook bubble: (pp-1) full-tick equivalents out of
+        # M*V + pp - 1 — i.e. the (pp-1)/V interleaving win.
+        def warm_tick(carry, t):
+            ring, abuf, gbuf, grad_acc, loss_acc, count_acc = carry
+            ring, abuf, loss_acc, count_acc = _f_half(
+                ring, abuf, loss_acc, count_acc, t)
+            return (ring, abuf, gbuf, grad_acc, loss_acc, count_acc), None
+
+        def drain_tick(carry, t):
+            ring, abuf, gbuf, grad_acc, loss_acc, count_acc = carry
+            gbuf, grad_acc = _b_half(ring, gbuf, grad_acc, t)
+            return (ring, abuf, gbuf, grad_acc, loss_acc, count_acc), None
+
+        W = vpp - 1                        # fill ticks: F-only
+        steady_end = off_max + vpp         # last F tick is steady_end - 1
         ring0 = jnp.zeros((R, mb, S_loc, D), dtype)
         buf0 = jnp.zeros((mb, S_loc, D), dtype)
         grad0 = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32), params)
-        (_, _, _, grads, loss_acc, count_acc), _ = jax.lax.scan(
-            tick, (ring0, buf0, buf0, grad0, f32z, f32z), jnp.arange(T))
+        carry = (ring0, buf0, buf0, grad0, f32z, f32z)
+        carry, _ = jax.lax.scan(warm_tick, carry, jnp.arange(W))
+        carry, _ = jax.lax.scan(tick, carry, jnp.arange(W, steady_end))
+        carry, _ = jax.lax.scan(drain_tick, carry, jnp.arange(steady_end, T))
+        (_, _, _, grads, loss_acc, count_acc) = carry
 
         total_count = jnp.maximum(jax.lax.psum(count_acc, sum_axes), 1.0)
         loss = jax.lax.psum(loss_acc, sum_axes) / total_count

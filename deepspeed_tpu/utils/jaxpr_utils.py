@@ -1,0 +1,29 @@
+"""Jaxpr introspection helpers shared by compiled-program perf gates
+(tests) and bench modes — structural facts about a traced program, e.g.
+every ``lax.scan`` trip count (the pipeline tick loops' bubble evidence).
+"""
+from __future__ import annotations
+
+from typing import List
+
+import jax
+
+
+def scan_lengths(fn, *args) -> List[int]:
+    """All ``lax.scan`` static trip counts in ``fn``'s jaxpr, including
+    scans nested inside pjit/cond/while/other-scan sub-jaxprs."""
+    found: List[int] = []
+
+    def walk(jx):
+        for eqn in jx.eqns:
+            if eqn.primitive.name == "scan":
+                found.append(int(eqn.params["length"]))
+            for v in eqn.params.values():
+                inner = v
+                while hasattr(inner, "jaxpr"):      # ClosedJaxpr → Jaxpr
+                    inner = inner.jaxpr
+                if hasattr(inner, "eqns"):
+                    walk(inner)
+
+    walk(jax.make_jaxpr(fn)(*args).jaxpr)
+    return found

@@ -106,47 +106,46 @@ class TestOneFOneB:
                 err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
 
     def test_interleaved_bubble_shrinks(self):
-        """Schedule arithmetic: each rank does M·V work ticks of 1/V
-        stage-cost; idle (bubble) stage-time strictly decreases with V."""
+        """Schedule arithmetic under the phase-split scan: warmup/drain
+        ticks cost half a tick (F-only / B-only bodies), so total stage-time
+        is (M·V + pp - 1)/V and idle (bubble) stage-time is (pp-1)/V —
+        strictly decreasing in V, the textbook interleaving win."""
         pp, M = 4, 8
         bubbles = []
         for V in (1, 2, 4):
-            off_max = M - 1 if V == 1 else (M // pp - 1) * V * pp + pp - 1
-            T = off_max + 2 * (V * pp - 1) + 1
-            bubbles.append((T - M * V) / V)   # idle ticks in stage-units
+            vpp = V * pp
+            off_max = M - 1 if V == 1 else (M // pp - 1) * vpp + pp - 1
+            warm = drain = vpp - 1            # half-cost ticks
+            steady = off_max + 1              # full-cost ticks
+            total_stage_time = (warm / 2 + steady + drain / 2) / V
+            bubbles.append(total_stage_time - M)
+        np.testing.assert_allclose(
+            bubbles, [(pp - 1) / V for V in (1, 2, 4)], rtol=1e-9)
         assert bubbles == sorted(bubbles, reverse=True)
-        assert bubbles[0] == 2 * pp - 2       # plain 1F1B fill+drain
-        assert bubbles[-1] < bubbles[0] / 1.3
 
     def test_bubble_tick_count(self):
-        """The schedule's tick count is M + 2·pp - 2 (fill+drain bubble of
-        2(pp-1) combined-slot ticks) vs the autodiff GPipe's effective
-        2(M + pp - 1) forward+backward ticks — fewer lockstep rounds for
-        any M > 0.  Asserted from the traced jaxpr: the 1F1B tick loop is a
-        scan whose static length must equal T."""
+        """Round-5 phase-split schedule: the tick loop is THREE scans —
+        warmup (pp-1 F-only ticks: no rank has a valid backward before
+        t = pp-1), steady (M full F+B ticks), drain (pp-1 B-only ticks) —
+        totalling the same T = M + 2(pp-1) tick positions, but the fill and
+        drain ticks cost half a tick each, so the bubble is (pp-1)
+        full-tick equivalents out of M + pp - 1 (the textbook 1F1B bubble)
+        instead of 2(pp-1).  Asserted from the traced jaxpr."""
+        from deepspeed_tpu.utils.jaxpr_utils import scan_lengths
+
         pp, num_micro = 4, 8
         topo, cfg, params, batch = _setup(pp)
         rng = jax.random.PRNGKey(0)
-        jaxpr = jax.make_jaxpr(lambda p: pipeline_lm_loss_1f1b(
-            p, batch, cfg, topo, rng, num_micro)[0])(params)
-
-        def scan_lengths(jxp):
-            out = []
-            for eqn in jxp.eqns:
-                if eqn.primitive.name == "scan":
-                    out.append(eqn.params["length"])
-                for v in eqn.params.values():
-                    inner = v
-                    while hasattr(inner, "jaxpr"):   # ClosedJaxpr → Jaxpr
-                        inner = inner.jaxpr
-                    if hasattr(inner, "eqns"):
-                        out.extend(scan_lengths(inner))
-            return out
-
-        lengths = scan_lengths(jaxpr.jaxpr)
-        T = num_micro + 2 * pp - 2
-        assert T in lengths, \
-            f"no scan of length {T} (tick loop) in 1F1B jaxpr; scans={lengths}"
+        lengths = scan_lengths(lambda p: pipeline_lm_loss_1f1b(
+            p, batch, cfg, topo, rng, num_micro)[0], params)
+        warm = drain = pp - 1
+        steady = num_micro
+        for want, what in ((warm, "warmup/drain"), (steady, "steady")):
+            assert want in lengths, \
+                f"no scan of length {want} ({what}) in 1F1B jaxpr; " \
+                f"scans={lengths}"
+        # the old single full-length scan must be gone
+        assert (num_micro + 2 * pp - 2) not in lengths, lengths
 
 
 class TestEngine1F1B:

@@ -45,6 +45,14 @@ WORKER = textwrap.dedent("""
 
 
 class TestPodLaunchRehearsal:
+    @staticmethod
+    def _free_port() -> int:
+        import socket
+
+        with socket.socket() as s:
+            s.bind(("127.0.0.1", 0))
+            return s.getsockname()[1]
+
     def test_dstpu_popen_two_process_coordinator(self, tmp_path):
         script = tmp_path / "worker.py"
         script.write_text(WORKER)
@@ -58,7 +66,7 @@ class TestPodLaunchRehearsal:
         proc = subprocess.run(
             [sys.executable, os.path.join(REPO, "bin", "dstpu"),
              "--launcher", "popen", "--num_procs", "2",
-             "--master_port", "29571", str(script)],
+             "--master_port", str(self._free_port()), str(script)],
             env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
             text=True, timeout=240)
         assert proc.returncode == 0, proc.stdout[-3000:]
