@@ -61,6 +61,7 @@ class TestDebugMode:
         finally:
             jax.config.update("jax_debug_nans", False)
 
+    @pytest.mark.slow
     def test_nan_check_off_tolerates(self):
         """Without the flag the engine's NaN-safe grad zeroing keeps going
         (the production behavior the debug mode exists to override) — the

@@ -100,7 +100,14 @@ class TestZeroOneAdam:
 
 
 class TestEngineWiring:
-    @pytest.mark.parametrize("opt", ["OneBitAdam", "OneBitLamb", "ZeroOneAdam"])
+    @pytest.mark.parametrize("opt", [
+        "OneBitAdam",
+        # full engine-train wiring is identical across variants; the
+        # algorithm differences are covered by the fast math tests above,
+        # so two of three full runs live outside the default suite budget
+        pytest.param("OneBitLamb", marks=pytest.mark.slow),
+        pytest.param("ZeroOneAdam", marks=pytest.mark.slow),
+    ])
     def test_engine_trains_with_onebit_config(self, opt):
         """DeepSpeed config names build the REAL algorithms, not aliases."""
         import deepspeed_tpu

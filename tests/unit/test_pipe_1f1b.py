@@ -105,6 +105,19 @@ class TestOneFOneB:
                 np.asarray(g1), np.asarray(g2), atol=1e-5, rtol=1e-4,
                 err_msg=f"grad mismatch at {jax.tree_util.keystr(path)}")
 
+    def test_interleaved_v2_loss_smoke(self):
+        """Fast default-suite guard on the V>1 path (the exhaustive grads
+        and engine-parity checks are slow-marked): one interleaved V=2
+        loss evaluation must match plain 1F1B exactly."""
+        pp = 2
+        topo, cfg, params, batch = _setup(pp, num_layers=4)
+        rng = jax.random.PRNGKey(0)
+        loss_v, _ = pipeline_lm_loss_1f1b(
+            params, batch, cfg, topo, rng, 4, virtual_stages=2)
+        loss_1, _ = pipeline_lm_loss_1f1b(
+            params, batch, cfg, topo, rng, 4)
+        np.testing.assert_allclose(float(loss_v), float(loss_1), rtol=1e-5)
+
     def test_interleaved_bubble_shrinks(self):
         """Schedule arithmetic under the phase-split scan: warmup/drain
         ticks cost half a tick (F-only / B-only bodies), so total stage-time
@@ -197,6 +210,7 @@ class TestPrepermutedVirtualStages:
             topology=topo)
         return engine
 
+    @pytest.mark.slow
     def test_engine_loss_parity_v2_vs_v1(self):
         rng = np.random.default_rng(0)
         batch = {"input_ids": jnp.asarray(
