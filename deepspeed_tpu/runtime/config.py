@@ -268,6 +268,7 @@ class DeepSpeedConfig:
         self.csv_monitor = MonitorWriterConfig(**config.get("csv_monitor", {}))
         self.wandb = MonitorWriterConfig(**config.get("wandb", {}))
         self.comet = MonitorWriterConfig(**config.get("comet", {}))
+        self.comet = MonitorWriterConfig(**config.get("comet", {}))
         self.tensor_parallel = TensorParallelConfig(**config.get(
             "tensor_parallel", config.get("autotp", {})))
         self.pipeline = PipelineConfig(**config.get("pipeline", {}))
