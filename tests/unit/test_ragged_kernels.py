@@ -161,6 +161,37 @@ class TestRaggedPagedAttention:
                                    block_q=8, pages_per_chunk=2)
 
 
+class TestRaggedFuzz:
+    @pytest.mark.parametrize("seed", [10, 11, 12, 13])
+    def test_random_batches_match_oracle(self, seed):
+        """Randomized mixed batches: prefill spans crossing block_q
+        boundaries, T landing exactly on tile edges, fresh prefills
+        (ctx == q_len), partial tail chunks — all must match the oracle."""
+        rng = np.random.default_rng(seed)
+        KV = int(rng.choice([1, 2]))
+        G = int(rng.choice([1, 2, 4]))
+        hd = int(rng.choice([32, 64]))
+        ps = int(rng.choice([4, 8, 16]))
+        S = int(rng.integers(1, 5))
+        q_lens, ctx_lens = [], []
+        for _ in range(S):
+            q = int(rng.integers(1, 12))
+            seen = int(rng.integers(0, 40))
+            q_lens.append(q)
+            ctx_lens.append(seen + q)
+        NB = max(-(-max(ctx_lens) // ps), 1)
+        q, pages, kvl, pt, cu = _case(rng, q_lens, ctx_lens, KV, G, hd, ps, NB)
+        bq = int(rng.choice([8, 16]))
+        p = int(rng.choice([1, 2, 4]))
+        out = ragged_paged_attention(q, pages, kvl, pt, cu, num_kv_heads=KV,
+                                     block_q=bq, pages_per_chunk=p)
+        ref = _oracle(q, pages, pt, q_lens, ctx_lens, hd)
+        np.testing.assert_allclose(
+            np.asarray(out), ref, atol=3e-5, rtol=3e-5,
+            err_msg=f"cfg KV={KV} G={G} hd={hd} ps={ps} q={q_lens} "
+                    f"ctx={ctx_lens} bq={bq} P={p}")
+
+
 class TestPagedKVAppend:
     def test_append_and_trash_isolation(self):
         KV, hd, ps, nb = 2, 16, 4, 3
