@@ -20,18 +20,24 @@ import time
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
+# Ordered by VERDICT r3 priority so a SHORT relay window still collects the
+# items that matter most: serving kernel A/B (#1) and one load point (#2)
+# first, then the MFU ladder (#3) incl. the Twin-Flow 2B configs (#6), then
+# the rest.  Each item is independent; a mid-window relay drop loses only
+# the tail.
 BACKLOG = [
-    # MFU ladder (VERDICT r3 #3): tuned 0.7B first (fast signal), then the
-    # ≥2B-class configs that need Twin-Flow pinned-host optimizer streaming
-    # to fit a 16GB chip — which is also the first silicon exercise of the
-    # offload path (VERDICT r3 #6).
+    # serving micro-bench (paged vs gather oracle) with the round-5
+    # flat-token kernel — the round's #1 question
+    ("serving_8k", {"DSTPU_BENCH_MODE": "serving", "DSTPU_BENCH_CTX": "8192"}),
+    ("serving_load_32", {"DSTPU_BENCH_MODE": "serving_load",
+                         "DSTPU_BENCH_CONC": "32"}),
     ("train_mfu", {"DSTPU_BENCH_MODE": "train",
                    "DSTPU_BENCH_REMAT_POLICY":
                        "dots_with_no_batch_dims_saveable"}),
-    ("train_mfu_b16", {"DSTPU_BENCH_MODE": "train",
-                       "DSTPU_BENCH_BATCH": "16",
-                       "DSTPU_BENCH_REMAT_POLICY":
-                           "dots_with_no_batch_dims_saveable"}),
+    ("serving_32k", {"DSTPU_BENCH_MODE": "serving", "DSTPU_BENCH_CTX": "32768",
+                     "DSTPU_BENCH_CHUNK": "1024"}),
+    # ≥2B-class MFU needs Twin-Flow pinned-host optimizer streaming to fit
+    # a 16GB chip — also the first silicon exercise of the offload path
     ("train_mfu_2b", {"DSTPU_BENCH_MODE": "train",
                       "DSTPU_BENCH_HIDDEN": "2560",
                       "DSTPU_BENCH_LAYERS": "24",
@@ -39,6 +45,16 @@ BACKLOG = [
                       "DSTPU_BENCH_OFFLOAD": "1.0",
                       "DSTPU_BENCH_ZERO_STAGE": "2",
                       "DSTPU_BENCH_REMAT_POLICY": "nothing_saveable"}),
+    # FastGen load curve (VERDICT r3 #2): req/s + TTFT at 16/64 streams
+    ("serving_load_16", {"DSTPU_BENCH_MODE": "serving_load",
+                         "DSTPU_BENCH_CONC": "16"}),
+    ("serving_load_64", {"DSTPU_BENCH_MODE": "serving_load",
+                         "DSTPU_BENCH_CONC": "64"}),
+    ("flash_sweep", {"DSTPU_BENCH_MODE": "flash_sweep"}),
+    ("train_mfu_b16", {"DSTPU_BENCH_MODE": "train",
+                       "DSTPU_BENCH_BATCH": "16",
+                       "DSTPU_BENCH_REMAT_POLICY":
+                           "dots_with_no_batch_dims_saveable"}),
     ("train_mfu_2b_twin07", {"DSTPU_BENCH_MODE": "train",
                              "DSTPU_BENCH_HIDDEN": "2560",
                              "DSTPU_BENCH_LAYERS": "24",
@@ -46,19 +62,6 @@ BACKLOG = [
                              "DSTPU_BENCH_OFFLOAD": "0.7",
                              "DSTPU_BENCH_ZERO_STAGE": "2",
                              "DSTPU_BENCH_REMAT_POLICY": "nothing_saveable"}),
-    ("flash_sweep", {"DSTPU_BENCH_MODE": "flash_sweep"}),
-    # serving micro-bench (paged vs gather oracle) at 8k/32k with the
-    # round-5 flat-token kernel
-    ("serving_8k", {"DSTPU_BENCH_MODE": "serving", "DSTPU_BENCH_CTX": "8192"}),
-    ("serving_32k", {"DSTPU_BENCH_MODE": "serving", "DSTPU_BENCH_CTX": "32768",
-                     "DSTPU_BENCH_CHUNK": "1024"}),
-    # FastGen load curve (VERDICT r3 #2): req/s + TTFT at 16/32/64 streams
-    ("serving_load_16", {"DSTPU_BENCH_MODE": "serving_load",
-                         "DSTPU_BENCH_CONC": "16"}),
-    ("serving_load_32", {"DSTPU_BENCH_MODE": "serving_load",
-                         "DSTPU_BENCH_CONC": "32"}),
-    ("serving_load_64", {"DSTPU_BENCH_MODE": "serving_load",
-                         "DSTPU_BENCH_CONC": "64"}),
     ("offload_step", {"DSTPU_BENCH_MODE": "offload"}),
 ]
 
