@@ -100,8 +100,22 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
             & ~seq_valid(t),
             lambda t: t + 1, s)
 
+    def eff_kvl(s):
+        """Causal context bound for THIS query block: the highest query row
+        of sequence s in the block attends keys up to its own absolute
+        position, so chunks past it are fully masked — skip their DMA and
+        compute entirely (the flash-attention causal skip, per sequence).
+        Decode (q_len 1) reduces to kvl; prefill blocks early in a long
+        prompt walk only their causal prefix (~2x less work overall)."""
+        s_c = jnp.minimum(s, S - 1)
+        kvl = kvl_ref[s_c]
+        q1 = cu(s_c + 1)
+        t_max = jnp.minimum(blk_end, q1) - 1          # last query row here
+        p_max = kvl - q1 + t_max                      # its absolute position
+        return jnp.clip(p_max + 1, 0, kvl)
+
     def page_needed(s, page_idx):
-        return page_idx * ps < kvl_ref[jnp.minimum(s, S - 1)]
+        return page_idx * ps < eff_kvl(s)
 
     def chunk_dma(s, c, slot, p):
         page_idx = c * P + p
@@ -146,12 +160,13 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
         q_pos = kvl - (q1 - q0) + (t - q0)           # absolute position
         mask = (t >= q0) & (t < q1) & (k_pos <= q_pos) & (k_pos < kvl)
         kv = kv_bufs[slot]                           # [P, ps, 2KV, hd]
-        # pages past kv_len are never DMA'd — their buffer rows hold stale /
-        # uninitialized data.  Scores there are masked, but V must be zeroed
-        # too: softmax weights for REAL rows are exactly 0 on those columns
-        # and 0·garbage(NaN) would still poison the accumulate.
+        # pages past this block's CAUSAL bound (eff_kvl <= kv_len) are never
+        # DMA'd — their buffer rows hold stale / uninitialized data.  Scores
+        # there are masked, but V must be zeroed too: softmax weights for
+        # REAL rows are exactly 0 on those columns and 0·garbage(NaN) would
+        # still poison the accumulate.
         col_ok = jax.lax.broadcasted_iota(
-            jnp.int32, (CH, 1), 0) + chunk_base < kvl
+            jnp.int32, (CH, 1), 0) + chunk_base < eff_kvl(s)
         for h in range(KV):
             qh = q_ref[:, h * G:(h + 1) * G, :].reshape(rows, -1) \
                 .astype(jnp.float32)
@@ -190,7 +205,7 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
     # ---- main walk: (sequence, chunk) pairs, double-buffered ------------ #
     def body(state):
         s, c, slot = state
-        nch = _cdiv(kvl_ref[jnp.minimum(s, S - 1)], CH)
+        nch = _cdiv(eff_kvl(s), CH)
         has_next = c + 1 < nch
         s_next = jnp.where(has_next, s, next_valid(s + 1))
         c_next = jnp.where(has_next, c + 1, 0)
