@@ -86,11 +86,12 @@ def _parse_result_line(path):
     return best
 
 
-def _newest_cached_tpu():
+def _newest_cached_tpu(metric=None):
     """bench_logs/wd_*.json silicon evidence from earlier relay windows,
     embedded whenever the live probe fails so a down relay can't erase the
-    round's on-chip numbers (VERDICT r3 #5).  Returns the newest parsed
-    result in full plus a one-line summary of every other wd file."""
+    round's on-chip numbers (VERDICT r3 #5).  Features the newest window
+    matching the metric being emitted (falling back to the overall newest)
+    plus a one-line summary of every other wd file."""
     import glob
 
     cands = sorted(glob.glob(os.path.join(os.path.dirname(
@@ -105,17 +106,40 @@ def _newest_cached_tpu():
         return time.strftime("%Y-%m-%dT%H:%M:%SZ",
                              time.gmtime(os.path.getmtime(p)))
 
-    path, data = parsed[-1]
+    def plausible(d):
+        """The same physical gate emit() applies to live values: a cached
+        window carrying a >peak TFLOP/s or MFU>1 artifact (e.g. the r3
+        relay-dispatch-collapse flash number) must never be featured as
+        silicon evidence."""
+        if d.get("unit") == "TFLOP/s" and d.get("value", 0) > 460:
+            return False          # above any current chip's bf16 peak
+        mfu = (d.get("extra") or {}).get("mfu")
+        if isinstance(mfu, (int, float)) and mfu > 1.0:
+            return False
+        return not (d.get("extra") or {}).get("error")
+
+    ok = [(p, d) for p, d in parsed if plausible(d)]
+    if not ok:
+        return None
+    same = [(p, d) for p, d in ok if d.get("metric") == metric]
+    path, data = (same or ok)[-1]
+    note = ("cached on-chip result from an earlier relay window "
+            "(live TPU probe failed this run)")
+    mismatch = data.get("metric") != metric
+    if mismatch:
+        note += (f" — NO cached window exists for metric {metric!r}; "
+                 f"this is the newest window of a DIFFERENT metric")
     return {
         "file": os.path.basename(path),
         "recorded_at": stamp(path),
-        "note": "cached on-chip result from an earlier relay window "
-                "(live TPU probe failed this run)",
+        "note": note,
+        "metric_mismatch": mismatch,
         "data": data,
         "all_windows": [
             {"file": os.path.basename(p), "recorded_at": stamp(p),
              "metric": d.get("metric"), "value": d.get("value"),
-             "unit": d.get("unit")}
+             "unit": d.get("unit"),
+             **({} if plausible(d) else {"rejected": "implausible"})}
             for p, d in parsed],
     }
 
@@ -141,7 +165,7 @@ def emit(metric, value, unit, vs_baseline, extra):
         extra["mfu"] = 0.0
         value, vs_baseline = 0.0, 0.0
     if not _ON_TPU:
-        cached = _newest_cached_tpu()
+        cached = _newest_cached_tpu(metric)
         if cached is not None:
             extra["cached_tpu"] = cached
     print(json.dumps({

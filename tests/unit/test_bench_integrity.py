@@ -59,6 +59,51 @@ class TestEmitGates:
         finally:
             bench._ON_TPU = False
 
+    def test_cached_selection_prefers_metric_and_rejects_implausible(self):
+        """An OLDER window of the emitted metric beats a newer other-metric
+        window; implausible windows (the r3 >peak flash artifact) are never
+        featured; a mismatch fallback is flagged."""
+        import json as j
+        import os
+        import shutil
+        import tempfile
+        import time as t
+
+        d = tempfile.mkdtemp()
+        logs = os.path.join(d, "bench_logs")
+        os.makedirs(logs)
+
+        def wd(name, payload, age):
+            p = os.path.join(logs, name)
+            with open(p, "w") as f:
+                f.write("[engine] noise\n" + j.dumps(payload) + "\n")
+            os.utime(p, (t.time() - age, t.time() - age))
+
+        wd("wd_train.json", {"metric": "train_tok", "value": 100,
+                             "unit": "tok/s", "extra": {"mfu": 0.4}}, 300)
+        wd("wd_serving.json", {"metric": "serving", "value": 5,
+                               "unit": "tok/s", "extra": {}}, 100)
+        wd("wd_flash.json", {"metric": "flash", "value": 3831.6,
+                             "unit": "TFLOP/s", "extra": {}}, 50)
+        orig = bench.os.path.dirname
+        real_file = bench.os.path.abspath(bench.__file__)
+        try:
+            bench.os.path.dirname = \
+                lambda p: d if p == real_file else orig(p)
+            got = bench._newest_cached_tpu("train_tok")
+            assert got["file"] == "wd_train.json"      # older but matching
+            assert got["metric_mismatch"] is False
+            got = bench._newest_cached_tpu("flash")
+            assert got["file"] != "wd_flash.json"      # implausible rejected
+            assert got["metric_mismatch"] is True      # fallback flagged
+            assert "DIFFERENT metric" in got["note"]
+            flagged = [w for w in got["all_windows"]
+                       if w["file"] == "wd_flash.json"]
+            assert flagged[0].get("rejected") == "implausible"
+        finally:
+            bench.os.path.dirname = orig
+            shutil.rmtree(d)
+
     def test_watchdog_log_parser(self):
         import os
         import tempfile
