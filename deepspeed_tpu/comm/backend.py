@@ -56,11 +56,21 @@ class XlaBackend(Backend):
         import jax
 
         if num_processes is not None and num_processes > 1:
-            jax.distributed.initialize(
-                coordinator_address=coordinator_address,
-                num_processes=num_processes,
-                process_id=process_id,
-            )
+            try:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator_address,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                )
+            except Exception:
+                # jax sets its global client/service state BEFORE connecting;
+                # without this reset a retry would die on jax's "initialize
+                # should only be called once" guard instead of reconnecting
+                try:
+                    jax.distributed.shutdown()
+                except Exception:
+                    pass
+                raise
         self.initialized = True
 
     def get_rank(self) -> int:

@@ -24,6 +24,9 @@ import time
 from enum import Enum
 from typing import Any, Optional, Sequence, Tuple, Union
 
+from ..runtime.fault import injection as _fault_injection
+from ..runtime.fault.retry import RetryPolicy as _RetryPolicy
+from ..runtime.fault.retry import retryable
 from ..utils.comms_logging import CommsLogger, get_caller_func
 from ..utils.logging import logger
 from .backend import XlaBackend
@@ -110,13 +113,40 @@ def init_distributed(
                 f"explicitly or use a hostname-based hostfile")
 
     cdb = XlaBackend()
-    cdb.init_process_group(
+    retryable("comm_init", policy=_comm_init_policy())(_init_process_group)(
+        cdb,
         coordinator_address=coordinator_address,
         num_processes=world_size,
         process_id=rank,
     )
     if config:
         configure(config)
+
+
+def _comm_init_policy():
+    """Backoff policy for the bootstrap (DSTPU_RETRY_* env — this runs before
+    any config exists), extended to retry jax's coordinator errors:
+    ``jax.distributed.initialize`` surfaces a refused/timed-out coordinator
+    connection as ``JaxRuntimeError``, not ``OSError``."""
+    import dataclasses
+
+    base = _RetryPolicy.from_env()
+    retry_on = base.retry_on
+    try:
+        from jax.errors import JaxRuntimeError
+
+        retry_on = retry_on + (JaxRuntimeError,)
+    except ImportError:
+        pass
+    return dataclasses.replace(base, retry_on=retry_on)
+
+
+def _init_process_group(backend: XlaBackend, **kwargs) -> None:
+    """Bootstrap body, retried with backoff+jitter: under gang restarts the
+    coordinator routinely comes up seconds after its workers, and one refused
+    connection must not kill a fresh worker group."""
+    _fault_injection.inject("comm_init")
+    backend.init_process_group(**kwargs)
 
 
 def is_initialized() -> bool:

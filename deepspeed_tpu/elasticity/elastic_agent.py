@@ -5,10 +5,19 @@ TPU formulation: torchelastic's rendezvous is replaced by
 ``jax.distributed.initialize`` (coordinator address in env) and recovery is
 "restart all workers from the latest (reshardable) universal checkpoint".
 The agent owns the worker processes: it spawns one per local rank, monitors
-exits, and on any failure tears the group down (SIGTERM — never SIGKILL a
-live TPU client) and restarts the whole gang with a fresh rendezvous, up to
-``max_restarts`` times.  ``DSTPU_ELASTIC_RESTART_COUNT`` tells workers they
-are a restart so they resume from their checkpoint.
+exits, and on any failure tears the group down and restarts the whole gang
+with a fresh rendezvous, up to ``max_restarts`` times, sleeping an
+exponentially backed-off (jittered) delay between restarts so a crash-looping
+gang doesn't hammer the coordinator or the checkpoint store.
+``DSTPU_ELASTIC_RESTART_COUNT`` tells workers they are a restart so they
+resume from their checkpoint.
+
+Termination is two-phase: SIGTERM, a ``term_timeout`` grace period for the
+worker to flush its checkpoint client, then SIGKILL (``escalate_kill=False``
+opts out for live TPU clients whose runtime must wind down on its own).  The
+agent itself shuts down gracefully on SIGTERM/SIGINT: the current gang is
+terminated with the same two-phase protocol and ``run()`` returns instead of
+leaving orphans.
 """
 from __future__ import annotations
 
@@ -17,9 +26,11 @@ import signal
 import socket
 import subprocess
 import sys
+import threading
 import time
 from typing import Dict, List, Optional, Sequence
 
+from ..runtime.fault.retry import RetryPolicy, record_fault_event
 from ..utils.logging import logger
 
 
@@ -39,19 +50,29 @@ class DSElasticAgent:
     Parameters mirror the reference agent's spec: ``cmd`` is the worker
     command line; each worker gets RANK/WORLD_SIZE/MASTER_ADDR/MASTER_PORT
     plus COORDINATOR_ADDRESS for ``jax.distributed.initialize``.
+    ``restart_policy`` shapes the between-restart backoff (its ``retry_on``
+    is irrelevant here — only the delay schedule is used).
     """
 
     def __init__(self, cmd: Sequence[str], world_size: int,
                  max_restarts: int = 3, monitor_interval: float = 0.5,
                  env: Optional[Dict[str, str]] = None,
-                 term_timeout: float = 30.0):
+                 term_timeout: float = 30.0, kill_timeout: float = 5.0,
+                 escalate_kill: bool = True,
+                 restart_policy: Optional[RetryPolicy] = None):
         self.cmd = list(cmd)
         self.world_size = int(world_size)
         self.max_restarts = int(max_restarts)
         self.monitor_interval = float(monitor_interval)
         self.base_env = dict(env if env is not None else os.environ)
         self.term_timeout = term_timeout
+        self.kill_timeout = kill_timeout
+        self.escalate_kill = escalate_kill
+        self.restart_policy = restart_policy or RetryPolicy(
+            max_retries=max_restarts, base_s=1.0, cap_s=30.0)
         self.restart_count = 0
+        self._procs: List[subprocess.Popen] = []
+        self._shutdown = threading.Event()
 
     # -------------------------------------------------------------- #
     def _spawn_workers(self) -> List[subprocess.Popen]:
@@ -75,42 +96,98 @@ class DSElasticAgent:
         return procs
 
     def _terminate(self, procs: List[subprocess.Popen]) -> None:
+        """Two-phase teardown: SIGTERM, grace period, then SIGKILL."""
         for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGTERM)
         deadline = time.time() + self.term_timeout
+        stubborn = []
         for p in procs:
             remaining = max(deadline - time.time(), 0.1)
             try:
                 p.wait(timeout=remaining)
             except subprocess.TimeoutExpired:
+                stubborn.append(p)
+        if not stubborn:
+            return
+        if not self.escalate_kill:
+            for p in stubborn:
                 logger.warning(f"worker pid {p.pid} ignored SIGTERM; leaving "
-                               f"it to the OS (never SIGKILL a TPU client)")
+                               f"it to the OS (escalate_kill disabled — "
+                               f"never SIGKILL a live TPU client)")
+            return
+        for p in stubborn:
+            logger.warning(f"worker pid {p.pid} ignored SIGTERM for "
+                           f"{self.term_timeout}s; escalating to SIGKILL")
+            record_fault_event("elastic/sigkill")
+            p.kill()
+        deadline = time.time() + self.kill_timeout
+        for p in stubborn:
+            try:
+                p.wait(timeout=max(deadline - time.time(), 0.1))
+            except subprocess.TimeoutExpired:
+                logger.error(f"worker pid {p.pid} survived SIGKILL "
+                             f"(unkillable/D-state); abandoning it")
 
     # -------------------------------------------------------------- #
-    def run(self) -> int:
-        """Reference ``_invoke_run``: monitor until success or restart
-        budget exhausted.  Returns 0 on success."""
-        while True:
-            procs = self._spawn_workers()
-            failed: Optional[int] = None
-            while True:
-                states = [p.poll() for p in procs]
-                if any(rc not in (None, 0) for rc in states):
-                    failed = next(rc for rc in states if rc not in (None, 0))
-                    break
-                if all(rc == 0 for rc in states):
-                    return 0
-                time.sleep(self.monitor_interval)
+    def shutdown(self, signum: Optional[int] = None, frame=None) -> None:
+        """Graceful stop: tear the current gang down and make run() return.
+        Installed as the SIGTERM/SIGINT handler; safe to call from any
+        thread."""
+        if signum is not None:
+            logger.info(f"elastic agent: received signal {signum}; shutting "
+                        f"down worker group")
+        self._shutdown.set()
 
-            logger.warning(f"elastic agent: worker failed rc={failed} "
-                           f"(restart {self.restart_count}/{self.max_restarts})")
-            self._terminate(procs)
-            if self.restart_count >= self.max_restarts:
-                raise WorkerGroupFailure(
-                    f"worker group failed rc={failed} after "
-                    f"{self.restart_count} restarts")
-            self.restart_count += 1
+    def _install_signal_handlers(self):
+        if threading.current_thread() is not threading.main_thread():
+            return {}
+        previous = {}
+        for sig in (signal.SIGTERM, signal.SIGINT):
+            previous[sig] = signal.signal(sig, self.shutdown)
+        return previous
+
+    def run(self) -> int:
+        """Reference ``_invoke_run``: monitor until success, graceful
+        shutdown, or restart budget exhausted.  Returns 0 on success/
+        shutdown."""
+        previous = self._install_signal_handlers()
+        try:
+            while True:
+                self._procs = self._spawn_workers()
+                failed: Optional[int] = None
+                while True:
+                    if self._shutdown.is_set():
+                        logger.info("elastic agent: graceful shutdown — "
+                                    "terminating worker group")
+                        self._terminate(self._procs)
+                        return 0
+                    states = [p.poll() for p in self._procs]
+                    if any(rc not in (None, 0) for rc in states):
+                        failed = next(rc for rc in states if rc not in (None, 0))
+                        break
+                    if all(rc == 0 for rc in states):
+                        return 0
+                    self._shutdown.wait(self.monitor_interval)
+
+                logger.warning(
+                    f"elastic agent: worker failed rc={failed} "
+                    f"(restart {self.restart_count}/{self.max_restarts})")
+                self._terminate(self._procs)
+                if self.restart_count >= self.max_restarts:
+                    raise WorkerGroupFailure(
+                        f"worker group failed rc={failed} after "
+                        f"{self.restart_count} restarts")
+                delay = self.restart_policy.delay(self.restart_count)
+                record_fault_event("elastic/restarts")
+                logger.info(f"elastic agent: restarting worker group in "
+                            f"{delay:.2f}s (backoff)")
+                if self._shutdown.wait(delay):
+                    return 0
+                self.restart_count += 1
+        finally:
+            for sig, handler in previous.items():
+                signal.signal(sig, handler)
 
 
 def main(argv: Optional[Sequence[str]] = None) -> None:
@@ -121,12 +198,18 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     parser = argparse.ArgumentParser()
     parser.add_argument("--world-size", type=int, default=1)
     parser.add_argument("--max-restarts", type=int, default=3)
+    parser.add_argument("--term-timeout", type=float, default=30.0)
+    parser.add_argument("--no-escalate-kill", action="store_true",
+                        help="never SIGKILL a worker that ignores SIGTERM "
+                             "(leave live TPU clients to the OS)")
     parser.add_argument("cmd", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
     if not cmd:
         parser.error("worker command required after --")
-    agent = DSElasticAgent(cmd, args.world_size, args.max_restarts)
+    agent = DSElasticAgent(cmd, args.world_size, args.max_restarts,
+                           term_timeout=args.term_timeout,
+                           escalate_kill=not args.no_escalate_kill)
     sys.exit(agent.run())
 
 

@@ -113,6 +113,17 @@ class csvMonitor(Monitor):  # reference class name
                 w.writerow([step, value])
 
 
+def fault_events(step: int) -> List[Event]:
+    """Fault-subsystem counters (``Fault/retries``, ``Fault/watchdog_timeouts``,
+    ``Fault/injected/*`` …) as monitor events.  Retries that silently succeed
+    are still a storage-health signal worth graphing — a run whose retry curve
+    climbs is about to become a run that fails."""
+    from ..runtime.fault.retry import fault_counters
+
+    return [(f"Fault/{label}", float(value), step)
+            for label, value in sorted(fault_counters().items())]
+
+
 class MonitorMaster(Monitor):
     def __init__(self, ds_config):
         from ..runtime.config import MonitorWriterConfig

@@ -7,51 +7,96 @@ and — because arrays are stored with global shape + shard metadata — every
 checkpoint is "universal" (reshardable across world sizes) by construction,
 which is the key property of the reference's universal checkpoint format
 (``deepspeed/checkpoint/ds_to_universal.py``).
+
+Fault tolerance (``runtime/fault/``): every save ends by writing a
+``manifest.json`` integrity record, ``commit()`` verifies the tag and updates
+the ``latest`` pointer atomically (tmp + fsync + ``os.replace``), and
+``load()``/``latest_tag()`` verify before trusting — a dangling or corrupt
+``latest`` falls back to the newest *valid* older tag instead of resuming
+from garbage.  Save/load/commit retry transient I/O with exponential
+backoff + jitter per the engine's :class:`~..fault.retry.RetryPolicy`.
 """
 from __future__ import annotations
 
 import json
 import os
-from typing import Any, Optional
+from typing import Any, List, Optional
 
+from ...utils.logging import logger
+from ..fault import injection
+from ..fault.atomic import atomic_write_text
+from ..fault.manifest import (CheckpointCorruptError, is_valid_checkpoint,
+                              read_manifest, verify_checkpoint, write_manifest)
+from ..fault.retry import RetryPolicy, retryable
 from .checkpoint_engine import CheckpointEngine
 
 LATEST_FILE = "latest"  # same pointer-file convention as the reference
+HISTORY_FILE = "commit_history"  # committed tags, oldest first
+HISTORY_LIMIT = 100
 
 
 class OrbaxCheckpointEngine(CheckpointEngine):
-    def __init__(self, ckpt_dir: str):
+    def __init__(self, ckpt_dir: str, fault_config: Any = None):
         super().__init__(os.path.abspath(ckpt_dir))
         os.makedirs(self.ckpt_dir, exist_ok=True)
+        self.retry_policy = RetryPolicy.from_config(fault_config)
+        self.verify = bool(getattr(fault_config, "verify_checkpoints", True))
+        self._verified_tags: set = set()   # tags this instance already verified
 
     def _path(self, tag: str) -> str:
         return os.path.join(self.ckpt_dir, str(tag))
 
+    # -------------------------------------------------------------- #
+    @retryable("ckpt_save")
     def save(self, payload: Any, tag: str) -> None:
         import orbax.checkpoint as ocp
 
-        state = payload.pop("state") if isinstance(payload, dict) else payload
+        injection.inject("ckpt_save")
         path = self._path(tag)
-        with ocp.PyTreeCheckpointer() as ckptr:
-            ckptr.save(os.path.join(path, "state"), state, force=True)
-        if isinstance(payload, dict):
-            meta = {k: v for k, v in payload.items()}
-            with open(os.path.join(path, "meta.json"), "w") as f:
-                json.dump(meta, f, default=_jsonable)
-            payload["state"] = state  # restore caller's dict
+        is_dict = isinstance(payload, dict)
+        state = payload.pop("state") if is_dict else payload
+        try:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                ckptr.save(os.path.join(path, "state"), state, force=True)
+            if is_dict:
+                meta = {k: v for k, v in payload.items()}
+                atomic_write_text(os.path.join(path, "meta.json"),
+                                  json.dumps(meta, default=_jsonable))
+        finally:
+            if is_dict:
+                payload["state"] = state  # restore caller's dict on ALL paths
+        # written last: its presence certifies a complete checkpoint
+        write_manifest(path, extra={"tag": str(tag), "step": _tag_step(tag)})
+        # torn-write injection AFTER the manifest is sealed, so the damage is
+        # something verification must catch — not something it certifies
+        injection.inject("ckpt_meta", path=os.path.join(path, "meta.json"))
+        # this instance just sealed the tag: trust it for commit()/load()
+        # (corruption between now and then is caught by the loading process's
+        # own verification — that engine instance has a cold cache)
+        self._verified_tags.add(str(tag))
 
+    @retryable("ckpt_load")
     def load(self, template: Any, tag: str) -> Any:
         import orbax.checkpoint as ocp
 
+        injection.inject("ckpt_load")
         path = self._path(tag)
-        state_t = template.pop("state") if isinstance(template, dict) else template
-        with ocp.PyTreeCheckpointer() as ckptr:
-            restore_args = ocp.checkpoint_utils.construct_restore_args(state_t)
-            state = ckptr.restore(
-                os.path.join(path, "state"), item=state_t,
-                restore_args=restore_args)
-        if isinstance(template, dict):
-            template["state"] = state_t
+        # skip re-hashing a tag this instance just verified in latest_tag() —
+        # on a network filesystem the metadata walk is the expensive part
+        if self.verify and str(tag) not in self._verified_tags:
+            verify_checkpoint(path)  # raises CheckpointCorruptError
+        is_dict = isinstance(template, dict)
+        state_t = template.pop("state") if is_dict else template
+        try:
+            with ocp.PyTreeCheckpointer() as ckptr:
+                restore_args = ocp.checkpoint_utils.construct_restore_args(state_t)
+                state = ckptr.restore(
+                    os.path.join(path, "state"), item=state_t,
+                    restore_args=restore_args)
+        finally:
+            if is_dict:
+                template["state"] = state_t  # restore caller's dict on ALL paths
+        if is_dict:
             out = {"state": state}
             meta_path = os.path.join(path, "meta.json")
             if os.path.exists(meta_path):
@@ -60,16 +105,135 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             return out
         return state
 
+    @retryable("ckpt_commit")
     def commit(self, tag: str) -> None:
-        with open(os.path.join(self.ckpt_dir, LATEST_FILE), "w") as f:
-            f.write(str(tag))
+        """Point ``latest`` at ``tag`` — only after verifying the tag is a
+        complete checkpoint, and atomically (tmp + fsync + ``os.replace``)
+        so a crashed committer can never leave a torn pointer."""
+        injection.inject("ckpt_commit")
+        if self.verify and str(tag) not in self._verified_tags:
+            verify_checkpoint(self._path(tag))
+            self._verified_tags.add(str(tag))
+        elif not self.verify and not self._dir_nonempty(tag):
+            # even unverified, never publish a pointer to nothing
+            raise CheckpointCorruptError(
+                f"{self._path(tag)}: cannot commit a missing/empty checkpoint")
+        atomic_write_text(os.path.join(self.ckpt_dir, LATEST_FILE), str(tag))
+        history = self.committed_tags()
+        if not history or history[-1] != str(tag):
+            history.append(str(tag))
+            atomic_write_text(os.path.join(self.ckpt_dir, HISTORY_FILE),
+                              "\n".join(history[-HISTORY_LIMIT:]) + "\n")
+
+    def committed_tags(self) -> List[str]:
+        """Tags ever published via commit(), oldest first (fallback
+        candidates: a save with ``save_latest=False`` is deliberately
+        unpublished and must never be resumed from)."""
+        p = os.path.join(self.ckpt_dir, HISTORY_FILE)
+        if not os.path.exists(p):
+            return []
+        with open(p) as f:
+            return [line.strip() for line in f if line.strip()]
+
+    # -------------------------------------------------------------- #
+    def all_tags(self) -> List[str]:
+        """Checkpoint tags on disk, newest first (by manifest step, then
+        pointer-file mtime as the tie-break for legacy tags)."""
+        tags = [t for t in os.listdir(self.ckpt_dir)
+                if os.path.isdir(self._path(t))]
+
+        def key(t):
+            m = None
+            try:
+                m = read_manifest(self._path(t))
+            except CheckpointCorruptError:
+                pass
+            step = (m or {}).get("step")
+            if step is None:
+                step = _tag_step(t)
+            return (step if step is not None else -1,
+                    os.path.getmtime(self._path(t)))
+
+        return sorted(tags, key=key, reverse=True)
+
+    def valid_tags(self) -> List[str]:
+        return [t for t in self.all_tags()
+                if is_valid_checkpoint(self._path(t))]
+
+    def _dir_nonempty(self, tag: str) -> bool:
+        try:
+            return bool(os.listdir(self._path(tag)))
+        except OSError:
+            return False
+
+    def _tag_ok(self, tag: str, require_manifest: bool = False) -> bool:
+        """Is ``tag`` safe to hand out?  Full manifest verification when
+        enabled; with ``verify_checkpoints`` disabled, still require the
+        directory to exist and be non-empty — a dangling pointer is never a
+        loadable checkpoint.  ``require_manifest=True`` (the fallback scan)
+        additionally rejects manifest-less directories: a save torn before
+        the manifest was sealed looks exactly like a legacy checkpoint, and
+        only an explicitly pointed/requested tag gets that benefit of the
+        doubt."""
+        path = self._path(tag)
+        if not self.verify:
+            return self._dir_nonempty(tag)
+        try:
+            verify_checkpoint(path, require_manifest=require_manifest)
+        except CheckpointCorruptError:
+            return False
+        self._verified_tags.add(str(tag))
+        return True
 
     def latest_tag(self) -> Optional[str]:
+        """The committed tag — or, when the pointer dangles or the pointed-to
+        checkpoint is incomplete/corrupt, the newest valid older *committed*
+        tag (a commit-history store never falls back to an unpublished save;
+        stores without a history file scan every tag, for layouts predating
+        it)."""
         p = os.path.join(self.ckpt_dir, LATEST_FILE)
-        if not os.path.exists(p):
-            return None
-        with open(p) as f:
-            return f.read().strip()
+        pointed = None
+        if os.path.exists(p):
+            with open(p) as f:
+                pointed = f.read().strip() or None
+        if pointed is not None:
+            if self._tag_ok(pointed):
+                return pointed
+            logger.warning(
+                f"checkpoint {self.ckpt_dir}/{pointed} (the committed "
+                f"'latest') is missing, incomplete, or corrupt; scanning "
+                f"for the newest valid older tag")
+        committed = self.committed_tags()
+        if committed:
+            candidates = list(reversed(committed))
+        elif not self.verify:
+            candidates = self.all_tags()   # unverified legacy stores only
+        else:
+            # no commit ever happened here: with verification on, anything a
+            # scan could turn up is either a torn save (no manifest) or a
+            # deliberately unpublished one (save_latest=False) — neither may
+            # be auto-resumed
+            candidates = []
+        for tag in candidates:
+            if tag == pointed:
+                continue
+            # scan candidates must carry a manifest: a torn pre-manifest save
+            # is indistinguishable from a legacy checkpoint by layout alone
+            if self._tag_ok(tag, require_manifest=True):
+                logger.warning(f"falling back to valid checkpoint "
+                               f"{self.ckpt_dir}/{tag}")
+                return tag
+        return None
+
+
+def _tag_step(tag) -> Optional[int]:
+    """Best-effort step number from a ``global_step{N}``-style tag: the
+    TRAILING integer only (concatenating every digit would rank
+    ``epoch1_step99`` above ``epoch2_step5``)."""
+    import re
+
+    m = re.search(r"(\d+)\s*$", str(tag))
+    return int(m.group(1)) if m else None
 
 
 def _jsonable(obj):

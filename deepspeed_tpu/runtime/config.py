@@ -184,6 +184,31 @@ class ElasticityConfig(DeepSpeedConfigModel):
     prefer_larger_batch: bool = True
 
 
+class FaultConfig(DeepSpeedConfigModel):
+    """Fault-tolerance knobs (``runtime/fault/``): retry/backoff for
+    transient I/O, checkpoint verification, and the training watchdog.
+
+    Fault *injection* is deliberately not configurable here — it comes only
+    from the ``DSTPU_FAULT_INJECT`` env var (see ``fault/injection.py``) so a
+    production config can never ship with faults enabled.
+    """
+
+    #: retries after the first attempt for checkpoint/comm I/O
+    max_retries: int = 3
+    retry_base_s: float = 0.05
+    retry_cap_s: float = 2.0
+    #: fraction of each backoff delay randomized (anti thundering-herd)
+    retry_jitter: float = 0.25
+    #: verify checkpoint manifests on load and honor fallback-to-valid-tag
+    verify_checkpoints: bool = True
+    watchdog_enabled: bool = False
+    #: max seconds between engine heartbeats before the watchdog reports
+    watchdog_deadline_s: float = 600.0
+    #: raise WatchdogTimeout from the training thread after a timeout
+    #: (default: log the post-mortem dump and keep waiting)
+    watchdog_raise: bool = False
+
+
 class AutotuningConfig(DeepSpeedConfigModel):
     enabled: bool = False
     fast: bool = True
@@ -288,6 +313,7 @@ class DeepSpeedConfig:
                              f"known: ['deterministic', 'nan_check']")
         self.compression_config = CompressionConfig(**config.get("compression_training", {}))
         self.elasticity = ElasticityConfig(**config.get("elasticity", {}))
+        self.fault = FaultConfig(**config.get("fault", {}))
         self.autotuning_config = AutotuningConfig(**config.get("autotuning", {}))
 
         self.sequence_parallel_size: int = config.get("sequence_parallel_size", 1)

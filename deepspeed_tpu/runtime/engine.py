@@ -38,6 +38,7 @@ from ..accelerator import get_accelerator
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .config import DeepSpeedConfig
+from .fault import injection as fault_injection
 from .fp16.loss_scaler import LossScaler, LossScalerState, create_loss_scaler
 from .lr_schedules import build_scheduler, get_schedule_fn
 from .optimizer import build_optimizer
@@ -234,6 +235,7 @@ class DeepSpeedEngine:
         self._compiled: Dict[str, Any] = {}
         self._losses: list = []
         self.monitor = self._configure_monitor()
+        self.watchdog = self._configure_watchdog()
 
         log_dist(
             f"engine ready: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
@@ -307,6 +309,32 @@ class DeepSpeedEngine:
             return MonitorMaster(self.config)
         except Exception:
             return None
+
+    def _configure_watchdog(self):
+        """Heartbeat thread over the step loop (``config.fault``): dumps the
+        last step/phase when a step or collective exceeds the deadline."""
+        fcfg = getattr(self.config, "fault", None)
+        if fcfg is None or not fcfg.watchdog_enabled:
+            return None
+        from .fault.watchdog import Watchdog
+
+        wd = Watchdog(deadline_s=fcfg.watchdog_deadline_s,
+                      raise_on_timeout=fcfg.watchdog_raise)
+        return wd.start()
+
+    def _heartbeat(self, phase: str, step: Optional[int] = None):
+        """Watchdog ping.  ``step`` must be a HOST-side int callers already
+        have — reading ``state.global_step`` here would force a device sync
+        on the hot path; with step=None the watchdog keeps its last value."""
+        if self.watchdog is not None:
+            self.watchdog.ping(step=step, phase=phase)
+
+    def close(self):
+        """Release host-side resources (watchdog thread); engine state and
+        compiled functions stay usable."""
+        if self.watchdog is not None:
+            self.watchdog.stop()
+            self.watchdog = None
 
     # ------------------------------------------------------------------ #
     # Introspection API (reference names)
@@ -486,6 +514,10 @@ class DeepSpeedEngine:
                 lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch)
         if "train_batch" not in self._compiled:
             self._compiled["train_batch"] = self._build_train_batch_fn()
+        self._heartbeat("train_batch")
+        injector = fault_injection.get_injector()
+        if injector is not None:   # don't pay the global_steps sync otherwise
+            injector.inject("step", step=self.global_steps)
         # Device-time attribution (reference: CUDA-event comms timing;
         # comms_logger.xprof_step): wrap ONE step in an xprof trace — per-op
         # device durations, collectives included.  A wrapper, not a separate
@@ -525,6 +557,7 @@ class DeepSpeedEngine:
     def _post_step_logging(self, loss, batch):
         self._write_monitor_events(loss)
         step = self.global_steps
+        self._heartbeat("idle", step=step)   # reuse the sync we just paid for
         cfg = self.config
         if cfg.steps_per_print and step > 0 and step % cfg.steps_per_print == 0:
             log_dist(f"step={step} loss={float(loss):.4f} "
@@ -594,6 +627,9 @@ class DeepSpeedEngine:
                   ("Train/Samples/lr", self.get_lr()[0], self.global_samples)]
         if self.loss_scaler.dynamic:
             events.append(("Train/Samples/loss_scale", self.get_loss_scale(), self.global_samples))
+        from ..monitor.monitor import fault_events
+
+        events.extend(fault_events(step))
         self.monitor.write_events(events)
 
     # ------------------------------------------------------------------ #
@@ -673,6 +709,7 @@ class DeepSpeedEngine:
             self._compiled.pop("micro", None)
         if "micro" not in self._compiled:
             self._compiled["micro"] = self._build_micro_fn()
+        self._heartbeat("backward")
         if self.config.wall_clock_breakdown:
             self._timers("backward").start()
         self.state, loss = self._compiled["micro"](self.state, batch)
@@ -688,10 +725,12 @@ class DeepSpeedEngine:
             return
         if "step" not in self._compiled:
             self._compiled["step"] = self._build_step_fn()
+        self._heartbeat("optimizer_step")
         self.state = self._compiled["step"](self.state)
         if self._losses:
             self._write_monitor_events(self._losses[-1])
             self._losses.clear()
+        self._heartbeat("idle")
 
     def eval_batch(self, batch):
         out = self.forward(batch)
@@ -706,7 +745,9 @@ class DeepSpeedEngine:
         from .checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
 
         tag = tag or f"global_step{self.global_steps}"
-        engine = OrbaxCheckpointEngine(save_dir)
+        self._heartbeat("checkpoint_save")
+        engine = OrbaxCheckpointEngine(save_dir,
+                                       fault_config=getattr(self.config, "fault", None))
         payload = {
             "state": self.state,
             "client_state": client_state or {},
@@ -718,6 +759,7 @@ class DeepSpeedEngine:
         engine.save(payload, tag)
         if save_latest:
             engine.commit(tag)
+        self._heartbeat("idle")
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
         return True
 
@@ -727,11 +769,13 @@ class DeepSpeedEngine:
                         load_module_only: bool = False):
         from .checkpoint_engine.orbax_checkpoint_engine import OrbaxCheckpointEngine
 
-        engine = OrbaxCheckpointEngine(load_dir)
+        self._heartbeat("checkpoint_load")
+        engine = OrbaxCheckpointEngine(load_dir,
+                                       fault_config=getattr(self.config, "fault", None))
         if tag is None:
-            tag = engine.latest_tag()
+            tag = engine.latest_tag()  # falls back to the newest VALID tag
             if tag is None:
-                logger.warning(f"no checkpoint found under {load_dir}")
+                logger.warning(f"no (valid) checkpoint found under {load_dir}")
                 return None, {}
         payload = engine.load({"state": self.state, "client_state": None,
                                "lr_scheduler": None, "config": None}, tag)
@@ -749,6 +793,7 @@ class DeepSpeedEngine:
         if load_lr_scheduler_states and payload.get("lr_scheduler") and \
                 hasattr(self.lr_scheduler, "load_state_dict"):
             self.lr_scheduler.load_state_dict(payload["lr_scheduler"])
+        self._heartbeat("idle")
         log_dist(f"loaded checkpoint {load_dir}/{tag}", ranks=[0])
         return os.path.join(load_dir, str(tag)), payload.get("client_state", {})
 

@@ -1,0 +1,27 @@
+"""Fault-tolerance subsystem (reference analogues: checkpoint commit
+semantics in ``runtime/checkpoint_engine``, restart agents in
+``elasticity/``, plus what large-scale practice assumes: workers die,
+storage flakes, collectives hang).
+
+Four cooperating pieces, wired through the engine / checkpoint engine /
+elastic agent / comm bootstrap:
+
+  * :mod:`.manifest` + :mod:`.atomic` — verified atomic checkpoints:
+    ``manifest.json`` written last, ``latest`` pointer committed via
+    tmp + fsync + ``os.replace``, load-time verification with automatic
+    fallback to the newest *valid* older tag.
+  * :mod:`.retry` — ``@retryable`` exponential backoff + jitter for
+    transient I/O, with process-global fault counters the monitor emits.
+  * :mod:`.watchdog` — daemon-thread heartbeat over the step loop;
+    post-mortem dumps of the last step/phase when a collective hangs.
+  * :mod:`.injection` — deterministic fault injection (EIO, torn writes,
+    stragglers, worker death) driven programmatically or via
+    ``DSTPU_FAULT_INJECT`` so recovery paths are provable in tests.
+"""
+from .atomic import atomic_write_text, fsync_dir  # noqa: F401
+from .injection import FaultInjector, FaultSpec, inject, truncate_file  # noqa: F401
+from .manifest import (CheckpointCorruptError, is_valid_checkpoint,  # noqa: F401
+                       read_manifest, verify_checkpoint, write_manifest)
+from .retry import (RetryPolicy, fault_counters, record_fault_event,  # noqa: F401
+                    reset_fault_counters, retryable)
+from .watchdog import Watchdog, WatchdogTimeout  # noqa: F401

@@ -1,0 +1,64 @@
+"""Crash-safe filesystem primitives for the fault subsystem.
+
+Everything durable the framework writes (the ``latest`` pointer, checkpoint
+manifests) goes through :func:`atomic_write_text`: tmp file in the target
+directory, flush + ``os.fsync``, ``os.replace`` (atomic on POSIX), then a
+best-effort fsync of the containing directory so the rename itself survives
+power loss.  A reader can therefore never observe a half-written file — it
+sees either the old content or the new content.
+
+This module is deliberately stdlib-only and loadable standalone (no package
+imports) so fault-injection worker scripts can use it without dragging in
+jax.
+"""
+from __future__ import annotations
+
+import os
+
+
+def fsync_dir(path: str) -> None:
+    """Best-effort fsync of a directory (persists a rename within it).
+
+    Some filesystems (and all of Windows) reject opening directories; the
+    rename is still atomic there, just not power-loss durable.
+    """
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    except OSError:
+        pass
+    finally:
+        os.close(fd)
+
+
+def atomic_write_text(path: str, text: str) -> None:
+    """Write ``text`` to ``path`` atomically (tmp + fsync + ``os.replace``).
+
+    The tmp file is opened with mode 0o666-minus-umask (not ``mkstemp``'s
+    0600, which would survive the rename and lock out other users of a
+    shared checkpoint store) and uuid-suffixed: pids alone collide across
+    hosts sharing a store (containers routinely run as pid 1), and two
+    writers truncating one tmp file would break the atomicity guarantee.
+    """
+    import uuid
+
+    path = os.path.abspath(path)
+    d = os.path.dirname(path)
+    tmp = f"{path}.tmp.{uuid.uuid4().hex}"
+    fd = os.open(tmp, os.O_WRONLY | os.O_CREAT | os.O_EXCL, 0o666)
+    try:
+        with os.fdopen(fd, "w") as f:
+            f.write(text)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+    except BaseException:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
+        raise
+    fsync_dir(d)

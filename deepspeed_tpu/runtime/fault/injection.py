@@ -1,0 +1,190 @@
+"""Deterministic fault-injection harness.
+
+Production code exposes named injection *sites* (``inject("ckpt_save")``,
+``inject("comm_init")``, ``inject("step", step=n)``) that are no-ops unless a
+:class:`FaultInjector` is installed — programmatically or via the
+``DSTPU_FAULT_INJECT`` env var, which lets tests inject faults into worker
+*subprocesses* (the elastic-agent recovery tests) without code changes.
+
+Spec string grammar (``;`` separates specs, ``,`` separates fields)::
+
+    DSTPU_FAULT_INJECT="site=ckpt_save,kind=io_error,times=2;site=step,kind=kill,steps=3"
+
+Fields: ``site`` (required), ``kind`` — one of
+
+  * ``io_error``  raise ``OSError(EIO)`` (transient storage failure),
+  * ``slow``      sleep ``delay`` seconds (hung collective / straggler),
+  * ``truncate``  truncate the file passed by the call site to
+                  ``truncate_to`` bytes (torn write),
+  * ``kill``      ``os._exit(exit_code)`` (worker death / preemption) —
+
+plus ``p`` (fire probability, default 1), ``times`` (max fires per process),
+``steps`` (only fire at these step numbers: ``3`` | ``3-5`` | ``3|7|9``),
+``delay``, ``truncate_to``, ``exit_code``, ``seed``.  Probability draws use a
+per-spec ``random.Random(seed)`` so runs are reproducible.
+
+Stdlib-only and loadable standalone (fault-injection worker scripts).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import errno
+import os
+import random
+import time
+from typing import FrozenSet, List, Optional, Sequence, Union
+
+try:
+    from ...utils.logging import logger
+except ImportError:  # loaded standalone, outside the package
+    import logging
+
+    logger = logging.getLogger("deepspeed_tpu.fault")
+
+try:
+    from .retry import record_fault_event
+except ImportError:  # loaded standalone, outside the package
+    try:
+        from retry import record_fault_event  # type: ignore
+    except ImportError:
+        def record_fault_event(name: str, n: int = 1) -> None:
+            pass
+
+ENV_VAR = "DSTPU_FAULT_INJECT"
+KINDS = ("io_error", "slow", "truncate", "kill")
+
+
+def truncate_file(path: str, nbytes: int = 0) -> None:
+    """Simulate a torn write: keep only the first ``nbytes`` of ``path``."""
+    with open(path, "rb+") as f:
+        f.truncate(nbytes)
+
+
+def _parse_steps(text: str) -> FrozenSet[int]:
+    if "-" in text:
+        lo, hi = text.split("-", 1)
+        return frozenset(range(int(lo), int(hi) + 1))
+    return frozenset(int(t) for t in text.split("|"))
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    site: str
+    kind: str = "io_error"
+    p: float = 1.0
+    times: Optional[int] = None        # max fires per process; None = unlimited
+    steps: Optional[FrozenSet[int]] = None
+    delay: float = 0.1
+    truncate_to: int = 0
+    exit_code: int = 1
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; known: {KINDS}")
+        self._rng = random.Random(self.seed)
+        self._fired = 0
+
+    @classmethod
+    def parse(cls, text: str) -> "FaultSpec":
+        kw = {}
+        for field in text.split(","):
+            if not field.strip():
+                continue
+            k, _, v = field.partition("=")
+            k = k.strip()
+            v = v.strip()
+            if k == "steps":
+                kw[k] = _parse_steps(v)
+            elif k in ("p", "delay"):
+                kw[k] = float(v)
+            elif k in ("times", "truncate_to", "exit_code", "seed"):
+                kw[k] = int(v)
+            else:
+                kw[k] = v
+        if "site" not in kw:
+            raise ValueError(f"fault spec needs site=: {text!r}")
+        return cls(**kw)
+
+
+class FaultInjector:
+    def __init__(self, specs: Union[str, Sequence[FaultSpec]] = ()):
+        if isinstance(specs, str):
+            specs = [FaultSpec.parse(s) for s in specs.split(";") if s.strip()]
+        self.specs: List[FaultSpec] = list(specs)
+        self.fires: "collections.Counter[str]" = collections.Counter()
+
+    def inject(self, site: str, step: Optional[int] = None,
+               path: Optional[str] = None) -> None:
+        """Fire every matching spec for ``site`` (called at injection points)."""
+        for spec in self.specs:
+            if spec.site != site:
+                continue
+            if spec.times is not None and spec._fired >= spec.times:
+                continue
+            if spec.steps is not None and (step is None or step not in spec.steps):
+                continue
+            if spec.p < 1.0 and spec._rng.random() >= spec.p:
+                continue
+            spec._fired += 1
+            self.fires[f"{site}:{spec.kind}"] += 1
+            self._fire(spec, site, step, path)
+
+    def _fire(self, spec: FaultSpec, site: str, step, path) -> None:
+        record_fault_event(f"injected/{site}")
+        where = f"site={site}" + (f" step={step}" if step is not None else "")
+        if spec.kind == "io_error":
+            logger.warning(f"fault injection: EIO at {where}")
+            raise OSError(errno.EIO, f"injected I/O error at {where}")
+        if spec.kind == "slow":
+            logger.warning(f"fault injection: sleeping {spec.delay}s at {where}")
+            time.sleep(spec.delay)
+            return
+        if spec.kind == "truncate":
+            if path is None:
+                raise ValueError(f"truncate fault at {where} but call site "
+                                 f"passed no path")
+            logger.warning(f"fault injection: truncating {path} to "
+                           f"{spec.truncate_to}B at {where}")
+            truncate_file(path, spec.truncate_to)
+            return
+        if spec.kind == "kill":
+            logger.warning(f"fault injection: killing process at {where}")
+            os._exit(spec.exit_code)
+
+
+_injector: Optional[FaultInjector] = None
+_env_checked = False
+
+
+def configure(specs: Union[str, Sequence[FaultSpec]]) -> FaultInjector:
+    """Install a process-global injector (tests / DSTPU_FAULT_INJECT)."""
+    global _injector, _env_checked
+    _injector = FaultInjector(specs)
+    _env_checked = True
+    return _injector
+
+
+def clear() -> None:
+    global _injector, _env_checked
+    _injector = None
+    _env_checked = False
+
+
+def get_injector() -> Optional[FaultInjector]:
+    global _injector, _env_checked
+    if _injector is None and not _env_checked:
+        _env_checked = True
+        env = os.environ.get(ENV_VAR)
+        if env:
+            _injector = FaultInjector(env)
+    return _injector
+
+
+def inject(site: str, step: Optional[int] = None,
+           path: Optional[str] = None) -> None:
+    """Production-code injection point; no-op unless an injector is active."""
+    inj = get_injector()
+    if inj is not None:
+        inj.inject(site, step=step, path=path)

@@ -1,0 +1,158 @@
+"""Checkpoint manifests: write-time integrity records, load-time verification.
+
+A checkpoint directory is only as trustworthy as the last byte a preempted
+writer managed to flush.  ``manifest.json`` (written last, atomically) records
+what a *complete* checkpoint looks like:
+
+  * per-file sizes for every file in the checkpoint directory,
+  * SHA-256 of ``meta.json`` (the small host-side metadata — cheap to hash,
+    and the file most often truncated by preemption),
+  * the sorted tensorstore shard listing under ``state/`` and its SHA-256
+    (a missing/renamed shard is detected without hashing gigabytes of
+    array data — sizes catch truncation, the listing catches deletion).
+
+:func:`verify_checkpoint` replays that record and raises
+:class:`CheckpointCorruptError` naming exactly what diverged.  A checkpoint
+with no manifest (pre-fault-subsystem layouts) is accepted iff its directory
+is non-empty, so old checkpoints remain loadable.
+
+Stdlib-only and loadable standalone (fault-injection worker scripts).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+try:
+    from .atomic import atomic_write_text
+except ImportError:  # loaded standalone, outside the package
+    from atomic import atomic_write_text  # type: ignore
+
+MANIFEST_FILE = "manifest.json"
+META_FILE = "meta.json"
+STATE_DIR = "state"
+MANIFEST_VERSION = 1
+
+
+class CheckpointCorruptError(RuntimeError):
+    """A checkpoint failed integrity verification (incomplete write,
+    truncated file, missing shard, or dangling ``latest`` pointer)."""
+
+
+def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        while True:
+            b = f.read(chunk)
+            if not b:
+                break
+            h.update(b)
+    return h.hexdigest()
+
+
+def _walk_files(ckpt_path: str) -> List[str]:
+    """Sorted relative paths of every file under ``ckpt_path`` except the
+    manifest itself."""
+    out = []
+    for root, _dirs, files in os.walk(ckpt_path):
+        for fn in files:
+            rel = os.path.relpath(os.path.join(root, fn), ckpt_path)
+            if rel != MANIFEST_FILE:
+                out.append(rel)
+    return sorted(out)
+
+
+def build_manifest(ckpt_path: str,
+                   extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    files = _walk_files(ckpt_path)
+    shards = [f for f in files if f.split(os.sep, 1)[0] == STATE_DIR]
+    manifest: Dict[str, Any] = {
+        "version": MANIFEST_VERSION,
+        "files": {f: os.path.getsize(os.path.join(ckpt_path, f)) for f in files},
+        "shard_listing": shards,
+        "shard_listing_sha256": hashlib.sha256(
+            "\n".join(shards).encode()).hexdigest(),
+    }
+    meta = os.path.join(ckpt_path, META_FILE)
+    if os.path.exists(meta):
+        manifest["meta_sha256"] = _sha256_file(meta)
+    if extra:
+        manifest.update(extra)
+    return manifest
+
+
+def write_manifest(ckpt_path: str,
+                   extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Build + atomically persist the manifest; returns it."""
+    manifest = build_manifest(ckpt_path, extra)
+    atomic_write_text(os.path.join(ckpt_path, MANIFEST_FILE),
+                      json.dumps(manifest, indent=2, sort_keys=True))
+    return manifest
+
+
+def read_manifest(ckpt_path: str) -> Optional[Dict[str, Any]]:
+    p = os.path.join(ckpt_path, MANIFEST_FILE)
+    if not os.path.exists(p):
+        return None
+    try:
+        with open(p) as f:
+            return json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        raise CheckpointCorruptError(f"{ckpt_path}: unreadable manifest: {e}")
+
+
+def verify_checkpoint(ckpt_path: str,
+                      require_manifest: bool = False) -> Optional[Dict[str, Any]]:
+    """Verify ``ckpt_path`` against its manifest.
+
+    Returns the manifest (None for a valid legacy checkpoint without one).
+    Raises :class:`CheckpointCorruptError` on any divergence.
+    """
+    if not os.path.isdir(ckpt_path):
+        raise CheckpointCorruptError(f"{ckpt_path}: checkpoint directory missing")
+    manifest = read_manifest(ckpt_path)
+    if manifest is None:
+        if require_manifest:
+            raise CheckpointCorruptError(f"{ckpt_path}: no manifest")
+        if not _walk_files(ckpt_path):
+            raise CheckpointCorruptError(f"{ckpt_path}: empty checkpoint directory")
+        return None
+
+    for rel, size in manifest.get("files", {}).items():
+        p = os.path.join(ckpt_path, rel)
+        if not os.path.exists(p):
+            raise CheckpointCorruptError(f"{ckpt_path}: missing file {rel!r}")
+        actual = os.path.getsize(p)
+        if actual != size:
+            raise CheckpointCorruptError(
+                f"{ckpt_path}: size mismatch for {rel!r} "
+                f"(manifest {size}, on disk {actual})")
+
+    shards = [f for f in _walk_files(ckpt_path)
+              if f.split(os.sep, 1)[0] == STATE_DIR]
+    want = hashlib.sha256("\n".join(shards).encode()).hexdigest()
+    if manifest.get("shard_listing_sha256") not in (None, want):
+        raise CheckpointCorruptError(
+            f"{ckpt_path}: tensorstore shard listing changed since save "
+            f"(shards added/removed under {STATE_DIR}/)")
+
+    if "meta_sha256" in manifest:
+        meta = os.path.join(ckpt_path, META_FILE)
+        if not os.path.exists(meta):
+            raise CheckpointCorruptError(f"{ckpt_path}: {META_FILE} missing")
+        actual = _sha256_file(meta)
+        if actual != manifest["meta_sha256"]:
+            raise CheckpointCorruptError(
+                f"{ckpt_path}: {META_FILE} content hash mismatch "
+                f"(truncated or partially written)")
+    return manifest
+
+
+def is_valid_checkpoint(ckpt_path: str) -> bool:
+    try:
+        verify_checkpoint(ckpt_path)
+        return True
+    except CheckpointCorruptError:
+        return False

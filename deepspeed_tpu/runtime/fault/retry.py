@@ -1,0 +1,155 @@
+"""Retry with exponential backoff + jitter, and the fault event counters.
+
+``@retryable`` is the one retry implementation for the whole framework —
+checkpoint save/load/commit, comm bootstrap, any I/O that can fail
+transiently on a preemptible TPU VM (GCS flakes, NFS EIO, coordinator not
+up yet).  The policy is resolved per call: an explicit ``policy=``, else a
+``retry_policy`` attribute on the bound instance (so engines configured via
+``config.fault`` Just Work), else env vars, else defaults.
+
+Every retry and exhaustion is counted in a process-global counter table
+(:func:`fault_counters`) which the engine emits as monitor events — retries
+that silently succeed are still a storage-health signal worth graphing.
+
+Stdlib-only and loadable standalone (fault-injection worker scripts).
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import functools
+import os
+import random
+import threading
+import time
+from typing import Callable, Optional, Tuple, Type
+
+try:
+    from ...utils.logging import logger
+except ImportError:  # loaded standalone, outside the package
+    import logging
+
+    logger = logging.getLogger("deepspeed_tpu.fault")
+
+#: exception types treated as transient by default — storage and transport
+#: errors, never programming errors (ValueError/TypeError must propagate).
+DEFAULT_RETRY_ON: Tuple[Type[BaseException], ...] = (
+    OSError, TimeoutError, ConnectionError)
+
+_COUNTER_LOCK = threading.Lock()
+_COUNTERS: "collections.Counter[str]" = collections.Counter()
+
+
+def record_fault_event(name: str, n: int = 1) -> None:
+    with _COUNTER_LOCK:
+        _COUNTERS[name] += n
+
+
+def fault_counters() -> dict:
+    """Snapshot of all fault counters (retries/<op>, exhausted/<op>,
+    watchdog_timeouts, injected/<site> …)."""
+    with _COUNTER_LOCK:
+        return dict(_COUNTERS)
+
+
+def reset_fault_counters() -> None:
+    with _COUNTER_LOCK:
+        _COUNTERS.clear()
+
+
+@dataclasses.dataclass(frozen=True)
+class RetryPolicy:
+    """Exponential backoff: delay_k = min(cap, base * 2**k), jittered
+    uniformly in ±(jitter * delay) so a gang of workers retrying the same
+    flaky store doesn't thundering-herd it."""
+
+    max_retries: int = 3          # retries AFTER the first attempt
+    base_s: float = 0.05
+    cap_s: float = 2.0
+    jitter: float = 0.25          # fraction of the delay randomized
+    retry_on: Tuple[Type[BaseException], ...] = DEFAULT_RETRY_ON
+
+    @property
+    def max_attempts(self) -> int:
+        return self.max_retries + 1
+
+    def delay(self, attempt: int, rng: Optional[random.Random] = None) -> float:
+        d = min(self.cap_s, self.base_s * (2.0 ** attempt))
+        if self.jitter > 0:
+            r = (rng or _RNG).random()          # in [0, 1)
+            d *= 1.0 + self.jitter * (2.0 * r - 1.0)
+        return max(d, 0.0)
+
+    @classmethod
+    def from_config(cls, fault_config) -> "RetryPolicy":
+        """Build from a ``config.fault`` block (``FaultConfig``); falls back
+        to env/defaults when ``fault_config`` is None."""
+        if fault_config is None:
+            return cls.from_env()
+        return cls(
+            max_retries=int(getattr(fault_config, "max_retries", 3)),
+            base_s=float(getattr(fault_config, "retry_base_s", 0.05)),
+            cap_s=float(getattr(fault_config, "retry_cap_s", 2.0)),
+            jitter=float(getattr(fault_config, "retry_jitter", 0.25)),
+        )
+
+    @classmethod
+    def from_env(cls) -> "RetryPolicy":
+        """Env override for code that runs before a config exists (comm
+        bootstrap): DSTPU_RETRY_MAX / _BASE_S / _CAP_S / _JITTER."""
+        return cls(
+            max_retries=int(os.environ.get("DSTPU_RETRY_MAX", 3)),
+            base_s=float(os.environ.get("DSTPU_RETRY_BASE_S", 0.05)),
+            cap_s=float(os.environ.get("DSTPU_RETRY_CAP_S", 2.0)),
+            jitter=float(os.environ.get("DSTPU_RETRY_JITTER", 0.25)),
+        )
+
+
+_seed_env = os.environ.get("DSTPU_FAULT_SEED")
+_RNG = random.Random(int(_seed_env)) if _seed_env else random.Random()
+
+
+def retryable(op_name: Optional[str] = None,
+              policy: Optional[RetryPolicy] = None,
+              policy_attr: str = "retry_policy",
+              sleep: Callable[[float], None] = time.sleep):
+    """Decorator: retry transient failures with exponential backoff + jitter.
+
+    Policy resolution order per call: explicit ``policy`` arg here →
+    ``getattr(args[0], policy_attr)`` when the wrapped callable is a method
+    of an object carrying one → :meth:`RetryPolicy.from_env`.
+    """
+
+    def deco(fn):
+        name = op_name or fn.__name__
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            pol = policy
+            if pol is None and args:
+                pol = getattr(args[0], policy_attr, None)
+                if pol is not None and not isinstance(pol, RetryPolicy):
+                    pol = None
+            if pol is None:
+                pol = RetryPolicy.from_env()
+            for attempt in range(pol.max_attempts):
+                try:
+                    return fn(*args, **kwargs)
+                except pol.retry_on as e:
+                    if attempt >= pol.max_retries:
+                        record_fault_event(f"exhausted/{name}")
+                        logger.error(
+                            f"{name}: giving up after {attempt + 1} attempts: {e!r}")
+                        raise
+                    d = pol.delay(attempt)
+                    record_fault_event("retries")
+                    record_fault_event(f"retries/{name}")
+                    logger.warning(
+                        f"{name}: transient failure ({e!r}); retry "
+                        f"{attempt + 1}/{pol.max_retries} in {d:.3f}s")
+                    sleep(d)
+            raise AssertionError("unreachable")  # pragma: no cover
+
+        return wrapper
+
+    return deco

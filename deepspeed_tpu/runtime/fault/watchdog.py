@@ -1,0 +1,142 @@
+"""Training watchdog: a daemon-thread heartbeat over the step loop.
+
+Hung collectives are the silent failure mode of gang-scheduled training — a
+peer dies mid-allreduce and every other worker blocks forever inside XLA with
+nothing in the logs.  The engine pings the watchdog at each phase transition
+(train_batch / backward / optimizer_step / checkpoint); a daemon thread
+checks the heartbeat age and, past ``deadline_s``, dumps the last-known step
+and phase for post-mortems, increments the ``watchdog_timeouts`` fault
+counter, and fires the ``on_timeout`` callback.  With ``raise_on_timeout``
+the *next* ``ping()``/``check()`` from the training thread raises
+:class:`WatchdogTimeout` — a Python thread cannot safely interrupt a peer
+blocked in native code, so the raise happens at the first point the training
+thread resurfaces (which is also the first point it can act on it).
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Callable, Optional
+
+from ...utils.logging import logger
+from .retry import record_fault_event
+
+
+class WatchdogTimeout(RuntimeError):
+    """A training step/collective exceeded the watchdog deadline."""
+
+
+class Watchdog:
+    def __init__(self, deadline_s: float = 600.0,
+                 raise_on_timeout: bool = False,
+                 on_timeout: Optional[Callable[[dict], None]] = None,
+                 poll_interval_s: Optional[float] = None,
+                 quiet_phases: tuple = ("init", "idle"),
+                 name: str = "dstpu-watchdog"):
+        self.deadline_s = float(deadline_s)
+        self.raise_on_timeout = raise_on_timeout
+        self.on_timeout = on_timeout
+        #: phases where the deadline does not apply — a hang can only happen
+        #: inside an active step/collective/checkpoint; a run that finished
+        #: its loop (or hasn't started one) parks in a quiet phase and must
+        #: not trip false "likely hung" post-mortems forever after
+        self.quiet_phases = tuple(quiet_phases)
+        self.poll_interval_s = poll_interval_s or max(
+            min(self.deadline_s / 4.0, 1.0), 0.01)
+        self.name = name
+        self.timeouts = 0
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._last_ping = time.monotonic()
+        self._step: Optional[int] = None
+        self._phase = "init"
+        self._timed_out = False      # pending WatchdogTimeout for the pinger
+        self._reported = False       # one report per heartbeat epoch
+
+    # ---------------------------------------------------------------- #
+    def start(self) -> "Watchdog":
+        if self._thread is not None:
+            return self
+        self._stop.clear()
+        with self._lock:
+            self._last_ping = time.monotonic()
+        self._thread = threading.Thread(target=self._run, name=self.name,
+                                        daemon=True)
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    # ---------------------------------------------------------------- #
+    def ping(self, step: Optional[int] = None, phase: Optional[str] = None) -> None:
+        """Heartbeat from the training thread; raises a pending
+        :class:`WatchdogTimeout` when ``raise_on_timeout`` is set."""
+        with self._lock:
+            self._last_ping = time.monotonic()
+            if step is not None:
+                self._step = step
+            if phase is not None:
+                self._phase = phase
+            self._reported = False
+            pending, self._timed_out = self._timed_out, False
+        if pending and self.raise_on_timeout:
+            raise WatchdogTimeout(
+                f"watchdog deadline {self.deadline_s}s exceeded: "
+                f"{json.dumps(self.dump())}")
+
+    def check(self) -> None:
+        """Raise a pending timeout without refreshing the heartbeat."""
+        if self.raise_on_timeout:
+            with self._lock:
+                pending = self._timed_out
+            if pending:
+                raise WatchdogTimeout(
+                    f"watchdog deadline {self.deadline_s}s exceeded: "
+                    f"{json.dumps(self.dump())}")
+
+    def dump(self) -> dict:
+        """Last-heartbeat snapshot for post-mortems."""
+        with self._lock:
+            return {
+                "step": self._step,
+                "phase": self._phase,
+                "last_heartbeat_age_s": round(
+                    time.monotonic() - self._last_ping, 3),
+                "deadline_s": self.deadline_s,
+                "timeouts": self.timeouts,
+            }
+
+    # ---------------------------------------------------------------- #
+    def _run(self) -> None:
+        while not self._stop.wait(self.poll_interval_s):
+            with self._lock:
+                age = time.monotonic() - self._last_ping
+                expired = (age > self.deadline_s and not self._reported
+                           and self._phase not in self.quiet_phases)
+                if expired:
+                    self._reported = True
+                    self._timed_out = True
+                    self.timeouts += 1
+            if expired:
+                info = self.dump()
+                record_fault_event("watchdog_timeouts")
+                logger.error(
+                    f"WATCHDOG: no heartbeat for {info['last_heartbeat_age_s']}s "
+                    f"(deadline {self.deadline_s}s) — last known state: "
+                    f"step={info['step']} phase={info['phase']!r}. A worker or "
+                    f"collective is likely hung; dump: {json.dumps(info)}")
+                if self.on_timeout is not None:
+                    try:
+                        self.on_timeout(info)
+                    except Exception as e:
+                        logger.warning(f"watchdog on_timeout callback failed: {e!r}")

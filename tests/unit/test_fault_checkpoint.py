@@ -1,0 +1,298 @@
+"""Verified atomic checkpoints: manifest integrity, atomic commit, and
+fallback-to-valid-tag recovery (runtime/fault/manifest.py + the orbax engine)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import (
+    LATEST_FILE, OrbaxCheckpointEngine)
+from deepspeed_tpu.runtime.config import FaultConfig
+from deepspeed_tpu.runtime.fault import injection
+from deepspeed_tpu.runtime.fault.injection import truncate_file
+from deepspeed_tpu.runtime.fault.manifest import (MANIFEST_FILE,
+                                                  CheckpointCorruptError,
+                                                  is_valid_checkpoint,
+                                                  read_manifest,
+                                                  verify_checkpoint,
+                                                  write_manifest)
+from deepspeed_tpu.runtime.fault.retry import (fault_counters,
+                                               reset_fault_counters)
+
+pytestmark = pytest.mark.fault
+
+FAST_FAULT = FaultConfig(max_retries=3, retry_base_s=0.001, retry_cap_s=0.004,
+                         retry_jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_state():
+    injection.clear()
+    reset_fault_counters()
+    yield
+    injection.clear()
+    reset_fault_counters()
+
+
+def payload(step=1):
+    return {"state": {"w": np.arange(8, dtype=np.float32) * step,
+                      "b": np.ones((2, 2), np.float32) * step},
+            "client_state": {"step": step}}
+
+
+def template():
+    return {"state": {"w": np.zeros(8, np.float32),
+                      "b": np.zeros((2, 2), np.float32)},
+            "client_state": None}
+
+
+def make_ckpt(tmp_path, tags=("global_step1",), commit=True):
+    eng = OrbaxCheckpointEngine(str(tmp_path), fault_config=FAST_FAULT)
+    for i, tag in enumerate(tags, start=1):
+        eng.save(payload(i), tag)
+        if commit:
+            eng.commit(tag)
+    return eng
+
+
+class TestManifest:
+    def test_save_writes_manifest(self, tmp_path):
+        eng = make_ckpt(tmp_path)
+        m = read_manifest(str(tmp_path / "global_step1"))
+        assert m["version"] == 1
+        assert m["tag"] == "global_step1"
+        assert m["step"] == 1
+        assert "meta_sha256" in m
+        assert m["files"]                      # per-file sizes recorded
+        assert any(f.startswith("state") for f in m["files"])
+        assert m["shard_listing_sha256"]
+        verify_checkpoint(str(tmp_path / "global_step1"))
+
+    def test_verify_catches_truncated_meta(self, tmp_path):
+        make_ckpt(tmp_path)
+        p = str(tmp_path / "global_step1")
+        truncate_file(os.path.join(p, "meta.json"), 3)
+        with pytest.raises(CheckpointCorruptError, match="meta.json"):
+            verify_checkpoint(p)
+
+    def test_verify_catches_deleted_shard(self, tmp_path):
+        make_ckpt(tmp_path)
+        p = str(tmp_path / "global_step1")
+        m = read_manifest(p)
+        shard = next(f for f in m["files"] if f.split(os.sep)[0] == "state")
+        os.remove(os.path.join(p, shard))
+        with pytest.raises(CheckpointCorruptError, match="missing file"):
+            verify_checkpoint(p)
+
+    def test_verify_catches_same_size_meta_rewrite(self, tmp_path):
+        """Equal-size corruption is invisible to size checks — the content
+        hash of meta.json catches it."""
+        make_ckpt(tmp_path)
+        p = str(tmp_path / "global_step1")
+        meta = os.path.join(p, "meta.json")
+        size = os.path.getsize(meta)
+        with open(meta, "wb") as f:
+            f.write(b"X" * size)
+        with pytest.raises(CheckpointCorruptError, match="hash mismatch"):
+            verify_checkpoint(p)
+
+    def test_legacy_checkpoint_without_manifest_accepted(self, tmp_path):
+        d = tmp_path / "old_tag"
+        d.mkdir()
+        (d / "meta.json").write_text("{}")
+        assert verify_checkpoint(str(d)) is None
+        assert is_valid_checkpoint(str(d))
+
+    def test_empty_or_missing_dir_rejected(self, tmp_path):
+        with pytest.raises(CheckpointCorruptError, match="missing"):
+            verify_checkpoint(str(tmp_path / "nope"))
+        empty = tmp_path / "empty"
+        empty.mkdir()
+        with pytest.raises(CheckpointCorruptError, match="empty"):
+            verify_checkpoint(str(empty))
+
+    def test_unreadable_manifest_is_corrupt(self, tmp_path):
+        d = tmp_path / "tag"
+        d.mkdir()
+        (d / "meta.json").write_text("{}")
+        write_manifest(str(d))
+        truncate_file(str(d / MANIFEST_FILE), 5)
+        with pytest.raises(CheckpointCorruptError, match="manifest"):
+            verify_checkpoint(str(d))
+
+
+class TestAtomicCommit:
+    def test_commit_then_latest(self, tmp_path):
+        eng = make_ckpt(tmp_path, tags=("global_step1", "global_step2"))
+        assert eng.latest_tag() == "global_step2"
+        # pointer file contains exactly the tag, no tmp litter left behind
+        assert (tmp_path / LATEST_FILE).read_text() == "global_step2"
+        assert not [f for f in os.listdir(tmp_path) if ".tmp." in f]
+
+    def test_commit_refuses_missing_or_corrupt_tag(self, tmp_path):
+        eng = make_ckpt(tmp_path)
+        with pytest.raises(CheckpointCorruptError):
+            eng.commit("global_step99")
+        truncate_file(str(tmp_path / "global_step1" / "meta.json"), 1)
+        # a fresh engine (cold verification cache) must refuse the torn tag;
+        # the saver instance itself trusts what it just sealed
+        fresh = OrbaxCheckpointEngine(str(tmp_path), fault_config=FAST_FAULT)
+        with pytest.raises(CheckpointCorruptError):
+            fresh.commit("global_step1")
+        # the failed commits must not have moved the pointer
+        assert (tmp_path / LATEST_FILE).read_text() == "global_step1"
+
+    def test_unverified_commit_still_refuses_missing_tag(self, tmp_path):
+        make_ckpt(tmp_path)
+        eng = OrbaxCheckpointEngine(
+            str(tmp_path), fault_config=FaultConfig(verify_checkpoints=False))
+        with pytest.raises(CheckpointCorruptError):
+            eng.commit("global_step99")
+
+
+class TestRetriedSave:
+    def test_save_succeeds_after_injected_eio(self, tmp_path):
+        injection.configure("site=ckpt_save,kind=io_error,times=2")
+        eng = make_ckpt(tmp_path)          # would raise without retry
+        assert eng.latest_tag() == "global_step1"
+        c = fault_counters()
+        assert c["retries/ckpt_save"] == 2
+        assert c["injected/ckpt_save"] == 2
+        out = eng.load(template(), "global_step1")
+        np.testing.assert_allclose(out["state"]["w"],
+                                   np.arange(8, dtype=np.float32))
+
+    def test_save_exhaustion_raises(self, tmp_path):
+        injection.configure("site=ckpt_save,kind=io_error")   # every attempt
+        eng = OrbaxCheckpointEngine(str(tmp_path), fault_config=FAST_FAULT)
+        with pytest.raises(OSError):
+            eng.save(payload(), "global_step1")
+        assert fault_counters()["exhausted/ckpt_save"] == 1
+        assert eng.latest_tag() is None
+
+
+class TestCallerDictsNotMutated:
+    def test_save_restores_payload_on_error(self, tmp_path):
+        eng = OrbaxCheckpointEngine(str(tmp_path), fault_config=FAST_FAULT)
+        bad = {"state": {"w": object()}, "client_state": {}}   # unsaveable leaf
+        with pytest.raises(Exception):
+            eng.save(bad, "t")
+        assert "state" in bad                # restored on the exception path
+
+    def test_save_and_load_leave_dicts_intact(self, tmp_path):
+        eng = make_ckpt(tmp_path)
+        p = payload()
+        keys_before = set(p)
+        eng.save(p, "global_step7")
+        assert set(p) == keys_before and "state" in p
+
+        t = template()
+        eng.load(t, "global_step7")
+        assert "state" in t
+
+    def test_load_restores_template_on_error(self, tmp_path):
+        eng = make_ckpt(tmp_path)
+        t = {"state": {"totally": np.zeros(3), "wrong": np.zeros(4)},
+             "client_state": None}
+        with pytest.raises(Exception):
+            eng.load(t, "global_step1")
+        assert "state" in t
+
+
+class TestFallbackToValidTag:
+    def corrupt(self, tmp_path, tag, how="truncate_meta"):
+        p = str(tmp_path / tag)
+        if how == "truncate_meta":
+            truncate_file(os.path.join(p, "meta.json"), 2)
+        else:
+            m = read_manifest(p)
+            shard = next(f for f in m["files"]
+                         if f.split(os.sep)[0] == "state")
+            os.remove(os.path.join(p, shard))
+
+    @pytest.mark.parametrize("how", ["truncate_meta", "delete_shard"])
+    def test_corrupt_latest_falls_back_to_newest_valid(self, tmp_path, how):
+        eng = make_ckpt(tmp_path,
+                        tags=("global_step1", "global_step2", "global_step3"))
+        self.corrupt(tmp_path, "global_step3", how)
+        assert eng.latest_tag() == "global_step2"
+        out = eng.load(template(), eng.latest_tag())
+        assert out["client_state"]["step"] == 2
+
+    def test_uncommitted_saves_are_not_fallback_candidates(self, tmp_path):
+        """A save with save_latest=False is deliberately unpublished — the
+        fallback must pick an older committed tag, never the unpublished one."""
+        eng = make_ckpt(tmp_path, tags=("global_step1", "global_step2"))
+        eng.save(payload(9), "global_step9")       # sealed but never committed
+        self.corrupt(tmp_path, "global_step2")
+        assert eng.latest_tag() == "global_step1"
+
+    def test_stale_pointer_falls_back(self, tmp_path):
+        eng = make_ckpt(tmp_path, tags=("global_step1", "global_step2"))
+        (tmp_path / LATEST_FILE).write_text("global_step99")   # dangling
+        assert eng.latest_tag() == "global_step2"
+
+    def test_torn_first_save_yields_none_not_garbage(self, tmp_path):
+        """A save preempted before the manifest was sealed (no manifest, no
+        commit, no history) must not be auto-resumed — it is layout-identical
+        to a legacy checkpoint, but nothing ever vouched for it."""
+        torn = tmp_path / "global_step1" / "state"
+        torn.mkdir(parents=True)
+        (torn / "partial_shard").write_bytes(b"x" * 32)
+        eng = OrbaxCheckpointEngine(str(tmp_path), fault_config=FAST_FAULT)
+        assert eng.latest_tag() is None
+
+    def test_all_corrupt_returns_none(self, tmp_path):
+        eng = make_ckpt(tmp_path, tags=("global_step1", "global_step2"))
+        self.corrupt(tmp_path, "global_step1")
+        self.corrupt(tmp_path, "global_step2")
+        assert eng.latest_tag() is None
+
+    def test_explicit_corrupt_tag_raises_not_silently_loads(self, tmp_path):
+        make_ckpt(tmp_path, tags=("global_step1", "global_step2"))
+        self.corrupt(tmp_path, "global_step2")
+        # a loader with a cold verification cache (any other process/instance)
+        fresh = OrbaxCheckpointEngine(str(tmp_path), fault_config=FAST_FAULT)
+        with pytest.raises(CheckpointCorruptError):
+            fresh.load(template(), "global_step2")
+
+    def test_verification_can_be_disabled(self, tmp_path):
+        make_ckpt(tmp_path, tags=("global_step1", "global_step2"))
+        self.corrupt(tmp_path, "global_step2")
+        eng = OrbaxCheckpointEngine(
+            str(tmp_path),
+            fault_config=FaultConfig(verify_checkpoints=False))
+        assert eng.latest_tag() == "global_step2"   # trusts the pointer
+
+    def test_dangling_pointer_never_returned_even_unverified(self, tmp_path):
+        """A pointer to a missing/empty directory is ignored regardless of
+        verify_checkpoints — it can never be loaded."""
+        make_ckpt(tmp_path, tags=("global_step1",))
+        eng = OrbaxCheckpointEngine(
+            str(tmp_path),
+            fault_config=FaultConfig(verify_checkpoints=False))
+        (tmp_path / LATEST_FILE).write_text("global_step9")     # missing dir
+        assert eng.latest_tag() == "global_step1"
+        (tmp_path / "global_step9").mkdir()                     # empty dir
+        assert eng.latest_tag() == "global_step1"
+
+
+class TestEngineLevelRecovery:
+    def test_engine_resumes_from_last_valid_checkpoint(self, tmp_path):
+        """End-to-end: the training engine falls back to the newest valid
+        tag when the committed-latest checkpoint is corrupt."""
+        from .test_engine import make_engine, random_batch
+
+        engine = make_engine(zero_stage=1)
+        batch = random_batch(engine.train_batch_size())
+        engine.train_batch(batch)
+        engine.save_checkpoint(str(tmp_path))          # global_step1
+        engine.train_batch(batch)
+        engine.save_checkpoint(str(tmp_path))          # global_step2 (latest)
+        truncate_file(str(tmp_path / "global_step2" / "meta.json"), 2)
+
+        fresh = make_engine(zero_stage=1, seed=1)
+        path, _client = fresh.load_checkpoint(str(tmp_path))
+        assert path.endswith("global_step1")
+        assert fresh.global_steps == 1

@@ -1,0 +1,236 @@
+"""Elastic agent fault tolerance: bounded restart with backoff, two-phase
+termination (SIGTERM grace then SIGKILL), graceful shutdown, and the
+end-to-end kill → restart → resume-from-last-valid-tag path."""
+import os
+import signal
+import sys
+import threading
+import time
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import (DSElasticAgent,
+                                                    WorkerGroupFailure)
+from deepspeed_tpu.runtime.fault import injection
+from deepspeed_tpu.runtime.fault.retry import (RetryPolicy, fault_counters,
+                                               reset_fault_counters)
+
+pytestmark = pytest.mark.fault
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+FAST_RESTART = RetryPolicy(max_retries=10, base_s=0.01, cap_s=0.02, jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_state():
+    injection.clear()
+    reset_fault_counters()
+    yield
+    injection.clear()
+    reset_fault_counters()
+
+
+def wait_for(pred, timeout=30.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.02)
+    return False
+
+
+def agent_env(**extra):
+    env = {"PATH": os.environ.get("PATH", ""),
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO_ROOT,
+           "HOME": os.environ.get("HOME", "/tmp")}
+    env.update(extra)
+    return env
+
+
+class TestRestartBudget:
+    def test_successful_group_returns_zero(self):
+        agent = DSElasticAgent([sys.executable, "-c", "import sys; sys.exit(0)"],
+                               world_size=2, max_restarts=2,
+                               monitor_interval=0.02, env=agent_env(),
+                               restart_policy=FAST_RESTART)
+        assert agent.run() == 0
+        assert agent.restart_count == 0
+
+    def test_max_restarts_honored_with_backoff(self):
+        agent = DSElasticAgent([sys.executable, "-c", "import sys; sys.exit(1)"],
+                               world_size=1, max_restarts=2,
+                               monitor_interval=0.02, env=agent_env(),
+                               term_timeout=0.2,
+                               restart_policy=RetryPolicy(
+                                   max_retries=5, base_s=0.05, cap_s=0.2,
+                                   jitter=0.0))
+        t0 = time.monotonic()
+        with pytest.raises(WorkerGroupFailure, match="after 2 restarts"):
+            agent.run()
+        elapsed = time.monotonic() - t0
+        assert agent.restart_count == 2
+        assert elapsed >= 0.05 + 0.1          # backoff slept between restarts
+        assert fault_counters()["elastic/restarts"] == 2
+
+    def test_restart_count_visible_to_workers(self, tmp_path):
+        """Workers see DSTPU_ELASTIC_RESTART_COUNT so they know to resume."""
+        log = tmp_path / "incarnations.log"
+        script = (f"import os; open({str(log)!r}, 'a').write("
+                  f"os.environ['DSTPU_ELASTIC_RESTART_COUNT'] + '\\n'); "
+                  f"import sys; sys.exit(1)")
+        agent = DSElasticAgent([sys.executable, "-c", script],
+                               world_size=1, max_restarts=2,
+                               monitor_interval=0.02, env=agent_env(),
+                               restart_policy=FAST_RESTART)
+        with pytest.raises(WorkerGroupFailure):
+            agent.run()
+        assert log.read_text().split() == ["0", "1", "2"]
+
+
+class TestTwoPhaseTermination:
+    def sigterm_ignorer(self, tmp_path):
+        ready = tmp_path / "ready"
+        script = ("import os, signal, time\n"
+                  "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+                  f"open({str(ready)!r}, 'w').write('x')\n"
+                  "time.sleep(60)\n")
+        return [sys.executable, "-c", script], ready
+
+    def test_sigterm_grace_then_sigkill(self, tmp_path):
+        cmd, ready = self.sigterm_ignorer(tmp_path)
+        agent = DSElasticAgent(cmd, world_size=1, env=agent_env(),
+                               term_timeout=0.3, kill_timeout=5.0)
+        procs = agent._spawn_workers()
+        try:
+            assert wait_for(ready.exists)
+            t0 = time.monotonic()
+            agent._terminate(procs)
+            elapsed = time.monotonic() - t0
+            assert procs[0].poll() == -signal.SIGKILL
+            assert elapsed >= 0.3             # full SIGTERM grace was given
+            assert fault_counters()["elastic/sigkill"] == 1
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+    def test_escalation_can_be_disabled(self, tmp_path):
+        cmd, ready = self.sigterm_ignorer(tmp_path)
+        agent = DSElasticAgent(cmd, world_size=1, env=agent_env(),
+                               term_timeout=0.2, escalate_kill=False)
+        procs = agent._spawn_workers()
+        try:
+            assert wait_for(ready.exists)
+            agent._terminate(procs)
+            assert procs[0].poll() is None    # left to the OS, not SIGKILLed
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+    def test_cooperative_worker_needs_no_sigkill(self, tmp_path):
+        ready = tmp_path / "ready"
+        script = f"import time; open({str(ready)!r}, 'w').write('x'); time.sleep(60)"
+        agent = DSElasticAgent([sys.executable, "-c", script], world_size=1,
+                               env=agent_env(), term_timeout=5.0)
+        procs = agent._spawn_workers()
+        try:
+            assert wait_for(ready.exists)
+            agent._terminate(procs)
+            assert procs[0].poll() == -signal.SIGTERM
+            assert "elastic/sigkill" not in fault_counters()
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+
+
+class TestGracefulShutdown:
+    def test_shutdown_terminates_group_and_returns(self, tmp_path):
+        ready = tmp_path / "ready"
+        script = f"import time; open({str(ready)!r}, 'w').write('x'); time.sleep(60)"
+        agent = DSElasticAgent([sys.executable, "-c", script], world_size=2,
+                               monitor_interval=0.02, env=agent_env(),
+                               term_timeout=5.0)
+        result = {}
+        t = threading.Thread(target=lambda: result.update(rc=agent.run()))
+        t.start()
+        try:
+            assert wait_for(ready.exists)
+            agent.shutdown(signal.SIGTERM)
+            t.join(timeout=30)
+            assert not t.is_alive()
+            assert result["rc"] == 0
+            assert all(p.poll() is not None for p in agent._procs)
+        finally:
+            for p in agent._procs:
+                if p.poll() is None:
+                    p.kill()
+            t.join(timeout=5)
+
+
+WORKER_SCRIPT = """\
+import os
+import numpy as np
+from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import \\
+    OrbaxCheckpointEngine
+from deepspeed_tpu.runtime.config import FaultConfig
+from deepspeed_tpu.runtime.fault import injection
+
+ckpt_dir = os.environ["WORKER_CKPT_DIR"]
+log_path = os.environ["WORKER_LOG"]
+restart = int(os.environ["DSTPU_ELASTIC_RESTART_COUNT"])
+
+eng = OrbaxCheckpointEngine(ckpt_dir, fault_config=FaultConfig(retry_base_s=0.001))
+tag = eng.latest_tag()          # newest VALID tag (verified via manifest)
+start = 0
+if tag is not None:
+    out = eng.load({"state": {"w": np.zeros(4, np.float32)}, "step": None}, tag)
+    start = int(out["step"])
+with open(log_path, "a") as f:
+    f.write(f"incarnation={restart} start={start}\\n")
+
+for step in range(start + 1, 6):
+    state = {"w": np.full(4, step, np.float32)}
+    eng.save({"state": state, "step": step}, f"global_step{step}")
+    eng.commit(f"global_step{step}")
+    # DSTPU_FAULT_INJECT (set by the test) kills the worker here at step 3
+    injection.inject("step", step=step)
+
+with open(log_path, "a") as f:
+    f.write("done\\n")
+"""
+
+
+class TestKillRestartResume:
+    def test_killed_group_restarts_and_resumes_from_last_valid_tag(self, tmp_path):
+        """Acceptance path: worker death at step 3 → elastic agent restarts
+        the gang with backoff → the new incarnation resumes from the last
+        committed (and manifest-verified) tag instead of step 0."""
+        worker = tmp_path / "worker.py"
+        worker.write_text(WORKER_SCRIPT)
+        log = tmp_path / "progress.log"
+        ckpt = tmp_path / "ckpt"
+        env = agent_env(
+            WORKER_CKPT_DIR=str(ckpt), WORKER_LOG=str(log),
+            DSTPU_FAULT_INJECT="site=step,kind=kill,steps=3,exit_code=17")
+        agent = DSElasticAgent([sys.executable, str(worker)], world_size=1,
+                               max_restarts=3, monitor_interval=0.05,
+                               env=env, restart_policy=FAST_RESTART)
+        assert agent.run() == 0
+        assert agent.restart_count == 1
+
+        lines = log.read_text().splitlines()
+        assert lines[0] == "incarnation=0 start=0"
+        assert lines[1] == "incarnation=1 start=3"     # resumed, not rewound
+        assert lines[2] == "done"
+
+        # the surviving store really is the committed step-5 checkpoint
+        from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine \
+            import OrbaxCheckpointEngine
+
+        eng = OrbaxCheckpointEngine(str(ckpt))
+        assert eng.latest_tag() == "global_step5"

@@ -1,0 +1,129 @@
+"""Fault-injection harness (deepspeed_tpu/runtime/fault/injection.py)."""
+import time
+
+import pytest
+
+from deepspeed_tpu.runtime.fault import injection
+from deepspeed_tpu.runtime.fault.injection import (FaultInjector, FaultSpec,
+                                                   truncate_file)
+from deepspeed_tpu.runtime.fault.retry import reset_fault_counters
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_state():
+    injection.clear()
+    reset_fault_counters()
+    yield
+    injection.clear()
+    reset_fault_counters()
+
+
+class TestSpecParsing:
+    def test_full_spec_string(self):
+        inj = FaultInjector(
+            "site=ckpt_save,kind=io_error,times=2;"
+            "site=step,kind=slow,steps=3-5,delay=0.01;"
+            "site=step,kind=kill,steps=7|9,exit_code=3")
+        assert len(inj.specs) == 3
+        assert inj.specs[0].site == "ckpt_save"
+        assert inj.specs[0].times == 2
+        assert inj.specs[1].steps == frozenset({3, 4, 5})
+        assert inj.specs[1].delay == pytest.approx(0.01)
+        assert inj.specs[2].steps == frozenset({7, 9})
+        assert inj.specs[2].exit_code == 3
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError, match="unknown fault kind"):
+            FaultSpec.parse("site=x,kind=meteor")
+
+    def test_site_required(self):
+        with pytest.raises(ValueError, match="site"):
+            FaultSpec.parse("kind=io_error")
+
+
+class TestFiring:
+    def test_io_error_respects_times(self):
+        inj = FaultInjector("site=save,kind=io_error,times=2")
+        for _ in range(2):
+            with pytest.raises(OSError):
+                inj.inject("save")
+        inj.inject("save")  # budget spent: no-op
+        assert inj.fires["save:io_error"] == 2
+
+    def test_step_schedule(self):
+        inj = FaultInjector("site=step,kind=io_error,steps=3-4")
+        inj.inject("step", step=2)
+        with pytest.raises(OSError):
+            inj.inject("step", step=3)
+        with pytest.raises(OSError):
+            inj.inject("step", step=4)
+        inj.inject("step", step=5)
+        inj.inject("step")  # no step info -> scheduled spec never fires
+
+    def test_other_sites_untouched(self):
+        inj = FaultInjector("site=save,kind=io_error")
+        inj.inject("load")
+        inj.inject("commit")
+        assert not inj.fires
+
+    def test_probability_deterministic_with_seed(self):
+        fires = []
+        for _ in range(2):
+            inj = FaultInjector([FaultSpec(site="s", kind="io_error",
+                                           p=0.5, seed=42)])
+            fired = []
+            for i in range(32):
+                try:
+                    inj.inject("s")
+                    fired.append(False)
+                except OSError:
+                    fired.append(True)
+            fires.append(fired)
+        assert fires[0] == fires[1]          # reproducible
+        assert 4 < sum(fires[0]) < 28        # actually probabilistic
+
+    def test_slow_sleeps(self):
+        inj = FaultInjector("site=step,kind=slow,delay=0.05")
+        t0 = time.monotonic()
+        inj.inject("step")
+        assert time.monotonic() - t0 >= 0.045
+
+    def test_truncate_needs_path(self, tmp_path):
+        f = tmp_path / "meta.json"
+        f.write_bytes(b"x" * 100)
+        inj = FaultInjector("site=meta,kind=truncate,truncate_to=10")
+        with pytest.raises(ValueError, match="no path"):
+            inj.inject("meta")
+        inj2 = FaultInjector("site=meta,kind=truncate,truncate_to=10")
+        inj2.inject("meta", path=str(f))
+        assert f.stat().st_size == 10
+
+    def test_truncate_file_helper(self, tmp_path):
+        f = tmp_path / "shard"
+        f.write_bytes(b"y" * 64)
+        truncate_file(str(f), 8)
+        assert f.read_bytes() == b"y" * 8
+
+
+class TestGlobalInjector:
+    def test_inject_noop_without_configuration(self):
+        injection.inject("anything", step=1)  # must not raise
+
+    def test_env_var_pickup(self, monkeypatch):
+        monkeypatch.setenv(injection.ENV_VAR,
+                           "site=save,kind=io_error,times=1")
+        injection.clear()
+        with pytest.raises(OSError):
+            injection.inject("save")
+        injection.inject("save")
+        assert injection.get_injector().fires["save:io_error"] == 1
+
+    def test_configure_overrides_env(self, monkeypatch):
+        monkeypatch.setenv(injection.ENV_VAR, "site=a,kind=io_error")
+        inj = injection.configure("site=b,kind=io_error")
+        injection.inject("a")  # env spec not active
+        with pytest.raises(OSError):
+            injection.inject("b")
+        assert inj.fires["b:io_error"] == 1

@@ -1,0 +1,158 @@
+"""Retry/backoff decorator (deepspeed_tpu/runtime/fault/retry.py)."""
+import errno
+import os
+
+import pytest
+
+from deepspeed_tpu.runtime.fault import injection
+from deepspeed_tpu.runtime.fault.retry import (RetryPolicy, fault_counters,
+                                               reset_fault_counters, retryable)
+
+pytestmark = pytest.mark.fault
+
+FAST = RetryPolicy(max_retries=3, base_s=0.001, cap_s=0.004, jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_state():
+    injection.clear()
+    reset_fault_counters()
+    yield
+    injection.clear()
+    reset_fault_counters()
+
+
+class Flaky:
+    """Raises ``fail_times`` transient errors, then succeeds."""
+
+    def __init__(self, fail_times, exc=None):
+        self.remaining = fail_times
+        self.calls = 0
+        self.exc = exc or OSError(errno.EIO, "injected")
+
+    def __call__(self):
+        self.calls += 1
+        if self.remaining > 0:
+            self.remaining -= 1
+            raise self.exc
+        return "ok"
+
+
+class TestRetryable:
+    def test_succeeds_after_transient_eio(self):
+        flaky = Flaky(2)
+        fn = retryable("op", policy=FAST)(lambda: flaky())
+        assert fn() == "ok"
+        assert flaky.calls == 3
+        c = fault_counters()
+        assert c["retries"] == 2
+        assert c["retries/op"] == 2
+        assert "exhausted/op" not in c
+
+    def test_exhausts_and_raises_last_error(self):
+        flaky = Flaky(10)
+        fn = retryable("op", policy=FAST)(lambda: flaky())
+        with pytest.raises(OSError):
+            fn()
+        assert flaky.calls == FAST.max_attempts == 4
+        assert fault_counters()["exhausted/op"] == 1
+
+    def test_non_transient_error_propagates_immediately(self):
+        flaky = Flaky(10, exc=ValueError("bug, not flake"))
+        fn = retryable("op", policy=FAST)(lambda: flaky())
+        with pytest.raises(ValueError):
+            fn()
+        assert flaky.calls == 1
+        assert "retries" not in fault_counters()
+
+    def test_policy_resolved_from_instance_attribute(self):
+        class Engine:
+            retry_policy = RetryPolicy(max_retries=1, base_s=0.001, jitter=0.0)
+
+            def __init__(self):
+                self.flaky = Flaky(1)
+
+            @retryable("save")
+            def save(self):
+                return self.flaky()
+
+        e = Engine()
+        assert e.save() == "ok"
+        assert e.flaky.calls == 2
+
+        e2 = Engine()
+        e2.flaky = Flaky(5)  # 1 retry allowed -> exhausts
+        with pytest.raises(OSError):
+            e2.save()
+        assert e2.flaky.calls == 2
+
+    def test_sleep_durations_follow_backoff(self):
+        slept = []
+        flaky = Flaky(3)
+        pol = RetryPolicy(max_retries=3, base_s=0.1, cap_s=0.25, jitter=0.0)
+        fn = retryable("op", policy=pol, sleep=slept.append)(lambda: flaky())
+        assert fn() == "ok"
+        assert slept == [pytest.approx(0.1), pytest.approx(0.2), pytest.approx(0.25)]
+
+
+class TestRetryPolicy:
+    def test_delay_exponential_and_capped(self):
+        pol = RetryPolicy(base_s=0.1, cap_s=0.5, jitter=0.0)
+        assert [pol.delay(k) for k in range(4)] == \
+            [pytest.approx(v) for v in (0.1, 0.2, 0.4, 0.5)]
+
+    def test_jitter_bounded(self):
+        pol = RetryPolicy(base_s=1.0, cap_s=1.0, jitter=0.25)
+        for k in range(50):
+            d = pol.delay(0)
+            assert 0.75 <= d <= 1.25
+
+    def test_from_env(self, monkeypatch):
+        monkeypatch.setenv("DSTPU_RETRY_MAX", "7")
+        monkeypatch.setenv("DSTPU_RETRY_BASE_S", "0.5")
+        pol = RetryPolicy.from_env()
+        assert pol.max_retries == 7
+        assert pol.base_s == pytest.approx(0.5)
+
+    def test_from_config(self):
+        from deepspeed_tpu.runtime.config import FaultConfig
+
+        pol = RetryPolicy.from_config(FaultConfig(max_retries=9, retry_cap_s=1.5))
+        assert pol.max_retries == 9
+        assert pol.cap_s == pytest.approx(1.5)
+        assert isinstance(RetryPolicy.from_config(None), RetryPolicy)
+
+
+class TestCommInitRetry:
+    def test_comm_init_retries_injected_failures(self, monkeypatch):
+        """comm.init_distributed survives transient coordinator failures."""
+        from deepspeed_tpu import comm
+
+        monkeypatch.setenv("DSTPU_RETRY_BASE_S", "0.001")
+        comm.destroy_process_group()
+        injection.configure("site=comm_init,kind=io_error,times=2")
+        try:
+            comm.init_distributed()
+            assert comm.is_initialized()
+            c = fault_counters()
+            assert c["retries/comm_init"] == 2
+            assert c["injected/comm_init"] == 2
+        finally:
+            comm.destroy_process_group()
+
+    def test_comm_init_exhaustion_raises(self, monkeypatch):
+        from deepspeed_tpu import comm
+
+        monkeypatch.setenv("DSTPU_RETRY_MAX", "1")
+        monkeypatch.setenv("DSTPU_RETRY_BASE_S", "0.001")
+        comm.destroy_process_group()
+        injection.configure("site=comm_init,kind=io_error")
+        try:
+            with pytest.raises(OSError):
+                comm.init_distributed()
+            assert not comm.is_initialized()
+        finally:
+            injection.clear()
+            comm.destroy_process_group()
+            os.environ.pop("DSTPU_RETRY_MAX", None)
+            comm.init_distributed()  # restore for other tests

@@ -1,0 +1,63 @@
+"""Fault-path hygiene tooling: the no-bare-except lint
+(tools/check_no_bare_except.py) that keeps fault paths from swallowing
+errors, and the fault pytest marker registration."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.fault
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+LINT = os.path.join(REPO_ROOT, "tools", "check_no_bare_except.py")
+
+
+class TestNoBareExceptLint:
+    def test_tree_is_clean(self):
+        """deepspeed_tpu/ must stay free of bare except clauses — this IS the
+        CI gate, not just a test of the linter."""
+        proc = subprocess.run(
+            [sys.executable, LINT,
+             os.path.join(REPO_ROOT, "deepspeed_tpu")],
+            capture_output=True, text=True)
+        assert proc.returncode == 0, \
+            f"bare except clauses found:\n{proc.stdout}"
+
+    def test_linter_catches_offenders(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("try:\n    pass\nexcept:\n    pass\n"
+                       "try:\n    pass\nexcept Exception:\n    pass\n")
+        proc = subprocess.run([sys.executable, LINT, str(bad)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "bad.py:3" in proc.stdout
+        offenders = [l for l in proc.stdout.splitlines()
+                     if l.endswith(": bare except")]
+        assert len(offenders) == 1                     # line 7 is fine
+
+    def test_linter_accepts_clean_file(self, tmp_path):
+        good = tmp_path / "good.py"
+        good.write_text("try:\n    pass\nexcept (OSError, ValueError):\n    pass\n")
+        proc = subprocess.run([sys.executable, LINT, str(good)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0
+
+    def test_linter_reports_unparseable_files(self, tmp_path):
+        broken = tmp_path / "broken.py"
+        broken.write_text("def f(:\n")
+        proc = subprocess.run([sys.executable, LINT, str(broken)],
+                              capture_output=True, text=True)
+        assert proc.returncode == 1
+        assert "syntax error" in proc.stdout
+
+
+class TestMarkerRegistration:
+    def test_fault_marker_registered(self):
+        """The fault marker is declared in tests/pytest.ini so `-m fault`
+        selects the suite and strict-marker runs stay green."""
+        ini = os.path.join(REPO_ROOT, "tests", "pytest.ini")
+        with open(ini) as f:
+            content = f.read()
+        assert "fault:" in content

@@ -1,0 +1,155 @@
+"""Training watchdog (deepspeed_tpu/runtime/fault/watchdog.py)."""
+import time
+
+import pytest
+
+from deepspeed_tpu.runtime.fault import injection
+from deepspeed_tpu.runtime.fault.retry import (fault_counters,
+                                               reset_fault_counters)
+from deepspeed_tpu.runtime.fault.watchdog import Watchdog, WatchdogTimeout
+
+pytestmark = pytest.mark.fault
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_state():
+    injection.clear()
+    reset_fault_counters()
+    yield
+    injection.clear()
+    reset_fault_counters()
+
+
+def wait_for(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.01)
+    return False
+
+
+class TestWatchdog:
+    def test_pings_keep_it_quiet(self):
+        wd = Watchdog(deadline_s=0.5, poll_interval_s=0.02).start()
+        try:
+            for i in range(10):
+                wd.ping(step=i, phase="train_batch")
+                time.sleep(0.03)
+            assert wd.timeouts == 0
+        finally:
+            wd.stop()
+
+    def test_timeout_fires_with_postmortem_dump(self):
+        fired = []
+        wd = Watchdog(deadline_s=0.1, poll_interval_s=0.02,
+                      on_timeout=fired.append).start()
+        try:
+            wd.ping(step=41, phase="optimizer_step")
+            assert wait_for(lambda: wd.timeouts >= 1)
+            info = fired[0]
+            assert info["step"] == 41
+            assert info["phase"] == "optimizer_step"
+            assert info["last_heartbeat_age_s"] >= 0.1
+            assert fault_counters()["watchdog_timeouts"] >= 1
+        finally:
+            wd.stop()
+
+    def test_one_report_per_heartbeat_epoch(self):
+        wd = Watchdog(deadline_s=0.05, poll_interval_s=0.01).start()
+        try:
+            wd.ping(step=1, phase="train_batch")
+            assert wait_for(lambda: wd.timeouts == 1)
+            time.sleep(0.15)               # several poll intervals later...
+            assert wd.timeouts == 1        # ...still one report, no spam
+            wd.ping(step=2, phase="train_batch")   # new epoch re-arms
+            assert wait_for(lambda: wd.timeouts == 2)
+        finally:
+            wd.stop()
+
+    def test_raise_on_timeout_surfaces_at_next_ping(self):
+        wd = Watchdog(deadline_s=0.05, poll_interval_s=0.01,
+                      raise_on_timeout=True).start()
+        try:
+            wd.ping(step=7, phase="train_batch")
+            assert wait_for(lambda: wd.timeouts >= 1)
+            with pytest.raises(WatchdogTimeout, match="train_batch"):
+                wd.ping(step=8, phase="train_batch")
+            wd.ping(step=9)                # pending flag consumed
+        finally:
+            wd.stop()
+
+    def test_check_does_not_refresh_heartbeat(self):
+        wd = Watchdog(deadline_s=0.05, poll_interval_s=0.01,
+                      raise_on_timeout=True).start()
+        try:
+            wd.ping(step=1, phase="train_batch")
+            assert wait_for(lambda: wd.timeouts >= 1)
+            with pytest.raises(WatchdogTimeout):
+                wd.check()
+        finally:
+            wd.stop()
+
+    def test_quiet_phases_never_alarm(self):
+        """A finished (or not-yet-started) run parks in a quiet phase and
+        must not produce false 'likely hung' reports, no matter how stale
+        the heartbeat gets."""
+        wd = Watchdog(deadline_s=0.05, poll_interval_s=0.01).start()
+        try:
+            wd.ping(step=5, phase="idle")       # loop done, engine idle
+            time.sleep(0.2)                     # many deadlines elapse
+            assert wd.timeouts == 0
+            wd.ping(step=6, phase="train_batch")   # active again -> armed
+            assert wait_for(lambda: wd.timeouts == 1)
+        finally:
+            wd.stop()
+
+    def test_stop_is_idempotent_and_joins(self):
+        wd = Watchdog(deadline_s=10).start()
+        assert wd.running
+        wd.stop()
+        assert not wd.running
+        wd.stop()
+
+
+class TestEngineIntegration:
+    def test_engine_watchdog_lifecycle_and_pings(self):
+        from .test_engine import make_engine, random_batch
+
+        engine = make_engine(extra={"fault": {
+            "watchdog_enabled": True, "watchdog_deadline_s": 60.0}})
+        try:
+            assert engine.watchdog is not None and engine.watchdog.running
+            batch = random_batch(engine.train_batch_size())
+            engine.train_batch(batch)
+            engine.train_batch(batch)
+            dump = engine.watchdog.dump()
+            assert dump["phase"] == "idle"         # pinged after the step
+            assert dump["step"] == 2
+            assert dump["timeouts"] == 0
+        finally:
+            engine.close()
+        assert engine.watchdog is None
+
+    def test_engine_without_fault_config_has_no_watchdog(self):
+        from .test_engine import make_engine
+
+        engine = make_engine()
+        assert engine.watchdog is None
+
+    def test_injected_slow_step_trips_watchdog(self):
+        """Acceptance path: a straggling step is detected and attributed."""
+        from .test_engine import make_engine, random_batch
+
+        engine = make_engine(extra={"fault": {
+            "watchdog_enabled": True, "watchdog_deadline_s": 0.15}})
+        engine.watchdog.poll_interval_s = 0.02
+        injection.configure("site=step,kind=slow,delay=0.5,times=1")
+        try:
+            batch = random_batch(engine.train_batch_size())
+            engine.train_batch(batch)      # injected 0.5s stall inside the step
+            assert engine.watchdog.timeouts >= 1
+            assert fault_counters()["watchdog_timeouts"] >= 1
+            assert fault_counters()["injected/step"] == 1
+        finally:
+            engine.close()

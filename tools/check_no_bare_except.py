@@ -1,0 +1,56 @@
+#!/usr/bin/env python
+"""Fail on bare ``except:`` clauses in deepspeed_tpu/.
+
+A bare except swallows KeyboardInterrupt/SystemExit and — worse for the
+fault subsystem — hides the storage/transport errors the retry and
+verification machinery exists to surface.  ``except Exception:`` (or
+narrower) is always available and is what reviewers should see.
+
+Usage: ``python tools/check_no_bare_except.py [root ...]``
+Exit status 1 lists every offender as ``path:line``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+DEFAULT_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "deepspeed_tpu")
+
+
+def bare_excepts(path: str):
+    with open(path, "rb") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+    return [(node.lineno, "bare except")
+            for node in ast.walk(tree)
+            if isinstance(node, ast.ExceptHandler) and node.type is None]
+
+
+def main(argv=None) -> int:
+    roots = (argv if argv else sys.argv[1:]) or [DEFAULT_ROOT]
+    offenders = []
+    for root in roots:
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            files = [os.path.join(d, fn)
+                     for d, _dirs, fns in os.walk(root)
+                     for fn in fns if fn.endswith(".py")]
+        for path in sorted(files):
+            for lineno, why in bare_excepts(path):
+                offenders.append(f"{os.path.relpath(path)}:{lineno}: {why}")
+    if offenders:
+        print("\n".join(offenders))
+        print(f"\n{len(offenders)} bare except clause(s) — use "
+              f"'except Exception:' or narrower so fault paths stay visible.")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
