@@ -261,22 +261,61 @@ def timed_op(fn):
 
     For in-jit collectives, invocation here is a *trace*; we log the message
     size and a zero latency marker.  Host-blocking ops measure real wall time.
+    Records flow to the comms logger (when enabled) and are aggregated into
+    the telemetry metrics registry (when a telemetry hub is installed) —
+    either can be on without the other.
     """
+    from ..telemetry import get_telemetry
+    from ..utils.comms_logging import record_comm_telemetry
 
     @functools.wraps(fn)
     def wrapper(*args, log_name: Optional[str] = None, **kwargs):
         name = log_name or fn.__name__
-        if not comms_logger.should_log(name):
+        log_comms = comms_logger.should_log(name)
+        if not log_comms and get_telemetry() is None:
             return fn(*args, **kwargs)
         size = _nbytes(args[0]) if args else 0
         t0 = time.time()
         out = fn(*args, **kwargs)
         group = kwargs.get("group")
         n = _axis_size(_resolve_axes(group))
-        comms_logger.append(fn.__name__, name, size, time.time() - t0, n)
+        # An abstract-tracer result means this invocation was a jit TRACE:
+        # the measured wall time is compile bookkeeping, not a transfer, and
+        # must not pollute the latency/bandwidth aggregates.
+        trace_time = _is_tracer(out)
+        if log_comms:
+            # append() aggregates into the telemetry registry too
+            comms_logger.append(fn.__name__, name, size, time.time() - t0, n,
+                                trace_time=trace_time)
+        else:
+            record_comm_telemetry(fn.__name__, size, time.time() - t0, n,
+                                  trace_time=trace_time)
         return out
 
     return wrapper
+
+
+_TRACER_TYPES: Optional[tuple] = None
+
+
+def _is_tracer(x: Any) -> bool:
+    global _TRACER_TYPES
+    if _TRACER_TYPES is None:
+        types = []
+        for locate in ("jax.core", "jax._src.core"):
+            try:
+                import importlib
+
+                types.append(importlib.import_module(locate).Tracer)
+                break
+            except (ImportError, AttributeError):
+                continue
+        _TRACER_TYPES = tuple(types)
+    if _TRACER_TYPES:
+        return isinstance(x, _TRACER_TYPES)
+    # Tracer class relocated again: duck-type rather than silently treating
+    # trace-time invocations as real transfers
+    return type(x).__name__.endswith("Tracer")
 
 
 # --------------------------------------------------------------------- #

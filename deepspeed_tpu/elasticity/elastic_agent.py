@@ -31,6 +31,7 @@ import time
 from typing import Dict, List, Optional, Sequence
 
 from ..runtime.fault.retry import RetryPolicy, record_fault_event
+from ..telemetry import emit_event
 from ..utils.logging import logger
 
 
@@ -173,6 +174,10 @@ class DSElasticAgent:
                 logger.warning(
                     f"elastic agent: worker failed rc={failed} "
                     f"(restart {self.restart_count}/{self.max_restarts})")
+                emit_event("elastic_worker_failure", rc=failed,
+                           restart=self.restart_count,
+                           max_restarts=self.max_restarts,
+                           world_size=self.world_size)
                 self._terminate(self._procs)
                 if self.restart_count >= self.max_restarts:
                     raise WorkerGroupFailure(
@@ -180,6 +185,8 @@ class DSElasticAgent:
                         f"{self.restart_count} restarts")
                 delay = self.restart_policy.delay(self.restart_count)
                 record_fault_event("elastic/restarts")
+                emit_event("elastic_restart", restart=self.restart_count + 1,
+                           backoff_s=round(delay, 3), rc=failed)
                 logger.info(f"elastic agent: restarting worker group in "
                             f"{delay:.2f}s (backoff)")
                 if self._shutdown.wait(delay):

@@ -23,6 +23,9 @@ class Monitor:
     def write_events(self, event_list: List[Event]) -> None:  # pragma: no cover
         raise NotImplementedError
 
+    def flush(self) -> None:
+        """Push buffered events to the backing store (engine shutdown hook)."""
+
 
 class TensorBoardMonitor(Monitor):
     def __init__(self, config):
@@ -45,6 +48,10 @@ class TensorBoardMonitor(Monitor):
         for label, value, step in event_list:
             self.summary_writer.add_scalar(label, value, step)
         self.summary_writer.flush()
+
+    def flush(self) -> None:
+        if self.summary_writer is not None:
+            self.summary_writer.flush()
 
 
 class WandbMonitor(Monitor):
@@ -91,9 +98,21 @@ class CometMonitor(Monitor):
 
 
 class csvMonitor(Monitor):  # reference class name
-    def __init__(self, config):
+    """CSV writer: one file open per label per ``write_events`` call instead
+    of per event.  Default ``flush_every=1`` keeps write-through durability —
+    every call lands on disk, so a crash loses nothing.  Raising it buffers
+    rows across calls (fewer opens on slow/remote filesystems) at the cost of
+    up to ``flush_every - 1`` tail rows on a crash; the engine flushes on
+    shutdown either way."""
+
+    def __init__(self, config, flush_every: Optional[int] = None):
         super().__init__(config)
         self.filenames = {}
+        if flush_every is None:  # config block `csv_monitor.flush_every`
+            flush_every = getattr(config, "flush_every", 1) or 1
+        self.flush_every = max(int(flush_every), 1)
+        self._buffer: dict = {}   # label -> [(step, value), ...]
+        self._buffered = 0
         if self.enabled:
             self.output_path = os.path.join(config.output_path or "csv_logs",
                                             config.job_name)
@@ -103,6 +122,17 @@ class csvMonitor(Monitor):  # reference class name
         if not self.enabled:
             return
         for label, value, step in event_list:
+            self._buffer.setdefault(label, []).append((step, value))
+            self._buffered += 1
+        if self._buffered >= self.flush_every:
+            self.flush()
+
+    def flush(self) -> None:
+        if not self.enabled or not self._buffered:
+            return
+        for label, rows in self._buffer.items():
+            if not rows:
+                continue
             fname = os.path.join(self.output_path,
                                  label.replace("/", "_") + ".csv")
             new = not os.path.exists(fname)
@@ -110,7 +140,9 @@ class csvMonitor(Monitor):  # reference class name
                 w = csv.writer(f)
                 if new:
                     w.writerow(["step", label])
-                w.writerow([step, value])
+                w.writerows(rows)
+        self._buffer.clear()
+        self._buffered = 0
 
 
 def fault_events(step: int) -> List[Event]:
@@ -138,6 +170,25 @@ class MonitorMaster(Monitor):
         self.enabled = any(m.enabled for m in self._writers)
 
     def write_events(self, event_list: List[Event]) -> None:
+        """Fan events out to every enabled writer AND the telemetry metrics
+        registry.  The registry route is unconditional (when a telemetry hub
+        is installed) so scalar history exists even with every writer
+        disabled, and writers vs. telemetry can never drift apart — both see
+        the exact same event tuples."""
+        from ..telemetry import get_telemetry
+        from ..utils.logging import warning_once
+
+        tel = get_telemetry()
+        if tel is not None:
+            try:
+                tel.record_monitor_events(event_list)
+            except Exception as e:  # observability must never kill a step
+                warning_once(f"telemetry monitor route failed: {e!r}")
         for m in self._writers:
             if m.enabled:
                 m.write_events(event_list)
+
+    def flush(self) -> None:
+        for m in self._writers:
+            if m.enabled:
+                m.flush()

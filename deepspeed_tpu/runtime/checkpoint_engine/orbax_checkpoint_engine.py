@@ -20,8 +20,11 @@ from __future__ import annotations
 
 import json
 import os
+import time
 from typing import Any, List, Optional
 
+from ...telemetry import emit_event, span as telemetry_span
+from ...telemetry.events import _jsonable
 from ...utils.logging import logger
 from ..fault import injection
 from ..fault.atomic import atomic_write_text
@@ -52,16 +55,18 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         import orbax.checkpoint as ocp
 
         injection.inject("ckpt_save")
+        t0 = time.perf_counter()
         path = self._path(tag)
         is_dict = isinstance(payload, dict)
         state = payload.pop("state") if is_dict else payload
         try:
-            with ocp.PyTreeCheckpointer() as ckptr:
-                ckptr.save(os.path.join(path, "state"), state, force=True)
-            if is_dict:
-                meta = {k: v for k, v in payload.items()}
-                atomic_write_text(os.path.join(path, "meta.json"),
-                                  json.dumps(meta, default=_jsonable))
+            with telemetry_span("checkpoint/save", tag=str(tag)):
+                with ocp.PyTreeCheckpointer() as ckptr:
+                    ckptr.save(os.path.join(path, "state"), state, force=True)
+                if is_dict:
+                    meta = {k: v for k, v in payload.items()}
+                    atomic_write_text(os.path.join(path, "meta.json"),
+                                      json.dumps(meta, default=_jsonable))
         finally:
             if is_dict:
                 payload["state"] = state  # restore caller's dict on ALL paths
@@ -74,12 +79,15 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         # (corruption between now and then is caught by the loading process's
         # own verification — that engine instance has a cold cache)
         self._verified_tags.add(str(tag))
+        emit_event("checkpoint_save", tag=str(tag), path=path,
+                   duration_s=round(time.perf_counter() - t0, 6))
 
     @retryable("ckpt_load")
     def load(self, template: Any, tag: str) -> Any:
         import orbax.checkpoint as ocp
 
         injection.inject("ckpt_load")
+        t0 = time.perf_counter()
         path = self._path(tag)
         # skip re-hashing a tag this instance just verified in latest_tag() —
         # on a network filesystem the metadata walk is the expensive part
@@ -88,14 +96,17 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         is_dict = isinstance(template, dict)
         state_t = template.pop("state") if is_dict else template
         try:
-            with ocp.PyTreeCheckpointer() as ckptr:
-                restore_args = ocp.checkpoint_utils.construct_restore_args(state_t)
-                state = ckptr.restore(
-                    os.path.join(path, "state"), item=state_t,
-                    restore_args=restore_args)
+            with telemetry_span("checkpoint/load", tag=str(tag)):
+                with ocp.PyTreeCheckpointer() as ckptr:
+                    restore_args = ocp.checkpoint_utils.construct_restore_args(state_t)
+                    state = ckptr.restore(
+                        os.path.join(path, "state"), item=state_t,
+                        restore_args=restore_args)
         finally:
             if is_dict:
                 template["state"] = state_t  # restore caller's dict on ALL paths
+        emit_event("checkpoint_load", tag=str(tag), path=path,
+                   duration_s=round(time.perf_counter() - t0, 6))
         if is_dict:
             out = {"state": state}
             meta_path = os.path.join(path, "meta.json")
@@ -124,6 +135,7 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             history.append(str(tag))
             atomic_write_text(os.path.join(self.ckpt_dir, HISTORY_FILE),
                               "\n".join(history[-HISTORY_LIMIT:]) + "\n")
+        emit_event("checkpoint_commit", tag=str(tag), dir=self.ckpt_dir)
 
     def committed_tags(self) -> List[str]:
         """Tags ever published via commit(), oldest first (fallback
@@ -236,11 +248,5 @@ def _tag_step(tag) -> Optional[int]:
     return int(m.group(1)) if m else None
 
 
-def _jsonable(obj):
-    import numpy as np
-
-    if hasattr(obj, "item"):
-        return obj.item()
-    if isinstance(obj, np.ndarray):
-        return obj.tolist()
-    return str(obj)
+# _jsonable (the json.dumps default for meta.json) is shared with the
+# telemetry event log so the same payload serializes identically in both.

@@ -89,6 +89,10 @@ class MonitorWriterConfig(DeepSpeedConfigModel):
     enabled: bool = False
     output_path: str = ""
     job_name: str = "DeepSpeedTPUJob"
+    #: csv writer only: rows buffered between file writes (1 = write-through,
+    #: every write_events lands on disk; >1 trades crash-tail durability for
+    #: fewer file opens on slow/remote filesystems)
+    flush_every: int = 1
     # wandb extras
     team: Optional[str] = None
     group: Optional[str] = None
@@ -209,6 +213,33 @@ class FaultConfig(DeepSpeedConfigModel):
     watchdog_raise: bool = False
 
 
+class TelemetryConfig(DeepSpeedConfigModel):
+    """Unified telemetry (``deepspeed_tpu/telemetry/``): span tracing,
+    metrics registry, structured JSONL events, memory sampling.  Disabled by
+    default; when disabled the hot path sees only a ``None`` check."""
+
+    enabled: bool = False
+    #: all artifacts (events.jsonl, trace.json, metrics.prom) land here
+    output_dir: str = "telemetry"
+    #: write structured events through to events.jsonl as they happen
+    jsonl: bool = True
+    #: export a Chrome-trace/Perfetto trace.json of recorded spans on flush
+    chrome_trace: bool = True
+    #: write a Prometheus text-exposition snapshot (metrics.prom) on flush
+    prometheus: bool = True
+    #: fence instrumented spans with ``jax.block_until_ready`` so span times
+    #: cover device execution (adds a sync per fenced span — measurement mode)
+    fence: bool = False
+    #: sample live-array/device memory every N steps (0 disables)
+    memory_interval: int = 1
+    #: span ring-buffer cap (oldest spans drop past this, counted)
+    max_spans: int = 100000
+    #: per-histogram-series reservoir size for percentile estimates
+    histogram_max_samples: int = 4096
+    #: mirror spans into jax.profiler Trace/StepTraceAnnotation
+    jax_annotations: bool = True
+
+
 class AutotuningConfig(DeepSpeedConfigModel):
     enabled: bool = False
     fast: bool = True
@@ -293,7 +324,6 @@ class DeepSpeedConfig:
         self.csv_monitor = MonitorWriterConfig(**config.get("csv_monitor", {}))
         self.wandb = MonitorWriterConfig(**config.get("wandb", {}))
         self.comet = MonitorWriterConfig(**config.get("comet", {}))
-        self.comet = MonitorWriterConfig(**config.get("comet", {}))
         self.tensor_parallel = TensorParallelConfig(**config.get(
             "tensor_parallel", config.get("autotp", {})))
         self.pipeline = PipelineConfig(**config.get("pipeline", {}))
@@ -314,6 +344,7 @@ class DeepSpeedConfig:
         self.compression_config = CompressionConfig(**config.get("compression_training", {}))
         self.elasticity = ElasticityConfig(**config.get("elasticity", {}))
         self.fault = FaultConfig(**config.get("fault", {}))
+        self.telemetry = TelemetryConfig(**config.get("telemetry", {}))
         self.autotuning_config = AutotuningConfig(**config.get("autotuning", {}))
 
         self.sequence_parallel_size: int = config.get("sequence_parallel_size", 1)

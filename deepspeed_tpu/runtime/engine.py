@@ -35,6 +35,7 @@ from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..accelerator import get_accelerator
+from ..telemetry.trace import NULL_SPAN
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
 from .config import DeepSpeedConfig
@@ -88,11 +89,26 @@ class DeepSpeedEngine:
         self.topology = topology or get_topology()
         self.mesh = self.topology.mesh
         self.module = model
-        self._timers = SynchronizedWallClockTimer()
+
+        # ---- telemetry (must precede the timers that feed it) --------- #
+        # Installed process-globally so module-level instrumentation (comm
+        # facade, monitor fan-out, fault counters, checkpoint engine) can
+        # reach it; disabled = None, and every hot-path site guards on that.
+        self.telemetry = None
+        tcfg = getattr(config, "telemetry", None)
+        if tcfg is not None and tcfg.enabled:
+            from ..telemetry import Telemetry, set_telemetry
+
+            self.telemetry = Telemetry.from_config(tcfg)
+            set_telemetry(self.telemetry)
+        self._host_step_calls = 0   # host-side step counter (no device sync)
+
+        self._timers = SynchronizedWallClockTimer(telemetry=self.telemetry)
         self.tput_timer = ThroughputTimer(
             batch_size=config.train_batch_size or 1,
             steps_per_output=config.steps_per_print,
-            logging_fn=lambda m: log_dist(m, ranks=[0]))
+            logging_fn=lambda m: log_dist(m, ranks=[0]),
+            telemetry=self.telemetry)
 
         # ---- debug mode (SURVEY §5 determinism/NaN-check ask) --------- #
         # These toggle PROCESS-GLOBAL jax config (debug modes are process
@@ -329,12 +345,44 @@ class DeepSpeedEngine:
         if self.watchdog is not None:
             self.watchdog.ping(step=step, phase=phase)
 
+    def _span(self, name: str, sync=None, **attrs):
+        """Telemetry span, or the shared no-op when telemetry is disabled —
+        keeps instrumentation inline on the hot path at the cost of one
+        ``is None`` check."""
+        if self.telemetry is None:
+            return NULL_SPAN
+        return self.telemetry.span(name, sync=sync, **attrs)
+
+    def _fence_span(self, sp, value) -> None:
+        """Honor ``config.telemetry.fence``: make span ``sp`` block on
+        ``value`` at exit so it measures device execution, not dispatch.
+        The sync target (loss / updated state) only exists mid-span, hence
+        post-hoc rather than at span creation."""
+        if self.telemetry is not None and self.telemetry.fence:
+            sp.fence_on(value)
+
     def close(self):
-        """Release host-side resources (watchdog thread); engine state and
-        compiled functions stay usable."""
+        """Release host-side resources (watchdog thread) and flush
+        observability sinks (monitor writers, telemetry exports); engine
+        state and compiled functions stay usable."""
         if self.watchdog is not None:
             self.watchdog.stop()
             self.watchdog = None
+        if self.monitor is not None:
+            try:
+                self.monitor.flush()
+            except Exception as e:
+                logger.warning(f"monitor flush on close failed: {e!r}")
+        if self.telemetry is not None:
+            from ..telemetry import get_telemetry, set_telemetry
+
+            try:
+                self.telemetry.close()
+            except Exception as e:
+                logger.warning(f"telemetry flush on close failed: {e!r}")
+            if get_telemetry() is self.telemetry:
+                set_telemetry(None)
+            self.telemetry = None
 
     # ------------------------------------------------------------------ #
     # Introspection API (reference names)
@@ -531,18 +579,28 @@ class DeepSpeedEngine:
 
         ctx = jax.profiler.trace(cl.xprof_dir) if trace_now \
             else contextlib.nullcontext()
+        self._host_step_calls += 1
+        tel = self.telemetry
+        step_span = tel.tracer.step_span(
+            self._host_step_calls, name="engine/train_batch") \
+            if tel is not None else contextlib.nullcontext()
         self.tput_timer.start()
         if self.config.wall_clock_breakdown:
             self._timers("step").start()
-        with ctx:
-            self.state, loss = self._compiled["train_batch"](self.state, batch)
+        with step_span:
+            with ctx:
+                with self._span("engine/dispatch") as sp:
+                    self.state, loss = self._compiled["train_batch"](self.state, batch)
+                    self._fence_span(sp, loss)
+                if trace_now:
+                    jax.block_until_ready(loss)
             if trace_now:
-                jax.block_until_ready(loss)
-        if trace_now:
-            self._xprof_fired = True
-            log_dist(f"comms_logger: xprof trace for step {cl.xprof_step} "
-                     f"→ {cl.xprof_dir}", ranks=[0])
-        self.tput_timer.stop(sync=loss)
+                self._xprof_fired = True
+                log_dist(f"comms_logger: xprof trace for step {cl.xprof_step} "
+                         f"→ {cl.xprof_dir}", ranks=[0])
+            # the fence inside the step span makes it cover device time, not
+            # just Python dispatch
+            self.tput_timer.stop(sync=loss)
         if self.config.wall_clock_breakdown:
             self._timers("step").stop(sync=loss)
         if getattr(self.config, "debug_nan_check", False) and \
@@ -558,6 +616,9 @@ class DeepSpeedEngine:
         self._write_monitor_events(loss)
         step = self.global_steps
         self._heartbeat("idle", step=step)   # reuse the sync we just paid for
+        if self.telemetry is not None:
+            with self._span("telemetry/memory_sample"):
+                self.telemetry.memory.maybe_sample(step)
         cfg = self.config
         if cfg.steps_per_print and step > 0 and step % cfg.steps_per_print == 0:
             log_dist(f"step={step} loss={float(loss):.4f} "
@@ -620,7 +681,12 @@ class DeepSpeedEngine:
                 grad_acc=jax.tree.map(jnp.zeros_like, self.state.grad_acc))
 
     def _write_monitor_events(self, loss):
-        if self.monitor is None or not getattr(self.monitor, "enabled", False):
+        """Scalar fan-out: runs when any monitor writer OR telemetry is on
+        (MonitorMaster routes every event through the telemetry registry, so
+        telemetry alone still gets the scalar history)."""
+        if self.monitor is None or not (
+                getattr(self.monitor, "enabled", False)
+                or self.telemetry is not None):
             return
         step = self.global_steps
         events = [("Train/Samples/train_loss", float(loss), self.global_samples),
@@ -712,7 +778,9 @@ class DeepSpeedEngine:
         self._heartbeat("backward")
         if self.config.wall_clock_breakdown:
             self._timers("backward").start()
-        self.state, loss = self._compiled["micro"](self.state, batch)
+        with self._span("engine/backward") as sp:
+            self.state, loss = self._compiled["micro"](self.state, batch)
+            self._fence_span(sp, loss)
         if self.config.wall_clock_breakdown:
             self._timers("backward").stop(sync=loss)
         self._losses.append(loss)
@@ -726,7 +794,9 @@ class DeepSpeedEngine:
         if "step" not in self._compiled:
             self._compiled["step"] = self._build_step_fn()
         self._heartbeat("optimizer_step")
-        self.state = self._compiled["step"](self.state)
+        with self._span("engine/optimizer_step") as sp:
+            self.state = self._compiled["step"](self.state)
+            self._fence_span(sp, self.state.global_step)
         if self._losses:
             self._write_monitor_events(self._losses[-1])
             self._losses.clear()
@@ -756,9 +826,10 @@ class DeepSpeedEngine:
             "config": {"zero_stage": self.zero_stage,
                        "world_size": self.topology.world_size()},
         }
-        engine.save(payload, tag)
-        if save_latest:
-            engine.commit(tag)
+        with self._span("engine/save_checkpoint", tag=str(tag)):
+            engine.save(payload, tag)
+            if save_latest:
+                engine.commit(tag)
         self._heartbeat("idle")
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
         return True
@@ -777,8 +848,9 @@ class DeepSpeedEngine:
             if tag is None:
                 logger.warning(f"no (valid) checkpoint found under {load_dir}")
                 return None, {}
-        payload = engine.load({"state": self.state, "client_state": None,
-                               "lr_scheduler": None, "config": None}, tag)
+        with self._span("engine/load_checkpoint", tag=str(tag)):
+            payload = engine.load({"state": self.state, "client_state": None,
+                                   "lr_scheduler": None, "config": None}, tag)
         restored = payload["state"]
         # Re-place on this engine's target shardings (restore may commit
         # scalar leaves to a single device, which conflicts under jit).

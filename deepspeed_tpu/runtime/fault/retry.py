@@ -40,9 +40,20 @@ _COUNTER_LOCK = threading.Lock()
 _COUNTERS: "collections.Counter[str]" = collections.Counter()
 
 
-def record_fault_event(name: str, n: int = 1) -> None:
+def record_fault_event(name: str, n: int = 1, **fields) -> None:
     with _COUNTER_LOCK:
         _COUNTERS[name] += n
+    # Mirror into the telemetry subsystem (no-op when disabled): a counter
+    # for graphing plus a structured "fault" event for the run log.  Guarded:
+    # this module must stay loadable standalone, outside the package.
+    try:
+        from ...telemetry import get_telemetry
+    except (ImportError, ValueError):
+        return
+    tel = get_telemetry()
+    if tel is not None:
+        tel.metrics.counter("fault/events").inc(n, name=name)
+        tel.event("fault", name=name, count=n, **fields)
 
 
 def fault_counters() -> dict:

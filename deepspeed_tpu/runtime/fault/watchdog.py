@@ -15,9 +15,11 @@ thread resurfaces (which is also the first point it can act on it).
 from __future__ import annotations
 
 import json
+import sys
 import threading
 import time
-from typing import Callable, Optional
+import traceback
+from typing import Callable, Dict, List, Optional
 
 from ...utils.logging import logger
 from .retry import record_fault_event
@@ -25,6 +27,25 @@ from .retry import record_fault_event
 
 class WatchdogTimeout(RuntimeError):
     """A training step/collective exceeded the watchdog deadline."""
+
+
+def dump_all_stacks() -> Dict[str, List[str]]:
+    """Stack traces of EVERY live thread, keyed ``"<name>:<ident>"``.
+
+    The hung thread is almost never the watchdog's own — it's the training
+    thread stuck in a collective, a checkpoint writer stuck in I/O, or a
+    data-loader worker deadlocked on a queue.  A single-thread dump can't
+    show that; this is the post-mortem a timeout report needs.
+    """
+    names = {t.ident: t.name for t in threading.enumerate()}
+    stacks: Dict[str, List[str]] = {}
+    for tid, frame in sys._current_frames().items():
+        key = f"{names.get(tid, 'unknown')}:{tid}"
+        try:
+            stacks[key] = traceback.format_stack(frame)
+        except Exception as e:  # a frame can vanish mid-walk
+            stacks[key] = [f"<unavailable: {e!r}>"]
+    return stacks
 
 
 class Watchdog:
@@ -130,11 +151,21 @@ class Watchdog:
             if expired:
                 info = self.dump()
                 record_fault_event("watchdog_timeouts")
+                stacks = dump_all_stacks()
                 logger.error(
                     f"WATCHDOG: no heartbeat for {info['last_heartbeat_age_s']}s "
                     f"(deadline {self.deadline_s}s) — last known state: "
                     f"step={info['step']} phase={info['phase']!r}. A worker or "
                     f"collective is likely hung; dump: {json.dumps(info)}")
+                logger.error("WATCHDOG all-thread stack dump:\n" + "\n".join(
+                    f"--- thread {key} ---\n" + "".join(frames)
+                    for key, frames in stacks.items()))
+                try:
+                    from ...telemetry import emit_event
+
+                    emit_event("watchdog_timeout", thread_stacks=stacks, **info)
+                except Exception as e:
+                    logger.warning(f"watchdog telemetry event failed: {e!r}")
                 if self.on_timeout is not None:
                     try:
                         self.on_timeout(info)

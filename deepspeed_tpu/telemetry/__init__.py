@@ -1,0 +1,21 @@
+"""Unified telemetry: structured tracing, metrics, events, memory sampling.
+
+Enable via the ``telemetry`` config block (see ``runtime/config.py``):
+
+    {"telemetry": {"enabled": true, "output_dir": "telemetry_out"}}
+
+then summarize a finished run with ``bin/dstpu-telemetry <output_dir>``.
+"""
+from .events import EventLog, read_jsonl
+from .hub import (Telemetry, emit_event, get_telemetry, set_telemetry, span,
+                  telemetry_enabled)
+from .memory import MemorySampler
+from .metrics import Counter, Gauge, Histogram, MetricsRegistry
+from .trace import NULL_SPAN, SpanRecord, Tracer
+
+__all__ = [
+    "Counter", "EventLog", "Gauge", "Histogram", "MemorySampler",
+    "MetricsRegistry", "NULL_SPAN", "SpanRecord", "Telemetry", "Tracer",
+    "emit_event", "get_telemetry", "read_jsonl", "set_telemetry", "span",
+    "telemetry_enabled",
+]

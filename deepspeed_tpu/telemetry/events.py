@@ -1,0 +1,106 @@
+"""Structured event log: append-only JSONL with an in-memory ring mirror.
+
+Every telemetry record — spans, metric snapshots, checkpoint lifecycle,
+fault/watchdog incidents, memory samples — flows through here as one JSON
+object per line, so a single ``events.jsonl`` fully describes a run and the
+``dstpu-telemetry`` CLI (or any jq pipeline) can reconstruct it offline.
+
+Write-through semantics: events are flushed to disk as they are emitted
+(line-buffered + explicit flush) because the most interesting events are the
+ones right before a crash.  Event volume is low (per step / per incident,
+never per op dispatch), so durability wins over batching here.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+
+def _jsonable(obj):
+    """json.dumps ``default`` shared by the event log and checkpoint
+    meta.json: numpy/jax scalars → Python scalars, arrays → lists,
+    set/tuple → list, everything else → str."""
+    if hasattr(obj, "item"):        # 0-d numpy/jax scalar
+        try:
+            return obj.item()
+        except Exception:
+            pass                    # multi-element array: fall through
+    if hasattr(obj, "tolist"):
+        try:
+            return obj.tolist()
+        except Exception:
+            pass
+    if isinstance(obj, (set, tuple)):
+        return list(obj)
+    return str(obj)
+
+
+class EventLog:
+    def __init__(self, path: Optional[str] = None, max_memory: int = 10_000):
+        self.path = path
+        self._lock = threading.Lock()
+        self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
+            maxlen=int(max_memory))
+        self._fh = None
+        if path:
+            os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+            self._fh = open(path, "a", buffering=1)
+
+    # ---------------------------------------------------------------- #
+    def emit(self, kind: str, **fields) -> Dict[str, Any]:
+        rec: Dict[str, Any] = {"ts": time.time(), "kind": str(kind)}
+        rec.update(fields)
+        with self._lock:
+            self._ring.append(rec)
+            if self._fh is not None:
+                try:
+                    self._fh.write(json.dumps(rec, default=_jsonable) + "\n")
+                    self._fh.flush()
+                except (OSError, ValueError):
+                    pass  # a full/closed disk must not kill the training loop
+        return rec
+
+    def recent(self, n: Optional[int] = None,
+               kind: Optional[str] = None) -> List[Dict[str, Any]]:
+        with self._lock:
+            events = list(self._ring)
+        if kind is not None:
+            events = [e for e in events if e.get("kind") == kind]
+        return events[-n:] if n else events
+
+    def flush(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                    os.fsync(self._fh.fileno())
+                except (OSError, ValueError):
+                    pass
+
+    def close(self) -> None:
+        with self._lock:
+            if self._fh is not None:
+                try:
+                    self._fh.flush()
+                    self._fh.close()
+                except (OSError, ValueError):
+                    pass
+                self._fh = None
+
+
+def read_jsonl(path: str) -> Iterator[Dict[str, Any]]:
+    """Parse an events.jsonl, skipping torn/corrupt lines (a crashed writer
+    may leave a partial last line — the rest of the log is still good)."""
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                yield json.loads(line)
+            except json.JSONDecodeError:
+                continue

@@ -1,0 +1,87 @@
+"""Per-step memory sampler.
+
+What memory "did" during a run is the question HBM-bound training debugging
+always starts with.  Two complementary sources, both polled from the host:
+
+  * ``jax.live_arrays()`` — every live jax.Array this process holds a
+    reference to, summed into total bytes + count (catches Python-side leaks:
+    a list someone keeps appending device arrays to);
+  * ``device.memory_stats()`` — the runtime allocator's view
+    (``bytes_in_use`` / ``peak_bytes_in_use``) where the backend provides it
+    (TPU does; CPU may return None/{}).
+
+Samples land in the metrics registry (gauges track the high-water mark
+automatically) and as ``kind: "memory"`` structured events, so the run
+summary can print the peak and when it happened.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class MemorySampler:
+    def __init__(self, metrics, events=None, interval: int = 1):
+        self.metrics = metrics
+        self.events = events
+        #: sample every N steps; 0 disables periodic sampling
+        self.interval = int(interval)
+
+    def maybe_sample(self, step: int) -> Optional[Dict[str, Any]]:
+        if self.interval <= 0 or (step % self.interval) != 0:
+            return None
+        return self.sample(step=step)
+
+    def sample(self, step: Optional[int] = None) -> Dict[str, Any]:
+        import jax
+
+        out: Dict[str, Any] = {}
+        try:
+            live = jax.live_arrays()
+            out["live_array_bytes"] = int(
+                sum(getattr(a, "nbytes", 0) or 0 for a in live))
+            out["live_array_count"] = len(live)
+        except Exception:
+            pass
+
+        per_device = []
+        try:
+            for d in jax.local_devices():
+                stats = None
+                try:
+                    stats = d.memory_stats()
+                except Exception:
+                    stats = None
+                if not stats:
+                    continue
+                per_device.append({
+                    "device": str(d.id),
+                    "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                    "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+                })
+        except Exception:
+            pass
+        if per_device:
+            out["device_bytes_in_use"] = sum(
+                d["bytes_in_use"] for d in per_device)
+            out["device_peak_bytes_in_use"] = max(
+                d["peak_bytes_in_use"] for d in per_device)
+
+        if self.metrics is not None:
+            if "live_array_bytes" in out:
+                self.metrics.gauge("memory/live_array_bytes").set(
+                    out["live_array_bytes"])
+                self.metrics.gauge("memory/live_array_count").set(
+                    out["live_array_count"])
+            if "device_bytes_in_use" in out:
+                self.metrics.gauge("memory/device_bytes_in_use").set(
+                    out["device_bytes_in_use"])
+                self.metrics.gauge("memory/device_peak_bytes_in_use").set(
+                    out["device_peak_bytes_in_use"])
+        if self.events is not None and out:
+            fields = dict(out)
+            if step is not None:
+                fields["step"] = int(step)
+            self.events.emit("memory", **fields)
+        if step is not None:
+            out["step"] = int(step)
+        return out

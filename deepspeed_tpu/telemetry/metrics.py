@@ -1,0 +1,282 @@
+"""Metrics registry: counters, gauges, and histograms with labels.
+
+One process-wide aggregation point for everything the framework measures —
+step times, collective message sizes and bandwidths, memory samples, fault
+counters, monitor scalars.  Consumers:
+
+  * ``snapshot()`` — list of plain dicts, one per (metric, labelset) series,
+    written as ``kind: "metric"`` lines into the telemetry JSONL log;
+  * ``prometheus_text()`` — Prometheus text-exposition rendering for
+    scrape-style integration (written as ``metrics.prom`` on flush).
+
+Histograms keep exact count/sum/min/max plus a bounded uniform reservoir of
+samples for percentiles (`p50/p90/p95/p99`) — memory stays O(cap) no matter
+how many observations arrive, and the reservoir keeps every observation
+equally likely to be retained (Vitter's algorithm R).
+"""
+from __future__ import annotations
+
+import random
+import re
+import threading
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+_NAME_SANITIZE = re.compile(r"[^a-zA-Z0-9_:]")
+
+
+def _prom_name(name: str) -> str:
+    name = _NAME_SANITIZE.sub("_", name)
+    if name and name[0].isdigit():
+        name = "_" + name
+    return name
+
+
+def _prom_labels(key: LabelKey, extra: Iterable[Tuple[str, str]] = ()) -> str:
+    items = list(key) + list(extra)
+    if not items:
+        return ""
+    body = ",".join(f'{_prom_name(k)}="{v}"' for k, v in items)
+    return "{" + body + "}"
+
+
+class _CounterSeries:
+    __slots__ = ("value",)
+
+    def __init__(self):
+        self.value = 0.0
+
+
+class _GaugeSeries:
+    __slots__ = ("value", "vmin", "vmax", "count")
+
+    def __init__(self):
+        self.value = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.count = 0
+
+
+class _HistogramSeries:
+    __slots__ = ("count", "total", "vmin", "vmax", "samples")
+
+    def __init__(self):
+        self.count = 0
+        self.total = 0.0
+        self.vmin = float("inf")
+        self.vmax = float("-inf")
+        self.samples: List[float] = []
+
+
+class Metric:
+    kind = "abstract"
+
+    def __init__(self, name: str, registry: "MetricsRegistry"):
+        self.name = name
+        self._registry = registry
+        self._series: Dict[LabelKey, Any] = {}
+
+    def _get(self, labels: Dict[str, Any], factory):
+        key = _label_key(labels)
+        series = self._series.get(key)
+        if series is None:
+            series = self._series.setdefault(key, factory())
+        return series
+
+    def labelsets(self) -> List[LabelKey]:
+        return list(self._series.keys())
+
+
+class Counter(Metric):
+    kind = "counter"
+
+    def inc(self, n: float = 1.0, **labels) -> None:
+        with self._registry._lock:
+            self._get(labels, _CounterSeries).value += n
+
+    def value(self, **labels) -> float:
+        series = self._series.get(_label_key(labels))
+        return series.value if series else 0.0
+
+
+class Gauge(Metric):
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        with self._registry._lock:
+            s = self._get(labels, _GaugeSeries)
+            value = float(value)
+            s.value = value
+            s.count += 1
+            if value < s.vmin:
+                s.vmin = value
+            if value > s.vmax:
+                s.vmax = value
+
+    def value(self, **labels) -> Optional[float]:
+        s = self._series.get(_label_key(labels))
+        return s.value if s else None
+
+    def high_water(self, **labels) -> Optional[float]:
+        s = self._series.get(_label_key(labels))
+        return s.vmax if s and s.count else None
+
+
+class Histogram(Metric):
+    kind = "histogram"
+
+    def observe(self, value: float, **labels) -> None:
+        reg = self._registry
+        with reg._lock:
+            s = self._get(labels, _HistogramSeries)
+            value = float(value)
+            s.count += 1
+            s.total += value
+            if value < s.vmin:
+                s.vmin = value
+            if value > s.vmax:
+                s.vmax = value
+            cap = reg.histogram_max_samples
+            if len(s.samples) < cap:
+                s.samples.append(value)
+            else:  # reservoir: replace a uniform victim so old samples decay
+                j = reg._rng.randrange(s.count)
+                if j < cap:
+                    s.samples[j] = value
+
+    def percentile(self, q: float, **labels) -> Optional[float]:
+        s = self._series.get(_label_key(labels))
+        if s is None or not s.samples:
+            return None
+        return _percentile(sorted(s.samples), q)
+
+    def count(self, **labels) -> int:
+        s = self._series.get(_label_key(labels))
+        return s.count if s else 0
+
+    def sum(self, **labels) -> float:
+        s = self._series.get(_label_key(labels))
+        return s.total if s else 0.0
+
+    def mean(self, **labels) -> Optional[float]:
+        s = self._series.get(_label_key(labels))
+        if s is None or s.count == 0:
+            return None
+        return s.total / s.count
+
+
+def _percentile(sorted_vals: List[float], q: float) -> float:
+    """Linear-interpolated percentile, q in [0, 100]."""
+    if not sorted_vals:
+        raise ValueError("empty sample set")
+    if len(sorted_vals) == 1:
+        return sorted_vals[0]
+    pos = (q / 100.0) * (len(sorted_vals) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(sorted_vals) - 1)
+    frac = pos - lo
+    return sorted_vals[lo] * (1.0 - frac) + sorted_vals[hi] * frac
+
+
+_QUANTILES = (50.0, 90.0, 95.0, 99.0)
+
+
+class MetricsRegistry:
+    def __init__(self, histogram_max_samples: int = 4096, seed: int = 0):
+        self._lock = threading.RLock()
+        self._metrics: Dict[str, Metric] = {}
+        self.histogram_max_samples = int(histogram_max_samples)
+        self._rng = random.Random(seed)
+
+    # ---------------------------------------------------------------- #
+    def _get_or_create(self, name: str, cls):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = self._metrics[name] = cls(name, self)
+            elif not isinstance(m, cls):
+                raise TypeError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested {cls.kind}")
+            return m
+
+    def counter(self, name: str) -> Counter:
+        return self._get_or_create(name, Counter)
+
+    def gauge(self, name: str) -> Gauge:
+        return self._get_or_create(name, Gauge)
+
+    def histogram(self, name: str) -> Histogram:
+        return self._get_or_create(name, Histogram)
+
+    def get(self, name: str) -> Optional[Metric]:
+        return self._metrics.get(name)
+
+    def names(self) -> List[str]:
+        with self._lock:
+            return sorted(self._metrics.keys())
+
+    def clear(self) -> None:
+        with self._lock:
+            self._metrics.clear()
+
+    # ---------------------------------------------------------------- #
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """One dict per (metric, labelset) series — JSONL-ready."""
+        out: List[Dict[str, Any]] = []
+        with self._lock:
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                for key, s in sorted(m._series.items()):
+                    row: Dict[str, Any] = {"name": name, "type": m.kind,
+                                           "labels": dict(key)}
+                    if m.kind == "counter":
+                        row["value"] = s.value
+                    elif m.kind == "gauge":
+                        row.update(value=s.value, min=s.vmin, max=s.vmax,
+                                   count=s.count)
+                    else:
+                        row.update(count=s.count, sum=s.total)
+                        if s.count:
+                            row.update(min=s.vmin, max=s.vmax,
+                                       mean=s.total / s.count)
+                            svals = sorted(s.samples)
+                            for q in _QUANTILES:
+                                row[f"p{q:g}"] = _percentile(svals, q)
+                    out.append(row)
+        return out
+
+    def prometheus_text(self) -> str:
+        """Prometheus text exposition format snapshot."""
+        lines: List[str] = []
+        with self._lock:
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                pname = _prom_name(name)
+                lines.append(f"# TYPE {pname} "
+                             f"{'summary' if m.kind == 'histogram' else m.kind}")
+                for key, s in sorted(m._series.items()):
+                    if m.kind == "counter":
+                        lines.append(f"{pname}{_prom_labels(key)} {s.value:g}")
+                    elif m.kind == "gauge":
+                        lines.append(f"{pname}{_prom_labels(key)} {s.value:g}")
+                        if s.count:
+                            lines.append(
+                                f"{pname}_max{_prom_labels(key)} {s.vmax:g}")
+                    else:
+                        lines.append(f"{pname}_count{_prom_labels(key)} {s.count}")
+                        lines.append(f"{pname}_sum{_prom_labels(key)} {s.total:g}")
+                        if s.samples:
+                            svals = sorted(s.samples)
+                            for q in _QUANTILES:
+                                lab = _prom_labels(
+                                    key, [("quantile", f"{q / 100.0:g}")])
+                                lines.append(
+                                    f"{pname}{lab} {_percentile(svals, q):g}")
+        return "\n".join(lines) + ("\n" if lines else "")

@@ -1,0 +1,341 @@
+"""Run-summary: turn a telemetry output directory into a human report.
+
+Backs the ``bin/dstpu-telemetry`` CLI.  Reads the ``events.jsonl`` written by
+a run (spans, metric snapshots, structured events) — with ``trace.json`` as a
+span fallback for logs that predate the JSONL span mirror — and prints:
+
+  * a step-phase time breakdown (count / total / mean / p50 / p95 per span);
+  * a per-collective communication table (calls, bytes, latency, alg/bus
+    bandwidth estimates);
+  * memory high-water marks (live jax.Arrays + device allocator stats);
+  * an incident digest (faults, watchdog timeouts, checkpoint lifecycle).
+
+Everything is computed into a plain dict first (``summarize_run``) so tests
+and downstream tooling can consume the numbers without scraping text.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from .events import read_jsonl
+from .metrics import _percentile
+
+EVENT_KINDS_INCIDENT = ("fault", "watchdog_timeout", "elastic_worker_failure",
+                        "elastic_restart")
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n)
+    for unit in ("B", "KB", "MB", "GB", "TB"):
+        if abs(n) < 1024.0 or unit == "TB":
+            return f"{n:.2f} {unit}" if unit != "B" else f"{n:.0f} B"
+        n /= 1024.0
+    return f"{n:.2f} TB"
+
+
+def _fmt_ms(seconds: float) -> str:
+    return f"{seconds * 1e3:.2f}"
+
+
+# --------------------------------------------------------------------- #
+# Loaders
+# --------------------------------------------------------------------- #
+def load_run(events_path: Optional[str],
+             trace_path: Optional[str] = None) -> Dict[str, Any]:
+    """Parse the raw artifacts into {spans, metrics, events}.
+
+    ``metrics``: metric snapshots are cumulative, so only the LAST snapshot
+    row per (name, labelset) counts.
+    """
+    spans: List[Dict[str, Any]] = []
+    metrics: Dict[tuple, Dict[str, Any]] = {}
+    events: List[Dict[str, Any]] = []
+    runs = 0
+    if events_path and os.path.exists(events_path):
+        for rec in read_jsonl(events_path):
+            kind = rec.get("kind")
+            if kind == "run_start":
+                # append-mode log: summarize only the LATEST run, consistent
+                # with trace.json (which the last run overwrote)
+                runs += 1
+                spans.clear()
+                metrics.clear()
+                events.clear()
+                continue
+            if kind == "span":
+                spans.append(rec)
+            elif kind == "metric":
+                labels = rec.get("labels") or {}
+                key = (rec.get("name"),
+                       tuple(sorted((str(k), str(v))
+                                    for k, v in labels.items())))
+                metrics[key] = rec
+            else:
+                events.append(rec)
+    if not spans and trace_path and os.path.exists(trace_path):
+        try:
+            with open(trace_path) as f:
+                trace = json.load(f)
+            for ev in trace.get("traceEvents", []):
+                if ev.get("ph") != "X":
+                    continue
+                spans.append({
+                    "name": ev.get("name", "?"),
+                    "start_s": float(ev.get("ts", 0.0)) / 1e6,
+                    "dur_s": float(ev.get("dur", 0.0)) / 1e6,
+                    "depth": 0,
+                    "parent": (ev.get("args") or {}).get("parent"),
+                })
+        except (OSError, json.JSONDecodeError, ValueError):
+            pass
+    return {"spans": spans, "metrics": list(metrics.values()),
+            "events": events, "runs_in_log": max(runs, 1)}
+
+
+# --------------------------------------------------------------------- #
+# Sections
+# --------------------------------------------------------------------- #
+def step_breakdown(spans: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    groups: Dict[str, List[float]] = {}
+    errors: Dict[str, int] = {}
+    for s in spans:
+        name = s.get("name", "?")
+        groups.setdefault(name, []).append(float(s.get("dur_s", 0.0)))
+        if s.get("error"):
+            errors[name] = errors.get(name, 0) + 1
+    rows = []
+    for name, durs in groups.items():
+        durs_sorted = sorted(durs)
+        total = sum(durs)
+        rows.append({
+            "phase": name,
+            "count": len(durs),
+            "total_s": total,
+            "mean_s": total / len(durs),
+            "p50_s": _percentile(durs_sorted, 50),
+            "p95_s": _percentile(durs_sorted, 95),
+            "max_s": durs_sorted[-1],
+            "errors": errors.get(name, 0),
+        })
+    rows.sort(key=lambda r: r["total_s"], reverse=True)
+    return rows
+
+
+def _metric_map(metrics: Sequence[Dict[str, Any]],
+                name: str) -> Dict[tuple, Dict[str, Any]]:
+    out = {}
+    for m in metrics:
+        if m.get("name") == name:
+            labels = m.get("labels") or {}
+            out[tuple(sorted(labels.items()))] = m
+    return out
+
+
+def comm_table(metrics: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    calls = _metric_map(metrics, "comm/calls")
+    sizes = _metric_map(metrics, "comm/bytes")
+    lats = _metric_map(metrics, "comm/latency_s")
+    algbw = _metric_map(metrics, "comm/algbw_gbps")
+    busbw = _metric_map(metrics, "comm/busbw_gbps")
+    ranks = _metric_map(metrics, "comm/ranks")
+    ops = sorted({k for k in list(calls) + list(sizes)})
+    rows = []
+    for key in ops:
+        op = dict(key).get("op", "?")
+        size = sizes.get(key, {})
+        lat = lats.get(key, {})
+        rows.append({
+            "op": op,
+            "calls": int(calls.get(key, {}).get("value", 0)),
+            "bytes_total": size.get("sum", 0),
+            "bytes_mean": size.get("mean", 0),
+            "bytes_max": size.get("max", 0),
+            "latency_total_s": lat.get("sum", 0),
+            "latency_mean_s": lat.get("mean", 0),
+            "algbw_mean_gbps": algbw.get(key, {}).get("mean"),
+            "busbw_mean_gbps": busbw.get(key, {}).get("mean"),
+            "ranks": ranks.get(key, {}).get("value"),
+        })
+    rows.sort(key=lambda r: r["bytes_total"] or 0, reverse=True)
+    return rows
+
+
+def memory_summary(metrics: Sequence[Dict[str, Any]],
+                   events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    out: Dict[str, Any] = {}
+    for name in ("memory/live_array_bytes", "memory/live_array_count",
+                 "memory/device_bytes_in_use",
+                 "memory/device_peak_bytes_in_use"):
+        for m in metrics:
+            if m.get("name") == name and m.get("count"):
+                out[name.split("/", 1)[1] + "_max"] = m.get("max")
+    # which step hit the live-bytes peak (from per-step memory events)
+    peak, peak_step = -1.0, None
+    for e in events:
+        if e.get("kind") != "memory":
+            continue
+        v = e.get("live_array_bytes")
+        if v is not None and float(v) > peak:
+            peak, peak_step = float(v), e.get("step")
+    if peak_step is not None:
+        out["live_array_bytes_peak_step"] = peak_step
+    return out
+
+
+def incident_summary(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    counts: Dict[str, int] = {}
+    for e in events:
+        counts[e.get("kind", "?")] = counts.get(e.get("kind", "?"), 0) + 1
+    incidents = [e for e in events if e.get("kind") in EVENT_KINDS_INCIDENT]
+    checkpoints = [e for e in events
+                   if str(e.get("kind", "")).startswith("checkpoint")]
+    return {"event_counts": counts,
+            "incidents": incidents[-20:],
+            "checkpoints": checkpoints[-20:]}
+
+
+def summarize_run(events_path: Optional[str],
+                  trace_path: Optional[str] = None) -> Dict[str, Any]:
+    run = load_run(events_path, trace_path)
+    return {
+        "sources": {"events": events_path, "trace": trace_path},
+        "runs_in_log": run["runs_in_log"],
+        "n_spans": len(run["spans"]),
+        "step_breakdown": step_breakdown(run["spans"]),
+        "comm": comm_table(run["metrics"]),
+        "memory": memory_summary(run["metrics"], run["events"]),
+        "incidents": incident_summary(run["events"]),
+    }
+
+
+# --------------------------------------------------------------------- #
+# Rendering
+# --------------------------------------------------------------------- #
+def format_summary(s: Dict[str, Any]) -> str:
+    lines: List[str] = []
+    add = lines.append
+    add("=== dstpu telemetry run summary ===")
+    add(f"sources: events={s['sources']['events']} "
+        f"trace={s['sources']['trace']}")
+    if s.get("runs_in_log", 1) > 1:
+        add(f"note: log contains {s['runs_in_log']} runs — summarizing the "
+            f"latest only")
+    add("")
+
+    add("--- step-phase breakdown ---")
+    rows = s["step_breakdown"]
+    if rows:
+        add(f"{'phase':<32}{'count':>7}{'total(ms)':>12}{'mean(ms)':>11}"
+            f"{'p50(ms)':>11}{'p95(ms)':>11}{'max(ms)':>11}{'err':>5}")
+        for r in rows:
+            add(f"{r['phase']:<32}{r['count']:>7}{_fmt_ms(r['total_s']):>12}"
+                f"{_fmt_ms(r['mean_s']):>11}{_fmt_ms(r['p50_s']):>11}"
+                f"{_fmt_ms(r['p95_s']):>11}{_fmt_ms(r['max_s']):>11}"
+                f"{r['errors']:>5}")
+    else:
+        add("(no spans recorded)")
+    add("")
+
+    add("--- communication ---")
+    rows = s["comm"]
+    if rows:
+        add(f"{'op':<22}{'calls':>7}{'total':>12}{'mean msg':>12}"
+            f"{'lat(ms)':>10}{'algbw(GB/s)':>13}{'busbw(GB/s)':>13}")
+        for r in rows:
+            alg = f"{r['algbw_mean_gbps']:.2f}" if r.get("algbw_mean_gbps") \
+                else "-"
+            bus = f"{r['busbw_mean_gbps']:.2f}" if r.get("busbw_mean_gbps") \
+                else "-"
+            add(f"{r['op']:<22}{r['calls']:>7}"
+                f"{_fmt_bytes(r['bytes_total'] or 0):>12}"
+                f"{_fmt_bytes(r['bytes_mean'] or 0):>12}"
+                f"{_fmt_ms(r['latency_mean_s'] or 0):>10}{alg:>13}{bus:>13}")
+    else:
+        add("(no collectives recorded)")
+    add("")
+
+    add("--- memory high-water marks ---")
+    mem = s["memory"]
+    if mem:
+        if "live_array_bytes_max" in mem:
+            step = mem.get("live_array_bytes_peak_step")
+            at = f" (at step {step})" if step is not None else ""
+            add(f"live jax.Arrays: {_fmt_bytes(mem['live_array_bytes_max'])}"
+                f"{at}, count max "
+                f"{int(mem.get('live_array_count_max') or 0)}")
+        if "device_peak_bytes_in_use_max" in mem:
+            add(f"device allocator peak: "
+                f"{_fmt_bytes(mem['device_peak_bytes_in_use_max'])} "
+                f"(in_use max {_fmt_bytes(mem.get('device_bytes_in_use_max') or 0)})")
+    else:
+        add("(no memory samples)")
+    add("")
+
+    inc = s["incidents"]
+    add("--- events ---")
+    add("counts: " + json.dumps(inc["event_counts"], sort_keys=True))
+    for e in inc["checkpoints"]:
+        dur = e.get("duration_s")
+        dur_txt = f" in {dur:.3f}s" if isinstance(dur, (int, float)) else ""
+        add(f"  {e.get('kind')}: tag={e.get('tag')}{dur_txt}")
+    for e in inc["incidents"]:
+        add("  INCIDENT " + json.dumps(
+            {k: v for k, v in e.items() if k != "thread_stacks"},
+            sort_keys=True, default=str))
+    return "\n".join(lines)
+
+
+# --------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------- #
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+    import sys
+
+    parser = argparse.ArgumentParser(
+        prog="dstpu-telemetry",
+        description="Summarize a deepspeed_tpu telemetry output directory "
+                    "(step-phase breakdown, comm bandwidth, memory "
+                    "high-water marks).")
+    parser.add_argument("path",
+                        help="telemetry output dir (containing events.jsonl/"
+                             "trace.json) or a path to an events.jsonl")
+    parser.add_argument("--trace", default=None,
+                        help="explicit trace.json path (default: "
+                             "<dir>/trace.json)")
+    parser.add_argument("--json", action="store_true", dest="as_json",
+                        help="emit the summary as JSON instead of text")
+    args = parser.parse_args(argv)
+
+    path = args.path
+    if os.path.isdir(path):
+        events_path = os.path.join(path, "events.jsonl")
+        trace_path = args.trace or os.path.join(path, "trace.json")
+    else:
+        events_path = path
+        trace_path = args.trace
+    if not os.path.exists(events_path) and not (
+            trace_path and os.path.exists(trace_path)):
+        print(f"dstpu-telemetry: no events.jsonl or trace.json at {path}")
+        return 2
+
+    summary = summarize_run(events_path, trace_path)
+    try:
+        if args.as_json:
+            print(json.dumps(summary, indent=2, sort_keys=True, default=str))
+        else:
+            print(format_summary(summary))
+    except BrokenPipeError:   # e.g. piped into `head`
+        try:
+            sys.stdout.close()
+        except BrokenPipeError:
+            pass
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

@@ -1,0 +1,277 @@
+"""Span-based structured tracer.
+
+``tracer.span("fwd")`` nests (thread-local stack), records wall-clock
+durations, optionally fences on a JAX value (``block_until_ready``) so the
+measured time covers device execution instead of dispatch, and mirrors every
+span into ``jax.profiler.TraceAnnotation`` so spans line up with XLA ops when
+an xprof/jax profile is active.  ``step_span`` is the
+``StepTraceAnnotation`` analogue that delimits whole training steps.
+
+Export: :meth:`Tracer.to_chrome_trace` renders the recorded spans as a
+Chrome-trace/Perfetto-compatible JSON object (``ph: "X"`` complete events,
+microsecond timestamps) so a run can be dropped into ``chrome://tracing`` or
+https://ui.perfetto.dev with no conversion step.
+
+Disabled cost: a disabled tracer hands back one shared no-op span object —
+no allocation, no locking — so instrumentation can stay in the hot path
+unconditionally.
+"""
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class _NullSpan:
+    """Shared do-nothing span for disabled telemetry (zero per-call cost)."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        return False
+
+    def set(self, **attrs):
+        return self
+
+    def fence_on(self, value):
+        return self
+
+
+NULL_SPAN = _NullSpan()
+
+
+class SpanRecord:
+    __slots__ = ("name", "start_s", "dur_s", "depth", "parent", "tid",
+                 "attrs", "error")
+
+    def __init__(self, name: str, start_s: float, dur_s: float, depth: int,
+                 parent: Optional[str], tid: int,
+                 attrs: Optional[Dict[str, Any]], error: Optional[str]):
+        self.name = name
+        self.start_s = start_s      # seconds since tracer epoch
+        self.dur_s = dur_s
+        self.depth = depth
+        self.parent = parent
+        self.tid = tid
+        self.attrs = attrs
+        self.error = error
+
+    def to_dict(self) -> Dict[str, Any]:
+        d = {"name": self.name, "start_s": round(self.start_s, 9),
+             "dur_s": round(self.dur_s, 9), "depth": self.depth,
+             "parent": self.parent, "tid": self.tid}
+        if self.attrs:
+            d["attrs"] = self.attrs
+        if self.error:
+            d["error"] = self.error
+        return d
+
+
+class _Span:
+    __slots__ = ("_tracer", "name", "_attrs", "_sync", "_t0", "_annotation",
+                 "_step_num")
+
+    def __init__(self, tracer: "Tracer", name: str, sync: Any,
+                 attrs: Optional[Dict[str, Any]], step_num: Optional[int] = None):
+        self._tracer = tracer
+        self.name = name
+        self._attrs = attrs
+        self._sync = sync
+        self._t0 = 0.0
+        self._annotation = None
+        self._step_num = step_num
+
+    def set(self, **attrs) -> "_Span":
+        """Attach attributes after entry (e.g. values known only mid-span)."""
+        if self._attrs is None:
+            self._attrs = {}
+        self._attrs.update(attrs)
+        return self
+
+    def fence_on(self, value) -> "_Span":
+        """Fence span exit on ``value`` (``jax.block_until_ready``) — for
+        sync targets that only exist mid-span, e.g. the step's loss."""
+        self._sync = value
+        return self
+
+    def __enter__(self) -> "_Span":
+        tracer = self._tracer
+        stack = tracer._stack()
+        stack.append(self)
+        if tracer.jax_annotations:
+            try:
+                import jax
+
+                if self._step_num is not None:
+                    self._annotation = jax.profiler.StepTraceAnnotation(
+                        self.name, step_num=self._step_num)
+                else:
+                    self._annotation = jax.profiler.TraceAnnotation(self.name)
+                self._annotation.__enter__()
+            except Exception:
+                self._annotation = None
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> bool:
+        tracer = self._tracer
+        end = time.perf_counter()
+        try:
+            if self._sync is not None and exc_type is None:
+                try:
+                    import jax
+
+                    jax.block_until_ready(self._sync)
+                    end = time.perf_counter()
+                except Exception:
+                    pass
+            if self._annotation is not None:
+                try:
+                    self._annotation.__exit__(exc_type, exc, tb)
+                except Exception:
+                    pass
+        finally:
+            stack = tracer._stack()
+            depth = len(stack) - 1
+            if stack and stack[-1] is self:
+                stack.pop()
+            else:  # unbalanced exit — drop up to and including this span
+                while stack:
+                    if stack.pop() is self:
+                        break
+            parent = stack[-1].name if stack else None
+            tracer._record(SpanRecord(
+                name=self.name,
+                start_s=self._t0 - tracer._epoch,
+                dur_s=end - self._t0,
+                depth=max(depth, 0),
+                parent=parent,
+                tid=threading.get_ident(),
+                attrs=self._attrs,
+                error=exc_type.__name__ if exc_type is not None else None))
+        return False  # never swallow the exception
+
+
+class Tracer:
+    """Records nested spans; exports Chrome-trace JSON.
+
+    Parameters
+    ----------
+    enabled: disabled tracers return the shared :data:`NULL_SPAN`.
+    max_spans: ring-buffer cap — the newest spans win, and a dropped-span
+        counter records how many fell off (no silent truncation).
+    jax_annotations: mirror spans into ``jax.profiler.TraceAnnotation``.
+    """
+
+    def __init__(self, enabled: bool = True, max_spans: int = 100_000,
+                 jax_annotations: bool = True):
+        self.enabled = enabled
+        self.max_spans = max(int(max_spans), 1)
+        self.jax_annotations = jax_annotations
+        self.dropped = 0
+        self.total_recorded = 0   # monotonic; never decreases on eviction
+        self._epoch = time.perf_counter()
+        self._epoch_unix = time.time()
+        self._lock = threading.Lock()
+        self._spans: "collections.deque[SpanRecord]" = collections.deque(
+            maxlen=self.max_spans)
+        self._tls = threading.local()
+
+    # ---------------------------------------------------------------- #
+    def _stack(self) -> list:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        return stack
+
+    def _record(self, rec: SpanRecord) -> None:
+        with self._lock:
+            if len(self._spans) == self.max_spans:
+                self.dropped += 1   # deque(maxlen) evicts the oldest in O(1)
+            self._spans.append(rec)
+            self.total_recorded += 1
+
+    # ---------------------------------------------------------------- #
+    def span(self, name: str, sync: Any = None, **attrs):
+        """Context manager for one timed span.
+
+        ``sync``: a JAX value to ``block_until_ready`` at span exit, so the
+        span covers device time, not just Python dispatch.
+        """
+        if not self.enabled:
+            return NULL_SPAN
+        return _Span(self, name, sync, attrs or None)
+
+    def step_span(self, step_num: int, name: str = "train_step",
+                  sync: Any = None):
+        """Step-delimiting span; also emits ``StepTraceAnnotation`` so an
+        active JAX profile groups device ops per training step."""
+        if not self.enabled:
+            return NULL_SPAN
+        return _Span(self, name, sync, {"step": int(step_num)},
+                     step_num=int(step_num))
+
+    def current_span(self) -> Optional[str]:
+        stack = self._stack()
+        return stack[-1].name if stack else None
+
+    def depth(self) -> int:
+        return len(self._stack())
+
+    # ---------------------------------------------------------------- #
+    def records(self) -> List[SpanRecord]:
+        with self._lock:
+            return list(self._spans)
+
+    def snapshot(self) -> Tuple[List[SpanRecord], int]:
+        """(buffered records, total ever recorded) read atomically — the
+        incremental-export bookkeeping in ``Telemetry.flush`` needs both from
+        the same instant or ring eviction between the two reads skews it."""
+        with self._lock:
+            return list(self._spans), self.total_recorded
+
+    def clear(self) -> None:
+        with self._lock:
+            self._spans.clear()
+            self.dropped = 0
+            self.total_recorded = 0
+
+    def to_chrome_trace(self) -> Dict[str, Any]:
+        """Chrome-trace JSON object (``chrome://tracing`` / Perfetto)."""
+        events = []
+        for rec in self.records():
+            ev = {
+                "name": rec.name,
+                "ph": "X",
+                "ts": rec.start_s * 1e6,     # µs
+                "dur": rec.dur_s * 1e6,
+                "pid": 0,
+                "tid": rec.tid,
+                "args": dict(rec.attrs or {}),
+            }
+            if rec.error:
+                ev["args"]["error"] = rec.error
+            if rec.parent:
+                ev["args"]["parent"] = rec.parent
+            events.append(ev)
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            "metadata": {"epoch_unix_s": self._epoch_unix,
+                         "dropped_spans": self.dropped},
+        }
+
+    def export_chrome_trace(self, path: str) -> str:
+        import json
+        import os
+
+        from ..runtime.fault.atomic import atomic_write_text
+
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+        atomic_write_text(path, json.dumps(self.to_chrome_trace()))
+        return path

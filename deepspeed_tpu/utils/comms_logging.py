@@ -21,9 +21,19 @@ from typing import Dict, List, Optional
 
 
 def get_caller_func(frame_depth: int = 3) -> str:
+    """Name of the function ``frame_depth`` frames up the stack.
+
+    Robust to shallow stacks: a fixed ``sys._getframe(3)`` raises ValueError
+    when the caller sits near the top level (REPL, script body, test
+    function) — walk up instead and stop at the outermost frame.
+    """
     import sys
 
-    frame = sys._getframe(frame_depth)
+    frame = sys._getframe(0)
+    for _ in range(max(int(frame_depth), 0)):
+        if frame.f_back is None:
+            break
+        frame = frame.f_back
     return frame.f_code.co_name
 
 
@@ -46,6 +56,31 @@ def calc_bw_log(comm_op: str, size_bytes: int, duration_s: float, n_ranks: int):
         algbw = size_bytes / duration_s
         busbw = algbw
     return algbw / 1e9, busbw / 1e9
+
+
+def record_comm_telemetry(op_name: str, size_bytes: int, duration_s: float,
+                          n_ranks: int, algbw: Optional[float] = None,
+                          busbw: Optional[float] = None,
+                          trace_time: bool = False) -> None:
+    """Aggregate one collective into the telemetry metrics registry (no-op
+    when telemetry is disabled): per-op message-size/latency/bandwidth
+    histograms the run summary renders into the comm table.
+
+    ``trace_time=True`` marks an in-jit invocation: the wall time measured
+    around a *trace* is compile-time bookkeeping, not a transfer, so only
+    calls/sizes/ranks are aggregated — one bogus trace sample would corrupt
+    the mean bandwidth the summary table reports."""
+    from ..telemetry import get_telemetry
+
+    tel = get_telemetry()
+    if tel is None:
+        return
+    if trace_time:
+        tel.record_comm_op(op_name, size_bytes, None, n_ranks, 0.0, 0.0)
+        return
+    if algbw is None or busbw is None:
+        algbw, busbw = calc_bw_log(op_name, size_bytes, duration_s, n_ranks)
+    tel.record_comm_op(op_name, size_bytes, duration_s, n_ranks, algbw, busbw)
 
 
 class CommsLogger:
@@ -76,13 +111,22 @@ class CommsLogger:
         return self.prof_all or op_name in self.prof_ops
 
     def append(self, op_name: str, raw_name: str, size_bytes: int,
-               duration_s: float, n_ranks: int) -> None:
-        algbw, busbw = calc_bw_log(op_name, size_bytes, duration_s, n_ranks)
+               duration_s: float, n_ranks: int,
+               trace_time: bool = False) -> None:
+        if trace_time:
+            # the documented "zero latency marker": a jit trace is not a
+            # transfer, so its wall time (compile bookkeeping) must not skew
+            # the per-size latency/bandwidth aggregates log_summary reports
+            duration_s, algbw, busbw = 0.0, 0.0, 0.0
+        else:
+            algbw, busbw = calc_bw_log(op_name, size_bytes, duration_s, n_ranks)
         per_size = self.comms_dict[op_name].setdefault(size_bytes, [0, 0.0, 0.0, 0.0])
         per_size[0] += 1
         per_size[1] += duration_s
         per_size[2] += algbw
         per_size[3] += busbw
+        record_comm_telemetry(op_name, size_bytes, duration_s, n_ranks,
+                              algbw, busbw, trace_time=trace_time)
         if self.verbose:
             from .logging import logger
 

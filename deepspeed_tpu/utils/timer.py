@@ -18,12 +18,13 @@ STEP_GLOBAL_TIMER = "step"
 
 
 class _Timer:
-    def __init__(self, name: str):
+    def __init__(self, name: str, telemetry=None):
         self.name = name
         self.started = False
         self._start = 0.0
         self.elapsed_total = 0.0
         self.count = 0
+        self.telemetry = telemetry
 
     def start(self):
         self.started = True
@@ -36,9 +37,13 @@ class _Timer:
             import jax
 
             jax.block_until_ready(sync)
-        self.elapsed_total += time.perf_counter() - self._start
+        duration = time.perf_counter() - self._start
+        self.elapsed_total += duration
         self.count += 1
         self.started = False
+        if self.telemetry is not None:
+            self.telemetry.metrics.histogram("timer/seconds").observe(
+                duration, name=self.name)
 
     def elapsed(self, reset: bool = True) -> float:
         out = self.elapsed_total
@@ -56,14 +61,17 @@ class _Timer:
 
 
 class SynchronizedWallClockTimer:
-    """Named-timer registry (reference: utils/timer.py:44)."""
+    """Named-timer registry (reference: utils/timer.py:44).  With a telemetry
+    hub attached, every ``stop()`` also lands in the ``timer/seconds``
+    histogram (labelled by timer name)."""
 
-    def __init__(self):
+    def __init__(self, telemetry=None):
         self.timers: Dict[str, _Timer] = {}
+        self.telemetry = telemetry
 
     def __call__(self, name: str) -> _Timer:
         if name not in self.timers:
-            self.timers[name] = _Timer(name)
+            self.timers[name] = _Timer(name, telemetry=self.telemetry)
         return self.timers[name]
 
     def log(self, names: List[str], normalizer: float = 1.0, reset: bool = True,
@@ -86,11 +94,12 @@ class ThroughputTimer:
 
     def __init__(self, batch_size: int, start_step: int = 2,
                  steps_per_output: int = 50, monitor_memory: bool = False,
-                 logging_fn=None):
+                 logging_fn=None, telemetry=None):
         self.batch_size = max(batch_size, 1)
         self.start_step = start_step
         self.steps_per_output = steps_per_output
         self.logging = logging_fn
+        self.telemetry = telemetry
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
@@ -117,6 +126,11 @@ class ThroughputTimer:
             return  # skip warmup/compile steps
         self.total_elapsed_time += duration
         self.step_elapsed_time += duration
+        if self.telemetry is not None:
+            m = self.telemetry.metrics
+            m.histogram("engine/step_time_s").observe(duration)
+            m.gauge("engine/samples_per_sec").set(self.avg_samples_per_sec())
+            m.counter("engine/steps").inc()
         if report_speed and self.logging and \
                 self.global_step_count % self.steps_per_output == 0:
             self.logging(

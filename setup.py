@@ -22,5 +22,5 @@ setup(
         "hf": ["transformers", "torch"],
         "dev": ["pytest", "chex"],
     },
-    scripts=["bin/dstpu", "bin/ds_report"],
+    scripts=["bin/dstpu", "bin/ds_report", "bin/dstpu-telemetry"],
 )

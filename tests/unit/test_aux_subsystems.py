@@ -188,9 +188,42 @@ class TestMonitor:
         mon = csvMonitor(MonitorWriterConfig(enabled=True, output_path=str(tmp_path),
                                              job_name="job"))
         mon.write_events([("Train/loss", 1.5, 10)])
+        # default flush_every=1 is write-through: on disk with no flush()
         files = list((tmp_path / "job").glob("*.csv"))
         assert len(files) == 1
         assert "1.5" in files[0].read_text()
+
+    def test_csv_monitor_opt_in_buffering_flushed_explicitly(self, tmp_path):
+        from deepspeed_tpu.monitor.monitor import csvMonitor
+        from deepspeed_tpu.runtime.config import MonitorWriterConfig
+
+        mon = csvMonitor(MonitorWriterConfig(enabled=True, output_path=str(tmp_path),
+                                             job_name="job"), flush_every=10)
+        mon.write_events([("Train/loss", 1.5, 10)])
+        assert not list((tmp_path / "job").glob("*.csv"))  # buffered
+        mon.flush()  # what engine.close() calls
+        assert "1.5" in list((tmp_path / "job").glob("*.csv"))[0].read_text()
+
+    def test_csv_monitor_auto_flushes_past_threshold(self, tmp_path):
+        from deepspeed_tpu.monitor.monitor import csvMonitor
+        from deepspeed_tpu.runtime.config import MonitorWriterConfig
+
+        mon = csvMonitor(MonitorWriterConfig(enabled=True, output_path=str(tmp_path),
+                                             job_name="job"), flush_every=3)
+        mon.write_events([("Train/loss", float(i), i) for i in range(3)])
+        files = list((tmp_path / "job").glob("*.csv"))
+        assert len(files) == 1 and mon._buffered == 0
+
+    def test_csv_monitor_flush_every_reachable_from_config(self, tmp_path):
+        from deepspeed_tpu.monitor.monitor import csvMonitor
+        from deepspeed_tpu.runtime.config import MonitorWriterConfig
+
+        cfg = MonitorWriterConfig(enabled=True, output_path=str(tmp_path),
+                                  job_name="job", flush_every=5)
+        mon = csvMonitor(cfg)
+        assert mon.flush_every == 5
+        mon.write_events([("Train/loss", 1.0, 1)])
+        assert not list((tmp_path / "job").glob("*.csv"))  # buffered
 
 
 class TestMonitorMaster:

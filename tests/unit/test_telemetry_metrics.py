@@ -1,0 +1,155 @@
+"""Metrics registry tests: counters/gauges/histograms with labels,
+percentiles, Prometheus exposition, and the JSONL event log round-trip."""
+import json
+import threading
+
+import pytest
+
+from deepspeed_tpu.telemetry.events import EventLog, read_jsonl
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+
+pytestmark = pytest.mark.telemetry
+
+
+class TestCounters:
+    def test_inc_and_labels(self):
+        reg = MetricsRegistry()
+        c = reg.counter("comm/calls")
+        c.inc(op="all_reduce")
+        c.inc(2, op="all_reduce")
+        c.inc(op="barrier")
+        assert c.value(op="all_reduce") == 3
+        assert c.value(op="barrier") == 1
+        assert c.value(op="missing") == 0
+
+    def test_type_conflict_raises(self):
+        reg = MetricsRegistry()
+        reg.counter("x")
+        with pytest.raises(TypeError):
+            reg.gauge("x")
+
+
+class TestGauges:
+    def test_high_water_tracking(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("memory/bytes")
+        for v in (10, 50, 20):
+            g.set(v)
+        assert g.value() == 20
+        assert g.high_water() == 50
+
+
+class TestHistograms:
+    def test_percentiles_uniform(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("lat")
+        for i in range(1, 101):
+            h.observe(float(i))
+        assert h.count() == 100
+        assert h.sum() == sum(range(1, 101))
+        assert abs(h.percentile(50) - 50.5) < 1e-9
+        assert abs(h.percentile(95) - 95.05) < 1e-9
+        assert h.mean() == pytest.approx(50.5)
+
+    def test_reservoir_caps_memory_keeps_stats_exact(self):
+        reg = MetricsRegistry(histogram_max_samples=64)
+        h = reg.histogram("big")
+        for i in range(10_000):
+            h.observe(float(i))
+        series = h._series[()]
+        assert len(series.samples) == 64       # bounded
+        assert h.count() == 10_000             # exact
+        assert series.vmin == 0 and series.vmax == 9999
+        # reservoir percentile is approximate but must stay in range
+        assert 0 <= h.percentile(50) <= 9999
+
+    def test_labelled_series_isolated(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("comm/bytes")
+        h.observe(100, op="all_reduce")
+        h.observe(300, op="all_gather")
+        assert h.mean(op="all_reduce") == 100
+        assert h.mean(op="all_gather") == 300
+
+    def test_thread_safety(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t")
+
+        def work():
+            for i in range(1000):
+                h.observe(i)
+
+        threads = [threading.Thread(target=work) for _ in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert h.count() == 4000
+
+
+class TestSnapshots:
+    def test_snapshot_rows(self):
+        reg = MetricsRegistry()
+        reg.counter("c").inc(5)
+        reg.gauge("g").set(1.5)
+        reg.histogram("h").observe(2.0, op="x")
+        rows = {(r["name"], tuple(sorted(r["labels"].items())))
+                : r for r in reg.snapshot()}
+        assert rows[("c", ())]["value"] == 5
+        assert rows[("g", ())]["max"] == 1.5
+        hrow = rows[("h", (("op", "x"),))]
+        assert hrow["count"] == 1 and hrow["p50"] == 2.0
+
+    def test_prometheus_text(self):
+        reg = MetricsRegistry()
+        reg.counter("comm/calls").inc(3, op="all_reduce")
+        reg.gauge("mem.bytes").set(7)
+        reg.histogram("lat").observe(0.5)
+        text = reg.prometheus_text()
+        assert '# TYPE comm_calls counter' in text
+        assert 'comm_calls{op="all_reduce"} 3' in text
+        assert "mem_bytes 7" in text          # sanitized name
+        assert "lat_count 1" in text
+        assert 'lat{quantile="0.5"} 0.5' in text
+
+
+class TestEventLogRoundTrip:
+    def test_jsonl_write_and_read(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path=path)
+        log.emit("checkpoint_save", tag="t1", duration_s=0.25)
+        log.emit("fault", name="retries", count=2)
+        log.close()
+        recs = list(read_jsonl(path))
+        assert [r["kind"] for r in recs] == ["checkpoint_save", "fault"]
+        assert recs[0]["tag"] == "t1"
+        assert all("ts" in r for r in recs)
+
+    def test_torn_last_line_skipped(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path=path)
+        log.emit("ok", a=1)
+        log.close()
+        with open(path, "a") as f:
+            f.write('{"kind": "torn", "a"')   # crash mid-write
+        recs = list(read_jsonl(path))
+        assert [r["kind"] for r in recs] == ["ok"]
+
+    def test_ring_mirror(self):
+        log = EventLog(path=None, max_memory=3)
+        for i in range(5):
+            log.emit("e", i=i)
+        recent = log.recent()
+        assert [r["i"] for r in recent] == [2, 3, 4]
+        assert log.recent(kind="nope") == []
+
+    def test_non_jsonable_values_stringified(self, tmp_path):
+        import numpy as np
+
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path=path)
+        log.emit("e", arr=np.float32(1.5), obj=object())
+        log.close()
+        (rec,) = list(read_jsonl(path))
+        assert rec["arr"] == 1.5
+        assert isinstance(rec["obj"], str)

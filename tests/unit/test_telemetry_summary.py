@@ -1,0 +1,113 @@
+"""Run-summary tests: JSONL round-trip through the summarizer and the
+``bin/dstpu-telemetry`` CLI."""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from deepspeed_tpu.telemetry import Telemetry
+from deepspeed_tpu.telemetry.summary import format_summary, summarize_run
+
+pytestmark = pytest.mark.telemetry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CLI = os.path.join(REPO_ROOT, "bin", "dstpu-telemetry")
+
+
+def make_run(tmp_path) -> str:
+    """Produce a realistic telemetry output dir via the public API."""
+    out = str(tmp_path / "tel")
+    tel = Telemetry(output_dir=out, memory_interval=0)
+    for step in range(3):
+        with tel.tracer.step_span(step, name="engine/train_batch"):
+            with tel.span("engine/dispatch"):
+                pass
+        tel.metrics.histogram("engine/step_time_s").observe(0.1 + 0.01 * step)
+    tel.record_comm_op("all_reduce", 1 << 20, 0.002, 8, 0.52, 0.92)
+    tel.record_comm_op("all_reduce", 1 << 20, 0.002, 8, 0.52, 0.92)
+    tel.record_comm_op("all_gather", 1 << 18, 0.001, 8, 0.26, 0.23)
+    tel.metrics.gauge("memory/live_array_bytes").set(100.0)
+    tel.metrics.gauge("memory/live_array_bytes").set(4096.0)
+    tel.metrics.gauge("memory/live_array_bytes").set(2048.0)
+    tel.event("memory", live_array_bytes=4096, step=1)
+    tel.event("checkpoint_save", tag="global_step3", duration_s=0.5)
+    tel.event("fault", name="retries", count=1)
+    tel.close()
+    return out
+
+
+class TestSummarize:
+    def test_step_breakdown(self, tmp_path):
+        out = make_run(tmp_path)
+        s = summarize_run(os.path.join(out, "events.jsonl"),
+                          os.path.join(out, "trace.json"))
+        phases = {r["phase"]: r for r in s["step_breakdown"]}
+        assert phases["engine/train_batch"]["count"] == 3
+        assert phases["engine/dispatch"]["count"] == 3
+        assert phases["engine/train_batch"]["p95_s"] >= \
+            phases["engine/train_batch"]["p50_s"]
+
+    def test_comm_table(self, tmp_path):
+        out = make_run(tmp_path)
+        s = summarize_run(os.path.join(out, "events.jsonl"))
+        comm = {r["op"]: r for r in s["comm"]}
+        ar = comm["all_reduce"]
+        assert ar["calls"] == 2
+        assert ar["bytes_total"] == 2 * (1 << 20)
+        assert ar["busbw_mean_gbps"] == pytest.approx(0.92)
+        assert comm["all_gather"]["calls"] == 1
+
+    def test_memory_high_water(self, tmp_path):
+        out = make_run(tmp_path)
+        s = summarize_run(os.path.join(out, "events.jsonl"))
+        assert s["memory"]["live_array_bytes_max"] == 4096.0
+        assert s["memory"]["live_array_bytes_peak_step"] == 1
+
+    def test_incidents_and_checkpoints(self, tmp_path):
+        out = make_run(tmp_path)
+        s = summarize_run(os.path.join(out, "events.jsonl"))
+        assert s["incidents"]["event_counts"]["fault"] == 1
+        assert s["incidents"]["checkpoints"][0]["tag"] == "global_step3"
+
+    def test_trace_fallback_when_no_jsonl(self, tmp_path):
+        """Spans recoverable from trace.json alone (older logs)."""
+        out = make_run(tmp_path)
+        s = summarize_run(str(tmp_path / "missing.jsonl"),
+                          os.path.join(out, "trace.json"))
+        assert s["n_spans"] > 0
+        assert any(r["phase"] == "engine/dispatch"
+                   for r in s["step_breakdown"])
+
+    def test_format_contains_all_sections(self, tmp_path):
+        out = make_run(tmp_path)
+        text = format_summary(summarize_run(os.path.join(out, "events.jsonl")))
+        for needle in ("step-phase breakdown", "engine/train_batch",
+                       "communication", "all_reduce", "memory high-water",
+                       "4.00 KB", "checkpoint_save", "INCIDENT"):
+            assert needle in text, f"missing {needle!r} in summary"
+
+
+class TestCli:
+    def test_cli_text_output(self, tmp_path):
+        out = make_run(tmp_path)
+        proc = subprocess.run([sys.executable, CLI, out],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "engine/train_batch" in proc.stdout
+        assert "all_reduce" in proc.stdout
+
+    def test_cli_json_output_round_trips(self, tmp_path):
+        out = make_run(tmp_path)
+        proc = subprocess.run([sys.executable, CLI, out, "--json"],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        data = json.loads(proc.stdout)
+        assert data["memory"]["live_array_bytes_max"] == 4096.0
+
+    def test_cli_missing_dir(self, tmp_path):
+        proc = subprocess.run([sys.executable, CLI, str(tmp_path / "nope")],
+                              capture_output=True, text=True)
+        assert proc.returncode == 2

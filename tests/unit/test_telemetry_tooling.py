@@ -1,0 +1,85 @@
+"""Telemetry tooling: the no-bare-print lint (tools/check_no_bare_print.py)
+that keeps library output on loggers/telemetry, enforced here as the CI
+gate (same pattern as check_no_bare_except.py)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.telemetry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+LINT = os.path.join(REPO_ROOT, "tools", "check_no_bare_print.py")
+
+
+def run_lint(*paths):
+    return subprocess.run([sys.executable, LINT, *paths],
+                          capture_output=True, text=True)
+
+
+class TestNoBarePrintLint:
+    def test_tree_is_clean(self):
+        """deepspeed_tpu/ library code must not print() outside CLI mains —
+        this IS the CI gate, not just a test of the linter."""
+        proc = run_lint(os.path.join(REPO_ROOT, "deepspeed_tpu"))
+        assert proc.returncode == 0, \
+            f"bare print calls found:\n{proc.stdout}"
+
+    def test_linter_catches_library_print(self, tmp_path):
+        bad = tmp_path / "bad.py"
+        bad.write_text("def work():\n    print('hi')\n")
+        proc = run_lint(str(bad))
+        assert proc.returncode == 1
+        assert "bad.py:2" in proc.stdout
+
+    def test_main_function_prints_allowed(self, tmp_path):
+        ok = tmp_path / "cli.py"
+        ok.write_text(
+            "def main():\n"
+            "    print('cli output')\n"
+            "    def helper():\n"
+            "        print('nested in main')\n"
+            "    helper()\n")
+        proc = run_lint(str(ok))
+        assert proc.returncode == 0, proc.stdout
+
+    def test_dunder_main_guard_prints_allowed(self, tmp_path):
+        ok = tmp_path / "script.py"
+        ok.write_text("if __name__ == '__main__':\n    print('x')\n")
+        proc = run_lint(str(ok))
+        assert proc.returncode == 0, proc.stdout
+
+    def test_explicit_marker_allows_print(self, tmp_path):
+        ok = tmp_path / "marked.py"
+        ok.write_text("def f():\n"
+                      "    print('banner')  # lint: allow-print\n")
+        proc = run_lint(str(ok))
+        assert proc.returncode == 0, proc.stdout
+
+    def test_non_main_function_named_print_user_caught(self, tmp_path):
+        bad = tmp_path / "mixed.py"
+        bad.write_text(
+            "def main():\n    print('fine')\n"
+            "def other():\n    print('not fine')\n")
+        proc = run_lint(str(bad))
+        assert proc.returncode == 1
+        offenders = [l for l in proc.stdout.splitlines()
+                     if l.endswith(": bare print")]
+        assert len(offenders) == 1 and "mixed.py:4" in offenders[0]
+
+    def test_syntax_error_reported(self, tmp_path):
+        broken = tmp_path / "broken.py"
+        broken.write_text("def f(:\n")
+        proc = run_lint(str(broken))
+        assert proc.returncode == 1
+        assert "syntax error" in proc.stdout
+
+
+class TestMarkerRegistration:
+    def test_telemetry_marker_registered(self):
+        ini = os.path.join(REPO_ROOT, "tests", "pytest.ini")
+        with open(ini) as f:
+            content = f.read()
+        assert "telemetry:" in content

@@ -1,0 +1,313 @@
+"""Instrumentation wiring tests: engine smoke run with telemetry enabled
+(the acceptance path), comm-op bandwidth aggregation through the registry,
+monitor fan-out with all writers disabled, watchdog all-thread stack dumps,
+Fault/* structured events, and get_caller_func hardening."""
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu import comm
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+from deepspeed_tpu.telemetry import (Telemetry, get_telemetry, read_jsonl,
+                                     set_telemetry)
+
+from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+pytestmark = pytest.mark.telemetry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CLI = os.path.join(REPO_ROOT, "bin", "dstpu-telemetry")
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_telemetry():
+    set_telemetry(None)
+    yield
+    set_telemetry(None)
+
+
+def make_engine(tmp_path, extra_cfg=None, **telemetry_overrides):
+    topo = initialize_mesh(TopologyConfig(), force=True)
+    tcfg = {"enabled": True, "output_dir": str(tmp_path / "tel")}
+    tcfg.update(telemetry_overrides)
+    config = {
+        "train_micro_batch_size_per_gpu": 2,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "telemetry": tcfg,
+    }
+    if extra_cfg:
+        config.update(extra_cfg)
+    params = init_mlp_params(jax.random.PRNGKey(0))
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=mlp_loss_fn, model_parameters=params, config=config,
+        topology=topo)
+    return engine
+
+
+class TestEngineSmoke:
+    def test_smoke_run_produces_artifacts_cli_summarizes(self, tmp_path):
+        """Acceptance: a telemetry-enabled run writes events.jsonl + a
+        Chrome trace that dstpu-telemetry summarizes into a step-phase
+        breakdown and memory high-water mark."""
+        engine = make_engine(tmp_path)
+        batch = random_batch(engine.train_batch_size())
+        for _ in range(4):
+            engine.train_batch(batch)
+        out = engine.telemetry.output_dir
+        engine.close()
+        assert engine.telemetry is None          # close() releases the hub
+        assert get_telemetry() is None           # and uninstalls the global
+
+        events_path = os.path.join(out, "events.jsonl")
+        trace_path = os.path.join(out, "trace.json")
+        assert os.path.exists(events_path)
+        assert os.path.exists(trace_path)
+        assert os.path.exists(os.path.join(out, "metrics.prom"))
+
+        trace = json.load(open(trace_path))
+        names = {e["name"] for e in trace["traceEvents"]}
+        assert "engine/train_batch" in names and "engine/dispatch" in names
+
+        proc = subprocess.run([sys.executable, CLI, out],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stderr
+        assert "engine/train_batch" in proc.stdout
+        assert "live jax.Arrays" in proc.stdout  # memory high-water present
+
+    def test_step_metrics_and_memory_events(self, tmp_path):
+        engine = make_engine(tmp_path)
+        batch = random_batch(engine.train_batch_size())
+        for _ in range(5):
+            engine.train_batch(batch)
+        tel = engine.telemetry
+        # start_step=2 warmup steps are excluded from throughput metrics
+        assert tel.metrics.histogram("engine/step_time_s").count() == 3
+        assert tel.metrics.counter("engine/steps").value() == 3
+        assert tel.metrics.gauge("memory/live_array_bytes").high_water() > 0
+        mem_events = tel.events.recent(kind="memory")
+        assert len(mem_events) == 5
+        assert all("live_array_bytes" in e for e in mem_events)
+        engine.close()
+
+    def test_fence_config_fences_engine_spans(self, tmp_path):
+        """telemetry.fence=true must actually attach block_until_ready
+        fences to engine spans — the dispatch span then covers device time,
+        so it cannot be much shorter than the fenced step."""
+        engine = make_engine(tmp_path, fence=True)
+        assert engine.telemetry.fence
+        batch = random_batch(engine.train_batch_size())
+        for _ in range(3):
+            engine.train_batch(batch)
+        dispatch = [r for r in engine.telemetry.tracer.records()
+                    if r.name == "engine/dispatch"][-1]
+        step = [r for r in engine.telemetry.tracer.records()
+                if r.name == "engine/train_batch"][-1]
+        # fenced dispatch ≈ whole step (dispatch-only would be ~100x smaller
+        # than a compiled CPU step)
+        assert dispatch.dur_s >= 0.5 * step.dur_s
+        engine.close()
+
+    def test_imperative_path_spans(self, tmp_path):
+        engine = make_engine(tmp_path, extra_cfg={
+            "train_micro_batch_size_per_gpu": 2,
+            "gradient_accumulation_steps": 2})
+        batch = random_batch(engine.train_micro_batch_size_per_gpu() * 8)
+        for _ in range(2):
+            engine.backward(batch)
+        engine.step()
+        names = {r.name for r in engine.telemetry.tracer.records()}
+        assert "engine/backward" in names
+        assert "engine/optimizer_step" in names
+        engine.close()
+
+    def test_monitor_scalars_reach_registry_with_all_writers_disabled(
+            self, tmp_path):
+        """Satellite: MonitorMaster routes through the telemetry registry, so
+        loss/lr history exists even when TB/W&B/CSV/comet are all off."""
+        engine = make_engine(tmp_path)
+        assert engine.monitor is not None and not engine.monitor.enabled
+        batch = random_batch(engine.train_batch_size())
+        engine.train_batch(batch)
+        tel = engine.telemetry
+        assert tel.metrics.gauge("Train/Samples/train_loss").value() \
+            is not None
+        assert tel.metrics.gauge("Train/Samples/lr").value() \
+            == pytest.approx(1e-2)
+        # full per-step history survives as compact "scalars" events
+        engine.train_batch(batch)
+        scalars = tel.events.recent(kind="scalars")
+        assert len(scalars) == 2
+        assert all("Train/Samples/train_loss" in e["values"] for e in scalars)
+        engine.close()
+
+    def test_checkpoint_events_emitted(self, tmp_path):
+        engine = make_engine(tmp_path)
+        batch = random_batch(engine.train_batch_size())
+        engine.train_batch(batch)
+        engine.save_checkpoint(str(tmp_path / "ckpt"))
+        tel = engine.telemetry
+        saves = tel.events.recent(kind="checkpoint_save")
+        commits = tel.events.recent(kind="checkpoint_commit")
+        assert len(saves) == 1 and saves[0]["duration_s"] >= 0
+        assert len(commits) == 1
+        span_names = {r.name for r in tel.tracer.records()}
+        assert "checkpoint/save" in span_names
+        assert "engine/save_checkpoint" in span_names
+        engine.close()
+
+
+class TestCommAggregation:
+    def test_host_op_and_in_jit_trace_records(self, tmp_path):
+        initialize_mesh(TopologyConfig(), force=True)
+        tel = Telemetry(output_dir=str(tmp_path / "tel"))
+        set_telemetry(tel)
+        comm.barrier()
+        comm.barrier()
+        assert tel.metrics.counter("comm/calls").value(op="barrier") == 2
+        assert tel.metrics.histogram("comm/latency_s").count(op="barrier") == 2
+
+        from jax.experimental.shard_map import shard_map
+        from jax.sharding import PartitionSpec
+
+        from deepspeed_tpu.runtime.topology import get_topology
+
+        mesh = get_topology().mesh
+
+        def f(x):
+            return comm.all_reduce(x, group="data")
+
+        jax.jit(shard_map(f, mesh=mesh, in_specs=PartitionSpec("data"),
+                          out_specs=PartitionSpec("data")))(jnp.ones((8,)))
+        assert tel.metrics.counter("comm/calls").value(op="all_reduce") == 1
+        # trace-time record: per-shard message size (8 f32 over 8 shards)
+        assert tel.metrics.histogram("comm/bytes").mean(op="all_reduce") == 4.0
+        # ...but a jit TRACE is not a transfer: it must be flagged as traced
+        # and kept out of the latency/bandwidth aggregates (real host-blocking
+        # ops like barrier keep real latency samples)
+        assert tel.metrics.counter("comm/traced_calls").value(
+            op="all_reduce") == 1
+        assert tel.metrics.histogram("comm/latency_s").count(
+            op="all_reduce") == 0
+
+    def test_comms_logger_append_feeds_registry(self, tmp_path):
+        """Upgraded comms_logging: CommsLogger aggregation lands in the
+        registry with bandwidth estimates."""
+        from deepspeed_tpu.utils.comms_logging import CommsLogger
+
+        tel = Telemetry(output_dir=str(tmp_path / "tel"))
+        set_telemetry(tel)
+        cl = CommsLogger(enabled=True)
+        cl.append("all_reduce", "all_reduce", 1 << 20, 0.001, 8)
+        assert tel.metrics.counter("comm/calls").value(op="all_reduce") == 1
+        busbw = tel.metrics.histogram("comm/busbw_gbps").mean(op="all_reduce")
+        # 1MB/1ms ≈ 1.05 GB/s algbw × 2(n-1)/n = 1.75 factor
+        assert busbw == pytest.approx(1.05e9 * 1.75 / 1e9, rel=1e-2)
+        # and the classic comms_dict aggregation still works
+        assert cl.comms_dict["all_reduce"][1 << 20][0] == 1
+
+    def test_disabled_telemetry_records_nothing(self):
+        initialize_mesh(TopologyConfig(), force=True)
+        assert get_telemetry() is None
+        comm.barrier()  # must not raise nor create state
+
+
+class TestFaultTelemetry:
+    def test_fault_counters_mirrored_as_events(self, tmp_path):
+        from deepspeed_tpu.runtime.fault.retry import record_fault_event
+
+        tel = Telemetry(output_dir=str(tmp_path / "tel"))
+        set_telemetry(tel)
+        record_fault_event("retries/ckpt_save", 2)
+        assert tel.metrics.counter("fault/events").value(
+            name="retries/ckpt_save") == 2
+        (ev,) = tel.events.recent(kind="fault")
+        assert ev["name"] == "retries/ckpt_save" and ev["count"] == 2
+
+    def test_watchdog_timeout_emits_all_thread_stack_dump(self, tmp_path):
+        from deepspeed_tpu.runtime.fault.watchdog import Watchdog
+
+        tel = Telemetry(output_dir=str(tmp_path / "tel"))
+        set_telemetry(tel)
+        wd = Watchdog(deadline_s=0.05, poll_interval_s=0.01).start()
+        try:
+            wd.ping(step=7, phase="train_batch")
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline and not tel.events.recent(
+                    kind="watchdog_timeout"):
+                time.sleep(0.01)
+            (ev,) = tel.events.recent(kind="watchdog_timeout")[:1]
+            assert ev["step"] == 7 and ev["phase"] == "train_batch"
+            stacks = ev["thread_stacks"]
+            # every live thread is dumped: at least main + watchdog
+            assert len(stacks) >= 2
+            assert any("MainThread" in k for k in stacks)
+            assert any("dstpu-watchdog" in k for k in stacks)
+            main_stack = "".join(
+                v for k, v in ((k, "".join(f)) for k, f in stacks.items())
+                if "MainThread" in k)
+            assert "test_watchdog_timeout_emits" in main_stack
+        finally:
+            wd.stop()
+
+    def test_dump_all_stacks_standalone(self):
+        from deepspeed_tpu.runtime.fault.watchdog import dump_all_stacks
+
+        stacks = dump_all_stacks()
+        assert any("MainThread" in k for k in stacks)
+        assert all(isinstance(v, list) for v in stacks.values())
+
+
+class TestCallerFuncHardening:
+    def test_shallow_stack_does_not_raise(self):
+        from deepspeed_tpu.utils.comms_logging import get_caller_func
+
+        # far deeper than any real stack: must clamp, not ValueError
+        name = get_caller_func(10_000)
+        assert isinstance(name, str) and name
+
+    def test_normal_depth_still_resolves_caller(self):
+        from deepspeed_tpu.utils.comms_logging import get_caller_func
+
+        def inner():
+            return get_caller_func(2)
+
+        def outer():
+            return inner()
+
+        assert outer() == "outer"
+
+
+class TestJsonlOnDisk:
+    def test_events_jsonl_written_through_on_emit(self, tmp_path):
+        """Structured events reach disk before flush() — crash durability.
+        Every run opens with a run_start delimiter."""
+        tel = Telemetry(output_dir=str(tmp_path / "tel"))
+        tel.event("checkpoint_save", tag="t0", duration_s=0.1)
+        recs = list(read_jsonl(os.path.join(tel.output_dir, "events.jsonl")))
+        assert [r["kind"] for r in recs] == ["run_start", "checkpoint_save"]
+        tel.close()
+
+    def test_reused_output_dir_summarizes_latest_run_only(self, tmp_path):
+        """events.jsonl is append-mode; the summarizer isolates the run after
+        the last run_start delimiter (consistent with trace.json)."""
+        from deepspeed_tpu.telemetry.summary import summarize_run
+
+        out = str(tmp_path / "tel")
+        for run in range(2):
+            tel = Telemetry(output_dir=out, memory_interval=0)
+            for _ in range(run + 1):   # run 0: 1 span; run 1: 2 spans
+                with tel.span("engine/train_batch"):
+                    pass
+            tel.close()
+        s = summarize_run(os.path.join(out, "events.jsonl"))
+        assert s["runs_in_log"] == 2
+        (row,) = s["step_breakdown"]
+        assert row["phase"] == "engine/train_batch" and row["count"] == 2

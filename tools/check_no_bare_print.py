@@ -1,0 +1,100 @@
+#!/usr/bin/env python
+"""Fail on bare ``print(`` calls in deepspeed_tpu/ library code.
+
+Library output must go through ``deepspeed_tpu.utils.logging`` (rank-aware,
+level-filtered, capturable) or the telemetry subsystem (structured,
+aggregatable).  A stray ``print`` bypasses both: it spams every rank, can't
+be silenced, and is invisible to the run summary.
+
+CLI entry points are exempt: ``print`` inside a function named ``main`` (or
+any function nested in it) or directly under an ``if __name__ ==
+"__main__":`` block is how a CLI talks to its user.  A deliberate exception
+elsewhere takes a ``# lint: allow-print`` comment on the offending line.
+
+Usage: ``python tools/check_no_bare_print.py [root ...]``
+Exit status 1 lists every offender as ``path:line``.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import sys
+
+DEFAULT_ROOT = os.path.join(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))), "deepspeed_tpu")
+
+ALLOW_MARKER = "lint: allow-print"
+
+
+def _main_guard_lines(tree: ast.Module) -> set:
+    """Line ranges of top-level ``if __name__ == "__main__":`` blocks."""
+    lines = set()
+    for node in tree.body:
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        is_guard = (isinstance(test, ast.Compare)
+                    and isinstance(test.left, ast.Name)
+                    and test.left.id == "__name__")
+        if is_guard:
+            end = getattr(node, "end_lineno", node.lineno)
+            lines.update(range(node.lineno, end + 1))
+    return lines
+
+
+def bare_prints(path: str):
+    with open(path, "rb") as f:
+        source = f.read()
+    try:
+        tree = ast.parse(source, filename=path)
+    except SyntaxError as e:
+        return [(e.lineno or 0, f"syntax error: {e.msg}")]
+
+    allowed_lines = {i + 1 for i, line in
+                     enumerate(source.decode("utf-8", "replace").splitlines())
+                     if ALLOW_MARKER in line}
+    allowed_lines |= _main_guard_lines(tree)
+
+    offenders = []
+
+    def walk(node, in_main: bool):
+        for child in ast.iter_child_nodes(node):
+            child_in_main = in_main
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_in_main = in_main or child.name == "main"
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Name)
+                    and child.func.id == "print"
+                    and not in_main
+                    and child.lineno not in allowed_lines):
+                offenders.append((child.lineno, "bare print"))
+            walk(child, child_in_main)
+
+    walk(tree, in_main=False)
+    return offenders
+
+
+def main(argv=None) -> int:
+    roots = (argv if argv else sys.argv[1:]) or [DEFAULT_ROOT]
+    offenders = []
+    for root in roots:
+        if os.path.isfile(root):
+            files = [root]
+        else:
+            files = [os.path.join(d, fn)
+                     for d, _dirs, fns in os.walk(root)
+                     for fn in fns if fn.endswith(".py")]
+        for path in sorted(files):
+            for lineno, why in bare_prints(path):
+                offenders.append(f"{os.path.relpath(path)}:{lineno}: {why}")
+    if offenders:
+        print("\n".join(offenders))
+        print(f"\n{len(offenders)} bare print call(s) in library code — "
+              f"use utils.logging / telemetry, or move CLI output into "
+              f"main() (see tools/check_no_bare_print.py docstring).")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
