@@ -55,12 +55,25 @@ PEAK_FLOPS = {
 
 
 def peak_flops_per_chip() -> float:
-    d = jax.devices()[0]
-    kind = str(getattr(d, "device_kind", "cpu"))
-    for key, val in PEAK_FLOPS.items():
-        if key.lower() in kind.lower():
-            return val
-    return 197e12 if d.platform == "tpu" else 1e12
+    # single source of truth: the profiling subsystem's roofline table
+    # (deepspeed_tpu/profiling/roofline.py); local PEAK_FLOPS is the
+    # fallback for a broken/partial checkout
+    try:
+        from deepspeed_tpu.profiling.roofline import \
+            peak_flops_per_chip as _peak
+
+        return _peak()
+    except Exception as exc:  # noqa: BLE001
+        # the bench must always emit its JSON line, even from a checkout
+        # whose package is broken — but never fall back silently
+        log(f"roofline module unavailable ({exc!r}); "
+            f"using bench-local PEAK_FLOPS fallback")
+        d = jax.devices()[0]
+        kind = str(getattr(d, "device_kind", "cpu"))
+        for key, val in PEAK_FLOPS.items():
+            if key.lower() in kind.lower():
+                return val
+        return 197e12 if d.platform == "tpu" else 1e12
 
 
 def env_int(name, default):
@@ -269,6 +282,15 @@ def run_train_bench(on_tpu: bool, tpu_reason: str) -> None:
         # full observability run: JSONL events + Chrome trace + metrics.prom
         # under $DSTPU_BENCH_TELEMETRY, summarized by bin/dstpu-telemetry
         ds_config["telemetry"] = {"enabled": True, "output_dir": telemetry_dir}
+        # ... plus performance attribution: per-module cost tree + roofline
+        # gauges (profile fires on warmup step 1, off the timed window) and
+        # an xprof device trace for the summary's device-time breakdown
+        ds_config["profiling"] = {
+            "enabled": True, "roofline_interval": 1,
+            "flops_profiler": {"enabled": True, "profile_step": 1}}
+        ds_config["comms_logger"] = {
+            "enabled": True, "xprof_step": 1,
+            "xprof_dir": os.path.join(telemetry_dir, "xprof")}
     engine, _, _, _ = deepspeed_tpu.initialize(
         model=model, model_parameters=params, config=ds_config,
         topology=topo)
@@ -294,14 +316,36 @@ def run_train_bench(on_tpu: bool, tpu_reason: str) -> None:
 
     tokens = engine.train_batch_size() * seq * steps
     tok_per_sec_chip = tokens / dt / n_chips
-    # 6N params-flops + 12*L*D*S attention-flops per token, ×1.33 for remat
-    attn = 12 * cfg.num_layers * cfg.hidden_size * seq
-    flops_per_token = model.flops_per_token() + 3 * attn
-    mfu = tok_per_sec_chip * flops_per_token / peak_flops_per_chip()
-    log(f"done: {tok_per_sec_chip:.0f} tok/s/chip, mfu={mfu:.3f}")
+    # MFU numerator: the flops profiler's XLA cost analysis of the compiled
+    # step (per-device — the post-SPMD module has local shapes), falling
+    # back to the 6N+attention hand formula only when cost analysis is
+    # unavailable on this backend
+    step_flops = 0.0
+    mfu_source = "analytic"
+    try:
+        stats = engine.train_step_cost()
+        if stats and stats.get("flops_per_device"):
+            step_flops = stats["flops_per_device"]
+            mfu_source = "flops_profiler"
+    except Exception as exc:  # noqa: BLE001
+        log(f"profiler step cost unavailable ({str(exc)[:120]}); "
+            f"falling back to analytic flops")
+    if step_flops:
+        mfu = step_flops / (dt / steps) / peak_flops_per_chip()
+        flops_per_token = step_flops * n_chips / (engine.train_batch_size() * seq)
+    else:
+        # 6N params-flops + 12*L*D*S attention-flops per token, ×1.33 remat
+        attn = 12 * cfg.num_layers * cfg.hidden_size * seq
+        flops_per_token = model.flops_per_token() + 3 * attn
+        mfu = tok_per_sec_chip * flops_per_token / peak_flops_per_chip()
+    log(f"done: {tok_per_sec_chip:.0f} tok/s/chip, mfu={mfu:.3f} "
+        f"(flops source: {mfu_source})")
 
     extra = {
         "mfu": round(mfu, 4),
+        "mfu_flops_source": mfu_source,
+        "flops_per_token": round(flops_per_token, 1),
+        "flops_per_step_per_device": step_flops,
         "model_params": model.num_params(),
         "loss": float(loss),
         "chips": n_chips,

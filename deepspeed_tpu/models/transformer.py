@@ -272,8 +272,12 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
             return_aux_loss: bool = False) -> jax.Array:
     """tokens [B, S] int32 → logits [B, S, V] (+ MoE aux loss if requested)."""
     dtype = params["layers"]["q_proj"]["kernel"].dtype
-    x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
-    x = _constrain(x, _activation_spec())
+    # jax.named_scope annotations flow into jaxpr name stacks (and xprof op
+    # names), feeding the profiler's per-module cost tree
+    # (profiling/module_tree.py) — zero runtime cost.
+    with jax.named_scope("embed"):
+        x = jnp.take(params["embed"]["embedding"], tokens, axis=0)
+        x = _constrain(x, _activation_spec())
     S = tokens.shape[1]
     cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
 
@@ -305,23 +309,25 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
 
         x, aux = carry
         B = x.shape[0]
-        h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
-        q = proj(h, lp["q_proj"], B, cfg.num_heads)
-        k = proj(h, lp["k_proj"], B, cfg.num_kv_heads)
-        v = proj(h, lp["v_proj"], B, cfg.num_kv_heads)
-        q = apply_rope(q, cos, sin)
-        k = apply_rope(k, cos, sin)
-        o = attention(q, k, v, cfg, causal=True)
-        x = x + (o.reshape(B, S, -1) @ lp["o_proj"]["kernel"])
+        with jax.named_scope("attention"):
+            h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
+            q = proj(h, lp["q_proj"], B, cfg.num_heads)
+            k = proj(h, lp["k_proj"], B, cfg.num_kv_heads)
+            v = proj(h, lp["v_proj"], B, cfg.num_kv_heads)
+            q = apply_rope(q, cos, sin)
+            k = apply_rope(k, cos, sin)
+            o = attention(q, k, v, cfg, causal=True)
+            x = x + (o.reshape(B, S, -1) @ lp["o_proj"]["kernel"])
         # Named + mesh-sharded residual stream: the activation-checkpointing
         # config's save/offload policies select these by name (runtime/
         # activation_checkpointing/checkpointing.py RESIDUAL_NAMES), and the
         # sharding constraint means a saved residual is PARTITIONED over the
         # data/seq axes — the reference's partition_activations.
         x = checkpoint_name(_constrain(x, _activation_spec()), "attn_residual")
-        h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
-        mlp_out, l_aux = mlp_block(h, lp)
-        x = x + mlp_out
+        with jax.named_scope("mlp"):
+            h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
+            mlp_out, l_aux = mlp_block(h, lp)
+            x = x + mlp_out
         x = checkpoint_name(_constrain(x, _activation_spec()), "mlp_residual")
         return (x, aux + l_aux), None
 
@@ -344,13 +350,17 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
                     f"jax.checkpoint_policies member; valid: {valid}")
         layer_fn = jax.checkpoint(layer, policy=policy)
 
-    (x, aux_loss), _ = jax.lax.scan(layer_fn, (x, jnp.zeros((), jnp.float32)),
-                                    params["layers"])
-    x = rms_norm(x, params["norm_f"]["scale"], cfg.norm_eps)
-    if cfg.tie_embeddings:
-        logits = x @ params["embed"]["embedding"].T
-    else:
-        logits = x @ params["lm_head"]["kernel"]
+    with jax.named_scope("layers"):
+        (x, aux_loss), _ = jax.lax.scan(layer_fn,
+                                        (x, jnp.zeros((), jnp.float32)),
+                                        params["layers"])
+    with jax.named_scope("final_norm"):
+        x = rms_norm(x, params["norm_f"]["scale"], cfg.norm_eps)
+    with jax.named_scope("lm_head"):
+        if cfg.tie_embeddings:
+            logits = x @ params["embed"]["embedding"].T
+        else:
+            logits = x @ params["lm_head"]["kernel"]
     if return_aux_loss:
         return logits, aux_loss
     return logits
@@ -367,12 +377,14 @@ def lm_loss(params: Dict, batch: Any, cfg: TransformerConfig,
     logits, aux_loss = forward(params, tokens, cfg, return_aux_loss=True)
     if labels is None:
         labels = jnp.pad(tokens[:, 1:], ((0, 0), (0, 1)), constant_values=-100)
-    logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    valid = labels >= 0
-    safe_labels = jnp.where(valid, labels, 0)
-    token_logp = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
-    loss = -jnp.sum(token_logp * valid) / jnp.maximum(jnp.sum(valid), 1)
+    with jax.named_scope("loss"):
+        logits = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        valid = labels >= 0
+        safe_labels = jnp.where(valid, labels, 0)
+        token_logp = jnp.take_along_axis(
+            logp, safe_labels[..., None], axis=-1)[..., 0]
+        loss = -jnp.sum(token_logp * valid) / jnp.maximum(jnp.sum(valid), 1)
     if cfg.num_experts > 1:
         loss = loss + cfg.moe_aux_loss_coef * aux_loss / cfg.num_layers
     return loss

@@ -1,0 +1,30 @@
+"""Performance-attribution subsystem: where did the step's compute go, and
+how close to the hardware roofline is it?
+
+  * ``flops_profiler`` — compiled-program cost analysis + the reference's
+    start/stop/print profiler API;
+  * ``module_tree`` — per-module cost tree from jaxpr named-scope walk;
+  * ``roofline`` — per-device-kind peak flops/bandwidth + MFU reporting;
+  * ``xprof_parse`` — device-time attribution from a captured xprof trace;
+  * ``straggler`` — cross-host step-time skew detection.
+"""
+from .flops_profiler.profiler import (FlopsProfiler, compiled_cost_stats,
+                                      emit_report, get_model_profile,
+                                      num_params, profile_fn)
+from .module_tree import (ModuleProfile, attribute_engine_step, attribute_fn,
+                          format_module_table, params_by_scope)
+from .roofline import (DeviceSpec, device_spec, format_roofline_line,
+                       peak_flops_per_chip, publish_gauges, roofline_report)
+from .straggler import StragglerDetector
+from .xprof_parse import attribute_device_time, format_device_table
+
+__all__ = [
+    "FlopsProfiler", "compiled_cost_stats", "emit_report",
+    "get_model_profile", "num_params", "profile_fn",
+    "ModuleProfile", "attribute_engine_step", "attribute_fn",
+    "format_module_table", "params_by_scope",
+    "DeviceSpec", "device_spec", "format_roofline_line",
+    "peak_flops_per_chip", "publish_gauges", "roofline_report",
+    "StragglerDetector",
+    "attribute_device_time", "format_device_table",
+]

@@ -1,0 +1,128 @@
+"""Roofline model per device kind: peak flops, HBM bandwidth, and the
+achieved-vs-peak report ("The Big Send-off", arXiv:2504.18658, uses the same
+per-device rooflines to locate collective bottlenecks).
+
+One table maps ``device_kind`` strings (as reported by ``jax.devices()``) to
+bf16 peak flops and HBM bandwidth.  :func:`roofline_report` turns a step's
+(flops, bytes, seconds) into achieved TFLOP/s, MFU, HBM utilization,
+arithmetic intensity, and which side of the ridge the step sits on; the
+engine publishes that through the telemetry metrics registry as
+``roofline/*`` gauges (see ``bin/dstpu-telemetry``).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+from ..utils.logging import logger
+
+
+@dataclasses.dataclass(frozen=True)
+class DeviceSpec:
+    """Peak numbers for one device kind (bf16 matmul peak, HBM stream BW)."""
+
+    kind: str
+    peak_flops: float          # bf16 FLOP/s per chip
+    hbm_bandwidth: float       # bytes/s per chip
+
+    @property
+    def ridge_intensity(self) -> float:
+        """Flops/byte above which the chip is compute-bound."""
+        return self.peak_flops / max(self.hbm_bandwidth, 1.0)
+
+
+#: ordered: first substring match against device_kind wins
+DEVICE_SPECS = (
+    DeviceSpec("TPU v6 lite", 918e12, 1640e9),   # Trillium
+    DeviceSpec("TPU v6", 918e12, 1640e9),
+    DeviceSpec("TPU v5p", 459e12, 2765e9),
+    DeviceSpec("TPU v5 lite", 197e12, 819e9),    # v5e self-reports "v5 lite"
+    DeviceSpec("TPU v5e", 197e12, 819e9),
+    DeviceSpec("TPU v4", 275e12, 1228e9),
+    DeviceSpec("TPU v3", 123e12, 900e9),
+)
+
+#: conservative stand-in so CPU smoke runs produce finite (clearly labelled)
+#: utilization numbers instead of dividing by zero
+CPU_FALLBACK = DeviceSpec("cpu", 1e12, 100e9)
+
+
+def device_spec(device: Any = None) -> DeviceSpec:
+    """Spec for ``device`` (default: first visible device).  Unknown TPU
+    kinds get the v5e numbers (the most common fleet chip) with a warning;
+    non-TPU backends get the CPU fallback."""
+    if device is None:
+        import jax
+
+        device = jax.devices()[0]
+    kind = str(getattr(device, "device_kind", "cpu"))
+    for spec in DEVICE_SPECS:
+        if spec.kind.lower() in kind.lower():
+            return dataclasses.replace(spec, kind=kind)
+    if getattr(device, "platform", "cpu") == "tpu":
+        logger.warning(f"no roofline spec for device kind {kind!r}; "
+                       f"assuming TPU v5e peaks")
+        return DeviceSpec(kind, 197e12, 819e9)
+    return dataclasses.replace(CPU_FALLBACK, kind=kind)
+
+
+def peak_flops_per_chip(device: Any = None) -> float:
+    """bf16 peak FLOP/s for one chip (bench.py's MFU denominator)."""
+    return device_spec(device).peak_flops
+
+
+def roofline_report(flops: float, bytes_accessed: float, seconds: float,
+                    n_devices: int = 1,
+                    spec: Optional[DeviceSpec] = None) -> Dict[str, Any]:
+    """Achieved-vs-peak summary for one step.
+
+    ``flops``/``bytes_accessed`` are whole-program (all devices) per step;
+    utilization is computed per chip.  Returns plain floats so the dict can
+    land in a telemetry event or a metrics snapshot unmodified.
+    """
+    spec = spec or device_spec()
+    n = max(int(n_devices), 1)
+    dt = max(float(seconds), 1e-12)
+    achieved = flops / dt / n                   # FLOP/s per chip
+    hbm = bytes_accessed / dt / n               # bytes/s per chip
+    ai = flops / max(bytes_accessed, 1.0)       # flops per byte
+    return {
+        "device_kind": spec.kind,
+        "peak_tflops": spec.peak_flops / 1e12,
+        "peak_hbm_gbps": spec.hbm_bandwidth / 1e9,
+        "achieved_tflops": achieved / 1e12,
+        "mfu": achieved / spec.peak_flops,
+        "hbm_gbps": hbm / 1e9,
+        "hbm_utilization": hbm / spec.hbm_bandwidth,
+        "arithmetic_intensity": ai,
+        "ridge_intensity": spec.ridge_intensity,
+        "bound": "compute" if ai >= spec.ridge_intensity else "memory",
+        "step_time_s": float(seconds),
+        "flops_per_step": float(flops),
+        "bytes_per_step": float(bytes_accessed),
+        "n_devices": n,
+    }
+
+
+def publish_gauges(metrics, report: Dict[str, Any]) -> None:
+    """Mirror a roofline report into ``roofline/*`` gauges (labelled by
+    device kind) so Prometheus snapshots and the run summary see it."""
+    kind = str(report.get("device_kind", "?"))
+    for key in ("achieved_tflops", "mfu", "hbm_gbps", "hbm_utilization",
+                "arithmetic_intensity", "peak_tflops", "step_time_s"):
+        v = report.get(key)
+        if isinstance(v, (int, float)):
+            metrics.gauge(f"roofline/{key}").set(float(v), device=kind)
+
+
+def format_roofline_line(report: Dict[str, Any]) -> str:
+    """One human line: the MFU headline the run summary and the profiler
+    report both print."""
+    return (f"roofline [{report['device_kind']}]: "
+            f"{report['achieved_tflops']:.1f}/{report['peak_tflops']:.0f} "
+            f"TFLOP/s/chip (MFU {report['mfu']*100:.1f}%), "
+            f"HBM {report['hbm_gbps']:.0f} GB/s "
+            f"({report['hbm_utilization']*100:.1f}%), "
+            f"AI {report['arithmetic_intensity']:.1f} fl/B "
+            f"(ridge {report['ridge_intensity']:.1f}) — "
+            f"{report['bound']}-bound")

@@ -1,0 +1,150 @@
+"""Cross-host straggler detection from per-step timing skew.
+
+On a pod, one slow host (thermal throttling, a noisy neighbor, a flaky NIC)
+drags every step: collectives run at the pace of the last arriver, so the
+skew is invisible in any single host's profile — every host just sees slow
+collectives.  The detector makes it visible: each host measures its own
+step wall time, the window means are allgathered, and when the slowest
+host's mean exceeds the cross-host median by more than ``threshold`` the
+detector emits a ``straggler`` structured event naming the host, plus a
+``Straggler/skew`` monitor-style gauge and a ``straggler/skew`` histogram
+through the telemetry registry.
+
+Single-process runs degrade gracefully (the gather returns just the local
+duration; skew is 0), so the wiring can stay on unconditionally and tests
+inject a synthetic ``gather_fn``.
+"""
+from __future__ import annotations
+
+import collections
+import statistics
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from ..utils.logging import logger
+
+
+def _default_gather(value: float) -> List[float]:
+    """Per-host window means, one entry per process (multihost allgather;
+    identity on single-process runs)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return [float(value)]
+    try:
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        gathered = multihost_utils.process_allgather(
+            np.asarray([value], dtype=np.float64))
+        return [float(x) for x in np.asarray(gathered).reshape(-1)]
+    except Exception as e:  # noqa: BLE001 — detection must never kill a step
+        logger.warning(f"straggler gather failed ({e!r}); "
+                       f"using local timing only")
+        return [float(value)]
+
+
+class StragglerDetector:
+    """Rolling-window cross-host step-time skew detector.
+
+    Parameters
+    ----------
+    threshold: relative skew ((max - median) / median) above which an
+        incident fires.
+    window: per-host rolling window of step durations (means are compared,
+        so a single GC pause doesn't page anyone).
+    interval: gather/check every N observed steps (an allgather per step
+        would itself perturb the steady state).
+    min_steps: observations required before the first check.
+    telemetry: optional Telemetry hub for events + metrics.
+    gather_fn: duration → per-host durations list; injectable for tests.
+    host_id: this process's index (``jax.process_index()`` by default).
+    """
+
+    def __init__(self, threshold: float = 0.25, window: int = 8,
+                 interval: int = 1, min_steps: int = 4, telemetry=None,
+                 gather_fn: Optional[Callable[[float], Sequence[float]]] = None,
+                 host_id: Optional[int] = None):
+        self.threshold = float(threshold)
+        self.window = max(int(window), 1)
+        self.interval = max(int(interval), 1)
+        self.min_steps = max(int(min_steps), 1)
+        self.telemetry = telemetry
+        self.gather_fn = gather_fn or _default_gather
+        if host_id is None:
+            try:
+                import jax
+
+                host_id = jax.process_index()
+            except Exception:  # noqa: BLE001
+                host_id = 0
+        self.host_id = int(host_id)
+        self._durations: "collections.deque[float]" = collections.deque(
+            maxlen=self.window)
+        self._observed = 0
+        self.incidents = 0
+        self.last_skew: Optional[float] = None
+
+    # ---------------------------------------------------------------- #
+    def observe_step(self, step: int, duration_s: float) -> Optional[Dict]:
+        """Record one step's wall time; every ``interval`` steps gather the
+        window means and check for skew.  Returns the incident dict when one
+        fired, else None."""
+        if duration_s <= 0:
+            return None
+        self._durations.append(float(duration_s))
+        self._observed += 1
+        if self._observed < self.min_steps or \
+                self._observed % self.interval != 0:
+            return None
+        mean = sum(self._durations) / len(self._durations)
+        try:
+            per_host = [float(x) for x in self.gather_fn(mean)]
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"straggler gather failed ({e!r}); skipping check")
+            return None
+        return self.check(step, per_host)
+
+    def check(self, step: int, per_host: Sequence[float]) -> Optional[Dict]:
+        """Skew check over per-host durations (one entry per host).  Emits
+        metrics always, an incident event only past the threshold."""
+        if not per_host:
+            return None
+        med = statistics.median(per_host)
+        worst = max(per_host)
+        skew = (worst - med) / max(med, 1e-12)
+        self.last_skew = skew
+        tel = self.telemetry
+        if tel is not None:
+            tel.metrics.histogram("straggler/skew").observe(skew)
+            tel.metrics.gauge("Straggler/skew").set(skew)
+            tel.metrics.gauge("Straggler/worst_step_time_s").set(worst)
+        if skew <= self.threshold or len(per_host) < 2:
+            return None
+        worst_host = int(max(range(len(per_host)), key=lambda i: per_host[i]))
+        self.incidents += 1
+        incident = {
+            "step": int(step),
+            "skew": round(skew, 4),
+            "threshold": self.threshold,
+            "worst_host": worst_host,
+            "median_s": round(med, 6),
+            "worst_s": round(worst, 6),
+            "per_host_s": [round(d, 6) for d in per_host],
+            "window": self.window,
+        }
+        if tel is not None:
+            tel.event("straggler", **incident)
+            tel.metrics.counter("straggler/events").inc()
+        logger.warning(
+            f"straggler detected at step {step}: host {worst_host} is "
+            f"{skew * 100:.0f}% over the cross-host median "
+            f"({worst * 1e3:.1f}ms vs {med * 1e3:.1f}ms median)")
+        return incident
+
+    @classmethod
+    def from_config(cls, pcfg: Any, telemetry=None) -> "StragglerDetector":
+        """Build from a ``ProfilingConfig`` block (runtime/config.py)."""
+        return cls(threshold=pcfg.straggler_threshold,
+                   window=pcfg.straggler_window,
+                   interval=pcfg.straggler_interval,
+                   telemetry=telemetry)

@@ -1,0 +1,182 @@
+"""xprof / Chrome-trace parser: device-time attribution for a captured step.
+
+``jax.profiler.trace`` (fired by ``comms_logger.xprof_step``, see
+``runtime/engine.py``) writes a TensorBoard profile directory containing one
+``*.trace.json.gz`` Chrome trace per host.  This module ingests that trace —
+or any plain Chrome-trace JSON, including telemetry's own ``trace.json`` —
+and attributes device time to fused ops, bucketed into compute /
+communication / host-transfer categories (T3, arXiv:2401.16677: the
+compute-vs-collective split is the prerequisite for overlap optimization).
+
+Stdlib-only; consumed by ``bin/dstpu-telemetry`` and the profiling tests.
+"""
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence
+
+#: device-lane op-name patterns → category (first match wins)
+COMM_PAT = re.compile(
+    r"all-reduce|all-gather|reduce-scatter|all-to-all|collective-permute|"
+    r"collective|cross-replica|send(?:-done)?$|recv(?:-done)?$|ncclk?|"
+    r"megascale", re.IGNORECASE)
+TRANSFER_PAT = re.compile(
+    r"infeed|outfeed|copy-start|copy-done|host-transfer|[hd]2[hd]|"
+    r"transpose-convert", re.IGNORECASE)
+#: process-name patterns marking device (vs host) trace lanes
+DEVICE_PROC_PAT = re.compile(r"/device:|^TPU|XLA Op|Tensor ?Core|SparseCore",
+                             re.IGNORECASE)
+
+CATEGORIES = ("compute", "communication", "host_transfer")
+
+
+def find_trace_files(root: str) -> List[str]:
+    """Every Chrome trace under ``root`` (a file is returned as itself):
+    xprof's ``*.trace.json.gz`` plus plain ``*.trace.json`` /
+    ``trace.json``, newest first."""
+    if os.path.isfile(root):
+        return [root]
+    pats = ("**/*.trace.json.gz", "**/*.trace.json", "**/trace.json")
+    found: List[str] = []
+    for pat in pats:
+        found.extend(glob.glob(os.path.join(root, pat), recursive=True))
+    uniq = sorted(set(found), key=lambda p: os.path.getmtime(p), reverse=True)
+    return uniq
+
+
+def load_trace_events(path: str) -> List[Dict[str, Any]]:
+    opener = gzip.open if path.endswith(".gz") else open
+    with opener(path, "rt") as f:
+        data = json.load(f)
+    if isinstance(data, list):        # bare event-array variant
+        return data
+    return data.get("traceEvents", [])
+
+
+def _lane_names(events: Sequence[Dict[str, Any]]):
+    """(pid → process name, (pid, tid) → thread name) from metadata events."""
+    procs: Dict[Any, str] = {}
+    threads: Dict[Any, str] = {}
+    for ev in events:
+        if ev.get("ph") != "M":
+            continue
+        args = ev.get("args") or {}
+        if ev.get("name") == "process_name":
+            procs[ev.get("pid")] = str(args.get("name", ""))
+        elif ev.get("name") == "thread_name":
+            threads[(ev.get("pid"), ev.get("tid"))] = str(args.get("name", ""))
+    return procs, threads
+
+
+def categorize_op(name: str) -> str:
+    if COMM_PAT.search(name):
+        return "communication"
+    if TRANSFER_PAT.search(name):
+        return "host_transfer"
+    return "compute"
+
+
+def attribute_device_time(path_or_dir: str,
+                          top_n: int = 15) -> Dict[str, Any]:
+    """Parse trace file(s) and attribute duration per op and per category.
+
+    Returns::
+
+        {files, device_lanes, categories: {compute|communication|
+         host_transfer: seconds}, device_time_s, host_time_s,
+         top_ops: [{op, category, calls, total_s, pct}]}
+
+    Device lanes are processes whose metadata name looks like a device
+    (``/device:TPU:0`` etc.); when a trace has none (CPU-only capture), the
+    host lanes are attributed instead and ``device_lanes`` is empty — the
+    table is then host wall time, clearly labelled by the caller.
+    """
+    all_files = find_trace_files(path_or_dir)
+    # a reused xprof dir accumulates one timestamped capture dir per run;
+    # summing across runs would silently double device time.  Keep only the
+    # newest capture (all hosts of one capture share a directory) and count
+    # what was skipped.
+    files = [p for p in all_files
+             if os.path.dirname(p) == os.path.dirname(all_files[0])] \
+        if all_files else []
+    skipped = len(all_files) - len(files)
+    per_op: Dict[str, Dict[str, float]] = {}
+    host_per_op: Dict[str, Dict[str, float]] = {}
+    device_lanes: List[str] = []
+    host_time = 0.0
+    device_time = 0.0
+    for path in files:
+        try:
+            events = load_trace_events(path)
+        except (OSError, json.JSONDecodeError, EOFError):
+            continue
+        procs, _threads = _lane_names(events)
+        dev_pids = {pid for pid, name in procs.items()
+                    if DEVICE_PROC_PAT.search(name)}
+        device_lanes.extend(sorted(procs[p] for p in dev_pids))
+        for ev in events:
+            if ev.get("ph") != "X":
+                continue
+            dur_s = float(ev.get("dur", 0.0)) / 1e6
+            name = str(ev.get("name", "?"))
+            if ev.get("pid") in dev_pids:
+                device_time += dur_s
+                bucket = per_op
+            else:
+                host_time += dur_s
+                bucket = host_per_op
+            rec = bucket.setdefault(name, {"total_s": 0.0, "calls": 0})
+            rec["total_s"] += dur_s
+            rec["calls"] += 1
+    if not device_lanes:
+        # host-only capture (CPU smoke runs): attribute host lanes so the
+        # table stays useful, flagged by the empty device_lanes list
+        per_op = host_per_op
+    categories = {c: 0.0 for c in CATEGORIES}
+    for name, rec in per_op.items():
+        categories[categorize_op(name)] += rec["total_s"]
+    attributed = device_time if device_lanes else host_time
+    top = sorted(per_op.items(), key=lambda kv: -kv[1]["total_s"])[:top_n]
+    return {
+        "files": files,
+        "stale_files_skipped": skipped,
+        "device_lanes": sorted(set(device_lanes)),
+        "categories": categories,
+        "device_time_s": device_time,
+        "host_time_s": host_time,
+        "top_ops": [
+            {"op": name, "category": categorize_op(name),
+             "calls": rec["calls"], "total_s": rec["total_s"],
+             "pct": round(100.0 * rec["total_s"] / max(attributed, 1e-12), 2)}
+            for name, rec in top],
+    }
+
+
+def format_device_table(report: Dict[str, Any]) -> List[str]:
+    """Human rendering of an :func:`attribute_device_time` report."""
+    lines: List[str] = []
+    lanes = report.get("device_lanes") or []
+    where = ", ".join(lanes) if lanes else "host lanes (no device lane found)"
+    lines.append(f"trace lanes: {where}")
+    if report.get("stale_files_skipped"):
+        lines.append(f"(skipped {report['stale_files_skipped']} older trace "
+                     f"file(s) from previous captures in this dir)")
+    total = sum(report["categories"].values()) or 1e-12
+    cat_txt = "  ".join(
+        f"{c}: {report['categories'][c]*1e3:.2f} ms "
+        f"({100.0*report['categories'][c]/total:.1f}%)" for c in CATEGORIES)
+    lines.append(cat_txt)
+    if report["top_ops"]:
+        lines.append(f"{'op':<48}{'cat':<16}{'calls':>7}{'total(ms)':>12}"
+                     f"{'%':>7}")
+        for r in report["top_ops"]:
+            op = r["op"] if len(r["op"]) <= 46 else r["op"][:43] + "..."
+            lines.append(f"{op:<48}{r['category']:<16}{r['calls']:>7}"
+                         f"{r['total_s']*1e3:>12.3f}{r['pct']:>6.1f}%")
+    else:
+        lines.append("(no duration events in trace)")
+    return lines

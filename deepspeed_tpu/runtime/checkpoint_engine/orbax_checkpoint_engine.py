@@ -29,7 +29,8 @@ from ...utils.logging import logger
 from ..fault import injection
 from ..fault.atomic import atomic_write_text
 from ..fault.manifest import (CheckpointCorruptError, is_valid_checkpoint,
-                              read_manifest, verify_checkpoint, write_manifest)
+                              read_manifest, start_sha256, verify_checkpoint,
+                              write_manifest)
 from ..fault.retry import RetryPolicy, retryable
 from .checkpoint_engine import CheckpointEngine
 
@@ -59,19 +60,26 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         path = self._path(tag)
         is_dict = isinstance(payload, dict)
         state = payload.pop("state") if is_dict else payload
+        hash_job = None
         try:
             with telemetry_span("checkpoint/save", tag=str(tag)):
                 with ocp.PyTreeCheckpointer() as ckptr:
                     ckptr.save(os.path.join(path, "state"), state, force=True)
                 if is_dict:
                     meta = {k: v for k, v in payload.items()}
-                    atomic_write_text(os.path.join(path, "meta.json"),
+                    meta_path = os.path.join(path, "meta.json")
+                    atomic_write_text(meta_path,
                                       json.dumps(meta, default=_jsonable))
+                    # hash off-thread, overlapping the manifest's directory
+                    # walk; write_manifest joins before sealing, so the
+                    # digest still gates commit()
+                    hash_job = start_sha256(meta_path)
         finally:
             if is_dict:
                 payload["state"] = state  # restore caller's dict on ALL paths
         # written last: its presence certifies a complete checkpoint
-        write_manifest(path, extra={"tag": str(tag), "step": _tag_step(tag)})
+        write_manifest(path, extra={"tag": str(tag), "step": _tag_step(tag)},
+                       meta_hash=hash_job)
         # torn-write injection AFTER the manifest is sealed, so the damage is
         # something verification must catch — not something it certifies
         injection.inject("ckpt_meta", path=os.path.join(path, "meta.json"))

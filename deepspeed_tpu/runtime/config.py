@@ -80,9 +80,41 @@ class FlopsProfilerConfig(DeepSpeedConfigModel):
     recompute_fwd_factor: float = 0.0
     profile_step: int = 1
     module_depth: int = -1
-    top_modules: int = 1
+    #: most-expensive children shown per tree level (0 = all)
+    top_modules: int = 0
     detailed: bool = True
     output_file: Optional[str] = None
+
+
+class ProfilingConfig(DeepSpeedConfigModel):
+    """Performance attribution (``deepspeed_tpu/profiling/``): per-module
+    cost tree, roofline/MFU gauges, and cross-host straggler detection.
+
+    Folds the reference's ``flops_profiler`` block in as a sub-config; the
+    legacy top-level ``flops_profiler`` key still loads (it becomes
+    ``profiling.flops_profiler``).  ``enabled`` turns on the engine-side
+    attribution paths (roofline gauges + straggler detection + the profile
+    report at ``flops_profiler.profile_step``); everything publishes through
+    the telemetry subsystem, so it is inert unless ``telemetry.enabled``
+    (the profile report still prints without telemetry).
+    """
+
+    enabled: bool = False
+    flops_profiler: FlopsProfilerConfig = Field(
+        default_factory=FlopsProfilerConfig)
+    #: publish ``roofline/*`` gauges (achieved TFLOP/s, MFU, HBM util)
+    roofline: bool = True
+    #: steps between roofline gauge updates (the flops figure is cached; the
+    #: per-update cost is just reading the step timer)
+    roofline_interval: int = 10
+    #: compare per-step wall time across hosts and flag outliers
+    straggler_detection: bool = True
+    #: relative skew (worst - median)/median above which an incident fires
+    straggler_threshold: float = 0.25
+    #: rolling window of step durations whose mean is compared
+    straggler_window: int = 8
+    #: steps between cross-host gathers (1 = every step)
+    straggler_interval: int = 1
 
 
 class MonitorWriterConfig(DeepSpeedConfigModel):
@@ -319,7 +351,15 @@ class DeepSpeedConfig:
         self.activation_checkpointing_explicit = \
             "activation_checkpointing" in config
         self.comms_logger = CommsLoggerConfig(**config.get("comms_logger", {}))
-        self.flops_profiler = FlopsProfilerConfig(**config.get("flops_profiler", {}))
+        # ``profiling`` folds the reference's flops_profiler block in as a
+        # sub-config; a legacy top-level ``flops_profiler`` key still loads.
+        # An explicit profiling.flops_profiler wins over the legacy spelling.
+        prof_raw = dict(config.get("profiling", {}))
+        if "flops_profiler" in config and "flops_profiler" not in prof_raw:
+            prof_raw["flops_profiler"] = config["flops_profiler"]
+        self.profiling = ProfilingConfig(**prof_raw)
+        #: legacy alias — same object the engine's profile-step path reads
+        self.flops_profiler = self.profiling.flops_profiler
         self.tensorboard = MonitorWriterConfig(**config.get("tensorboard", {}))
         self.csv_monitor = MonitorWriterConfig(**config.get("csv_monitor", {}))
         self.wandb = MonitorWriterConfig(**config.get("wandb", {}))

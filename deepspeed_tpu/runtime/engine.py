@@ -253,6 +253,25 @@ class DeepSpeedEngine:
         self.monitor = self._configure_monitor()
         self.watchdog = self._configure_watchdog()
 
+        # ---- performance attribution (config.profiling) --------------- #
+        # Cached compiled-step cost analysis + the last batch's shapes feed
+        # train_step_cost(); the straggler detector compares per-step wall
+        # time across hosts through the telemetry registry.
+        self._step_cost: Optional[Tuple[Any, Dict[str, float]]] = None
+        self._step_jaxpr: Optional[Tuple[Any, Any]] = None  # (shape key, jaxpr)
+        self._last_batch_struct = None
+        self._roofline_spec = None
+        pcfg = getattr(config, "profiling", None)
+        self._profiling_on = bool(pcfg is not None and (
+            pcfg.enabled or pcfg.flops_profiler.enabled))
+        self._straggler = None
+        if pcfg is not None and pcfg.enabled and pcfg.straggler_detection \
+                and self.telemetry is not None:
+            from ..profiling.straggler import StragglerDetector
+
+            self._straggler = StragglerDetector.from_config(
+                pcfg, telemetry=self.telemetry)
+
         log_dist(
             f"engine ready: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
             f"mesh={self.topology.dims} batch={config.train_batch_size} "
@@ -430,6 +449,105 @@ class DeepSpeedEngine:
         return self._timers(name)
 
     # ------------------------------------------------------------------ #
+    # Performance attribution (config.profiling)
+    # ------------------------------------------------------------------ #
+    def train_step_cost(self, batch_struct=None) -> Optional[Dict[str, float]]:
+        """Cost of the fused train step: flops, bytes accessed, peak memory —
+        the profiler's and bench's MFU numerator.
+
+        Two sources, reconciled:
+
+          * a scan-aware jaxpr walk (``utils/jaxpr_utils.total_flops``) of
+            the *global* logical program — XLA's own cost analysis counts a
+            while-loop body ONCE (verified empirically), so it undercounts
+            scanned-layer models by ~num_layers·gas; the traced count
+            multiplies trip counts back in;
+          * ``compiled.cost_analysis()`` of the post-SPMD *per-device*
+            module (an AOT ``lower().compile()`` of the already-jitted step
+            fn — hits XLA's executable cache after the first real step, not
+            a recompile), whose bytes/peak-memory figures reflect fusion.
+
+        ``flops``/``bytes_accessed`` are GLOBAL (logical program);
+        ``flops_per_device``/``bytes_accessed_per_device`` are one chip's
+        share (the MFU numerator); ``flops_traced``/
+        ``flops_compiled_per_device`` record provenance.  Returns None when
+        no batch shape is known yet.  Cached per batch shape.
+        """
+        struct = batch_struct if batch_struct is not None \
+            else self._last_batch_struct
+        if struct is None:
+            return None
+        key = tuple((tuple(l.shape), str(l.dtype))
+                    for l in jax.tree.leaves(struct))
+        if self._step_cost is not None and self._step_cost[0] == key:
+            return self._step_cost[1]
+        from ..profiling.flops_profiler.profiler import compiled_cost_stats
+        from ..utils.jaxpr_utils import total_flops_of_jaxpr
+
+        if "train_batch" not in self._compiled:
+            self._compiled["train_batch"] = self._build_train_batch_fn()
+        state_struct = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.state)
+        n_dev = max(self.topology.world_size(), 1)
+        with self._span("profiling/step_cost"):
+            fn = self._compiled["train_batch"]
+            compiled = fn.lower(state_struct, struct).compile()
+            cstats = compiled_cost_stats(compiled)
+            traced = 0.0
+            try:
+                jaxpr = jax.make_jaxpr(fn)(state_struct, struct).jaxpr
+                # cached for the module-tree walk — tracing the full step
+                # costs seconds on large models; one trace serves both
+                self._step_jaxpr = (key, jaxpr)
+                traced = float(total_flops_of_jaxpr(jaxpr))
+            except Exception as e:  # noqa: BLE001 — e.g. shard_map paths
+                logger.debug(f"traced flop count unavailable: {e}")
+        # MFU convention: the numerator is LOGICAL model flops — the traced
+        # global count (scan-aware, matmul-exact).  compiled*n_dev would
+        # count replicated work (e.g. an unsharded optimizer update) once
+        # per device and still miss loop trip counts; it is only the
+        # fallback when tracing failed.
+        flops_global = traced if traced > 0 else cstats["flops"] * n_dev
+        bytes_global = cstats["bytes_accessed"] * n_dev
+        stats = {
+            "flops": flops_global,
+            "flops_per_device": flops_global / n_dev,
+            "bytes_accessed": bytes_global,
+            "bytes_accessed_per_device": cstats["bytes_accessed"],
+            "flops_traced": traced,
+            "flops_compiled_per_device": cstats["flops"],
+            "transcendentals": cstats["transcendentals"],
+            "peak_memory_bytes": cstats["peak_memory_bytes"],
+        }
+        self._step_cost = (key, stats)
+        return stats
+
+    def _publish_roofline(self, step: int) -> None:
+        """Roofline/MFU gauges for the current steady state (``roofline/*``
+        in the metrics registry; surfaced by ``bin/dstpu-telemetry``)."""
+        from ..profiling import roofline
+
+        dt = getattr(self.tput_timer, "last_step_time", 0.0)
+        if not dt:
+            return
+        try:
+            stats = self.train_step_cost()
+        except Exception as e:  # noqa: BLE001 — attribution is best-effort
+            logger.debug(f"roofline: step cost unavailable: {e}")
+            return
+        if not stats or not stats.get("flops"):
+            return
+        if self._roofline_spec is None:
+            self._roofline_spec = roofline.device_spec()
+        # per-device figures vs one chip's roofline
+        report = roofline.roofline_report(
+            stats["flops_per_device"],
+            stats.get("bytes_accessed_per_device", 0.0), dt,
+            n_devices=1, spec=self._roofline_spec)
+        report["step"] = step
+        roofline.publish_gauges(self.telemetry.metrics, report)
+
+    # ------------------------------------------------------------------ #
     # Data
     # ------------------------------------------------------------------ #
     def deepspeed_io(self, dataset, batch_size=None, collate_fn=None, num_local_io_workers=None,
@@ -560,6 +678,9 @@ class DeepSpeedEngine:
         if gas > 1:
             batch = jax.tree.map(
                 lambda x: x.reshape((gas, x.shape[0] // gas) + x.shape[1:]), batch)
+        # shapes feed train_step_cost() (profiler/bench MFU, roofline gauges)
+        self._last_batch_struct = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
         if "train_batch" not in self._compiled:
             self._compiled["train_batch"] = self._build_train_batch_fn()
         self._heartbeat("train_batch")
@@ -596,6 +717,12 @@ class DeepSpeedEngine:
                     jax.block_until_ready(loss)
             if trace_now:
                 self._xprof_fired = True
+                if self.telemetry is not None:
+                    # breadcrumb so the run summary can find + parse the
+                    # captured trace for device-time attribution
+                    self.telemetry.event(
+                        "xprof_trace", dir=os.path.abspath(cl.xprof_dir),
+                        step=cl.xprof_step)
                 log_dist(f"comms_logger: xprof trace for step {cl.xprof_step} "
                          f"→ {cl.xprof_dir}", ranks=[0])
             # the fence inside the step span makes it cover device time, not
@@ -619,6 +746,17 @@ class DeepSpeedEngine:
         if self.telemetry is not None:
             with self._span("telemetry/memory_sample"):
                 self.telemetry.memory.maybe_sample(step)
+        if self._straggler is not None:
+            dur = getattr(self.tput_timer, "last_step_time", 0.0)
+            if dur > 0:
+                with self._span("profiling/straggler_check"):
+                    self._straggler.observe_step(step, dur)
+        pcfg = self.config.profiling
+        if self._profiling_on and pcfg.enabled and pcfg.roofline and \
+                self.telemetry is not None and step > 0 and \
+                pcfg.roofline_interval > 0 and \
+                step % pcfg.roofline_interval == 0:
+            self._publish_roofline(step)
         cfg = self.config
         if cfg.steps_per_print and step > 0 and step % cfg.steps_per_print == 0:
             log_dist(f"step={step} loss={float(loss):.4f} "
@@ -628,19 +766,24 @@ class DeepSpeedEngine:
                      ranks=[0])
         if cfg.wall_clock_breakdown and step % cfg.steps_per_print == 0:
             self._timers.log(["forward", "backward", "step"])
-        if cfg.flops_profiler.enabled and step == cfg.flops_profiler.profile_step:
+        fp = cfg.flops_profiler
+        if (fp.enabled or pcfg.enabled) and step == fp.profile_step:
             from ..profiling.flops_profiler.profiler import FlopsProfiler
 
-            prof = FlopsProfiler(ds_engine=self)
+            prof = FlopsProfiler(ds_engine=self,
+                                 recompute_fwd_factor=fp.recompute_fwd_factor)
             try:
-                flat = batch
-                if self.gradient_accumulation_steps() > 1:
-                    flat = jax.tree.map(
-                        lambda x: x.reshape((-1,) + x.shape[2:]), batch)
-                prof.profile_engine_step(flat)
-                prof.latency = self.tput_timer.total_elapsed_time / max(
-                    self.tput_timer.global_step_count - self.tput_timer.start_step, 1)
-                prof.print_model_profile(output_file=cfg.flops_profiler.output_file)
+                # batch already carries the step fn's shapes ([gas, micro]
+                # under grad accumulation — train_batch reshaped it)
+                prof.profile_engine_step(batch, pre_reshaped=True)
+                prof.latency = getattr(self.tput_timer, "last_step_time", 0.0) \
+                    or self.tput_timer.total_elapsed_time / max(
+                        self.tput_timer.global_step_count -
+                        self.tput_timer.start_step, 1)
+                prof.print_model_profile(
+                    profile_step=step, module_depth=fp.module_depth,
+                    top_modules=fp.top_modules, detailed=fp.detailed,
+                    output_file=fp.output_file)
             except Exception as e:
                 logger.warning(f"flops profile failed: {e}")
 

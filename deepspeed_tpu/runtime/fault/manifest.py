@@ -23,7 +23,8 @@ from __future__ import annotations
 import hashlib
 import json
 import os
-from typing import Any, Dict, List, Optional
+import threading
+from typing import Any, Dict, List, Optional, Union
 
 try:
     from .atomic import atomic_write_text
@@ -52,6 +53,48 @@ def _sha256_file(path: str, chunk: int = 1 << 20) -> str:
     return h.hexdigest()
 
 
+class HashJob:
+    """Off-thread SHA-256 of one file.
+
+    For multi-GB ``meta.json`` payloads (universal-checkpoint client state),
+    hashing serially inside save/verify stalls the training thread; a
+    :class:`HashJob` overlaps the hash with the rest of the manifest work
+    (directory walk, size stat, shard listing) and joins at the point the
+    digest is actually needed.  ``result()`` re-raises any I/O error from
+    the worker, so a truncated/unreadable file fails the manifest exactly as
+    the synchronous path would — the hash still gates commit.
+    """
+
+    def __init__(self, path: str):
+        self.path = path
+        self._digest: Optional[str] = None
+        self._error: Optional[BaseException] = None
+        self._thread = threading.Thread(target=self._run, daemon=True,
+                                        name=f"manifest-hash:{path}")
+        self._thread.start()
+
+    def _run(self) -> None:
+        try:
+            self._digest = _sha256_file(self.path)
+        except BaseException as e:  # noqa: BLE001 — re-raised in result()
+            self._error = e
+
+    def result(self, timeout: Optional[float] = None) -> str:
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            raise TimeoutError(f"hashing {self.path} did not finish "
+                               f"within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        assert self._digest is not None
+        return self._digest
+
+
+def start_sha256(path: str) -> HashJob:
+    """Kick off an off-thread SHA-256 of ``path``; join via ``result()``."""
+    return HashJob(path)
+
+
 def _walk_files(ckpt_path: str) -> List[str]:
     """Sorted relative paths of every file under ``ckpt_path`` except the
     manifest itself."""
@@ -65,7 +108,17 @@ def _walk_files(ckpt_path: str) -> List[str]:
 
 
 def build_manifest(ckpt_path: str,
-                   extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+                   extra: Optional[Dict[str, Any]] = None,
+                   meta_hash: Union[str, HashJob, None] = None) -> Dict[str, Any]:
+    """Build the integrity record for ``ckpt_path``.
+
+    ``meta_hash``: a precomputed digest or an in-flight :class:`HashJob`
+    for ``meta.json`` (started by the caller right after writing the file,
+    so the hash overlaps the directory walk below); None hashes inline.
+    """
+    meta = os.path.join(ckpt_path, META_FILE)
+    if meta_hash is None and os.path.exists(meta):
+        meta_hash = start_sha256(meta)   # overlap with the metadata walk
     files = _walk_files(ckpt_path)
     shards = [f for f in files if f.split(os.sep, 1)[0] == STATE_DIR]
     manifest: Dict[str, Any] = {
@@ -75,18 +128,21 @@ def build_manifest(ckpt_path: str,
         "shard_listing_sha256": hashlib.sha256(
             "\n".join(shards).encode()).hexdigest(),
     }
-    meta = os.path.join(ckpt_path, META_FILE)
-    if os.path.exists(meta):
-        manifest["meta_sha256"] = _sha256_file(meta)
+    if meta_hash is not None:
+        manifest["meta_sha256"] = meta_hash.result() \
+            if isinstance(meta_hash, HashJob) else str(meta_hash)
     if extra:
         manifest.update(extra)
     return manifest
 
 
 def write_manifest(ckpt_path: str,
-                   extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
-    """Build + atomically persist the manifest; returns it."""
-    manifest = build_manifest(ckpt_path, extra)
+                   extra: Optional[Dict[str, Any]] = None,
+                   meta_hash: Union[str, HashJob, None] = None) -> Dict[str, Any]:
+    """Build + atomically persist the manifest; returns it.  The manifest is
+    sealed only after any in-flight meta hash has joined — an async hash
+    never weakens the commit gate."""
+    manifest = build_manifest(ckpt_path, extra, meta_hash=meta_hash)
     atomic_write_text(os.path.join(ckpt_path, MANIFEST_FILE),
                       json.dumps(manifest, indent=2, sort_keys=True))
     return manifest
@@ -120,6 +176,14 @@ def verify_checkpoint(ckpt_path: str,
             raise CheckpointCorruptError(f"{ckpt_path}: empty checkpoint directory")
         return None
 
+    # overlap the (potentially multi-GB) meta hash with the metadata checks
+    hash_job: Optional[HashJob] = None
+    if "meta_sha256" in manifest:
+        meta = os.path.join(ckpt_path, META_FILE)
+        if not os.path.exists(meta):
+            raise CheckpointCorruptError(f"{ckpt_path}: {META_FILE} missing")
+        hash_job = start_sha256(meta)
+
     for rel, size in manifest.get("files", {}).items():
         p = os.path.join(ckpt_path, rel)
         if not os.path.exists(p):
@@ -138,11 +202,12 @@ def verify_checkpoint(ckpt_path: str,
             f"{ckpt_path}: tensorstore shard listing changed since save "
             f"(shards added/removed under {STATE_DIR}/)")
 
-    if "meta_sha256" in manifest:
-        meta = os.path.join(ckpt_path, META_FILE)
-        if not os.path.exists(meta):
-            raise CheckpointCorruptError(f"{ckpt_path}: {META_FILE} missing")
-        actual = _sha256_file(meta)
+    if hash_job is not None:
+        try:
+            actual = hash_job.result()
+        except OSError as e:
+            raise CheckpointCorruptError(
+                f"{ckpt_path}: {META_FILE} unreadable: {e}")
         if actual != manifest["meta_sha256"]:
             raise CheckpointCorruptError(
                 f"{ckpt_path}: {META_FILE} content hash mismatch "

@@ -7,8 +7,13 @@ span fallback for logs that predate the JSONL span mirror — and prints:
   * a step-phase time breakdown (count / total / mean / p50 / p95 per span);
   * a per-collective communication table (calls, bytes, latency, alg/bus
     bandwidth estimates);
+  * performance attribution: the profiler's per-module cost tree
+    (``profile_report`` events), the roofline/MFU line (``roofline/*``
+    gauges), and a device-time breakdown parsed from the captured xprof
+    trace (``xprof_trace`` events / ``--xprof``);
   * memory high-water marks (live jax.Arrays + device allocator stats);
-  * an incident digest (faults, watchdog timeouts, checkpoint lifecycle).
+  * an incident digest (faults, watchdog timeouts, stragglers, checkpoint
+    lifecycle).
 
 Everything is computed into a plain dict first (``summarize_run``) so tests
 and downstream tooling can consume the numbers without scraping text.
@@ -23,7 +28,18 @@ from .events import read_jsonl
 from .metrics import _percentile
 
 EVENT_KINDS_INCIDENT = ("fault", "watchdog_timeout", "elastic_worker_failure",
-                        "elastic_restart")
+                        "elastic_restart", "straggler")
+
+#: roofline table columns, shared between the section renderer and --help
+ROOFLINE_COLUMNS = (
+    ("achieved_tflops", "achieved TFLOP/s per chip (step flops / step time)"),
+    ("peak_tflops", "device bf16 peak TFLOP/s (profiling/roofline.py table)"),
+    ("mfu", "model flops utilization = achieved / peak"),
+    ("hbm_gbps", "achieved HBM bandwidth, GB/s per chip"),
+    ("hbm_utilization", "achieved / peak HBM bandwidth"),
+    ("arithmetic_intensity", "flops per byte accessed; above the ridge "
+                             "point the step is compute-bound"),
+)
 
 
 def _fmt_bytes(n: float) -> str:
@@ -184,6 +200,51 @@ def memory_summary(metrics: Sequence[Dict[str, Any]],
     return out
 
 
+def profile_summary(events: Sequence[Dict[str, Any]],
+                    metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """Performance attribution: the last ``profile_report`` event (module
+    rows + roofline snapshot at profile time) plus the latest ``roofline/*``
+    gauges (steady-state MFU, updated every roofline_interval steps)."""
+    out: Dict[str, Any] = {}
+    for e in events:
+        if e.get("kind") == "profile_report":
+            out["report"] = {k: v for k, v in e.items() if k != "kind"}
+    gauges: Dict[str, Any] = {}
+    for m in metrics:
+        name = str(m.get("name", ""))
+        if name.startswith("roofline/"):
+            gauges[name.split("/", 1)[1]] = m.get("value")
+            labels = m.get("labels") or {}
+            if labels.get("device"):
+                gauges["device_kind"] = labels["device"]
+    if gauges:
+        out["roofline_gauges"] = gauges
+    return out
+
+
+def xprof_summary(events: Sequence[Dict[str, Any]],
+                  explicit_dir: Optional[str] = None) -> Optional[Dict[str, Any]]:
+    """Device-time attribution from the captured xprof trace: ``--xprof``
+    wins, else the engine's ``xprof_trace`` breadcrumb event."""
+    candidates = [explicit_dir] if explicit_dir else []
+    for e in events:
+        if e.get("kind") == "xprof_trace" and e.get("dir"):
+            candidates.append(str(e["dir"]))
+    for path in candidates:
+        if not path or not os.path.exists(path):
+            continue
+        try:
+            from ..profiling.xprof_parse import attribute_device_time
+
+            report = attribute_device_time(path)
+        except Exception:  # noqa: BLE001 — a bad trace must not kill the CLI
+            continue
+        if report["files"]:
+            report["source"] = path
+            return report
+    return None
+
+
 def incident_summary(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     counts: Dict[str, int] = {}
     for e in events:
@@ -197,14 +258,18 @@ def incident_summary(events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
 
 
 def summarize_run(events_path: Optional[str],
-                  trace_path: Optional[str] = None) -> Dict[str, Any]:
+                  trace_path: Optional[str] = None,
+                  xprof_dir: Optional[str] = None) -> Dict[str, Any]:
     run = load_run(events_path, trace_path)
     return {
-        "sources": {"events": events_path, "trace": trace_path},
+        "sources": {"events": events_path, "trace": trace_path,
+                    "xprof": xprof_dir},
         "runs_in_log": run["runs_in_log"],
         "n_spans": len(run["spans"]),
         "step_breakdown": step_breakdown(run["spans"]),
         "comm": comm_table(run["metrics"]),
+        "profile": profile_summary(run["events"], run["metrics"]),
+        "xprof": xprof_summary(run["events"], explicit_dir=xprof_dir),
         "memory": memory_summary(run["metrics"], run["events"]),
         "incidents": incident_summary(run["events"]),
     }
@@ -256,6 +321,54 @@ def format_summary(s: Dict[str, Any]) -> str:
         add("(no collectives recorded)")
     add("")
 
+    add("--- performance attribution ---")
+    prof = s.get("profile") or {}
+    gauges = prof.get("roofline_gauges")
+    report = prof.get("report")
+    roof = (report or {}).get("roofline") or gauges
+    if roof:
+        dev = roof.get("device_kind", "?")
+        mfu = roof.get("mfu")
+        line = f"roofline [{dev}]: "
+        if roof.get("achieved_tflops") is not None:
+            line += f"{roof['achieved_tflops']:.1f}"
+            if roof.get("peak_tflops"):
+                line += f"/{roof['peak_tflops']:.0f}"
+            line += " TFLOP/s/chip"
+        if mfu is not None:
+            line += f" (MFU {mfu * 100:.1f}%)"
+        if roof.get("hbm_gbps") is not None:
+            line += f", HBM {roof['hbm_gbps']:.0f} GB/s"
+            if roof.get("hbm_utilization") is not None:
+                line += f" ({roof['hbm_utilization'] * 100:.1f}%)"
+        add(line + "  [source: flops profiler]")
+    if report:
+        add(f"profile @ step {report.get('step')}: "
+            f"flops/step={report.get('flops', 0):.3e} "
+            f"params={report.get('params', 0):.3e} "
+            f"latency={report.get('latency_s', 0):.3f}s")
+        rows = report.get("module_rows") or []
+        if rows:
+            add(f"{'module':<34}{'params':>12}{'flops':>12}{'AI':>8}"
+                f"{'%flops':>8}")
+            for r in rows:
+                label = "  " * int(r.get("depth", 0)) + str(r.get("module"))
+                add(f"{label:<34}{r.get('params', 0):>12.3g}"
+                    f"{r.get('flops', 0):>12.3g}"
+                    f"{r.get('arithmetic_intensity', 0):>8.1f}"
+                    f"{r.get('pct_flops', 0):>7.1f}%")
+    if not roof and not report:
+        add("(no profile_report events — enable config.profiling)")
+    xp = s.get("xprof")
+    if xp:
+        add("")
+        add(f"--- device-time breakdown (xprof: {xp.get('source')}) ---")
+        from ..profiling.xprof_parse import format_device_table
+
+        for line in format_device_table(xp):
+            add(line)
+    add("")
+
     add("--- memory high-water marks ---")
     mem = s["memory"]
     if mem:
@@ -294,17 +407,33 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     import argparse
     import sys
 
+    roofline_doc = "\n".join(f"  {name:<22}{desc}"
+                             for name, desc in ROOFLINE_COLUMNS)
     parser = argparse.ArgumentParser(
         prog="dstpu-telemetry",
+        formatter_class=argparse.RawDescriptionHelpFormatter,
         description="Summarize a deepspeed_tpu telemetry output directory "
-                    "(step-phase breakdown, comm bandwidth, memory "
-                    "high-water marks).")
+                    "(step-phase breakdown, comm bandwidth, performance "
+                    "attribution, memory high-water marks).",
+        epilog="roofline columns (the 'performance attribution' section, "
+               "from roofline/* gauges\nand profile_report events):\n"
+               + roofline_doc +
+               "\n\nThe per-module cost tree attributes analytic "
+               "flops/bytes to jax.named_scope\nmodules (fwd+bwd), anchored "
+               "to XLA cost analysis of the compiled step; the\ndevice-time "
+               "breakdown parses the xprof trace captured at "
+               "comms_logger.xprof_step\ninto compute / communication / "
+               "host-transfer buckets.")
     parser.add_argument("path",
                         help="telemetry output dir (containing events.jsonl/"
                              "trace.json) or a path to an events.jsonl")
     parser.add_argument("--trace", default=None,
                         help="explicit trace.json path (default: "
                              "<dir>/trace.json)")
+    parser.add_argument("--xprof", default=None,
+                        help="xprof trace dir/file for the device-time "
+                             "breakdown (default: the run's xprof_trace "
+                             "event, if any)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit the summary as JSON instead of text")
     args = parser.parse_args(argv)
@@ -321,7 +450,7 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"dstpu-telemetry: no events.jsonl or trace.json at {path}")
         return 2
 
-    summary = summarize_run(events_path, trace_path)
+    summary = summarize_run(events_path, trace_path, xprof_dir=args.xprof)
     try:
         if args.as_json:
             print(json.dumps(summary, indent=2, sort_keys=True, default=str))

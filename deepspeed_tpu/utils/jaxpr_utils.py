@@ -1,12 +1,41 @@
 """Jaxpr introspection helpers shared by compiled-program perf gates
-(tests) and bench modes — structural facts about a traced program, e.g.
-every ``lax.scan`` trip count (the pipeline tick loops' bubble evidence).
+(tests), bench modes, and the performance-attribution profiler —
+structural facts about a traced program: ``lax.scan`` trip counts (the
+pipeline tick loops' bubble evidence) and per-``jax.named_scope``
+flop/byte attribution (the profiler's module cost tree).
 """
 from __future__ import annotations
 
-from typing import List
+import dataclasses
+import re
+from typing import Any, Dict, List, Tuple
 
 import jax
+import numpy as np
+
+
+#: primitives whose sub-jaxpr is a scalar COMBINER (e.g. scatter-add's
+#: `{lambda a,b. add a b}`), not program structure — recursing into it would
+#: count one combiner application instead of eqn_flops's per-element figure
+_COMBINER_PRIMS_PREFIXES = ("scatter", "reduce", "select_and_scatter",
+                            "select_and_gather", "argmin", "argmax",
+                            "cumsum", "cumprod", "cummax", "cummin")
+
+
+def _is_leaf_eqn(eqn) -> bool:
+    return eqn.primitive.name.startswith(_COMBINER_PRIMS_PREFIXES)
+
+
+def _sub_jaxprs(eqn):
+    """Every sub-jaxpr hiding in an eqn's params (pjit/scan/cond/while/
+    remat/custom_* all stash theirs under different keys)."""
+    for v in eqn.params.values():
+        vals = v if isinstance(v, (list, tuple)) else [v]
+        for inner in vals:
+            while hasattr(inner, "jaxpr"):      # ClosedJaxpr → Jaxpr
+                inner = inner.jaxpr
+            if hasattr(inner, "eqns"):
+                yield inner
 
 
 def scan_lengths(fn, *args) -> List[int]:
@@ -18,12 +47,261 @@ def scan_lengths(fn, *args) -> List[int]:
         for eqn in jx.eqns:
             if eqn.primitive.name == "scan":
                 found.append(int(eqn.params["length"]))
-            for v in eqn.params.values():
-                inner = v
-                while hasattr(inner, "jaxpr"):      # ClosedJaxpr → Jaxpr
-                    inner = inner.jaxpr
-                if hasattr(inner, "eqns"):
-                    walk(inner)
+            for inner in _sub_jaxprs(eqn):
+                walk(inner)
 
     walk(jax.make_jaxpr(fn)(*args).jaxpr)
     return found
+
+
+# --------------------------------------------------------------------- #
+# Per-named-scope cost attribution
+# --------------------------------------------------------------------- #
+#: primitives whose flop count is the *output* element count and which the
+#: hardware evaluates via its transcendental unit (tracked separately, like
+#: XLA cost analysis does)
+_TRANSCENDENTAL = frozenset({
+    "exp", "log", "log1p", "expm1", "tanh", "logistic", "erf", "erfc",
+    "erf_inv", "sin", "cos", "tan", "atan2", "pow", "rsqrt", "sqrt",
+    "cbrt", "digamma", "lgamma",
+})
+
+#: elementwise / reduction primitives counted as one flop per element
+_ELEMENTWISE = frozenset({
+    "add", "sub", "mul", "div", "max", "min", "neg", "abs", "sign",
+    "floor", "ceil", "round", "rem", "nextafter", "add_any", "and", "or",
+    "xor", "not", "select_n", "clamp", "integer_pow", "square",
+})
+
+_REDUCTIONS = frozenset({
+    "reduce_sum", "reduce_max", "reduce_min", "reduce_prod", "reduce_and",
+    "reduce_or", "argmax", "argmin", "cumsum", "cumprod", "cummax", "cummin",
+})
+
+#: pure data-movement: zero flops, bytes only
+_ZERO_FLOP = frozenset({
+    "reshape", "transpose", "broadcast_in_dim", "squeeze", "slice",
+    "dynamic_slice", "dynamic_update_slice", "concatenate", "gather",
+    "scatter", "rev", "pad", "convert_element_type",
+    "bitcast_convert_type", "copy", "iota", "stop_gradient", "device_put",
+    "split", "expand_dims",
+})
+
+#: combining scatters: one combine op per UPDATES element (the embedding
+#: gradient lowers to scatter-add of ~B·S·D adds — not data movement)
+_SCATTER_COMBINE = frozenset({"scatter-add", "scatter-mul", "scatter-max",
+                              "scatter-min"})
+
+
+def _aval_size(v) -> int:
+    try:
+        return int(np.prod(v.aval.shape))
+    except Exception:  # noqa: BLE001 — abstract tokens etc.
+        return 0
+
+
+def _aval_bytes(v) -> int:
+    try:
+        aval = v.aval
+        return int(np.prod(aval.shape)) * np.dtype(aval.dtype).itemsize
+    except Exception:  # noqa: BLE001
+        return 0
+
+
+def _dot_general_flops(eqn) -> float:
+    """2·batch·M·N·K for a ``dot_general`` from its dimension numbers."""
+    (lc, rc), (lb, _rb) = eqn.params["dimension_numbers"]
+    lhs, rhs = eqn.invars[0].aval.shape, eqn.invars[1].aval.shape
+    batch = float(np.prod([lhs[i] for i in lb], initial=1.0))
+    contract = float(np.prod([lhs[i] for i in lc], initial=1.0))
+    m = float(np.prod([d for i, d in enumerate(lhs)
+                       if i not in lc and i not in lb], initial=1.0))
+    n = float(np.prod([d for i, d in enumerate(rhs)
+                       if i not in rc and i not in _rb_set(eqn)], initial=1.0))
+    return 2.0 * batch * m * n * contract
+
+
+def _rb_set(eqn):
+    return set(eqn.params["dimension_numbers"][1][1])
+
+
+def eqn_flops(eqn) -> Tuple[float, float]:
+    """(flops, transcendentals) analytic estimate for one jaxpr eqn.
+
+    Matmuls get the exact 2·M·N·K count; elementwise/reduction ops count one
+    flop per element; data movement counts zero.  Unknown primitives fall
+    back to output element count — an undercount for exotic kernels, never
+    an overcount that would inflate MFU.
+    """
+    name = eqn.primitive.name
+    if name == "dot_general":
+        return _dot_general_flops(eqn), 0.0
+    if name in _SCATTER_COMBINE:
+        # invars: operand, indices, updates — one combine per update element
+        updates = eqn.invars[-1] if eqn.invars else None
+        return (float(_aval_size(updates)) if updates is not None else 0.0,
+                0.0)
+    out_elems = float(sum(_aval_size(v) for v in eqn.outvars))
+    if name in _TRANSCENDENTAL:
+        return out_elems, out_elems
+    if name in _ZERO_FLOP:
+        return 0.0, 0.0
+    if name in _REDUCTIONS:
+        return float(sum(_aval_size(v) for v in eqn.invars)), 0.0
+    if name in _ELEMENTWISE:
+        return out_elems, 0.0
+    return out_elems, 0.0
+
+
+def eqn_bytes(eqn) -> float:
+    """Static bytes-touched estimate: operand + result footprints.  Ignores
+    fusion (XLA will elide many intermediates), so per-module arithmetic
+    intensity from this is a lower bound."""
+    return float(sum(_aval_bytes(v) for v in eqn.invars) +
+                 sum(_aval_bytes(v) for v in eqn.outvars))
+
+
+_WRAPPER = re.compile(r"^(transpose|jvp|vmap|pmap)\((.*)\)$")
+
+
+def _normalize_component(comp: str) -> Tuple[str, bool]:
+    """Strip AD/batching decorations from one name-stack element.
+
+    ``transpose(jvp(layers))`` → (``layers``, True): the transpose wrapper
+    marks backward-pass eqns.  ``rematted_computation`` (the recompute body
+    jax.checkpoint splices in) is dropped from the path but noted.
+    """
+    bwd = False
+    while True:
+        m = _WRAPPER.match(comp)
+        if m is None:
+            break
+        if m.group(1) == "transpose":
+            bwd = True
+        comp = m.group(2)
+    return comp, bwd
+
+
+def _split_scope(stack_str: str) -> Tuple[Tuple[str, ...], str]:
+    """Name-stack string → (normalized scope path, phase).
+
+    Phase: ``bwd`` when any component carries a transpose() wrapper,
+    ``remat`` when the path runs through a rematted_computation body
+    (recompute work — real flops, but double-counted against fwd), else
+    ``fwd``.
+    """
+    comps: List[str] = []
+    bwd = remat = False
+    for raw in stack_str.split("/"):
+        if not raw:
+            continue
+        comp, is_bwd = _normalize_component(raw)
+        bwd = bwd or is_bwd
+        if comp == "rematted_computation":
+            remat = True
+            continue
+        if comp:
+            comps.append(comp)
+    phase = "bwd" if bwd else ("remat" if remat else "fwd")
+    return tuple(comps), phase
+
+
+@dataclasses.dataclass
+class ScopeCost:
+    """Accumulated static cost of every eqn under one named-scope path."""
+
+    flops: float = 0.0
+    bytes: float = 0.0
+    transcendentals: float = 0.0
+    eqns: int = 0
+    flops_by_phase: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    def add(self, flops: float, byts: float, trans: float, phase: str,
+            count: int = 1) -> None:
+        self.flops += flops
+        self.bytes += byts
+        self.transcendentals += trans
+        self.eqns += count
+        self.flops_by_phase[phase] = self.flops_by_phase.get(phase, 0.0) + flops
+
+
+def scope_costs_of_jaxpr(jaxpr) -> Dict[Tuple[str, ...], ScopeCost]:
+    """:func:`scope_costs` on an already-traced jaxpr — callers that traced
+    once for a flop total can reuse the jaxpr instead of re-tracing (a full
+    fwd+bwd+optimizer trace costs seconds on large models)."""
+    costs: Dict[Tuple[str, ...], ScopeCost] = {}
+
+    def walk(jx, prefix: Tuple[str, ...], mult: float) -> None:
+        for eqn in jx.eqns:
+            comps, phase = _split_scope(str(eqn.source_info.name_stack))
+            scope = prefix + comps
+            subs = [] if _is_leaf_eqn(eqn) else list(_sub_jaxprs(eqn))
+            if subs:
+                inner_mult = mult
+                if eqn.primitive.name == "scan":
+                    inner_mult *= float(eqn.params.get("length", 1))
+                if eqn.primitive.name == "cond":
+                    # count only the most expensive branch, not their sum
+                    best, best_cost = None, -1.0
+                    for sub in subs:
+                        c = _jaxpr_flops(sub)
+                        if c > best_cost:
+                            best, best_cost = sub, c
+                    subs = [best] if best is not None else []
+                for sub in subs:
+                    walk(sub, scope, inner_mult)
+                continue
+            flops, trans = eqn_flops(eqn)
+            byts = eqn_bytes(eqn)
+            costs.setdefault(scope, ScopeCost()).add(
+                flops * mult, byts * mult, trans * mult, phase)
+
+    walk(jaxpr, (), 1.0)
+    return costs
+
+
+def scope_costs(fn, *args) -> Dict[Tuple[str, ...], ScopeCost]:
+    """Attribute ``fn``'s analytic flops/bytes to ``jax.named_scope`` paths.
+
+    Traces ``fn`` (no compile) and walks the jaxpr, recursing into
+    pjit/scan/cond/while/remat sub-jaxprs.  Scan bodies multiply by the
+    static trip count; cond takes the most expensive branch; while bodies
+    count one trip (the count is dynamic — an explicit undercount).
+    AD decorations are stripped so forward and backward eqns of the same
+    module aggregate under one path (split out in ``flops_by_phase``).
+
+    ``args`` may be concrete arrays or ``jax.ShapeDtypeStruct``s.
+    """
+    return scope_costs_of_jaxpr(jax.make_jaxpr(fn)(*args).jaxpr)
+
+
+def _jaxpr_flops(jx) -> float:
+    total = 0.0
+    for eqn in jx.eqns:
+        subs = [] if _is_leaf_eqn(eqn) else list(_sub_jaxprs(eqn))
+        if subs:
+            if eqn.primitive.name == "scan":
+                total += float(eqn.params.get("length", 1)) * \
+                    sum(_jaxpr_flops(s) for s in subs)
+            elif eqn.primitive.name == "cond":
+                # most expensive branch only — matching scope_costs_of_jaxpr
+                # so the module tree and the MFU numerator agree
+                total += max((_jaxpr_flops(s) for s in subs), default=0.0)
+            else:
+                total += sum(_jaxpr_flops(s) for s in subs)
+        else:
+            total += eqn_flops(eqn)[0]
+    return total
+
+
+def total_flops_of_jaxpr(jaxpr) -> float:
+    """:func:`total_flops` on an already-traced jaxpr."""
+    return _jaxpr_flops(jaxpr)
+
+
+def total_flops(fn, *args) -> float:
+    """Whole-program analytic flop count (trace-only — no XLA compile).
+    Cheaper than ``compiled.cost_analysis()`` and fusion-independent; use it
+    when an extra compile is unaffordable and a matmul-exact/elementwise-
+    approximate count is enough."""
+    return _jaxpr_flops(jax.make_jaxpr(fn)(*args).jaxpr)

@@ -103,6 +103,9 @@ class ThroughputTimer:
         self.global_step_count = 0
         self.total_elapsed_time = 0.0
         self.step_elapsed_time = 0.0
+        #: wall time of the most recent post-warmup step (straggler
+        #: detection + roofline gauges read this)
+        self.last_step_time = 0.0
         self._start = 0.0
         self.started = False
 
@@ -124,6 +127,7 @@ class ThroughputTimer:
         self.global_step_count += 1
         if self.global_step_count <= self.start_step:
             return  # skip warmup/compile steps
+        self.last_step_time = duration
         self.total_elapsed_time += duration
         self.step_elapsed_time += duration
         if self.telemetry is not None:

@@ -296,3 +296,69 @@ class TestEngineLevelRecovery:
         path, _client = fresh.load_checkpoint(str(tmp_path))
         assert path.endswith("global_step1")
         assert fresh.global_steps == 1
+
+
+class TestAsyncManifestHash:
+    """Off-thread meta.json hashing (PR-1 follow-up): the hash overlaps the
+    manifest's directory walk but the manifest only seals after the join —
+    the digest must gate commit exactly as the synchronous path did."""
+
+    def test_hash_job_matches_sync_digest(self, tmp_path):
+        from deepspeed_tpu.runtime.fault.manifest import (_sha256_file,
+                                                          start_sha256)
+
+        p = tmp_path / "meta.json"
+        p.write_text(json.dumps({"k": list(range(1000))}))
+        assert start_sha256(str(p)).result() == _sha256_file(str(p))
+
+    def test_hash_job_propagates_io_error(self, tmp_path):
+        from deepspeed_tpu.runtime.fault.manifest import start_sha256
+
+        job = start_sha256(str(tmp_path / "does_not_exist"))
+        with pytest.raises(OSError):
+            job.result()
+
+    def test_write_manifest_joins_inflight_job(self, tmp_path):
+        from deepspeed_tpu.runtime.fault.manifest import (_sha256_file,
+                                                          start_sha256)
+
+        ckpt = tmp_path / "tag1"
+        (ckpt / "state").mkdir(parents=True)
+        (ckpt / "state" / "shard0").write_bytes(b"x" * 64)
+        (ckpt / "meta.json").write_text('{"step": 1}')
+        job = start_sha256(str(ckpt / "meta.json"))
+        m = write_manifest(str(ckpt), meta_hash=job)
+        assert m["meta_sha256"] == _sha256_file(str(ckpt / "meta.json"))
+        verify_checkpoint(str(ckpt))
+
+    def test_async_hash_still_gates_commit(self, tmp_path):
+        """Fault-marker proof: corrupt meta.json after an async-hashed save;
+        a fresh engine's commit must refuse the tag."""
+        from deepspeed_tpu.runtime.fault.manifest import _sha256_file
+
+        eng = OrbaxCheckpointEngine(str(tmp_path), fault_config=FAST_FAULT)
+        eng.save(payload(1), "global_step1")
+        m = read_manifest(str(tmp_path / "global_step1"))
+        meta = str(tmp_path / "global_step1" / "meta.json")
+        assert m["meta_sha256"] == _sha256_file(meta)   # async == sync digest
+        # same-size byte flip: only the CONTENT hash can catch this
+        with open(meta, "r+b") as f:
+            raw = f.read()
+            f.seek(0)
+            f.write(raw[:-1] + bytes([raw[-1] ^ 0xFF]))
+        fresh = OrbaxCheckpointEngine(str(tmp_path), fault_config=FAST_FAULT)
+        with pytest.raises(CheckpointCorruptError):
+            fresh.commit("global_step1")
+        assert not os.path.exists(str(tmp_path / LATEST_FILE))
+
+    def test_verify_overlapped_hash_catches_same_size_corruption(self, tmp_path):
+        make_ckpt(tmp_path)
+        p = str(tmp_path / "global_step1")
+        meta = os.path.join(p, "meta.json")
+        with open(meta, "r+b") as f:
+            raw = f.read()
+            f.seek(0)
+            f.write(raw[:-1] + bytes([raw[-1] ^ 0xFF]))
+        # size check passes; the off-thread content hash must still catch it
+        with pytest.raises(CheckpointCorruptError, match="hash mismatch"):
+            verify_checkpoint(p)

@@ -1,0 +1,208 @@
+"""Jaxpr named-scope attribution: exact flop counts on a 2-layer toy model,
+scan multiplication, params classification, and the transformer tree
+(utils/jaxpr_utils.py + profiling/module_tree.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.profiling.module_tree import (attribute_fn,
+                                                 format_module_table,
+                                                 params_by_scope)
+from deepspeed_tpu.utils.jaxpr_utils import (eqn_flops, scope_costs,
+                                             total_flops)
+
+pytestmark = pytest.mark.profiling
+
+B, D1, D2 = 4, 8, 16
+
+
+def two_layer(x, w1, w2):
+    """Toy model with one matmul per named scope — exact expected flops."""
+    with jax.named_scope("layer1"):
+        h = x @ w1                       # 2*B*D1*D2
+    with jax.named_scope("layer2"):
+        y = h @ w2                       # 2*B*D2*D1
+    return y.sum()
+
+
+def args():
+    return (jnp.ones((B, D1)), jnp.ones((D1, D2)), jnp.ones((D2, D1)))
+
+
+class TestScopeCosts:
+    def test_exact_matmul_flops_per_scope(self):
+        costs = {k: v for k, v in scope_costs(two_layer, *args()).items()}
+        assert costs[("layer1",)].flops == 2 * B * D1 * D2
+        assert costs[("layer2",)].flops == 2 * B * D2 * D1
+
+    def test_backward_attributed_to_originating_scope(self):
+        """AD transposes carry the forward scope.  grad w.r.t. (w1, w2):
+        layer1 gets fwd + dw1 (no dx — x isn't differentiated); layer2 gets
+        fwd + dh + dw2, each a same-size matmul."""
+        costs = scope_costs(jax.grad(two_layer, argnums=(1, 2)), *args())
+        mm = 2 * B * D1 * D2
+        l1, l2 = costs[("layer1",)], costs[("layer2",)]
+        assert l1.flops == 2 * mm
+        assert l1.flops_by_phase == {"fwd": mm, "bwd": mm}
+        assert l2.flops == 3 * mm
+        assert l2.flops_by_phase == {"fwd": mm, "bwd": 2 * mm}
+
+    def test_scan_multiplies_trip_count(self):
+        L = 5
+
+        def scanned(x, ws):
+            def body(c, w):
+                with jax.named_scope("inner"):
+                    return c @ w, None
+            with jax.named_scope("stack"):
+                y, _ = jax.lax.scan(body, x, ws)
+            return y.sum()
+
+        costs = scope_costs(scanned, jnp.ones((B, D1)),
+                            jnp.ones((L, D1, D1)))
+        assert costs[("stack", "inner")].flops == L * 2 * B * D1 * D1
+
+    def test_shape_structs_accepted(self):
+        costs = scope_costs(two_layer,
+                            jax.ShapeDtypeStruct((B, D1), jnp.float32),
+                            jax.ShapeDtypeStruct((D1, D2), jnp.float32),
+                            jax.ShapeDtypeStruct((D2, D1), jnp.float32))
+        assert costs[("layer1",)].flops == 2 * B * D1 * D2
+
+    def test_total_flops_matches_scope_sum(self):
+        costs = scope_costs(two_layer, *args())
+        assert total_flops(two_layer, *args()) == pytest.approx(
+            sum(c.flops for c in costs.values()))
+
+    def test_bytes_positive(self):
+        costs = scope_costs(two_layer, *args())
+        assert costs[("layer1",)].bytes >= 4 * (B * D1 + D1 * D2 + B * D2)
+
+
+class TestEqnFlops:
+    def test_transcendental_tracked(self):
+        jaxpr = jax.make_jaxpr(lambda x: jnp.tanh(x))(jnp.ones((7,)))
+        flops, trans = eqn_flops(jaxpr.jaxpr.eqns[0])
+        assert flops == 7 and trans == 7
+
+    def test_scatter_add_counts_per_update_element(self):
+        """The embedding-gradient scatter-add must count one combine per
+        UPDATE element — not recurse into its scalar combiner jaxpr (which
+        would report 1 flop for the whole scatter)."""
+        V, D, N = 32, 16, 8
+
+        def embed_loss(emb, idx):
+            with jax.named_scope("embed"):
+                return jnp.take(emb, idx, axis=0).sum()
+
+        costs = scope_costs(jax.grad(embed_loss),
+                            jnp.ones((V, D)), jnp.arange(N))
+        embed = costs[("embed",)]
+        assert embed.flops >= N * D     # one add per gathered element
+        assert total_flops(jax.grad(embed_loss),
+                           jnp.ones((V, D)), jnp.arange(N)) >= N * D
+
+    def test_cond_counts_max_branch_in_both_walkers(self):
+        """total_flops and scope_costs must agree on lax.cond: the most
+        expensive branch, never the sum of both (fp16 loss-scaler and the
+        1-bit optimizers wrap the update in cond)."""
+        def f(x, pred):
+            with jax.named_scope("update"):
+                return jax.lax.cond(pred,
+                                    lambda v: (v @ v).sum(),
+                                    lambda v: v.sum(), x)
+
+        a = (jnp.ones((D1, D1)), jnp.array(True))
+        mm = 2 * D1 * D1 * D1
+        tot = total_flops(f, *a)
+        scoped = sum(c.flops for c in scope_costs(f, *a).values())
+        assert tot == pytest.approx(scoped)
+        assert mm <= tot < 1.5 * mm     # one branch, not both
+
+
+class TestAttributeFn:
+    def test_tree_rows_and_table(self):
+        params = {"layer1": {"kernel": np.ones((D1, D2))},
+                  "layer2": {"kernel": np.ones((D2, D1))}}
+        prof = attribute_fn(two_layer, *args(), params=params)
+        rows = {r["module"]: r for r in prof.rows()}
+        assert rows["layer1"]["flops"] == 2 * B * D1 * D2
+        assert rows["layer1"]["macs"] == B * D1 * D2
+        assert rows["layer1"]["params"] == D1 * D2
+        assert rows["layer2"]["params"] == D2 * D1
+        # pct of traced total (the final unscoped sum() takes the rest)
+        assert rows["layer1"]["pct_flops"] + rows["layer2"]["pct_flops"] \
+            > 98.0
+        table = "\n".join(format_module_table(prof))
+        assert "layer1" in table and "%" in table
+        assert "traced total" in table
+
+    def test_anchor_line(self):
+        prof = attribute_fn(two_layer, *args(), measured={"flops": 1000.0})
+        assert prof.total_flops_measured == 1000.0
+        assert any("anchor" in ln for ln in format_module_table(prof))
+
+    def test_depth_limit(self):
+        def nested(x):
+            with jax.named_scope("outer"):
+                with jax.named_scope("deep"):
+                    x = x @ x
+            return x.sum()
+
+        prof = attribute_fn(nested, jnp.ones((D1, D1)))
+        shallow = format_module_table(prof, max_depth=0)
+        assert not any("deep" in ln for ln in shallow)
+        deep = format_module_table(prof, max_depth=-1)
+        assert any("deep" in ln for ln in deep)
+
+
+class TestTransformerAttribution:
+    def test_param_classification_exact(self):
+        from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                      init_params)
+
+        cfg = TransformerConfig.tiny()
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        by_scope = params_by_scope(params)
+        D, F, L, V = (cfg.hidden_size, cfg.intermediate_size,
+                      cfg.num_layers, cfg.vocab_size)
+        H, KV, hd = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+        assert by_scope[("embed",)] == V * D
+        assert by_scope[("lm_head",)] == V * D
+        assert by_scope[("final_norm",)] == D
+        # q/k/v/o kernels + attn_norm scales, stacked over L layers
+        assert by_scope[("layers", "attention")] == \
+            L * (D * (H + 2 * KV) * hd + H * hd * D + D)
+        # gate/up/down kernels + mlp_norm scales
+        assert by_scope[("layers", "mlp")] == L * (3 * D * F + D)
+        # nothing dropped
+        total = sum(int(np.prod(l.shape))
+                    for l in jax.tree.leaves(params))
+        assert sum(by_scope.values()) == total
+
+    def test_forward_tree_has_module_scopes(self):
+        from deepspeed_tpu.models.transformer import (TransformerConfig,
+                                                      forward, init_params)
+
+        cfg = TransformerConfig.tiny(use_flash=False)
+        params = init_params(cfg, jax.random.PRNGKey(0))
+        tokens = jnp.zeros((2, 16), jnp.int32)
+        prof = attribute_fn(lambda p, t: forward(p, t, cfg).sum(),
+                            params, tokens, params=params)
+        rows = {}
+        for r in prof.rows():   # rows are flops-sorted: keep the big one
+            rows.setdefault(r["module"], r)
+        for scope in ("layers", "attention", "mlp", "lm_head", "embed"):
+            assert scope in rows, f"missing scope {scope}"
+        D, F, L = cfg.hidden_size, cfg.intermediate_size, cfg.num_layers
+        S, Btok = 16, 2
+        # mlp matmuls are exact: scan multiplies by L
+        mlp_matmul = L * 2 * Btok * S * D * F * 3
+        assert rows["mlp"]["flops"] >= mlp_matmul
+        assert rows["mlp"]["flops"] < mlp_matmul * 1.1
+        # lm_head projection
+        assert rows["lm_head"]["flops"] >= 2 * Btok * S * D * cfg.vocab_size
+        # layers node aggregates its children
+        assert rows["layers"]["flops"] >= \
+            rows["attention"]["flops"] + rows["mlp"]["flops"]

@@ -1,0 +1,101 @@
+"""Cross-host straggler detection on synthetic skewed timings
+(profiling/straggler.py)."""
+import pytest
+
+from deepspeed_tpu.profiling.straggler import StragglerDetector
+from deepspeed_tpu.telemetry import Telemetry
+
+pytestmark = pytest.mark.profiling
+
+
+@pytest.fixture
+def tel(tmp_path):
+    t = Telemetry(output_dir=str(tmp_path), chrome_trace=False,
+                  prometheus=False)
+    yield t
+    t.close()
+
+
+class TestCheck:
+    def test_skewed_hosts_fire_incident(self, tel):
+        det = StragglerDetector(threshold=0.25, telemetry=tel)
+        incident = det.check(step=7, per_host=[0.10, 0.11, 0.10, 0.20])
+        assert incident is not None
+        assert incident["worst_host"] == 3
+        assert incident["step"] == 7
+        # (0.20 - 0.105) / 0.105
+        assert incident["skew"] == pytest.approx(0.9048, abs=1e-3)
+        events = tel.events.recent(kind="straggler")
+        assert len(events) == 1
+        assert events[0]["worst_host"] == 3
+        assert tel.metrics.counter("straggler/events").value() == 1
+
+    def test_balanced_hosts_quiet_but_metered(self, tel):
+        det = StragglerDetector(threshold=0.25, telemetry=tel)
+        assert det.check(1, [0.10, 0.101, 0.099, 0.1]) is None
+        assert tel.events.recent(kind="straggler") == []
+        # the skew histogram observes every check (the trend is the signal)
+        assert tel.metrics.histogram("straggler/skew").count() == 1
+        assert tel.metrics.gauge("Straggler/skew").value() is not None
+
+    def test_single_host_never_fires(self, tel):
+        det = StragglerDetector(threshold=0.0, telemetry=tel)
+        assert det.check(1, [0.5]) is None
+
+    def test_empty_input(self, tel):
+        assert StragglerDetector(telemetry=tel).check(1, []) is None
+
+
+class TestObserveStep:
+    def test_window_means_gathered_and_incident_fires(self, tel):
+        gathered = []
+
+        def fake_gather(mean):
+            gathered.append(mean)
+            return [mean, mean * 2.0, mean]   # host 1 is 2x slower
+
+        det = StragglerDetector(threshold=0.5, window=4, interval=2,
+                                min_steps=4, telemetry=tel,
+                                gather_fn=fake_gather)
+        incidents = [det.observe_step(s, 0.1) for s in range(1, 9)]
+        fired = [i for i in incidents if i]
+        assert fired, "synthetic 2x skew must fire"
+        assert all(i["worst_host"] == 1 for i in fired)
+        # gathers every `interval` steps once min_steps reached
+        assert len(gathered) >= 2
+        assert gathered[0] == pytest.approx(0.1)
+
+    def test_below_min_steps_no_gather(self, tel):
+        calls = []
+        det = StragglerDetector(min_steps=10, telemetry=tel,
+                                gather_fn=lambda m: calls.append(m) or [m])
+        for s in range(5):
+            det.observe_step(s, 0.1)
+        assert calls == []
+
+    def test_gather_failure_does_not_raise(self, tel):
+        def broken(mean):
+            raise RuntimeError("network down")
+
+        det = StragglerDetector(min_steps=1, telemetry=tel,
+                                gather_fn=broken)
+        assert det.observe_step(1, 0.1) is None
+
+    def test_single_process_default_gather_degrades(self, tel):
+        # default gather on a single-process run returns [local]; no incident
+        det = StragglerDetector(threshold=0.0, min_steps=1, telemetry=tel)
+        assert det.observe_step(1, 0.25) is None
+        assert det.last_skew == 0.0
+
+
+class TestFromConfig:
+    def test_reads_profiling_block(self, tel):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        cfg = DeepSpeedConfig({"profiling": {
+            "enabled": True, "straggler_threshold": 0.5,
+            "straggler_window": 3, "straggler_interval": 4}})
+        det = StragglerDetector.from_config(cfg.profiling, telemetry=tel)
+        assert det.threshold == 0.5
+        assert det.window == 3
+        assert det.interval == 4

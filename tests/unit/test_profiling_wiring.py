@@ -1,0 +1,202 @@
+"""Engine ↔ profiling wiring: the config.profiling block, roofline gauges,
+the profile_report event, straggler hookup, and the run-summary sections
+(runtime/engine.py + telemetry/summary.py)."""
+import json
+import os
+
+import jax
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.config import DeepSpeedConfig
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+from deepspeed_tpu.telemetry.summary import format_summary, summarize_run
+
+from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+pytestmark = pytest.mark.profiling
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "mini_xprof.trace.json")
+
+
+def make_engine(tmp_path, profiling=None, extra=None):
+    topo = initialize_mesh(TopologyConfig(), force=True)
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": 0},
+        "telemetry": {"enabled": True, "output_dir": str(tmp_path)},
+        "profiling": profiling or {},
+    }
+    if extra:
+        config.update(extra)
+    params = init_mlp_params(jax.random.PRNGKey(0), hidden=16)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=mlp_loss_fn, model_parameters=params, config=config,
+        topology=topo)
+    return engine
+
+
+class TestConfigBlock:
+    def test_defaults(self):
+        cfg = DeepSpeedConfig({})
+        assert cfg.profiling.enabled is False
+        assert cfg.profiling.flops_profiler.enabled is False
+        assert cfg.profiling.straggler_threshold == 0.25
+
+    def test_legacy_flops_profiler_key_folds_in(self):
+        cfg = DeepSpeedConfig({"flops_profiler": {"enabled": True,
+                                                  "profile_step": 5}})
+        assert cfg.profiling.flops_profiler.enabled is True
+        assert cfg.profiling.flops_profiler.profile_step == 5
+        # the engine-facing alias is the same object
+        assert cfg.flops_profiler is cfg.profiling.flops_profiler
+
+    def test_explicit_nested_wins_over_legacy(self):
+        cfg = DeepSpeedConfig({
+            "flops_profiler": {"profile_step": 5},
+            "profiling": {"flops_profiler": {"profile_step": 9}}})
+        assert cfg.flops_profiler.profile_step == 9
+
+    def test_unknown_key_ignored_with_defaults_intact(self):
+        # DeepSpeedConfigModel contract: unknown keys warn + are ignored
+        cfg = DeepSpeedConfig({"profiling": {"no_such_knob": 1,
+                                             "enabled": True}})
+        assert cfg.profiling.enabled is True
+        assert cfg.profiling.straggler_threshold == 0.25
+
+
+class TestEngineWiring:
+    def test_profile_report_and_roofline_gauges(self, tmp_path):
+        eng = make_engine(
+            tmp_path,
+            profiling={"enabled": True, "roofline_interval": 1,
+                       "flops_profiler": {"enabled": True,
+                                          "profile_step": 2}})
+        batch = random_batch(eng.train_batch_size())
+        for _ in range(4):
+            eng.train_batch(batch)
+        # roofline gauges published (per-device figures vs cpu fallback)
+        mfu = eng.telemetry.metrics.gauge("roofline/mfu")
+        assert mfu.labelsets(), "roofline/mfu gauge never set"
+        eng.close()
+        events = [json.loads(l) for l in
+                  open(os.path.join(tmp_path, "events.jsonl"))]
+        reports = [e for e in events if e.get("kind") == "profile_report"]
+        assert len(reports) == 1
+        rep = reports[0]
+        assert rep["flops"] > 0
+        assert rep["module_rows"], "module tree missing from event"
+        assert rep["roofline"] is None or rep["roofline"]["mfu"] >= 0
+
+    def test_straggler_detector_built_and_observing(self, tmp_path):
+        eng = make_engine(tmp_path,
+                          profiling={"enabled": True,
+                                     "straggler_threshold": 0.1})
+        assert eng._straggler is not None
+        # inject a skewed gather: this host plus a 3x slower peer
+        eng._straggler.gather_fn = lambda m: [m, m * 3.0]
+        eng._straggler.min_steps = 1
+        batch = random_batch(eng.train_batch_size())
+        for _ in range(4):
+            eng.train_batch(batch)
+        assert eng._straggler.incidents >= 1
+        assert eng.telemetry.metrics.counter("straggler/events").value() >= 1
+        eng.close()
+        events = [json.loads(l) for l in
+                  open(os.path.join(tmp_path, "events.jsonl"))]
+        stragglers = [e for e in events if e.get("kind") == "straggler"]
+        assert stragglers and stragglers[0]["worst_host"] == 1
+
+    def test_disabled_profiling_adds_nothing(self, tmp_path):
+        eng = make_engine(tmp_path)
+        assert eng._straggler is None
+        batch = random_batch(eng.train_batch_size())
+        eng.train_batch(batch)
+        assert not eng.telemetry.metrics.gauge("roofline/mfu").labelsets()
+        eng.close()
+
+
+class TestSummarySections:
+    def _run(self, tmp_path):
+        eng = make_engine(
+            tmp_path,
+            profiling={"enabled": True, "roofline_interval": 1,
+                       "flops_profiler": {"enabled": True,
+                                          "profile_step": 2}})
+        batch = random_batch(eng.train_batch_size())
+        for _ in range(4):
+            eng.train_batch(batch)
+        eng.close()
+
+    def test_summary_prints_attribution_sections(self, tmp_path):
+        self._run(tmp_path)
+        s = summarize_run(os.path.join(tmp_path, "events.jsonl"),
+                          os.path.join(tmp_path, "trace.json"),
+                          xprof_dir=FIXTURE)
+        assert s["profile"]["report"]["flops"] > 0
+        assert s["profile"]["roofline_gauges"]["mfu"] >= 0
+        assert s["xprof"]["categories"]["communication"] > 0
+        text = format_summary(s)
+        assert "performance attribution" in text
+        assert "roofline [" in text
+        assert "device-time breakdown" in text
+        assert "all-reduce.7" in text
+
+    def test_straggler_counts_as_incident(self, tmp_path):
+        eng = make_engine(tmp_path,
+                          profiling={"enabled": True,
+                                     "straggler_threshold": 0.1})
+        eng._straggler.gather_fn = lambda m: [m, m * 3.0]
+        eng._straggler.min_steps = 1
+        batch = random_batch(eng.train_batch_size())
+        for _ in range(4):
+            eng.train_batch(batch)
+        eng.close()
+        s = summarize_run(os.path.join(tmp_path, "events.jsonl"))
+        assert any(e.get("kind") == "straggler"
+                   for e in s["incidents"]["incidents"])
+
+    def test_cli_help_documents_roofline_columns(self, capsys):
+        from deepspeed_tpu.telemetry.summary import main
+
+        with pytest.raises(SystemExit):
+            main(["--help"])
+        out = capsys.readouterr().out
+        for col in ("mfu", "achieved_tflops", "hbm_utilization",
+                    "arithmetic_intensity"):
+            assert col in out
+        assert "--xprof" in out
+
+
+class TestMarkerRegistration:
+    def test_profiling_marker_registered(self):
+        ini = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))), "tests", "pytest.ini")
+        with open(ini) as f:
+            content = f.read()
+        assert "profiling:" in content
+
+
+class TestXprofBreadcrumb:
+    def test_xprof_trace_event_emitted(self, tmp_path):
+        xdir = os.path.join(tmp_path, "xprof")
+        eng = make_engine(
+            tmp_path,
+            extra={"comms_logger": {"enabled": True, "xprof_step": 1,
+                                    "xprof_dir": xdir}})
+        batch = random_batch(eng.train_batch_size())
+        for _ in range(3):
+            eng.train_batch(batch)
+        eng.close()
+        events = [json.loads(l) for l in
+                  open(os.path.join(tmp_path, "events.jsonl"))]
+        crumbs = [e for e in events if e.get("kind") == "xprof_trace"]
+        assert len(crumbs) == 1
+        assert crumbs[0]["dir"] == os.path.abspath(xdir)
+        assert os.path.isdir(xdir)
+        # the summary can parse the captured trace end to end
+        s = summarize_run(os.path.join(tmp_path, "events.jsonl"))
+        assert s["xprof"] is not None
+        assert s["xprof"]["files"]

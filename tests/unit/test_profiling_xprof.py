@@ -1,0 +1,111 @@
+"""xprof/Chrome-trace parser: device-time attribution on the checked-in
+mini trace fixture (profiling/xprof_parse.py)."""
+import gzip
+import json
+import os
+import shutil
+
+import pytest
+
+from deepspeed_tpu.profiling.xprof_parse import (attribute_device_time,
+                                                 categorize_op,
+                                                 find_trace_files,
+                                                 format_device_table)
+
+pytestmark = pytest.mark.profiling
+
+FIXTURE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                       "fixtures", "mini_xprof.trace.json")
+
+
+class TestCategorize:
+    @pytest.mark.parametrize("name,cat", [
+        ("fusion.1", "compute"),
+        ("dot.42", "compute"),
+        ("all-reduce.7", "communication"),
+        ("all-gather.3", "communication"),
+        ("reduce-scatter.11", "communication"),
+        ("collective-permute.2", "communication"),
+        ("all-to-all.5", "communication"),
+        ("infeed.0", "host_transfer"),
+        ("copy-start.1", "host_transfer"),
+    ])
+    def test_category(self, name, cat):
+        assert categorize_op(name) == cat
+
+
+class TestFixtureAttribution:
+    def test_device_lane_detected(self):
+        rep = attribute_device_time(FIXTURE)
+        assert rep["device_lanes"] == ["/device:TPU:0"]
+        assert rep["files"] == [FIXTURE]
+
+    def test_category_durations_exact(self):
+        rep = attribute_device_time(FIXTURE)
+        # fixture durations are µs: compute 4000+2000+3000, comm 1500+500,
+        # transfer 250; host lanes excluded from the device buckets
+        assert rep["categories"]["compute"] == pytest.approx(9000e-6)
+        assert rep["categories"]["communication"] == pytest.approx(2000e-6)
+        assert rep["categories"]["host_transfer"] == pytest.approx(250e-6)
+        assert rep["device_time_s"] == pytest.approx(11250e-6)
+        assert rep["host_time_s"] == pytest.approx(10000e-6)
+
+    def test_top_ops_aggregated_and_sorted(self):
+        rep = attribute_device_time(FIXTURE)
+        top = rep["top_ops"]
+        assert top[0]["op"] == "fusion.1"           # 4000+2000 aggregated
+        assert top[0]["calls"] == 2
+        assert top[0]["total_s"] == pytest.approx(6000e-6)
+        comm = [r for r in top if r["category"] == "communication"]
+        assert {r["op"] for r in comm} == {"all-reduce.7", "all-gather.3"}
+        # percentages are of attributed device time
+        assert top[0]["pct"] == pytest.approx(100.0 * 6000 / 11250, abs=0.1)
+
+    def test_format_table_mentions_lane_and_ops(self):
+        rep = attribute_device_time(FIXTURE)
+        text = "\n".join(format_device_table(rep))
+        assert "/device:TPU:0" in text
+        assert "all-reduce.7" in text
+        assert "communication" in text
+
+
+class TestDiscoveryAndFormats:
+    def test_finds_gz_in_nested_dir(self, tmp_path):
+        # xprof layout: <dir>/plugins/profile/<run>/<host>.trace.json.gz
+        nested = tmp_path / "plugins" / "profile" / "2026_01_01"
+        nested.mkdir(parents=True)
+        with open(FIXTURE, "rb") as f:
+            raw = f.read()
+        with gzip.open(nested / "host0.trace.json.gz", "wb") as f:
+            f.write(raw)
+        files = find_trace_files(str(tmp_path))
+        assert len(files) == 1 and files[0].endswith(".trace.json.gz")
+        rep = attribute_device_time(str(tmp_path))
+        assert rep["categories"]["communication"] == pytest.approx(2000e-6)
+
+    def test_host_only_trace_falls_back_to_host_lanes(self, tmp_path):
+        trace = {"traceEvents": [
+            {"ph": "M", "pid": 5, "name": "process_name",
+             "args": {"name": "/host:CPU"}},
+            {"ph": "X", "pid": 5, "tid": 1, "ts": 0, "dur": 1000,
+             "name": "some python work"},
+        ]}
+        p = tmp_path / "trace.json"
+        p.write_text(json.dumps(trace))
+        rep = attribute_device_time(str(p))
+        assert rep["device_lanes"] == []
+        assert rep["categories"]["compute"] == pytest.approx(1000e-6)
+        assert rep["top_ops"][0]["op"] == "some python work"
+
+    def test_corrupt_file_skipped(self, tmp_path):
+        good = tmp_path / "a.trace.json"
+        shutil.copy(FIXTURE, good)
+        (tmp_path / "b.trace.json").write_text("{not json")
+        rep = attribute_device_time(str(tmp_path))
+        assert rep["device_time_s"] == pytest.approx(11250e-6)
+
+    def test_empty_dir(self, tmp_path):
+        rep = attribute_device_time(str(tmp_path))
+        assert rep["files"] == []
+        assert rep["top_ops"] == []
+        assert "no duration events" in "\n".join(format_device_table(rep))

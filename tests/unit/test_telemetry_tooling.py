@@ -45,6 +45,29 @@ class TestNoBarePrintLint:
         proc = run_lint(str(ok))
         assert proc.returncode == 0, proc.stdout
 
+    def test_emit_report_seam_prints_allowed(self, tmp_path):
+        """The profiler's report printer is one audited seam, not per-line
+        exemptions: a function named emit_report may print."""
+        ok = tmp_path / "prof.py"
+        ok.write_text(
+            "def emit_report(text):\n"
+            "    print(text)\n"
+            "def build_report():\n"
+            "    return 'x'\n")
+        proc = run_lint(str(ok))
+        assert proc.returncode == 0, proc.stdout
+
+    def test_emit_report_seam_does_not_leak(self, tmp_path):
+        bad = tmp_path / "prof2.py"
+        bad.write_text(
+            "def emit_report(text):\n"
+            "    print(text)\n"
+            "def sneaky():\n"
+            "    print('not the seam')\n")
+        proc = run_lint(str(bad))
+        assert proc.returncode == 1
+        assert "prof2.py:4" in proc.stdout
+
     def test_dunder_main_guard_prints_allowed(self, tmp_path):
         ok = tmp_path / "script.py"
         ok.write_text("if __name__ == '__main__':\n    print('x')\n")
