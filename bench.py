@@ -13,7 +13,8 @@ wedge; backend init is therefore probed in a subprocess with a timeout
 a parseable JSON result instead of a crash.
 
 Env knobs: DSTPU_BENCH_LAYERS / HIDDEN / SEQ / BATCH / STEPS,
-DSTPU_BENCH_MODE (train | flash_sweep | serving), DSTPU_BENCH_FORCE_CPU=1,
+DSTPU_BENCH_MODE (train | flash_sweep | serving | overlap_sweep | ...),
+DSTPU_BENCH_FORCE_CPU=1,
 DSTPU_BENCH_PROBE_TIMEOUT (seconds, default 300); serving mode also reads
 DSTPU_BENCH_CTX (context length) and DSTPU_BENCH_CHUNK (splitfuse chunk).
 DSTPU_BENCH_TELEMETRY=<dir> enables the telemetry subsystem for the train
@@ -27,9 +28,12 @@ import subprocess
 import sys
 import time
 
-if os.environ.get("DSTPU_BENCH_MODE") == "pipeline":
-    # pipeline bubbles are a schedule property measured on the CPU-sim
-    # mesh (the chip tunnel is single-device); must be set pre-jax-import
+if os.environ.get("DSTPU_BENCH_MODE") == "pipeline" or (
+        os.environ.get("DSTPU_BENCH_MODE") == "overlap_sweep"
+        and os.environ.get("DSTPU_BENCH_FORCE_CPU") == "1"):
+    # pipeline bubbles (and the CPU fallback of the overlap sweep) are
+    # schedule properties measured on the CPU-sim mesh (the chip tunnel is
+    # single-device); must be set pre-jax-import
     os.environ["JAX_PLATFORMS"] = "cpu"
     _f = os.environ.get("XLA_FLAGS", "")
     if "host_platform_device_count" not in _f:
@@ -814,6 +818,80 @@ def run_offload_bench(on_tpu: bool) -> None:
           "backend": jax.default_backend()})
 
 
+def run_overlap_sweep(on_tpu: bool) -> None:
+    """Comm/compute overlap sweep (runtime/overlap/): step time per overlap
+    config — eager baseline, deferred fused reduction, and the explicit
+    hand-written wire with per-leaf vs bucketed exchange.  The headline is
+    the best overlapped config's ms/step; vs_baseline is eager/best (>1 =
+    overlap wins).  On CPU this measures schedule/launch-count effects on
+    the 8-virtual-device sim — wire volume is identical by construction
+    (grads are bit-exact across configs, test-asserted)."""
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+    from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+    if on_tpu:
+        cfg = TransformerConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=8, num_heads=16, num_kv_heads=8, max_seq_len=1024,
+            remat=True, use_flash=True)
+        batch, gas, steps = 8, 4, 6
+    else:
+        cfg = TransformerConfig.tiny(use_flash=False)
+        batch, gas, steps = 2, 2, 2
+
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    rng = np.random.default_rng(0)
+    # global batch = micro * gas * dp (the default topology is pure DP)
+    rows = batch * gas * max(len(jax.devices()), 1)
+    data = {"input_ids": jnp.asarray(
+        rng.integers(0, cfg.vocab_size, size=(rows, cfg.max_seq_len)),
+        jnp.int32)}
+    sweep = (
+        ("eager", None),
+        ("deferred", {"enabled": True, "bucket_bytes": 0}),
+        ("explicit_per_leaf", {"enabled": True, "explicit_wire": True,
+                               "bucket_bytes": 0}),
+        ("explicit_bucketed", {"enabled": True, "explicit_wire": True,
+                               "bucket_bytes": 4 * 1024 * 1024}),
+    )
+    results = {}
+    for name, overlap in sweep:
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        conf = {"train_micro_batch_size_per_gpu": batch,
+                "gradient_accumulation_steps": gas,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-4}},
+                "zero_optimization": {"stage": 2},
+                "bf16": {"enabled": True}}
+        if overlap is not None:
+            conf["overlap"] = overlap
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model,
+            model_parameters=jax.tree.map(jnp.array, params),
+            config=conf, topology=topo)
+        loss = eng.train_batch(data)          # compile + warmup
+        jax.block_until_ready(loss)
+        t0 = time.perf_counter()
+        for _ in range(steps):
+            loss = eng.train_batch(data)
+        jax.block_until_ready(loss)
+        dt = (time.perf_counter() - t0) / steps
+        results[name] = round(dt * 1e3, 2)
+        log(f"overlap={name}: {dt*1e3:.2f} ms/step "
+            f"(deferred={eng._deferred_active})")
+    eager = results.get("eager", 0.0)
+    overlapped = {k: v for k, v in results.items() if k != "eager"}
+    best_name = min(overlapped, key=overlapped.get) if overlapped else "eager"
+    best = overlapped.get(best_name, eager)
+    emit("overlap_step_ms", best, "ms/step",
+         round(eager / max(best, 1e-9), 4),
+         {"results_ms": results, "best_config": best_name,
+          "gas": gas, "model_params": model.num_params(),
+          "backend": jax.default_backend(),
+          "n_devices": len(jax.devices())})
+
+
 def main():
     global _ON_TPU
     mode = os.environ.get("DSTPU_BENCH_MODE", "train")
@@ -834,6 +912,7 @@ def main():
         "serving_load": ("serving_requests_per_sec", "req/s"),
         "pipeline": ("pipeline_bubble_fraction", "fraction"),
         "offload": ("offload_step_ms", "ms/step"),
+        "overlap_sweep": ("overlap_step_ms", "ms/step"),
     }.get(mode, ("zero_train_tokens_per_sec_per_chip", "tokens/s/chip"))
     try:
         backend = jax.default_backend()
@@ -855,6 +934,8 @@ def main():
             run_pipeline_bench(on_tpu)
         elif mode == "offload":
             run_offload_bench(on_tpu)
+        elif mode == "overlap_sweep":
+            run_overlap_sweep(on_tpu)
         else:
             run_train_bench(on_tpu, reason)
     except Exception as exc:  # noqa: BLE001

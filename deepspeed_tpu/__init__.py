@@ -48,9 +48,6 @@ def initialize(
 
     from .runtime.engine import DeepSpeedEngine
 
-    if dist_init_required is None or dist_init_required:
-        comm.init_distributed(distributed_port=distributed_port)
-
     config = config if config is not None else config_params
     if args is not None and getattr(args, "deepspeed_config", None):
         if config is not None:
@@ -64,6 +61,17 @@ def initialize(
         with open(config) as f:
             config = json.load(f)
     raw_cfg = config.raw if isinstance(config, DeepSpeedConfig) else (config or {})
+
+    # Overlap's latency-hiding-scheduler flags must land in the environment
+    # BEFORE the first backend touch (libtpu reads LIBTPU_INIT_ARGS once at
+    # client init) — i.e. before init_distributed/mesh building below.
+    # Safe no-op on CPU and when the block doesn't ask for flags.
+    from .runtime.overlap.xla_flags import configure_from_raw
+
+    configure_from_raw(raw_cfg)
+
+    if dist_init_required is None or dist_init_required:
+        comm.init_distributed(distributed_port=distributed_port)
 
     if topology is None and mpu is not None:
         # Megatron-style mpu object (reference: engine honors

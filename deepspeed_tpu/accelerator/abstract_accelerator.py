@@ -119,6 +119,17 @@ class Accelerator(abc.ABC):
         return jax.random.PRNGKey(seed)
 
     # ------------------------------------------------------------------ #
+    # Backend tuning
+    # ------------------------------------------------------------------ #
+    def apply_xla_flags(self, flags: List[str]) -> bool:
+        """Record backend tuning flags (latency-hiding scheduler, async
+        collectives — see ``runtime/overlap/xla_flags.py``) so they take
+        effect at backend init.  Base implementation is a safe no-op:
+        only backends with a flag channel (libtpu) override this.
+        Returns True iff the flags were recorded."""
+        return False
+
+    # ------------------------------------------------------------------ #
     # Kernel/op support
     # ------------------------------------------------------------------ #
     def supports_pallas(self) -> bool:

@@ -31,6 +31,35 @@ def _probe() -> Accelerator:
     return CPUAccelerator()
 
 
+def peek_accelerator() -> Accelerator:
+    """Accelerator guess WITHOUT touching ``jax.devices()``.
+
+    Probing devices initializes the JAX backend, which is exactly what the
+    pre-init flag wiring (``runtime/overlap/xla_flags.py``) must avoid —
+    libtpu reads its flag env once at client creation.  Heuristics only:
+    ``DS_ACCELERATOR`` wins; ``JAX_PLATFORMS=cpu`` forces cpu; otherwise a
+    libtpu install means tpu.  The guess never replaces the probed global
+    (``get_accelerator`` still decides for everything else).
+    """
+    name = os.environ.get("DS_ACCELERATOR", "").lower()
+    if name == "cpu":
+        return CPUAccelerator()
+    if name == "tpu":
+        return TPUAccelerator()
+    platforms = os.environ.get("JAX_PLATFORMS", "").lower()
+    if platforms and "tpu" not in platforms:
+        return CPUAccelerator()
+    import importlib.util
+
+    for mod in ("libtpu", "jax_plugins.xla_tpu"):
+        try:
+            if importlib.util.find_spec(mod) is not None:
+                return TPUAccelerator()
+        except (ImportError, ValueError):
+            continue
+    return CPUAccelerator()
+
+
 def get_accelerator() -> Accelerator:
     global _ACCELERATOR
     if _ACCELERATOR is None:

@@ -1,14 +1,28 @@
 """TPU accelerator runtime (reference analogue: accelerator/cuda_accelerator.py)."""
 from __future__ import annotations
 
+import os
 from typing import Any, List
 
 from .abstract_accelerator import Accelerator
+
+#: env var libtpu reads (once, at client init) for XLA:TPU flags
+LIBTPU_ENV = "LIBTPU_INIT_ARGS"
 
 
 class TPUAccelerator(Accelerator):
     _name = "tpu"
     _communication_backend_name = "xla"
+
+    def apply_xla_flags(self, flags: List[str]) -> bool:
+        """Merge flags into ``LIBTPU_INIT_ARGS`` (deduplicated by flag
+        name — an explicit user setting of the same flag wins)."""
+        current = os.environ.get(LIBTPU_ENV, "").split()
+        have = {f.split("=", 1)[0] for f in current}
+        added = [f for f in flags if f.split("=", 1)[0] not in have]
+        if added:
+            os.environ[LIBTPU_ENV] = " ".join(current + added)
+        return True
 
     def is_available(self) -> bool:
         try:

@@ -60,7 +60,9 @@ class DSElasticAgent:
                  env: Optional[Dict[str, str]] = None,
                  term_timeout: float = 30.0, kill_timeout: float = 5.0,
                  escalate_kill: bool = True,
-                 restart_policy: Optional[RetryPolicy] = None):
+                 restart_policy: Optional[RetryPolicy] = None,
+                 ckpt_dir: Optional[str] = None,
+                 ckpt_keep_last: int = 0):
         self.cmd = list(cmd)
         self.world_size = int(world_size)
         self.max_restarts = int(max_restarts)
@@ -71,6 +73,13 @@ class DSElasticAgent:
         self.escalate_kill = escalate_kill
         self.restart_policy = restart_policy or RetryPolicy(
             max_retries=max_restarts, base_s=1.0, cap_s=30.0)
+        #: agent-side checkpoint GC: between restarts (workers are down,
+        #: nobody is writing) prune the store to the newest
+        #: ``ckpt_keep_last`` valid tags — the newest verified tag and the
+        #: committed 'latest' are never deleted (see
+        #: OrbaxCheckpointEngine.gc_tags)
+        self.ckpt_dir = ckpt_dir
+        self.ckpt_keep_last = int(ckpt_keep_last)
         self.restart_count = 0
         self._procs: List[subprocess.Popen] = []
         self._shutdown = threading.Event()
@@ -130,6 +139,23 @@ class DSElasticAgent:
                 logger.error(f"worker pid {p.pid} survived SIGKILL "
                              f"(unkillable/D-state); abandoning it")
 
+    def _gc_checkpoints(self) -> None:
+        """Prune old valid checkpoint tags while the gang is down.  Any
+        failure here must never block the restart — GC is housekeeping."""
+        if not self.ckpt_dir or self.ckpt_keep_last <= 0:
+            return
+        try:
+            from ..runtime.checkpoint_engine.orbax_checkpoint_engine import \
+                OrbaxCheckpointEngine
+
+            deleted = OrbaxCheckpointEngine(self.ckpt_dir).gc_tags(
+                self.ckpt_keep_last)
+            if deleted:
+                logger.info(f"elastic agent: checkpoint gc removed "
+                            f"{len(deleted)} old tag(s) before restart")
+        except Exception as e:  # noqa: BLE001 — housekeeping only
+            logger.warning(f"elastic agent: checkpoint gc failed: {e!r}")
+
     # -------------------------------------------------------------- #
     def shutdown(self, signum: Optional[int] = None, frame=None) -> None:
         """Graceful stop: tear the current gang down and make run() return.
@@ -183,6 +209,7 @@ class DSElasticAgent:
                     raise WorkerGroupFailure(
                         f"worker group failed rc={failed} after "
                         f"{self.restart_count} restarts")
+                self._gc_checkpoints()
                 delay = self.restart_policy.delay(self.restart_count)
                 record_fault_event("elastic/restarts")
                 emit_event("elastic_restart", restart=self.restart_count + 1,
@@ -209,6 +236,13 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
     parser.add_argument("--no-escalate-kill", action="store_true",
                         help="never SIGKILL a worker that ignores SIGTERM "
                              "(leave live TPU clients to the OS)")
+    parser.add_argument("--ckpt-dir", default=None,
+                        help="checkpoint store to garbage-collect between "
+                             "restarts (with --ckpt-keep-last)")
+    parser.add_argument("--ckpt-keep-last", type=int, default=0,
+                        help="keep only the newest N valid checkpoint tags "
+                             "(0 = never delete); the newest verified tag "
+                             "and the committed 'latest' are always kept")
     parser.add_argument("cmd", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
@@ -216,7 +250,9 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
         parser.error("worker command required after --")
     agent = DSElasticAgent(cmd, args.world_size, args.max_restarts,
                            term_timeout=args.term_timeout,
-                           escalate_kill=not args.no_escalate_kill)
+                           escalate_kill=not args.no_escalate_kill,
+                           ckpt_dir=args.ckpt_dir,
+                           ckpt_keep_last=args.ckpt_keep_last)
     sys.exit(agent.run())
 
 

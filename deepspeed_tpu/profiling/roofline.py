@@ -19,11 +19,16 @@ from ..utils.logging import logger
 
 @dataclasses.dataclass(frozen=True)
 class DeviceSpec:
-    """Peak numbers for one device kind (bf16 matmul peak, HBM stream BW)."""
+    """Peak numbers for one device kind (bf16 matmul peak, HBM stream BW,
+    aggregate inter-chip interconnect BW)."""
 
     kind: str
     peak_flops: float          # bf16 FLOP/s per chip
     hbm_bandwidth: float       # bytes/s per chip
+    #: approximate aggregate ICI bytes/s per chip (all links, one
+    #: direction) — the denominator for per-collective bus-bandwidth
+    #: "% of peak" in the comm table
+    ici_bandwidth: float = 0.0
 
     @property
     def ridge_intensity(self) -> float:
@@ -33,18 +38,34 @@ class DeviceSpec:
 
 #: ordered: first substring match against device_kind wins
 DEVICE_SPECS = (
-    DeviceSpec("TPU v6 lite", 918e12, 1640e9),   # Trillium
-    DeviceSpec("TPU v6", 918e12, 1640e9),
-    DeviceSpec("TPU v5p", 459e12, 2765e9),
-    DeviceSpec("TPU v5 lite", 197e12, 819e9),    # v5e self-reports "v5 lite"
-    DeviceSpec("TPU v5e", 197e12, 819e9),
-    DeviceSpec("TPU v4", 275e12, 1228e9),
-    DeviceSpec("TPU v3", 123e12, 900e9),
+    DeviceSpec("TPU v6 lite", 918e12, 1640e9, 448e9),   # Trillium
+    DeviceSpec("TPU v6", 918e12, 1640e9, 448e9),
+    DeviceSpec("TPU v5p", 459e12, 2765e9, 600e9),
+    DeviceSpec("TPU v5 lite", 197e12, 819e9, 200e9),    # v5e → "v5 lite"
+    DeviceSpec("TPU v5e", 197e12, 819e9, 200e9),
+    DeviceSpec("TPU v4", 275e12, 1228e9, 300e9),
+    DeviceSpec("TPU v3", 123e12, 900e9, 82e9),
 )
 
 #: conservative stand-in so CPU smoke runs produce finite (clearly labelled)
 #: utilization numbers instead of dividing by zero
-CPU_FALLBACK = DeviceSpec("cpu", 1e12, 100e9)
+CPU_FALLBACK = DeviceSpec("cpu", 1e12, 100e9, 10e9)
+
+
+def spec_for_kind(kind: str) -> DeviceSpec:
+    """Spec from a ``device_kind`` string alone — no backend probe, so the
+    offline tools (``dstpu-telemetry``'s comm table) can resolve peaks from
+    a recorded run's metadata.  Unknown kinds get the CPU fallback numbers
+    under the given name."""
+    for spec in DEVICE_SPECS:
+        if spec.kind.lower() in str(kind).lower():
+            return dataclasses.replace(spec, kind=str(kind))
+    return dataclasses.replace(CPU_FALLBACK, kind=str(kind))
+
+
+def interconnect_peak(kind: str) -> float:
+    """Aggregate ICI bytes/s per chip for a device-kind string."""
+    return spec_for_kind(kind).ici_bandwidth
 
 
 def device_spec(device: Any = None) -> DeviceSpec:
@@ -62,7 +83,7 @@ def device_spec(device: Any = None) -> DeviceSpec:
     if getattr(device, "platform", "cpu") == "tpu":
         logger.warning(f"no roofline spec for device kind {kind!r}; "
                        f"assuming TPU v5e peaks")
-        return DeviceSpec(kind, 197e12, 819e9)
+        return DeviceSpec(kind, 197e12, 819e9, 200e9)
     return dataclasses.replace(CPU_FALLBACK, kind=kind)
 
 

@@ -45,6 +45,8 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         os.makedirs(self.ckpt_dir, exist_ok=True)
         self.retry_policy = RetryPolicy.from_config(fault_config)
         self.verify = bool(getattr(fault_config, "verify_checkpoints", True))
+        self.keep_last = int(getattr(fault_config, "checkpoint_keep_last", 0)
+                             or 0)
         self._verified_tags: set = set()   # tags this instance already verified
 
     def _path(self, tag: str) -> str:
@@ -144,6 +146,56 @@ class OrbaxCheckpointEngine(CheckpointEngine):
             atomic_write_text(os.path.join(self.ckpt_dir, HISTORY_FILE),
                               "\n".join(history[-HISTORY_LIMIT:]) + "\n")
         emit_event("checkpoint_commit", tag=str(tag), dir=self.ckpt_dir)
+        if self.keep_last > 0:
+            self.gc_tags(self.keep_last)
+
+    def gc_tags(self, keep_last: int) -> List[str]:
+        """Delete all but the newest ``keep_last`` *valid* tags.
+
+        Protected unconditionally: the committed ``latest`` pointer target
+        and the newest valid tag (even if they'd fall outside the window).
+        Invalid/torn directories are left alone — an in-flight save from a
+        concurrent writer looks exactly like one, and disk space is cheaper
+        than a deleted half-written checkpoint that was about to be sealed.
+        Returns the deleted tags (oldest part of the valid set).
+        """
+        import shutil
+
+        keep_last = int(keep_last)
+        if keep_last <= 0:
+            return []
+        valid = self.valid_tags()          # newest first
+        protected = set(valid[:keep_last])
+        if valid:
+            protected.add(valid[0])        # newest valid, always
+        pointer = os.path.join(self.ckpt_dir, LATEST_FILE)
+        if os.path.exists(pointer):
+            with open(pointer) as f:
+                pointed = f.read().strip()
+            if pointed:
+                protected.add(pointed)
+        deleted: List[str] = []
+        for tag in valid[keep_last:]:
+            if tag in protected:
+                continue
+            try:
+                shutil.rmtree(self._path(tag))
+                deleted.append(tag)
+                self._verified_tags.discard(str(tag))
+            except OSError as e:
+                logger.warning(f"checkpoint gc: could not delete "
+                               f"{self._path(tag)}: {e}")
+        if deleted:
+            # prune deleted tags from the commit history so the fallback
+            # scan never walks tombstones
+            history = [t for t in self.committed_tags() if t not in deleted]
+            atomic_write_text(os.path.join(self.ckpt_dir, HISTORY_FILE),
+                              "\n".join(history[-HISTORY_LIMIT:]) + "\n")
+            emit_event("checkpoint_gc", dir=self.ckpt_dir,
+                       deleted=deleted, kept=sorted(protected))
+            logger.info(f"checkpoint gc: deleted {len(deleted)} old tag(s) "
+                        f"({deleted}), keeping newest {keep_last}")
+        return deleted
 
     def committed_tags(self) -> List[str]:
         """Tags ever published via commit(), oldest first (fallback

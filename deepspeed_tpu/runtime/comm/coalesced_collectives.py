@@ -114,6 +114,37 @@ def quantized_all_gather_params(param_shard: jnp.ndarray, axes=("data",),
     return dequant(q_all, s_all, dtype=out_dtype).reshape(-1)
 
 
+def bucketed_allreduce_coalesced(tensors: Sequence[jnp.ndarray],
+                                 axes=("data",),
+                                 bucket_bytes: int = 16 * 1024 * 1024,
+                                 n: int | None = None,
+                                 ) -> Tuple[List[jnp.ndarray], dict]:
+    """Mean-allreduce a list of gradient leaves with small leaves coalesced
+    into fused flat buckets (reference ``allreduce_bucket``/
+    ``reduce_bucket_size``; planning in ``runtime/overlap/bucketing.py``).
+
+    Each bucket is one ``psum`` launch instead of one per leaf; psum is
+    elementwise, so the results are bit-identical to per-leaf exchange.
+    Must run inside shard_map with ``axes`` bound.  ``n`` overrides the
+    divisor (callers that already computed the group size); returns
+    ``(exchanged leaves, bucket stats)`` — stats feed ``overlap/*`` gauges.
+    """
+    from ..overlap.bucketing import apply_bucketed, bucket_stats, plan_buckets
+
+    if n is None:
+        n = _axis_size(axes)
+    if n <= 1:
+        return list(tensors), {"bucket_count": 0, "fused_buckets": 0,
+                               "fused_leaves": 0, "max_bucket_bytes": 0,
+                               "total_bytes": 0}
+
+    def exchange(x):
+        return jax.lax.psum(x, axes) / n
+
+    plans = plan_buckets(tensors, bucket_bytes)
+    return apply_bucketed(list(tensors), plans, exchange), bucket_stats(plans)
+
+
 def loco_quantized_reduce_scatter(tensor: jnp.ndarray, error: jnp.ndarray,
                                   axes=("data",), bits: int = 4,
                                   group_size: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:

@@ -35,6 +35,8 @@ import jax.numpy as jnp
 from jax.sharding import PartitionSpec as P
 
 from ..ops.quantizer.quantizer import get_quant_fns
+from .comm.coalesced_collectives import bucketed_allreduce_coalesced
+from .overlap.deferred import DeferredAccumulator
 from .sparse_tensor import SparseTensor, sparse_allreduce
 from .topology import DATA, DATA_OUTER
 
@@ -202,6 +204,15 @@ class _WireContext:
         self.manual = set(self.data_axes)
         self.gas = engine.gradient_accumulation_steps()
 
+        # comm/compute overlap (runtime/overlap/): bucketed plain-psum
+        # exchange + one-iteration-deferred micro reduction.  Settings come
+        # from the manager so an auto-mode re-tune changes the next build.
+        mgr = getattr(engine, "overlap", None)
+        self.overlap_mgr = mgr
+        overlap_on = bool(mgr is not None and mgr.enabled)
+        self.bucket_bytes = int(mgr.bucket_bytes) if overlap_on else 0
+        self.overlap_deferred = overlap_on and bool(mgr.deferred)
+
         self.params_t = engine.state.params
         self.stage3 = engine.zero_stage >= 3
         param_specs = engine.plan.param_specs(self.params_t)
@@ -246,9 +257,12 @@ class _WireContext:
         pure model-parallel mesh)."""
         if not self.data_axes:
             return body
-        return jax.shard_map(body, mesh=self.topo.mesh,
-                             in_specs=tuple(in_specs), out_specs=out_specs,
-                             axis_names=self.manual, check_vma=False)
+        from .topology import compat_shard_map
+
+        return compat_shard_map(body, mesh=self.topo.mesh,
+                                in_specs=tuple(in_specs),
+                                out_specs=out_specs,
+                                manual_axes=self.manual)
 
     def gather_full(self, params_local):
         """Local shards → full compute-dtype params (qwZ wire if enabled)."""
@@ -281,30 +295,47 @@ class _WireContext:
         flat, treedef = jax.tree_util.tree_flatten_with_path(grads)
         err_flat = treedef.flatten_up_to(comm_error) if loco else \
             [None] * len(flat)
-        outs, errs = [], []
-        for (path, g), e in zip(flat, err_flat):
+        outs, errs = [None] * len(flat), []
+        plain = []   # indices riding the plain-psum wire (bucketable)
+        for idx, ((path, g), e) in enumerate(zip(flat, err_flat)):
             is_embed = any("embed" in str(getattr(k, "key", "")).lower()
                            for k in path)
             if self.sparse and is_embed and ids is not None and g.ndim == 2 \
                     and data_axes:
-                outs.append(sparse_embedding_allreduce(g, ids, data_axes))
+                outs[idx] = sparse_embedding_allreduce(g, ids, data_axes)
                 errs.append(e)
             elif self.qgz and data_axes:
                 out, new_w, new_s = quantized_allreduce(
                     g, data_axes, bits=self.grad_bits,
                     error=e["worker"][0] if loco else None,
                     server_error=e["server"][0] if loco else None)
-                outs.append(out)
+                outs[idx] = out
                 errs.append({"worker": new_w[None], "server": new_s[None]}
                             if loco else e)
             elif data_axes:
-                outs.append(jax.lax.psum(g, data_axes) / n)
+                plain.append(idx)
                 errs.append(e)
             else:
-                outs.append(g)
+                outs[idx] = g
                 errs.append(e)
+        if plain:
+            leaves = [flat[i][1] for i in plain]
+            for i, v in zip(plain, self._plain_psum_mean(leaves, n)):
+                outs[i] = v
         new_error = treedef.unflatten(errs) if loco else None
         return treedef.unflatten(outs), new_error
+
+    def _plain_psum_mean(self, leaves, n):
+        """Mean-allreduce the plain-wire leaves — one fused psum per size
+        bucket when ``overlap.bucket_bytes`` is set (bit-identical to the
+        per-leaf exchange; psum is elementwise), per-leaf otherwise."""
+        if self.bucket_bytes > 0:
+            exchanged, stats = bucketed_allreduce_coalesced(
+                leaves, self.data_axes, self.bucket_bytes, n=n)
+            if self.overlap_mgr is not None:   # trace-time, host side
+                self.overlap_mgr.note_bucket_plan(stats)
+            return exchanged
+        return [jax.lax.psum(g, self.data_axes) / n for g in leaves]
 
     def local_loss_and_grads(self, params_full, batch, rng, scaler_state):
         """LOCAL full-shape grads (no cross-device reduction over the manual
@@ -364,41 +395,111 @@ def _wire_ctx(engine) -> _WireContext:
     return ctx
 
 
-def build_explicit_comm_step(engine):
+def build_explicit_comm_step(engine, _force_eager_micro: bool = False):
     """Build the shard_map'd train-batch step for the explicit-comm config
     surface.  Mirrors engine._build_train_batch_fn's semantics (micro-step
-    scan, loss scaling, clipping, overflow skip) with hand-written wires."""
+    scan, loss scaling, clipping, overflow skip) with hand-written wires.
+
+    With overlap's deferred reduction on (plain wire, gas > 1), each
+    micro-batch's psum is double-buffered in the scan carry so collective
+    *i* overlaps compute *i+1* (``overlap/deferred.py``); quantized/LoCo/
+    sparse wires keep the single boundary exchange — a per-micro quantized
+    exchange would change the wire numerics, not just the schedule.
+    ``_force_eager_micro`` is the test seam proving deferred and eager
+    *issuance* of the same per-micro schedule produce bit-identical
+    gradients.  Note the schedule itself differs from overlap-off: off
+    exchanges once at the boundary (``psum(Σ g_i)/n``), deferred exchanges
+    per micro-batch (``Σ psum(g_i)/n``) — the same mean with a different
+    fp summation order, so toggling ``deferred_grad_reduce`` on the
+    explicit wire is reproducible-schedule-for-schedule, not bitwise
+    against the boundary schedule.  (The FUSED path's overlap toggle is
+    bitwise end-to-end: only the sharding constraint moves.)
+    """
     ctx = _wire_ctx(engine)
     gas, data_axes, loco = ctx.gas, ctx.data_axes, ctx.loco
     params_t = ctx.params_t
+    # deferred per-micro reduction: only the plain mean-psum wire is linear
+    # and stateless enough to fire per micro-batch without changing values
+    micro_wire = bool((ctx.overlap_deferred or _force_eager_micro)
+                      and gas > 1 and data_axes
+                      and not (ctx.qgz or ctx.loco or ctx.sparse))
+    engine._deferred_active = bool(micro_wire and not _force_eager_micro)
+    if ctx.overlap_deferred and gas > 1 and not micro_wire:
+        from ..utils.logging import logger
+
+        logger.info("overlap.deferred_grad_reduce: quantized/LoCo/sparse "
+                    "wires exchange once at the boundary — per-micro "
+                    "deferral skipped (schedule-only deferral would change "
+                    "those wires' numerics)")
 
     def local_step(params_local, batch, rng, scaler_state, comm_error):
         params_full = ctx.gather_full(jax.lax.stop_gradient(params_local))
+        exchanged = False
         if gas == 1:
             loss, grads = ctx.local_loss_and_grads(params_full, batch, rng,
                                                    scaler_state)
             mean_loss = loss
         else:
-            def micro(carry, mb):
-                acc, r = carry
-                r, r2 = jax.random.split(r)
-                loss, g = ctx.local_loss_and_grads(params_full, mb, r2,
-                                                   scaler_state)
-                return (jax.tree.map(jnp.add, acc, g), r), loss
-
             zeros = jax.tree.map(lambda x: jnp.zeros(x.shape, jnp.float32),
                                  params_t)
-            (grads, _), losses = jax.lax.scan(micro, (zeros, rng), batch)
+            if micro_wire:
+                n = jax.lax.psum(1, data_axes)
+
+                def exchange(tree):
+                    leaves, tdef = jax.tree_util.tree_flatten(tree)
+                    return tdef.unflatten(ctx._plain_psum_mean(leaves, n))
+
+                reducer = DeferredAccumulator(exchange, zeros)
+
+                if _force_eager_micro:
+                    def micro(carry, mb):
+                        acc, r = carry
+                        r, r2 = jax.random.split(r)
+                        loss, g = ctx.local_loss_and_grads(
+                            params_full, mb, r2, scaler_state)
+                        acc = jax.tree.map(jnp.add, acc, exchange(g))
+                        return (acc, r), loss
+
+                    (grads, _), losses = jax.lax.scan(
+                        micro, (zeros, rng), batch)
+                else:
+                    def micro(carry, mb):
+                        acc, pending, r = carry
+                        r, r2 = jax.random.split(r)
+                        loss, g = ctx.local_loss_and_grads(
+                            params_full, mb, r2, scaler_state)
+                        acc, pending = reducer.step((acc, pending), g)
+                        return (acc, pending, r), loss
+
+                    (acc, pending, _), losses = jax.lax.scan(
+                        micro, (zeros, zeros, rng), batch)
+                    grads = reducer.flush((acc, pending))
+                exchanged = True
+            else:
+                def micro(carry, mb):
+                    acc, r = carry
+                    r, r2 = jax.random.split(r)
+                    loss, g = ctx.local_loss_and_grads(params_full, mb, r2,
+                                                       scaler_state)
+                    return (jax.tree.map(jnp.add, acc, g), r), loss
+
+                (grads, _), losses = jax.lax.scan(micro, (zeros, rng), batch)
             grads = jax.tree.map(lambda g: g / gas, grads)
             mean_loss = losses.mean()
 
         # Unscale BEFORE the wire: LoCo residuals must live in true gradient
         # units, or a dynamic-loss-scale change would make the carried error
-        # wrong by the scale ratio.
+        # wrong by the scale ratio.  (With the per-micro wire the exchange
+        # already ran on scaled grads — psum is linear, so unscaling after
+        # is the same mean in true units.)
         grads = engine.loss_scaler.unscale_grads(grads, scaler_state)
-        flat_batch = batch if gas == 1 else \
-            jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), batch)
-        grads, new_error = ctx.exchange_grads(grads, flat_batch, comm_error)
+        if exchanged:
+            new_error = comm_error
+        else:
+            flat_batch = batch if gas == 1 else \
+                jax.tree.map(lambda x: x.reshape((-1,) + x.shape[2:]), batch)
+            grads, new_error = ctx.exchange_grads(grads, flat_batch,
+                                                  comm_error)
         mean_loss = jax.lax.pmean(mean_loss, data_axes) if data_axes else mean_loss
         return mean_loss, grads, new_error
 
@@ -460,15 +561,21 @@ def make_explicit_grad_acc(engine):
                    out_shardings=sharding)(params)
 
 
-def build_explicit_micro_fn(engine):
+def build_explicit_micro_fn(engine, pregathered: bool = False):
     """backward() under explicit comm: accumulate SCALED local grads into
     the per-rank accumulator; no cross-data-axis communication here (the
-    qwZ param gather still runs — stage 3 needs full params to compute)."""
+    qwZ param gather still runs — stage 3 needs full params to compute).
+
+    ``pregathered=True`` builds the weight-prefetch variant: the micro fn
+    takes the already-gathered full params as a third argument (produced
+    once per accumulation window by :func:`build_param_gather_fn` and
+    cached by the engine's :class:`~.overlap.prefetch.GatherWindowCache`),
+    so the per-micro-step program carries **no** param all-gather.
+    """
     ctx = _wire_ctx(engine)
     acc_spec = P(ctx.dp_axes_entry)
 
-    def body(params_local, acc, batch, rng, scaler_state):
-        params_full = ctx.gather_full(jax.lax.stop_gradient(params_local))
+    def grads_body(params_full, acc, batch, rng, scaler_state):
         loss, grads = ctx.local_loss_and_grads(params_full, batch, rng,
                                                scaler_state)
         new_acc = jax.tree.map(lambda a, g: a + g[None].astype(a.dtype),
@@ -477,7 +584,29 @@ def build_explicit_micro_fn(engine):
             loss = jax.lax.pmean(loss, ctx.data_axes)
         return loss, new_acc
 
+    def body(params_local, acc, batch, rng, scaler_state):
+        params_full = ctx.gather_full(jax.lax.stop_gradient(params_local))
+        return grads_body(params_full, acc, batch, rng, scaler_state)
+
     batch_spec = ctx.batch_spec_fn(batch_dim=0)
+
+    if pregathered:
+        def micro_fn(state, batch, params_full):
+            rng, sub = jax.random.split(state.rng)
+            fn = ctx.shard_mapped(
+                grads_body,
+                in_specs=[P(), acc_spec,
+                          jax.tree.map(batch_spec, batch), P(), P()],
+                out_specs=(P(), acc_spec))
+            loss, new_acc = fn(params_full, state.grad_acc, batch, sub,
+                               state.scaler)
+            return state.replace(grad_acc=new_acc,
+                                 micro_step=state.micro_step + 1,
+                                 rng=rng), loss
+
+        # params_full is deliberately NOT donated — the window cache
+        # reuses it across every micro-step until the optimizer step
+        return jax.jit(micro_fn, donate_argnums=(0,))
 
     def micro_fn(state, batch):
         rng, sub = jax.random.split(state.rng)
@@ -492,6 +621,21 @@ def build_explicit_micro_fn(engine):
                              micro_step=state.micro_step + 1, rng=rng), loss
 
     return jax.jit(micro_fn, donate_argnums=(0,))
+
+
+def build_param_gather_fn(engine):
+    """One jitted qwZ/plain gather of the full compute-dtype params — the
+    weight-prefetch cache's miss path.  Run once per accumulation window
+    (params only change at the optimizer step) and fed to the
+    ``pregathered`` micro fn, this removes (gas - 1) of every window's
+    param all-gathers on the imperative explicit path."""
+    ctx = _wire_ctx(engine)
+
+    def body(params_local):
+        return ctx.gather_full(jax.lax.stop_gradient(params_local))
+
+    fn = ctx.shard_mapped(body, in_specs=[ctx.param_in], out_specs=P())
+    return jax.jit(fn)
 
 
 def build_explicit_step_fn(engine):

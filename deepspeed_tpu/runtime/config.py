@@ -117,6 +117,59 @@ class ProfilingConfig(DeepSpeedConfigModel):
     straggler_interval: int = 1
 
 
+class OverlapConfig(DeepSpeedConfigModel):
+    """Communication/compute overlap (``runtime/overlap/``): deferred
+    micro-batch gradient reduction, size-targeted gradient bucketing,
+    ZeRO-3 weight-gather prefetch, and the XLA latency-hiding-scheduler
+    flags.  Accepts ``"overlap": "auto"`` / ``true`` shorthands; the
+    legacy ``zero_optimization.overlap_comm: true`` also enables the block
+    with defaults.  See the README "Comm/compute overlap" section.
+    """
+
+    enabled: bool = False
+    #: "manual" uses the knobs below as-is; "auto" re-derives deferred/
+    #: bucket_bytes from the gradient wire volume and the xprof
+    #: compute-vs-comm split once a trace is captured (one recompile per
+    #: re-tune)
+    mode: str = "manual"
+    #: double-buffer the micro-batch grad reduction in the scan carry so
+    #: collective i overlaps compute i+1 (costs one extra gradient tree;
+    #: bit-exact vs the eager schedule).  Effective with
+    #: gradient_accumulation_steps > 1.
+    deferred_grad_reduce: bool = True
+    #: coalesce small gradient leaves into fused flat buckets of at most
+    #: this many bytes for the explicit-comm exchange (0 = per-leaf).
+    #: psum is elementwise, so bucketing never changes values.
+    bucket_bytes: int = 16 * 1024 * 1024
+    #: reuse the gathered (qwZ/plain) full params across the backward()
+    #: micro-steps of one accumulation window on the imperative
+    #: explicit-comm path (params only change at step())
+    prefetch_params: bool = True
+    #: route training through the explicit-comm wire even without
+    #: quantized/sparse config, so deferred+bucketed hand-written
+    #: exchanges replace the XLA-inserted collectives
+    explicit_wire: bool = False
+    #: set the latency-hiding-scheduler / async-collective XLA flags
+    #: through the accelerator before backend init (no-op on CPU)
+    xla_flags: bool = True
+    xla_extra_flags: List[str] = Field(default_factory=list)
+    #: auto mode: minimum xprof communication fraction that justifies the
+    #: deferred gradient buffer
+    auto_comm_threshold: float = 0.05
+    #: auto mode: size buckets so the exchange runs in about this many
+    #: collective launches
+    auto_target_buckets: int = 8
+
+    @model_validator(mode="after")
+    def _check_mode(self):
+        if self.mode not in ("manual", "auto"):
+            raise ValueError(f"overlap.mode must be 'manual' or 'auto', "
+                             f"got {self.mode!r}")
+        if self.bucket_bytes < 0:
+            raise ValueError("overlap.bucket_bytes must be >= 0")
+        return self
+
+
 class MonitorWriterConfig(DeepSpeedConfigModel):
     enabled: bool = False
     output_path: str = ""
@@ -243,6 +296,10 @@ class FaultConfig(DeepSpeedConfigModel):
     #: raise WatchdogTimeout from the training thread after a timeout
     #: (default: log the post-mortem dump and keep waiting)
     watchdog_raise: bool = False
+    #: checkpoint GC: keep only the newest N *valid* committed tags after
+    #: each commit (0 = never delete).  The committed 'latest' pointer
+    #: target and the newest verified tag are never deleted.
+    checkpoint_keep_last: int = 0
 
 
 class TelemetryConfig(DeepSpeedConfigModel):
@@ -385,6 +442,15 @@ class DeepSpeedConfig:
         self.elasticity = ElasticityConfig(**config.get("elasticity", {}))
         self.fault = FaultConfig(**config.get("fault", {}))
         self.telemetry = TelemetryConfig(**config.get("telemetry", {}))
+        # ``overlap`` shorthands: "auto" → auto mode, true → defaults; the
+        # legacy reference key zero_optimization.overlap_comm also enables
+        # the block (its hand-rolled side-stream is this subsystem here).
+        # Shorthand expansion is shared with the pre-backend-init flag
+        # wiring (overlap/xla_flags.normalize_overlap_raw) so both parse
+        # the same spelling identically.
+        from .overlap.xla_flags import normalize_overlap_raw
+
+        self.overlap = OverlapConfig(**normalize_overlap_raw(config))
         self.autotuning_config = AutotuningConfig(**config.get("autotuning", {}))
 
         self.sequence_parallel_size: int = config.get("sequence_parallel_size", 1)

@@ -126,9 +126,11 @@ def overlap_evidence(cfg, lp, x, micro_splits: int = 2, lp_specs=None):
     layer = DominoTransformerLayer(cfg, micro_splits)
     if lp_specs is None:
         lp_specs = P()   # caller passes the Megatron specs for sharded lp
-    fn = jax.jit(jax.shard_map(
+    from ..topology import compat_shard_map
+
+    fn = jax.jit(compat_shard_map(
         lambda lp, x: layer(lp, x), mesh=topo.mesh,
-        in_specs=(lp_specs, P()), out_specs=P(), check_vma=False))
+        in_specs=(lp_specs, P()), out_specs=P()))
     txt = fn.lower(lp, x).compile().as_text()
     return {"all_reduce_start": len(re.findall(r"all-reduce-start", txt)),
             "all_reduce_done": len(re.findall(r"all-reduce-done", txt)),

@@ -103,6 +103,18 @@ class DeepSpeedEngine:
             set_telemetry(self.telemetry)
         self._host_step_calls = 0   # host-side step counter (no device sync)
 
+        # ---- comm/compute overlap (config.overlap) -------------------- #
+        # Effective settings + overlap/* gauges + the auto-mode re-tune
+        # live in the manager; step builders (fused scan and comm_path)
+        # consult it at trace time.
+        from .overlap import OverlapManager
+        from .overlap.prefetch import GatherWindowCache
+
+        self.overlap = OverlapManager.from_config(config,
+                                                  telemetry=self.telemetry)
+        self._gather_cache = GatherWindowCache()
+        self._deferred_active = False
+
         self._timers = SynchronizedWallClockTimer(telemetry=self.telemetry)
         self.tput_timer = ThroughputTimer(
             batch_size=config.train_batch_size or 1,
@@ -195,7 +207,11 @@ class DeepSpeedEngine:
         self._explicit_comm = bool(
             (zc.zero_quantized_weights and self.zero_stage >= 3)
             or zc.zero_quantized_gradients
-            or getattr(config, "sparse_gradients_enabled", False))
+            or getattr(config, "sparse_gradients_enabled", False)
+            # overlap.explicit_wire: hand-written (deferred + bucketed)
+            # exchanges replace the XLA-inserted collectives even without
+            # quantized/sparse wire formats
+            or (self.overlap.enabled and self.overlap.explicit_wire))
         if zc.zero_quantized_weights and self.zero_stage < 3:
             logger.warning("zero_quantized_weights ignored below ZeRO stage 3")
         comm_error = None
@@ -563,8 +579,15 @@ class DeepSpeedEngine:
     # ------------------------------------------------------------------ #
     # Core math (shared by both paths)
     # ------------------------------------------------------------------ #
-    def _loss_and_grads(self, params, batch, rng, scaler_state):
-        """One micro-batch: cast → forward → scaled backward → fp32 grads."""
+    def _loss_and_grads(self, params, batch, rng, scaler_state,
+                        constrain=True):
+        """One micro-batch: cast → forward → scaled backward → fp32 grads.
+
+        ``constrain=False`` skips the ZeRO grad-sharding constraint — the
+        overlap deferred path applies it one scan iteration later (the
+        reduce-scatter it induces then overlaps the next micro-batch's
+        compute) instead of inline.
+        """
 
         def scaled_loss(p32):
             p = jax.tree.map(lambda x: x.astype(self.compute_dtype), p32)
@@ -574,7 +597,8 @@ class DeepSpeedEngine:
 
         grads, loss = jax.grad(scaled_loss, has_aux=True)(params)
         grads = jax.tree.map(lambda g: g.astype(jnp.float32), grads)
-        grads = self._constrain_grads(grads)
+        if constrain:
+            grads = self._constrain_grads(grads)
         return loss, grads
 
     def _constrain_grads(self, grads):
@@ -639,6 +663,16 @@ class DeepSpeedEngine:
 
             return build_explicit_comm_step(self)
         gas = self.gradient_accumulation_steps()
+        # Deferred micro-batch reduction (overlap subsystem): park each
+        # micro-batch's unconstrained grads in the scan carry and apply the
+        # ZeRO sharding constraint one iteration later, so the reduce-
+        # scatter it induces has a whole micro-batch of independent compute
+        # to hide behind.  Same additions in the same order → bit-exact vs
+        # the eager schedule (asserted by the overlap tests).  Below stage
+        # 2 there is no grad-sharding collective to move, so eager stands.
+        use_deferred = bool(self.overlap.enabled and self.overlap.deferred
+                            and gas > 1 and self.zero_stage >= 2)
+        self._deferred_active = use_deferred
 
         def step_fn(state: EngineState, batch):
             rng, sub = jax.random.split(state.rng)
@@ -646,6 +680,27 @@ class DeepSpeedEngine:
             if gas == 1:
                 loss, grads = self._loss_and_grads(state.params, batch, sub, state.scaler)
                 mean_loss = loss
+            elif use_deferred:
+                from .overlap.deferred import DeferredAccumulator
+
+                reducer = DeferredAccumulator(self._constrain_grads,
+                                              _tree_zeros_like(state.params))
+
+                def micro(carry, mb):
+                    acc, pending, r = carry
+                    r, r2 = jax.random.split(r)
+                    loss, grads = self._loss_and_grads(
+                        state.params, mb, r2, state.scaler, constrain=False)
+                    acc, pending = reducer.step((acc, pending), grads)
+                    return (acc, pending, r), loss
+
+                zeros = self._constrain_grads(_tree_zeros_like(state.params))
+                (acc, pending, _), losses = jax.lax.scan(
+                    micro, (zeros, _tree_zeros_like(state.params), sub),
+                    batch)
+                grads = reducer.flush((acc, pending))
+                grads = jax.tree.map(lambda g: g / gas, grads)
+                mean_loss = losses.mean()
             else:
                 # batch leaves: [gas, micro_global, ...]
                 def micro(carry, mb):
@@ -751,6 +806,9 @@ class DeepSpeedEngine:
             if dur > 0:
                 with self._span("profiling/straggler_check"):
                     self._straggler.observe_step(step, dur)
+        if self.overlap.enabled:
+            with self._span("overlap/on_step"):
+                self.overlap.on_step(self, self._deferred_active)
         pcfg = self.config.profiling
         if self._profiling_on and pcfg.enabled and pcfg.roofline and \
                 self.telemetry is not None and step > 0 and \
@@ -916,13 +974,34 @@ class DeepSpeedEngine:
                 acc = _tree_zeros_like(self.state.params)
             self.state = self.state.replace(grad_acc=acc)
             self._compiled.pop("micro", None)
+        # ZeRO-3 weight-gather prefetch (overlap subsystem): the gathered
+        # full params are a pure function of params, which only change at
+        # step() — gather once per accumulation window and reuse, so the
+        # per-micro-step program carries no param all-gather.
+        prefetch = (self._explicit_comm and self.zero_stage >= 3
+                    and self.overlap.enabled and self.overlap.prefetch_params)
         if "micro" not in self._compiled:
-            self._compiled["micro"] = self._build_micro_fn()
+            if prefetch:
+                from .comm_path import (build_explicit_micro_fn,
+                                        build_param_gather_fn)
+
+                self._compiled["gather_full"] = build_param_gather_fn(self)
+                self._compiled["micro"] = build_explicit_micro_fn(
+                    self, pregathered=True)
+            else:
+                self._compiled["micro"] = self._build_micro_fn()
         self._heartbeat("backward")
         if self.config.wall_clock_breakdown:
             self._timers("backward").start()
         with self._span("engine/backward") as sp:
-            self.state, loss = self._compiled["micro"](self.state, batch)
+            if prefetch:
+                full = self._gather_cache.get(
+                    self.state.params, self._compiled["gather_full"])
+                self.overlap.note_prefetch(self._gather_cache)
+                self.state, loss = self._compiled["micro"](self.state, batch,
+                                                           full)
+            else:
+                self.state, loss = self._compiled["micro"](self.state, batch)
             self._fence_span(sp, loss)
         if self.config.wall_clock_breakdown:
             self._timers("backward").stop(sync=loss)
@@ -940,6 +1019,8 @@ class DeepSpeedEngine:
         with self._span("engine/optimizer_step") as sp:
             self.state = self._compiled["step"](self.state)
             self._fence_span(sp, self.state.global_step)
+        # params changed: the prefetched gathered-params window is over
+        self._gather_cache.invalidate()
         if self._losses:
             self._write_monitor_events(self._losses[-1])
             self._losses.clear()
@@ -1005,6 +1086,7 @@ class DeepSpeedEngine:
             self.state = self.state.replace(params=restored.params)
         else:
             self.state = restored
+        self._gather_cache.invalidate()   # params changed under the cache
         if load_lr_scheduler_states and payload.get("lr_scheduler") and \
                 hasattr(self.lr_scheduler, "load_state_dict"):
             self.lr_scheduler.load_state_dict(payload["lr_scheduler"])
@@ -1061,6 +1143,7 @@ class DeepSpeedEngine:
                 self.state = self.state.replace(params=tree)
         self._offloaded = {}
         self._compiled.clear()
+        self._gather_cache.invalidate()
 
     # ------------------------------------------------------------------ #
     def get_fp32_state_dict(self):

@@ -1,0 +1,41 @@
+"""Communication/compute overlap subsystem.
+
+Reference analogues: ``overlap_comm`` (stage_1_and_2.py side-stream grad
+reduction), the stage-3 prefetch coordinator (partitioned_param_coordinator
+.py:285), and the reduce/allgather bucket knobs (zero/config.py).  T3
+(arXiv:2401.16677) motivates the structural half — fine-grained overlap of
+collectives with independent compute recovers most exposed communication
+time — and ZeRO++ (arXiv:2306.10209) the transport half (bucketed/
+hierarchical collectives cut per-launch overhead).
+
+On TPU the collectives are inserted by XLA, so "overlap" decomposes into
+four independently-useful levers, each its own module:
+
+  * :mod:`.deferred` — double-buffered micro-batch gradient reduction: the
+    scan carry holds micro-batch *i*'s unreduced gradients for one
+    iteration so the reduce-scatter/psum for *i* is issued alongside the
+    compute of *i+1* (flushed at the accumulation boundary).  Pure
+    scheduling: the accumulation order is unchanged, so gradients are
+    bit-exact vs the eager schedule.
+  * :mod:`.bucketing` — size-targeted coalescing of small gradient leaves
+    into fused flat buckets (``overlap.bucket_bytes``) so per-leaf
+    collective launch overhead stops serializing the exchange.  psum is
+    elementwise, so bucketed and per-leaf exchanges are bit-identical.
+  * :mod:`.prefetch` — ZeRO-3 weight all-gather prefetch: a per-
+    accumulation-window gathered-param cache for the imperative explicit
+    path, and a double-buffered scanned-layer gather combinator that
+    issues layer *l+1*'s all-gather during layer *l*'s compute.
+  * :mod:`.xla_flags` — the latency-hiding-scheduler / async-collective
+    XLA flags, applied through the accelerator *before* backend init
+    (safe no-op on CPU).
+
+:mod:`.auto` turns the PR-3 xprof compute/comm split into a bucket-size /
+defer decision (``overlap: "auto"``), and :mod:`.manager` owns the engine
+side: effective settings, ``overlap/*`` gauges, and the one-shot re-tune.
+"""
+from .auto import AutoTuneDecision, autotune  # noqa: F401
+from .bucketing import BucketPlan, plan_buckets  # noqa: F401
+from .deferred import DeferredAccumulator  # noqa: F401
+from .manager import OverlapManager  # noqa: F401
+from .prefetch import prefetched_layer_scan  # noqa: F401
+from .xla_flags import configure_xla_overlap_flags  # noqa: F401

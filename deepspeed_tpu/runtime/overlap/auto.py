@@ -1,0 +1,90 @@
+"""Profiler-driven overlap auto-tuning (``overlap: "auto"``).
+
+PR 3 left the compute/comm/host device-time split (xprof_parse) unused as
+an input — this module closes the loop (the ROADMAP's "feed the
+comm-vs-compute split into an overlap optimizer" follow-up).  Given the
+attribution report of a captured step and the gradient wire volume, it
+decides:
+
+  * whether deferred micro-batch reduction is worth its extra gradient
+    buffer (only when communication is actually exposed), and
+  * a bucket byte target sized so the exchange runs in
+    ``auto_target_buckets`` launches (clamped to sane bounds).
+
+Without a trace (no ``comms_logger.xprof_step`` capture yet) the decision
+falls back to the size heuristic alone and is refined once a trace lands.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+#: bucket byte-target clamp for the auto mode
+AUTO_MIN_BUCKET = 1 << 20          # 1 MiB — below this, fusion overhead wins
+AUTO_MAX_BUCKET = 512 << 20        # reference reduce_bucket_size magnitude
+
+
+@dataclasses.dataclass(frozen=True)
+class AutoTuneDecision:
+    deferred: bool
+    bucket_bytes: int
+    exposed_comm_fraction: Optional[float]   # None = no trace yet
+    reason: str
+
+    def as_event(self) -> Dict[str, Any]:
+        return {
+            "deferred": self.deferred,
+            "bucket_bytes": self.bucket_bytes,
+            "exposed_comm_fraction": self.exposed_comm_fraction,
+            "reason": self.reason,
+        }
+
+
+def exposed_comm_fraction(xprof_report: Dict[str, Any]) -> Optional[float]:
+    """Communication share of attributed device time from an
+    ``xprof_parse.attribute_device_time`` report (None when the trace is
+    empty).  With a serial trace this is an upper bound on *exposed* comm —
+    overlapped collectives still show up in their own lane — which is the
+    conservative direction for an enable decision."""
+    cats = xprof_report.get("categories") or {}
+    total = sum(float(v) for v in cats.values())
+    if total <= 0:
+        return None
+    return float(cats.get("communication", 0.0)) / total
+
+
+def size_targeted_bucket(grad_bytes: float, target_buckets: int) -> int:
+    """Bucket byte target putting the whole gradient wire into roughly
+    ``target_buckets`` launches."""
+    if grad_bytes <= 0:
+        return AUTO_MIN_BUCKET
+    per = int(grad_bytes / max(int(target_buckets), 1))
+    return max(AUTO_MIN_BUCKET, min(AUTO_MAX_BUCKET, per))
+
+
+def autotune(xprof_report: Optional[Dict[str, Any]],
+             grad_bytes: float,
+             comm_threshold: float = 0.05,
+             target_buckets: int = 8) -> AutoTuneDecision:
+    """Pick deferred-reduction and bucket-size settings.
+
+    ``xprof_report``: device-time attribution of one captured step (or
+    None before any capture).  ``grad_bytes``: fp32 gradient wire volume
+    (``ZeroShardingPlan.grad_bytes``).  ``comm_threshold``: minimum
+    communication fraction that justifies the deferred buffer.
+    """
+    bucket = size_targeted_bucket(grad_bytes, target_buckets)
+    frac = exposed_comm_fraction(xprof_report) if xprof_report else None
+    if frac is None:
+        return AutoTuneDecision(
+            deferred=True, bucket_bytes=bucket, exposed_comm_fraction=None,
+            reason="no xprof capture yet: size heuristic only, deferred on")
+    if frac < comm_threshold:
+        return AutoTuneDecision(
+            deferred=False, bucket_bytes=bucket, exposed_comm_fraction=frac,
+            reason=f"comm fraction {frac:.3f} < threshold {comm_threshold}: "
+                   f"not worth the deferred gradient buffer")
+    return AutoTuneDecision(
+        deferred=True, bucket_bytes=bucket, exposed_comm_fraction=frac,
+        reason=f"comm fraction {frac:.3f} >= threshold {comm_threshold}: "
+               f"deferring reduction, {target_buckets}-launch buckets")

@@ -1,0 +1,109 @@
+"""Size-targeted gradient bucketing (reference: ``reduce_bucket_size`` and
+the coalesced exchange in runtime/comm/coalesced_collectives.py:158).
+
+A transformer gradient tree mixes a few huge leaves (embeddings, stacked
+layer weights) with many small ones (norm scales, biases).  Exchanging each
+leaf with its own collective serializes the backward on per-launch
+overhead; coalescing small leaves into flat fused buckets under a byte
+target issues one collective per bucket instead.
+
+``psum``/mean are elementwise, so a bucketed exchange is **bit-identical**
+to the per-leaf exchange — bucketing changes launch count, never values.
+
+Planning is host-side (shapes only) and happens once at trace time; the
+plan is also the source of the ``overlap/bucket_count`` gauges.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, List, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """One fused exchange: ``indices`` into the flat leaf list."""
+
+    indices: tuple          # leaf positions, in tree order
+    nbytes: int             # payload bytes (fp32 wire)
+
+    @property
+    def fused(self) -> bool:
+        return len(self.indices) > 1
+
+
+def leaf_bytes(leaf: Any, itemsize: int = 4) -> int:
+    """fp32 wire bytes of one gradient leaf."""
+    n = 1
+    for d in getattr(leaf, "shape", ()):
+        n *= int(d)
+    return n * itemsize
+
+
+def plan_buckets(leaves: Sequence[Any], bucket_bytes: int,
+                 itemsize: int = 4) -> List[BucketPlan]:
+    """Greedy in-order first-fit: consecutive leaves share a bucket until
+    the byte target is hit.  A leaf at or above the target gets a singleton
+    bucket (no concat copy is paid for tensors that are already large
+    enough to saturate a launch).  ``bucket_bytes <= 0`` → all singletons.
+    """
+    plans: List[BucketPlan] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i, leaf in enumerate(leaves):
+        nb = leaf_bytes(leaf, itemsize)
+        if bucket_bytes <= 0 or nb >= bucket_bytes:
+            if cur:
+                plans.append(BucketPlan(tuple(cur), cur_bytes))
+                cur, cur_bytes = [], 0
+            plans.append(BucketPlan((i,), nb))
+            continue
+        if cur and cur_bytes + nb > bucket_bytes:
+            plans.append(BucketPlan(tuple(cur), cur_bytes))
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += nb
+    if cur:
+        plans.append(BucketPlan(tuple(cur), cur_bytes))
+    return plans
+
+
+def bucket_stats(plans: Sequence[BucketPlan]) -> dict:
+    """Host-side summary for the ``overlap/*`` gauges."""
+    fused = [p for p in plans if p.fused]
+    return {
+        "bucket_count": len(plans),
+        "fused_buckets": len(fused),
+        "fused_leaves": sum(len(p.indices) for p in fused),
+        "max_bucket_bytes": max((p.nbytes for p in plans), default=0),
+        "total_bytes": sum(p.nbytes for p in plans),
+    }
+
+
+def apply_bucketed(leaves: List[Any], plans: Sequence[BucketPlan],
+                   exchange: Callable[[jnp.ndarray], jnp.ndarray]) -> List[Any]:
+    """Run ``exchange`` once per bucket over the selected ``leaves``.
+
+    ``exchange`` must be elementwise over a flat fp32 vector (psum/mean —
+    anything for which fusing concatenated payloads is value-preserving).
+    Singleton buckets skip the flatten/concat round-trip entirely.
+    Returns the exchanged leaves in the original order/dtype/shape.
+    """
+    out: List[Any] = [None] * len(leaves)
+    for plan in plans:
+        if not plan.fused:
+            (i,) = plan.indices
+            out[i] = exchange(leaves[i])
+            continue
+        parts = [leaves[i] for i in plan.indices]
+        flat = jnp.concatenate(
+            [p.reshape(-1).astype(jnp.float32) for p in parts])
+        fused = exchange(flat)
+        off = 0
+        for i, p in zip(plan.indices, parts):
+            n = int(p.size)
+            out[i] = fused[off:off + n].reshape(p.shape).astype(p.dtype)
+            off += n
+    return out

@@ -1,0 +1,168 @@
+"""Engine-side overlap orchestration: effective settings, ``overlap/*``
+telemetry, and the one-shot profiler-driven re-tune.
+
+The manager is the single object the engine and the explicit-comm step
+builders consult, so "what is the bucket size right now" has one answer
+even across an auto-mode re-tune (which invalidates the compiled step and
+rebuilds it against the new settings).
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from ...utils.logging import log_dist, logger
+from .auto import AutoTuneDecision, autotune
+
+
+class OverlapManager:
+    """Holds the *effective* overlap settings plus run counters.
+
+    ``deferred``/``bucket_bytes`` start from the config block; in ``auto``
+    mode they are re-derived from the gradient wire volume immediately and
+    refined once an xprof capture exists (``maybe_autotune`` returns True
+    when the compiled step must be rebuilt).
+    """
+
+    def __init__(self, cfg, telemetry=None):
+        self.cfg = cfg
+        self.telemetry = telemetry
+        self.enabled = bool(getattr(cfg, "enabled", False))
+        self.mode = getattr(cfg, "mode", "manual")
+        self.deferred = self.enabled and bool(
+            getattr(cfg, "deferred_grad_reduce", True))
+        self.bucket_bytes = int(getattr(cfg, "bucket_bytes", 0)) \
+            if self.enabled else 0
+        self.prefetch_params = self.enabled and bool(
+            getattr(cfg, "prefetch_params", True))
+        self.explicit_wire = self.enabled and bool(
+            getattr(cfg, "explicit_wire", False))
+        self.deferred_steps = 0
+        self.prefetch_hits = 0
+        self.prefetch_misses = 0
+        self.last_bucket_stats: Optional[Dict[str, Any]] = None
+        self.last_decision: Optional[AutoTuneDecision] = None
+        self._tuned_without_trace = False
+        self._tuned_with_trace = False
+
+    @classmethod
+    def from_config(cls, config, telemetry=None) -> "OverlapManager":
+        return cls(getattr(config, "overlap", None), telemetry=telemetry)
+
+    # ------------------------------------------------------------------ #
+    # Build-time notifications (trace-time, host side)
+    # ------------------------------------------------------------------ #
+    def note_bucket_plan(self, stats: Dict[str, Any]) -> None:
+        self.last_bucket_stats = dict(stats)
+
+    def note_prefetch(self, cache) -> None:
+        self.prefetch_hits = cache.hits
+        self.prefetch_misses = cache.misses
+
+    # ------------------------------------------------------------------ #
+    # Auto mode
+    # ------------------------------------------------------------------ #
+    def _apply(self, decision: AutoTuneDecision, engine) -> bool:
+        self.last_decision = decision
+        changed = (decision.deferred != self.deferred
+                   or decision.bucket_bytes != self.bucket_bytes)
+        self.deferred = decision.deferred
+        self.bucket_bytes = decision.bucket_bytes
+        if self.telemetry is not None:
+            self.telemetry.event("overlap_autotune", **decision.as_event())
+        log_dist(f"overlap auto: {decision.reason} "
+                 f"(bucket_bytes={decision.bucket_bytes})", ranks=[0])
+        return changed
+
+    def maybe_autotune(self, engine) -> bool:
+        """Run the auto-mode decision when its inputs are ready.  Returns
+        True iff effective settings changed (caller must rebuild the
+        compiled step — one recompile per tune, twice at most)."""
+        if not self.enabled or self.mode != "auto":
+            return False
+        if self._tuned_with_trace:
+            return False          # final state — nothing further to learn
+        # a trace-based refine is only pending once an xprof capture exists;
+        # until then, after the one size-heuristic pass there is nothing to
+        # do — and the early outs keep the per-step hook free of the param
+        # walk and trace re-parse below
+        cl = getattr(engine.config, "comms_logger", None)
+        trace_ready = (cl is not None
+                       and getattr(engine, "_xprof_fired", False)
+                       and os.path.isdir(cl.xprof_dir))
+        if self._tuned_without_trace and not trace_ready:
+            return False
+        try:
+            grad_bytes = engine.plan.grad_bytes(engine.state.params)
+        except Exception as e:  # noqa: BLE001 — sizing is best-effort
+            logger.debug(f"overlap auto: grad sizing unavailable: {e}")
+            grad_bytes = 0.0
+        report = None
+        if trace_ready:
+            try:
+                from ...profiling.xprof_parse import attribute_device_time
+
+                report = attribute_device_time(cl.xprof_dir)
+            except Exception as e:  # noqa: BLE001 — a bad trace must not
+                logger.debug(f"overlap auto: xprof parse failed: {e}")
+        if trace_ready and report is None:
+            # don't re-parse a broken capture forever
+            self._trace_failures = getattr(self, "_trace_failures", 0) + 1
+            if self._trace_failures >= 3:
+                self._tuned_with_trace = True
+        if report is not None:
+            self._tuned_with_trace = True
+            decision = autotune(report, grad_bytes,
+                                self.cfg.auto_comm_threshold,
+                                self.cfg.auto_target_buckets)
+            return self._apply(decision, engine)
+        if not self._tuned_without_trace:
+            self._tuned_without_trace = True
+            decision = autotune(None, grad_bytes,
+                                self.cfg.auto_comm_threshold,
+                                self.cfg.auto_target_buckets)
+            return self._apply(decision, engine)
+        return False
+
+    # ------------------------------------------------------------------ #
+    # Telemetry
+    # ------------------------------------------------------------------ #
+    def publish(self) -> None:
+        """Mirror the overlap state into ``overlap/*`` metrics (surfaced by
+        ``bin/dstpu-telemetry``'s exposed-comm line)."""
+        if self.telemetry is None or not self.enabled:
+            return
+        m = self.telemetry.metrics
+        m.gauge("overlap/deferred").set(1.0 if self.deferred else 0.0)
+        m.gauge("overlap/bucket_bytes").set(float(self.bucket_bytes))
+        m.counter("overlap/deferred_steps").inc(0)  # materialize the series
+        if self.last_bucket_stats:
+            m.gauge("overlap/bucket_count").set(
+                float(self.last_bucket_stats.get("bucket_count", 0)))
+            m.gauge("overlap/fused_leaves").set(
+                float(self.last_bucket_stats.get("fused_leaves", 0)))
+        if self.last_decision is not None and \
+                self.last_decision.exposed_comm_fraction is not None:
+            m.gauge("overlap/exposed_comm_fraction").set(
+                float(self.last_decision.exposed_comm_fraction))
+        if self.prefetch_hits or self.prefetch_misses:
+            m.gauge("overlap/prefetch_reuse").set(float(self.prefetch_hits))
+
+    def on_step(self, engine, deferred_active: bool) -> None:
+        """Per-step hook (engine ``_post_step_logging``): counters, auto
+        tune, gauge publication."""
+        if not self.enabled:
+            return
+        if deferred_active:
+            self.deferred_steps += 1
+            if self.telemetry is not None:
+                self.telemetry.metrics.counter("overlap/deferred_steps").inc()
+        if self.maybe_autotune(engine):
+            # new settings apply at the next build: drop the compiled step
+            # fns and the cached wire context that snapshotted old knobs
+            for key in ("train_batch", "micro", "step", "gather_full"):
+                engine._compiled.pop(key, None)
+            engine._wire_ctx_cache = None
+            log_dist("overlap auto: settings changed — train step will "
+                     "rebuild with the new schedule", ranks=[0])
+        self.publish()

@@ -1,0 +1,96 @@
+"""ZeRO-3 weight all-gather prefetch.
+
+Reference: the stage-3 parameter coordinator prefetches upcoming layers'
+allgathers on a side stream (partitioned_param_coordinator.py:285) and
+reuses gathered params across the micro-batches of one accumulation window
+(``max_reuse_distance``).  Two TPU-native mechanisms here:
+
+  * :func:`prefetched_layer_scan` — a scanned-layer forward whose carry
+    double-buffers the *next* layer group's gathered weights: the
+    all-gather for layer ``l+1`` is issued in iteration ``l``, giving the
+    scheduler a whole layer of compute to hide it behind.  Numerically
+    equivalent to the plain scan — the same gathered weights reach the
+    same per-layer compute; only the issue schedule changes (XLA may fuse
+    the restructured program differently, so equality is to fp tolerance,
+    not bitwise).
+  * :class:`GatherWindowCache` — host-side reuse of the gathered (qwZ-
+    dequantized or plain) full params across the ``backward()`` calls of
+    one accumulation window on the imperative explicit-comm path.  Params
+    only change at ``step()``, so the first micro-step's gather serves all
+    of them; the per-micro-step HLO then contains **no** param all-gather.
+    Bit-exact: the gather is a pure function of the (unchanged) shards.
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def prefetched_layer_scan(body: Callable[[Any, Any], Tuple[Any, Any]],
+                          gather_layer: Callable[[Any], Any],
+                          stacked_shards: Any,
+                          carry0: Any,
+                          length: int):
+    """Scan ``body`` over ``length`` stacked layer groups with the next
+    group's gather issued one iteration early.
+
+    ``stacked_shards`` leaves have a leading ``[length, ...]`` layer axis
+    holding this rank's *shards*; ``gather_layer`` turns one layer group's
+    shard tree into full weights (e.g. a quantized/plain all-gather inside
+    shard_map).  ``body(carry, full_weights) -> (carry, y)`` is the layer
+    compute.
+
+    The weights carry always holds the *current* iteration's gathered
+    weights; the gather for ``l+1`` (clamped at the last layer) is issued
+    before ``body`` runs, with no data dependence on it — the overlap
+    window.  Returns ``(final_carry, stacked_ys)``.
+    """
+    def slice_layer(i):
+        return jax.tree.map(
+            lambda s: jax.lax.dynamic_index_in_dim(s, i, 0, keepdims=False),
+            stacked_shards)
+
+    w0 = gather_layer(slice_layer(0))
+
+    def step(carry, i):
+        state, w = carry
+        # issue next layer's gather FIRST — independent of this layer's
+        # compute, so the scheduler may run them concurrently
+        nxt = gather_layer(slice_layer(jnp.minimum(i + 1, length - 1)))
+        state, y = body(state, w)
+        return (state, nxt), y
+
+    (state, _w), ys = jax.lax.scan(step, (carry0, w0),
+                                   jnp.arange(length))
+    return state, ys
+
+
+class GatherWindowCache:
+    """Gathered-param reuse across one gradient-accumulation window.
+
+    ``get(params, gather)`` returns the cached full params when the cache
+    is warm, else runs ``gather`` and caches.  The freshness contract is
+    ``invalidate()``, which the engine calls at every point params mutate
+    (optimizer step, checkpoint load, state reload) — identity-keying the
+    params would be useless, since donation gives the unchanged params new
+    array objects every micro-step.  ``hits``/``misses`` feed the
+    ``overlap/prefetch_reuse`` gauge.
+    """
+
+    def __init__(self):
+        self._full: Optional[Any] = None
+        self.hits = 0
+        self.misses = 0
+
+    def get(self, params: Any, gather: Callable[[Any], Any]) -> Any:
+        if self._full is not None:
+            self.hits += 1
+            return self._full
+        self.misses += 1
+        self._full = gather(params)
+        return self._full
+
+    def invalidate(self) -> None:
+        self._full = None

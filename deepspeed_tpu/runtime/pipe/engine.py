@@ -31,7 +31,8 @@ from jax.sharding import PartitionSpec as P
 
 from ...utils.logging import log_dist
 from ..engine import DeepSpeedEngine
-from ..topology import DATA, DATA_OUTER, EXPERT, PIPE, SEQ, TENSOR, get_topology
+from ..topology import (DATA, DATA_OUTER, EXPERT, PIPE, SEQ, TENSOR,
+                        compat_shard_map, get_topology)
 
 
 def _tp_psum(x, tp: int):
@@ -498,8 +499,9 @@ def _pipeline_lm(params: Dict, batch: Any, cfg, topo, rng, num_micro: int,
         return loss, grads
 
     if schedule == "gpipe":
-        return jax.shard_map(body, mesh=mesh, in_specs=(spec_tree, tok_spec),
-                             out_specs=P(), check_vma=False)(params, tokens)
+        return compat_shard_map(body, mesh=mesh,
+                                in_specs=(spec_tree, tok_spec),
+                                out_specs=P())(params, tokens)
 
     if virtual_stages > 1 and not layers_prepermuted:
         # Interleaved layer placement: virtual stage vs = c·pp + s means
@@ -516,9 +518,9 @@ def _pipeline_lm(params: Dict, batch: Any, cfg, topo, rng, num_micro: int,
     elif virtual_stages > 1:
         interleave_order(cfg.num_layers, pp, virtual_stages)  # validates
 
-    loss, grads = jax.shard_map(
+    loss, grads = compat_shard_map(
         body, mesh=mesh, in_specs=(spec_tree, tok_spec),
-        out_specs=(P(), spec_tree), check_vma=False)(params, tokens)
+        out_specs=(P(), spec_tree))(params, tokens)
     if virtual_stages > 1 and not layers_prepermuted:
         grads = {**grads, "layers": jax.tree.map(
             lambda a: jnp.take(a, inv, axis=0), grads["layers"])}
@@ -612,8 +614,8 @@ def pipeline_module_loss(module, params: Dict, batch: Any, rng,
     else:
         fn, in_specs, args = body, (spec_tree, data_spec, data_spec), \
             (params, x, labels)
-    return jax.shard_map(fn, mesh=mesh, in_specs=in_specs,
-                         out_specs=P(), check_vma=False)(*args)
+    return compat_shard_map(fn, mesh=mesh, in_specs=in_specs,
+                            out_specs=P())(*args)
 
 
 def _pipeline_param_specs(params, cfg):

@@ -264,6 +264,52 @@ class MeshTopology:
         return f"MeshTopology({self.dims})"
 
 
+def compat_shard_map(f, mesh, in_specs, out_specs, manual_axes=None):
+    """``shard_map`` across jax versions.
+
+    Newer jax exposes ``jax.shard_map`` with partial-manual ``axis_names``
+    and ``check_vma``; 0.4.x only has ``jax.experimental.shard_map`` where
+    the same partial-manual region is spelled as the complement set
+    (``auto=``) and the varying-manual check is ``check_rep``.  One seam so
+    every sharded step builder keeps working on both (``manual_axes=None``
+    = fully manual).
+    """
+    import jax
+
+    if hasattr(jax, "shard_map"):
+        kwargs = dict(mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+                      check_vma=False)
+        if manual_axes is not None:
+            kwargs["axis_names"] = set(manual_axes)
+        return jax.shard_map(f, **kwargs)
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    auto = frozenset()
+    if manual_axes is not None:
+        auto = frozenset(mesh.axis_names) - set(manual_axes)
+        # 0.4.x's auto= support miscompiles some partial-manual programs
+        # when an Auto axis is nontrivial (observed: XLA hard-abort on the
+        # quantized-wire step under tensor parallelism).  A process abort
+        # mid-suite is far worse than a clean refusal, so degrade exactly
+        # the unreliable combination.
+        try:
+            sizes = dict(getattr(mesh, "shape", {}) or {})
+        except TypeError:
+            sizes = {}
+        live_auto = sorted(a for a in auto if int(sizes.get(a, 1)) > 1)
+        if live_auto:
+            raise NotImplementedError(
+                f"partial-manual shard_map with nontrivial Auto axes "
+                f"{live_auto} needs jax.shard_map (newer jax); this jax's "
+                f"experimental shard_map miscompiles that combination — "
+                f"use the fused path on model-parallel meshes")
+    mapped = _shard_map(f, mesh=mesh, in_specs=in_specs,
+                        out_specs=out_specs, check_rep=False, auto=auto)
+    # 0.4.x partial-manual shard_map has no eager impl (NotImplementedError
+    # outside jit); wrapping is a no-op for callers already under jit
+    return jax.jit(mapped) if auto else mapped
+
+
 def shard_map_context(topo: "MeshTopology"):
     """(mesh, already_manual_axes) for building a possibly-nested shard_map.
 

@@ -131,6 +131,30 @@ class ZeroShardingPlan:
         return jax.tree_util.tree_map_with_path(fn, params)
 
     # -------------------------------------------------------------- #
+    def grad_bytes(self, params: Any) -> float:
+        """fp32 gradient wire volume of one accumulation boundary (the
+        overlap auto-tuner's bucket-sizing input: grads are exchanged in
+        fp32 regardless of compute dtype).  Per-leaf sizing is shared with
+        the bucket planner so the two can never disagree."""
+        from ..overlap.bucketing import leaf_bytes
+
+        return float(sum(leaf_bytes(leaf)
+                         for leaf in jax.tree.leaves(params)))
+
+    def prefetch_shard_dim(self, path, leaf) -> Optional[int]:
+        """Which dim of a stage-3 param carries the ZeRO axes (None when
+        replicated/persistent) — the gather dimension the weight-prefetch
+        machinery (``runtime/overlap/prefetch.py``) rebuilds a full layer
+        group along."""
+        spec = self._sharded_spec(path, leaf)
+        zset = set(self.zero_axes)
+        for d, entry in enumerate(spec):
+            entries = entry if isinstance(entry, (tuple, list)) else (entry,)
+            if any(a in zset for a in entries if a is not None):
+                return d
+        return None
+
+    # -------------------------------------------------------------- #
     def param_shardings(self, params: Any) -> Any:
         mesh = self.topology.mesh
         return jax.tree.map(lambda s: NamedSharding(mesh, s), self.param_specs(params),

@@ -90,10 +90,11 @@ class DistributedAttention:
         except TypeError:           # unhashable extra args: don't cache
             cache_key, fn = None, None
         if fn is None:
-            fn = jax.jit(jax.shard_map(
+            from ..runtime.topology import compat_shard_map
+
+            fn = jax.jit(compat_shard_map(
                 body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
-                out_specs=io_spec, axis_names={self.sp_axis},
-                check_vma=False))
+                out_specs=io_spec, manual_axes={self.sp_axis}))
             if cache_key is not None:
                 self._jit_cache[cache_key] = fn
         return fn(query, key, value)

@@ -126,9 +126,11 @@ def ring_attention(query, key, value, causal: bool = True,
     cache_key = (mesh, sp_axis, causal, float(scale), sp)
     fn = _ring_jit_cache.get(cache_key)
     if fn is None:
-        fn = jax.jit(jax.shard_map(
+        from ..runtime.topology import compat_shard_map
+
+        fn = jax.jit(compat_shard_map(
             body, mesh=mesh, in_specs=(io_spec, io_spec, io_spec),
-            out_specs=io_spec, axis_names={sp_axis}, check_vma=False))
+            out_specs=io_spec, manual_axes={sp_axis}))
         _ring_jit_cache[cache_key] = fn
     return fn(query, key, value)
 

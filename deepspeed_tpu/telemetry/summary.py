@@ -149,19 +149,35 @@ def _metric_map(metrics: Sequence[Dict[str, Any]],
     return out
 
 
-def comm_table(metrics: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+def comm_table(metrics: Sequence[Dict[str, Any]],
+               device_kind: Optional[str] = None) -> List[Dict[str, Any]]:
     calls = _metric_map(metrics, "comm/calls")
     sizes = _metric_map(metrics, "comm/bytes")
     lats = _metric_map(metrics, "comm/latency_s")
     algbw = _metric_map(metrics, "comm/algbw_gbps")
     busbw = _metric_map(metrics, "comm/busbw_gbps")
     ranks = _metric_map(metrics, "comm/ranks")
+    # per-collective bandwidth roofline: achieved bus bandwidth vs the
+    # device kind's aggregate interconnect peak (profiling/roofline.py)
+    ici_peak_gbps = None
+    if device_kind:
+        try:
+            from ..profiling.roofline import interconnect_peak
+
+            peak = interconnect_peak(device_kind)
+            ici_peak_gbps = peak / 1e9 if peak > 0 else None
+        except Exception:  # noqa: BLE001 — table degrades, never dies
+            ici_peak_gbps = None
     ops = sorted({k for k in list(calls) + list(sizes)})
     rows = []
     for key in ops:
         op = dict(key).get("op", "?")
         size = sizes.get(key, {})
         lat = lats.get(key, {})
+        bus = busbw.get(key, {}).get("mean")
+        pct_peak = None
+        if bus and ici_peak_gbps:
+            pct_peak = 100.0 * float(bus) / ici_peak_gbps
         rows.append({
             "op": op,
             "calls": int(calls.get(key, {}).get("value", 0)),
@@ -171,11 +187,25 @@ def comm_table(metrics: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
             "latency_total_s": lat.get("sum", 0),
             "latency_mean_s": lat.get("mean", 0),
             "algbw_mean_gbps": algbw.get(key, {}).get("mean"),
-            "busbw_mean_gbps": busbw.get(key, {}).get("mean"),
+            "busbw_mean_gbps": bus,
+            "busbw_pct_peak": pct_peak,
+            "ici_peak_gbps": ici_peak_gbps,
             "ranks": ranks.get(key, {}).get("value"),
         })
     rows.sort(key=lambda r: r["bytes_total"] or 0, reverse=True)
     return rows
+
+
+def overlap_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """The ``overlap/*`` gauges (comm/compute overlap subsystem): exposed
+    comm fraction, deferred-reduction activity, bucket shape."""
+    out: Dict[str, Any] = {}
+    for m in metrics:
+        name = str(m.get("name", ""))
+        if name.startswith("overlap/"):
+            key = name.split("/", 1)[1]
+            out[key] = m.get("value", m.get("count"))
+    return out
 
 
 def memory_summary(metrics: Sequence[Dict[str, Any]],
@@ -261,14 +291,19 @@ def summarize_run(events_path: Optional[str],
                   trace_path: Optional[str] = None,
                   xprof_dir: Optional[str] = None) -> Dict[str, Any]:
     run = load_run(events_path, trace_path)
+    profile = profile_summary(run["events"], run["metrics"])
+    # device kind recorded by the roofline gauges keys the per-collective
+    # bandwidth roofline in the comm table
+    device_kind = (profile.get("roofline_gauges") or {}).get("device_kind")
     return {
         "sources": {"events": events_path, "trace": trace_path,
                     "xprof": xprof_dir},
         "runs_in_log": run["runs_in_log"],
         "n_spans": len(run["spans"]),
         "step_breakdown": step_breakdown(run["spans"]),
-        "comm": comm_table(run["metrics"]),
-        "profile": profile_summary(run["events"], run["metrics"]),
+        "comm": comm_table(run["metrics"], device_kind=device_kind),
+        "overlap": overlap_summary(run["metrics"]),
+        "profile": profile,
         "xprof": xprof_summary(run["events"], explicit_dir=xprof_dir),
         "memory": memory_summary(run["metrics"], run["events"]),
         "incidents": incident_summary(run["events"]),
@@ -306,19 +341,45 @@ def format_summary(s: Dict[str, Any]) -> str:
     add("--- communication ---")
     rows = s["comm"]
     if rows:
+        peak = next((r["ici_peak_gbps"] for r in rows
+                     if r.get("ici_peak_gbps")), None)
+        if peak:
+            add(f"interconnect peak: {peak:.0f} GB/s/chip (aggregate ICI; "
+                f"%peak = achieved busbw vs this)")
         add(f"{'op':<22}{'calls':>7}{'total':>12}{'mean msg':>12}"
-            f"{'lat(ms)':>10}{'algbw(GB/s)':>13}{'busbw(GB/s)':>13}")
+            f"{'lat(ms)':>10}{'algbw(GB/s)':>13}{'busbw(GB/s)':>13}"
+            f"{'%peak':>8}")
         for r in rows:
             alg = f"{r['algbw_mean_gbps']:.2f}" if r.get("algbw_mean_gbps") \
                 else "-"
             bus = f"{r['busbw_mean_gbps']:.2f}" if r.get("busbw_mean_gbps") \
                 else "-"
+            pct = f"{r['busbw_pct_peak']:.1f}%" \
+                if r.get("busbw_pct_peak") is not None else "-"
             add(f"{r['op']:<22}{r['calls']:>7}"
                 f"{_fmt_bytes(r['bytes_total'] or 0):>12}"
                 f"{_fmt_bytes(r['bytes_mean'] or 0):>12}"
-                f"{_fmt_ms(r['latency_mean_s'] or 0):>10}{alg:>13}{bus:>13}")
+                f"{_fmt_ms(r['latency_mean_s'] or 0):>10}{alg:>13}{bus:>13}"
+                f"{pct:>8}")
     else:
         add("(no collectives recorded)")
+    ov = s.get("overlap") or {}
+    if ov:
+        frac = ov.get("exposed_comm_fraction")
+        exposed = f"{float(frac) * 100:.1f}% of device time" \
+            if frac is not None else "n/a (no xprof capture)"
+        bits = [f"exposed comm: {exposed}"]
+        if ov.get("deferred") is not None:
+            steps = int(ov.get("deferred_steps") or 0)
+            bits.append(f"deferred reduction "
+                        f"{'on' if ov['deferred'] else 'off'}"
+                        f" ({steps} steps)")
+        if ov.get("bucket_count"):
+            bits.append(f"buckets {int(ov['bucket_count'])}"
+                        f" @ {_fmt_bytes(ov.get('bucket_bytes') or 0)} target")
+        if ov.get("prefetch_reuse"):
+            bits.append(f"prefetch reuse {int(ov['prefetch_reuse'])}")
+        add("overlap: " + " · ".join(bits))
     add("")
 
     add("--- performance attribution ---")

@@ -10,11 +10,11 @@ from deepspeed_tpu.runtime.topology import DATA, TopologyConfig, initialize_mesh
 
 
 def shard_map_over(mesh, in_specs, out_specs):
-    from jax import shard_map
+    from deepspeed_tpu.runtime.topology import compat_shard_map
 
     def deco(f):
-        return shard_map(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
-                         check_vma=False)
+        return compat_shard_map(f, mesh=mesh, in_specs=in_specs,
+                                out_specs=out_specs)
 
     return deco
 

@@ -1,0 +1,141 @@
+"""Agent-side checkpoint GC (keep-last-N valid tags): the newest verified
+tag and the committed 'latest' must never be deleted; invalid/torn
+directories are never touched (they may be an in-flight save).
+"""
+import json
+import os
+
+import pytest
+
+from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import \
+    OrbaxCheckpointEngine
+from deepspeed_tpu.runtime.fault.manifest import write_manifest
+
+pytestmark = pytest.mark.fault
+
+
+def _make_ckpt(root, tag, step, valid=True):
+    """A minimal sealed checkpoint directory (manifest-backed)."""
+    path = os.path.join(root, tag)
+    os.makedirs(os.path.join(path, "state"), exist_ok=True)
+    with open(os.path.join(path, "state", "shard0"), "w") as f:
+        f.write("x" * 16)
+    with open(os.path.join(path, "meta.json"), "w") as f:
+        json.dump({"step": step}, f)
+    if valid:
+        write_manifest(path, extra={"tag": tag, "step": step})
+    else:
+        # torn save: manifest promises a file that isn't there
+        write_manifest(path, extra={"tag": tag, "step": step})
+        os.unlink(os.path.join(path, "state", "shard0"))
+    return path
+
+
+class _Fault:
+    verify_checkpoints = True
+    checkpoint_keep_last = 2
+    max_retries = 0
+    retry_base_s = 0.0
+    retry_cap_s = 0.0
+    retry_jitter = 0.0
+
+
+class TestGcTags:
+    def test_keeps_last_n_valid(self, tmp_path):
+        root = str(tmp_path)
+        for i in range(5):
+            _make_ckpt(root, f"global_step{i}", i)
+        eng = OrbaxCheckpointEngine(root)
+        deleted = eng.gc_tags(keep_last=2)
+        assert sorted(deleted) == ["global_step0", "global_step1",
+                                   "global_step2"]
+        assert sorted(eng.all_tags()) == ["global_step3", "global_step4"]
+
+    def test_never_deletes_newest_valid_or_pointer(self, tmp_path):
+        root = str(tmp_path)
+        for i in range(4):
+            _make_ckpt(root, f"global_step{i}", i)
+        eng = OrbaxCheckpointEngine(root)
+        # pointer pinned to an OLD tag (e.g. rolled back manually)
+        eng.commit("global_step1")
+        deleted = eng.gc_tags(keep_last=1)
+        remaining = set(eng.all_tags())
+        assert "global_step3" in remaining        # newest valid: protected
+        assert "global_step1" in remaining        # pointer target: protected
+        assert "global_step0" in deleted and "global_step2" in deleted
+
+    def test_invalid_dirs_left_alone(self, tmp_path):
+        root = str(tmp_path)
+        for i in range(3):
+            _make_ckpt(root, f"global_step{i}", i)
+        _make_ckpt(root, "global_step99_torn", 99, valid=False)
+        eng = OrbaxCheckpointEngine(root)
+        eng.gc_tags(keep_last=1)
+        # the torn dir survives — it may be a concurrent in-flight save
+        assert "global_step99_torn" in eng.all_tags()
+
+    def test_zero_keep_last_never_deletes(self, tmp_path):
+        root = str(tmp_path)
+        for i in range(3):
+            _make_ckpt(root, f"global_step{i}", i)
+        eng = OrbaxCheckpointEngine(root)
+        assert eng.gc_tags(keep_last=0) == []
+        assert len(eng.all_tags()) == 3
+
+    def test_commit_triggers_gc_via_fault_config(self, tmp_path):
+        root = str(tmp_path)
+        for i in range(4):
+            _make_ckpt(root, f"global_step{i}", i)
+        eng = OrbaxCheckpointEngine(root, fault_config=_Fault())
+        eng.commit("global_step3")
+        # keep_last=2 → newest two valid tags survive, older ones go
+        assert sorted(eng.all_tags()) == ["global_step2", "global_step3"]
+
+    def test_history_pruned_of_tombstones(self, tmp_path):
+        root = str(tmp_path)
+        for i in range(4):
+            _make_ckpt(root, f"global_step{i}", i)
+        eng = OrbaxCheckpointEngine(root)
+        for i in range(4):
+            eng.commit(f"global_step{i}")
+        eng.gc_tags(keep_last=2)
+        committed = eng.committed_tags()
+        assert "global_step0" not in committed
+        # fallback scan still lands on a live tag
+        assert eng.latest_tag() == "global_step3"
+
+
+class TestAgentWiring:
+    def test_agent_gc_between_restarts(self, tmp_path):
+        from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+        root = str(tmp_path)
+        for i in range(5):
+            _make_ckpt(root, f"global_step{i}", i)
+        agent = DSElasticAgent(["true"], world_size=1, ckpt_dir=root,
+                               ckpt_keep_last=2)
+        agent._gc_checkpoints()
+        eng = OrbaxCheckpointEngine(root)
+        assert sorted(eng.all_tags()) == ["global_step3", "global_step4"]
+
+    def test_agent_gc_failure_never_raises(self, tmp_path):
+        from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+
+        agent = DSElasticAgent(["true"], world_size=1,
+                               ckpt_dir=str(tmp_path / "nonexistent" / "x"),
+                               ckpt_keep_last=2)
+        agent._gc_checkpoints()   # must swallow, not raise
+
+    def test_cli_flags_exist(self):
+        from deepspeed_tpu.elasticity import elastic_agent
+
+        import inspect
+
+        src = inspect.getsource(elastic_agent.main)
+        assert "--ckpt-keep-last" in src and "--ckpt-dir" in src
+
+    def test_fault_config_knob_parses(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        cfg = DeepSpeedConfig({"fault": {"checkpoint_keep_last": 3}})
+        assert cfg.fault.checkpoint_keep_last == 3
