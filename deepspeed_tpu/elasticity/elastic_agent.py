@@ -81,6 +81,10 @@ class DSElasticAgent:
         self.ckpt_dir = ckpt_dir
         self.ckpt_keep_last = int(ckpt_keep_last)
         self.restart_count = 0
+        #: exit code of the worker that killed the previous incarnation —
+        #: exported to restarted workers so their /healthz can report
+        #: "recovering (last failure exit:N)" instead of a bare "healthy"
+        self.last_failure_rc: Optional[int] = None
         self._procs: List[subprocess.Popen] = []
         self._shutdown = threading.Event()
 
@@ -100,6 +104,8 @@ class DSElasticAgent:
                 "COORDINATOR_ADDRESS": f"localhost:{port}",
                 "DSTPU_ELASTIC_RESTART_COUNT": str(self.restart_count),
             })
+            if self.last_failure_rc is not None:
+                env["DSTPU_ELASTIC_LAST_RC"] = str(self.last_failure_rc)
             procs.append(subprocess.Popen(self.cmd, env=env))
         logger.info(f"elastic agent: spawned {self.world_size} workers "
                     f"(restart {self.restart_count}, rendezvous :{port})")
@@ -197,6 +203,7 @@ class DSElasticAgent:
                         return 0
                     self._shutdown.wait(self.monitor_interval)
 
+                self.last_failure_rc = failed
                 logger.warning(
                     f"elastic agent: worker failed rc={failed} "
                     f"(restart {self.restart_count}/{self.max_restarts})")

@@ -302,6 +302,108 @@ class FaultConfig(DeepSpeedConfigModel):
     checkpoint_keep_last: int = 0
 
 
+class AnomalyConfig(DeepSpeedConfigModel):
+    """In-flight anomaly detection (``telemetry/live/anomaly.py``), wired
+    into the engine's post-step hook: a non-finite loss/grad-norm guard, a
+    loss-spike z-score against a rolling window, and a step-time regression
+    check against a rolling baseline.  Incidents emit structured ``anomaly``
+    events plus ``Anomaly/*`` metrics and run the configured ``action``.
+    Active whenever telemetry is enabled (the default ``log`` action only
+    records); needs no live server."""
+
+    enabled: bool = True
+    #: what an incident does beyond the event/metrics: "log" (nothing
+    #: more), "checkpoint" (verified-checkpoint commit via the fault
+    #: subsystem), or "abort" (checkpoint nothing, raise AnomalyAbort from
+    #: the training thread)
+    action: str = "log"
+    #: where action="checkpoint" saves (engine.save_checkpoint target)
+    checkpoint_dir: str = "anomaly_checkpoints"
+    #: rolling window of recent finite losses for the z-score baseline
+    loss_window: int = 64
+    #: z-score above which a loss spike fires
+    loss_zscore: float = 8.0
+    #: observations required before spike/regression checks arm
+    min_steps: int = 8
+    #: rolling window of step times for the regression baseline
+    step_time_window: int = 32
+    #: median of the newest ``step_time_recent`` steps must exceed
+    #: (1 + threshold) * baseline-median to flag a regression
+    step_time_threshold: float = 0.75
+    step_time_recent: int = 3
+    #: ignore step-time regressions while both medians sit under this many
+    #: seconds — millisecond-scale steps are host-noise territory
+    step_time_min_s: float = 0.05
+    #: steps an incident type stays silenced after firing (no restorms)
+    cooldown_steps: int = 16
+
+    @model_validator(mode="after")
+    def _check(self):
+        if self.action not in ("log", "checkpoint", "abort"):
+            raise ValueError(f"telemetry.live.anomaly.action must be "
+                             f"'log', 'checkpoint' or 'abort', "
+                             f"got {self.action!r}")
+        # a window smaller than the arming threshold would silently disable
+        # the check forever (the rolling deque can never reach min_steps)
+        if self.loss_window < self.min_steps:
+            raise ValueError(
+                f"telemetry.live.anomaly.loss_window ({self.loss_window}) "
+                f"must be >= min_steps ({self.min_steps}), or the "
+                f"loss-spike check can never arm")
+        need = self.min_steps + max(self.step_time_recent, 1) - 1
+        if self.step_time_window < need:
+            raise ValueError(
+                f"telemetry.live.anomaly.step_time_window "
+                f"({self.step_time_window}) must be >= min_steps + "
+                f"step_time_recent - 1 ({need}), or the step-time "
+                f"regression check can never arm")
+        return self
+
+
+class LiveTelemetryConfig(DeepSpeedConfigModel):
+    """Live observability plane (``telemetry/live/``): an in-process HTTP
+    server on host 0 serving ``/metrics`` (Prometheus), ``/healthz``,
+    ``/events`` (SSE tail) and ``/summary`` (the run digest, live), plus
+    cross-host snapshot pushes from non-zero hosts and the anomaly
+    detector block."""
+
+    enabled: bool = False
+    #: TCP port for the host-0 HTTP server (0 = pick a free port; the
+    #: chosen port is logged and exposed as engine._live_server.port)
+    port: int = 8790
+    #: bind address; 0.0.0.0 so other hosts can push/scrape
+    bind: str = "0.0.0.0"
+    #: where non-zero hosts push snapshots — "http://<host0>:<port>"
+    #: (default: DSTPU_LIVE_PUSH_URL env; unset disables pushing)
+    push_url: Optional[str] = None
+    #: seconds between cross-host snapshot pushes
+    push_interval_s: float = 10.0
+    #: SSE tail poll interval (seconds) for /events followers
+    sse_poll_s: float = 0.25
+    #: after an elastic restart, /healthz reports "recovering" until this
+    #: many steps complete in the new incarnation
+    recovered_after_steps: int = 3
+    #: /healthz reports "degraded" while the last anomaly is within this
+    #: many steps of the current one
+    degraded_window_steps: int = 16
+    anomaly: AnomalyConfig = Field(default_factory=AnomalyConfig)
+
+    @model_validator(mode="after")
+    def _check(self):
+        if not 0 <= self.port <= 65535:
+            raise ValueError(f"telemetry.live.port must be 0-65535, "
+                             f"got {self.port}")
+        # zero would turn the pusher / SSE-follower waits into busy-spins
+        # contending with the training thread for the registry/event locks
+        if self.push_interval_s <= 0:
+            raise ValueError(f"telemetry.live.push_interval_s must be > 0, "
+                             f"got {self.push_interval_s}")
+        if self.sse_poll_s <= 0:
+            raise ValueError(f"telemetry.live.sse_poll_s must be > 0, "
+                             f"got {self.sse_poll_s}")
+        return self
+
+
 class TelemetryConfig(DeepSpeedConfigModel):
     """Unified telemetry (``deepspeed_tpu/telemetry/``): span tracing,
     metrics registry, structured JSONL events, memory sampling.  Disabled by
@@ -327,6 +429,14 @@ class TelemetryConfig(DeepSpeedConfigModel):
     histogram_max_samples: int = 4096
     #: mirror spans into jax.profiler Trace/StepTraceAnnotation
     jax_annotations: bool = True
+    #: rotate events.jsonl past this size (MB; 0 = unbounded) — week-long
+    #: runs must not fill the disk; readers walk rotated segments in order
+    events_max_mb: float = 0.0
+    #: rotated segments kept (events.jsonl.1 is the newest rotated)
+    events_keep: int = 3
+    #: live observability plane (HTTP endpoints, cross-host pushes, anomaly
+    #: detection)
+    live: LiveTelemetryConfig = Field(default_factory=LiveTelemetryConfig)
 
 
 class AutotuningConfig(DeepSpeedConfigModel):

@@ -288,6 +288,19 @@ class DeepSpeedEngine:
             self._straggler = StragglerDetector.from_config(
                 pcfg, telemetry=self.telemetry)
 
+        # ---- live observability plane (config.telemetry.live) --------- #
+        # Host 0 serves /metrics /healthz /events /summary beside the
+        # training loop; non-zero hosts push compact snapshots to it; the
+        # anomaly detector rides _post_step_logging on every host.  All of
+        # it host-side — the server/pusher threads never touch device
+        # state (they read _last_logged_step, a host mirror).
+        self._anomaly = None
+        self._live_server = None
+        self._live_pusher = None
+        self._last_logged_step: Optional[int] = None
+        if self.telemetry is not None:
+            self._configure_live_plane(tcfg)
+
         log_dist(
             f"engine ready: zero_stage={self.zero_stage} dtype={self.compute_dtype.__name__} "
             f"mesh={self.topology.dims} batch={config.train_batch_size} "
@@ -373,6 +386,59 @@ class DeepSpeedEngine:
                       raise_on_timeout=fcfg.watchdog_raise)
         return wd.start()
 
+    def _configure_live_plane(self, tcfg) -> None:
+        """Anomaly detector + live HTTP server (host 0) + snapshot pusher
+        (non-zero hosts) from ``config.telemetry.live``.  A port clash or
+        bad push URL degrades to a warning — observability must never keep
+        a training job from starting."""
+        lcfg = getattr(tcfg, "live", None)
+        if lcfg is None:
+            return
+        from ..telemetry.live import (AnomalyDetector,
+                                      LiveObservabilityServer,
+                                      SnapshotPusher)
+
+        acfg = lcfg.anomaly
+        if acfg.enabled:
+            self._anomaly = AnomalyDetector.from_config(
+                acfg, telemetry=self.telemetry, action_target=self)
+        if not lcfg.enabled:
+            return
+        try:
+            host_id = jax.process_index()
+        except Exception:  # noqa: BLE001 — no distributed runtime yet
+            host_id = 0
+        step_fn = lambda: self._last_logged_step  # noqa: E731 — host mirror
+        if host_id == 0:
+            try:
+                self._live_server = LiveObservabilityServer.from_config(
+                    lcfg, self.telemetry, watchdog=self.watchdog,
+                    anomaly=self._anomaly, host_id=host_id, step_fn=step_fn,
+                    steps_this_process_fn=lambda: self._host_step_calls,
+                ).start()
+            except (OSError, OverflowError, ValueError) as e:
+                logger.warning(f"live observability server failed to bind "
+                               f"{lcfg.bind}:{lcfg.port}: {e!r}; live "
+                               f"endpoints disabled for this run")
+        else:
+            push_url = lcfg.push_url or os.environ.get("DSTPU_LIVE_PUSH_URL")
+            if push_url:
+                from ..telemetry.live import publish_elastic_gauges
+                from .fault.retry import RetryPolicy
+
+                # this host's restart state must ride its pushed snapshots
+                # (host 0 publishes its own at server start)
+                publish_elastic_gauges(self.telemetry.metrics)
+                self._live_pusher = SnapshotPusher(
+                    self.telemetry, push_url, host_id, step_fn=step_fn,
+                    interval_s=lcfg.push_interval_s,
+                    retry_policy=RetryPolicy.from_config(
+                        getattr(self.config, "fault", None))).start()
+            else:
+                logger.warning("telemetry.live enabled on a non-zero host "
+                               "with no push_url (or DSTPU_LIVE_PUSH_URL); "
+                               "this host's series stay local")
+
     def _heartbeat(self, phase: str, step: Optional[int] = None):
         """Watchdog ping.  ``step`` must be a HOST-side int callers already
         have — reading ``state.global_step`` here would force a device sync
@@ -403,6 +469,19 @@ class DeepSpeedEngine:
         if self.watchdog is not None:
             self.watchdog.stop()
             self.watchdog = None
+        if self._live_pusher is not None:
+            # final snapshot, single attempt (never raises): host 0 being
+            # gone is a common reason we're closing — don't burn the whole
+            # retry backoff budget blocking shutdown
+            self._live_pusher.push_now(retry=False)
+            self._live_pusher.stop()
+            self._live_pusher = None
+        if self._live_server is not None:
+            try:
+                self._live_server.stop()
+            except Exception as e:
+                logger.warning(f"live server stop failed: {e!r}")
+            self._live_server = None
         if self.monitor is not None:
             try:
                 self.monitor.flush()
@@ -797,6 +876,7 @@ class DeepSpeedEngine:
     def _post_step_logging(self, loss, batch):
         self._write_monitor_events(loss)
         step = self.global_steps
+        self._last_logged_step = step   # host mirror for the live plane
         self._heartbeat("idle", step=step)   # reuse the sync we just paid for
         if self.telemetry is not None:
             with self._span("telemetry/memory_sample"):
@@ -806,6 +886,20 @@ class DeepSpeedEngine:
             if dur > 0:
                 with self._span("profiling/straggler_check"):
                     self._straggler.observe_step(step, dur)
+        if self._anomaly is not None:
+            # non-finite guard / loss-spike z-score / step-time regression;
+            # action="abort" raises AnomalyAbort out of train_batch (by
+            # design — the elastic agent restarts from the last good tag)
+            dur = getattr(self.tput_timer, "last_step_time", 0.0)
+            lval = float(loss)
+            if self.loss_scaler.dynamic and not np.isfinite(lval):
+                # fp16 dynamic scaling overflows BY DESIGN: the scaler
+                # skipped the update and will self-heal — not an incident
+                # (same carve-out debug.nan_check documents above)
+                lval = None
+            with self._span("telemetry/anomaly_check"):
+                self._anomaly.observe(step, loss=lval,
+                                      step_time_s=dur if dur > 0 else None)
         if self.overlap.enabled:
             with self._span("overlap/on_step"):
                 self.overlap.on_step(self, self._deferred_active)

@@ -4,9 +4,12 @@ Enable via the ``telemetry`` config block (see ``runtime/config.py``):
 
     {"telemetry": {"enabled": true, "output_dir": "telemetry_out"}}
 
-then summarize a finished run with ``bin/dstpu-telemetry <output_dir>``.
+then summarize a finished run with ``bin/dstpu-telemetry <output_dir>``,
+compare it against bench history with ``dstpu-telemetry <run> --compare``,
+or watch it live via the ``telemetry.live`` HTTP plane
+(``deepspeed_tpu/telemetry/live/``).
 """
-from .events import EventLog, read_jsonl
+from .events import EventLog, read_event_segments, read_jsonl
 from .hub import (Telemetry, emit_event, get_telemetry, set_telemetry, span,
                   telemetry_enabled)
 from .memory import MemorySampler
@@ -16,6 +19,6 @@ from .trace import NULL_SPAN, SpanRecord, Tracer
 __all__ = [
     "Counter", "EventLog", "Gauge", "Histogram", "MemorySampler",
     "MetricsRegistry", "NULL_SPAN", "SpanRecord", "Telemetry", "Tracer",
-    "emit_event", "get_telemetry", "read_jsonl", "set_telemetry", "span",
-    "telemetry_enabled",
+    "emit_event", "get_telemetry", "read_event_segments", "read_jsonl",
+    "set_telemetry", "span", "telemetry_enabled",
 ]

@@ -9,15 +9,25 @@ Write-through semantics: events are flushed to disk as they are emitted
 (line-buffered + explicit flush) because the most interesting events are the
 ones right before a crash.  Event volume is low (per step / per incident,
 never per op dispatch), so durability wins over batching here.
+
+Disk growth is bounded: with ``max_bytes`` set, the log rotates logrotate-
+style (``events.jsonl`` → ``events.jsonl.1`` → ``.2`` …, keep-last-``keep``)
+so a week-long run can't fill the volume; :func:`read_event_segments` walks
+the rotated segments oldest-first so readers still see one ordered stream.
+
+The log also carries a monotonic cursor (events ever emitted) so live
+followers — the ``/events`` SSE endpoint — can poll for "everything since
+my last read" against the ring without re-reading the file.
 """
 from __future__ import annotations
 
 import collections
 import json
 import os
+import re
 import threading
 import time
-from typing import Any, Dict, Iterator, List, Optional
+from typing import Any, Dict, Iterator, List, Optional, Tuple
 
 
 def _jsonable(obj):
@@ -40,12 +50,19 @@ def _jsonable(obj):
 
 
 class EventLog:
-    def __init__(self, path: Optional[str] = None, max_memory: int = 10_000):
+    def __init__(self, path: Optional[str] = None, max_memory: int = 10_000,
+                 max_bytes: int = 0, keep: int = 3):
         self.path = path
+        #: rotate the JSONL past this many bytes (0 = never rotate)
+        self.max_bytes = int(max_bytes)
+        #: rotated segments retained (``.1`` newest … ``.keep`` oldest)
+        self.keep = max(int(keep), 1)
         self._lock = threading.Lock()
         self._ring: "collections.deque[Dict[str, Any]]" = collections.deque(
             maxlen=int(max_memory))
+        self._total = 0              # events ever emitted (SSE cursor)
         self._fh = None
+        self._closed = False         # close() is final; a lost fh is not
         if path:
             os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
             self._fh = open(path, "a", buffering=1)
@@ -56,13 +73,50 @@ class EventLog:
         rec.update(fields)
         with self._lock:
             self._ring.append(rec)
+            self._total += 1
+            if self._fh is None and self.path and not self._closed:
+                # the handle was lost (a rotation reopen failed on a full
+                # disk) — keep trying, conditions like ENOSPC clear
+                try:
+                    self._fh = open(self.path, "a", buffering=1)
+                except OSError:
+                    self._fh = None
             if self._fh is not None:
                 try:
                     self._fh.write(json.dumps(rec, default=_jsonable) + "\n")
                     self._fh.flush()
+                    if self.max_bytes and self._fh.tell() >= self.max_bytes:
+                        self._rotate_locked()
                 except (OSError, ValueError):
                     pass  # a full/closed disk must not kill the training loop
         return rec
+
+    def _rotate_locked(self) -> None:
+        """Shift ``path`` → ``path.1`` → … → ``path.keep`` (oldest dropped)
+        and reopen a fresh live file.  Caller holds the lock; every step is
+        best-effort, and a failed reopen leaves ``_fh = None`` for
+        :meth:`emit` to retry — rotation must never permanently kill the
+        crash-forensics log."""
+        try:
+            self._fh.close()
+        except (OSError, ValueError):
+            pass
+        self._fh = None
+        try:
+            oldest = f"{self.path}.{self.keep}"
+            if os.path.exists(oldest):
+                os.remove(oldest)
+            for i in range(self.keep - 1, 0, -1):
+                src = f"{self.path}.{i}"
+                if os.path.exists(src):
+                    os.replace(src, f"{self.path}.{i + 1}")
+            os.replace(self.path, f"{self.path}.1")
+        except OSError:
+            pass
+        try:
+            self._fh = open(self.path, "a", buffering=1)
+        except OSError:
+            self._fh = None          # emit() retries the reopen
 
     def recent(self, n: Optional[int] = None,
                kind: Optional[str] = None) -> List[Dict[str, Any]]:
@@ -71,6 +125,32 @@ class EventLog:
         if kind is not None:
             events = [e for e in events if e.get("kind") == kind]
         return events[-n:] if n else events
+
+    def cursor(self) -> int:
+        """Monotonic count of events ever emitted (for events_since)."""
+        with self._lock:
+            return self._total
+
+    def tail(self, n: int) -> Tuple[List[Dict[str, Any]], int]:
+        """The newest ``n`` ring events AND the cursor just past them, read
+        under one lock — an SSE follower replaying then following must not
+        see an event land between the two reads and get it twice."""
+        with self._lock:
+            ring = list(self._ring)
+            return (ring[-n:] if n else []), self._total
+
+    def events_since(self, cursor: int) -> Tuple[List[Dict[str, Any]], int]:
+        """Events emitted after ``cursor`` (a prior :meth:`cursor` /
+        ``events_since`` return) and the new cursor, read atomically.
+        Events older than the ring window are gone — a slow follower just
+        resumes from what's retained (it is a tail, not a replay log)."""
+        with self._lock:
+            total = self._total
+            n_new = total - int(cursor)
+            if n_new <= 0:
+                return [], total
+            ring = list(self._ring)
+            return ring[-min(n_new, len(ring)):], total
 
     def flush(self) -> None:
         with self._lock:
@@ -83,6 +163,7 @@ class EventLog:
 
     def close(self) -> None:
         with self._lock:
+            self._closed = True
             if self._fh is not None:
                 try:
                     self._fh.flush()
@@ -104,3 +185,29 @@ def read_jsonl(path: str) -> Iterator[Dict[str, Any]]:
                 yield json.loads(line)
             except json.JSONDecodeError:
                 continue
+
+
+def event_segments(path: str) -> List[str]:
+    """All on-disk segments of a (possibly rotated) event log, oldest first:
+    ``path.N`` … ``path.2``, ``path.1``, then the live ``path``."""
+    out: List[str] = []
+    d = os.path.dirname(os.path.abspath(path)) or "."
+    base = os.path.basename(path)
+    pat = re.compile(re.escape(base) + r"\.(\d+)$")
+    if os.path.isdir(d):
+        rotated = []
+        for fn in os.listdir(d):
+            m = pat.match(fn)
+            if m:
+                rotated.append((int(m.group(1)), os.path.join(d, fn)))
+        out.extend(p for _, p in sorted(rotated, reverse=True))
+    if os.path.exists(path):
+        out.append(path)
+    return out
+
+
+def read_event_segments(path: str) -> Iterator[Dict[str, Any]]:
+    """Like :func:`read_jsonl`, but across rotation: yields the full ordered
+    stream from every retained segment (oldest rotated file first)."""
+    for seg in event_segments(path):
+        yield from read_jsonl(seg)

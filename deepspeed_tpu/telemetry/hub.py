@@ -34,7 +34,8 @@ class Telemetry:
                  chrome_trace: bool = True, prometheus: bool = True,
                  fence: bool = False, memory_interval: int = 1,
                  max_spans: int = 100_000, histogram_max_samples: int = 4096,
-                 jax_annotations: bool = True):
+                 jax_annotations: bool = True, events_max_mb: float = 0.0,
+                 events_keep: int = 3):
         self.output_dir = os.path.abspath(output_dir)
         self.chrome_trace = bool(chrome_trace)
         self.prometheus = bool(prometheus)
@@ -45,7 +46,9 @@ class Telemetry:
         self.metrics = MetricsRegistry(
             histogram_max_samples=histogram_max_samples)
         self.events = EventLog(
-            path=os.path.join(self.output_dir, EVENTS_FILE) if jsonl else None)
+            path=os.path.join(self.output_dir, EVENTS_FILE) if jsonl else None,
+            max_bytes=int(float(events_max_mb) * 1024 * 1024),
+            keep=events_keep)
         self.memory = MemorySampler(self.metrics, self.events,
                                     interval=memory_interval)
         self._flush_lock = threading.Lock()
@@ -70,6 +73,8 @@ class Telemetry:
             max_spans=tcfg.max_spans,
             histogram_max_samples=tcfg.histogram_max_samples,
             jax_annotations=tcfg.jax_annotations,
+            events_max_mb=getattr(tcfg, "events_max_mb", 0.0),
+            events_keep=getattr(tcfg, "events_keep", 3),
         )
 
     # ---------------------------------------------------------------- #

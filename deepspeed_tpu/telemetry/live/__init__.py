@@ -1,0 +1,23 @@
+"""Live observability plane: in-flight HTTP endpoints, cross-host
+aggregation, and anomaly detection over the telemetry hub.
+
+Enable via ``telemetry.live`` (see ``runtime/config.py``):
+
+    {"telemetry": {"enabled": true,
+                   "live": {"enabled": true, "port": 8790}}}
+
+then, during the run:  ``curl :8790/healthz`` / ``/metrics`` / ``/summary``
+or ``curl -N :8790/events`` for the SSE tail.
+"""
+from .aggregator import (CrossHostAggregator, SnapshotPusher,
+                         collect_snapshot, push_snapshot)
+from .anomaly import AnomalyAbort, AnomalyDetector
+from .server import (LiveObservabilityServer, elastic_state_from_env,
+                     health_report, live_summary, publish_elastic_gauges)
+
+__all__ = [
+    "AnomalyAbort", "AnomalyDetector", "CrossHostAggregator",
+    "LiveObservabilityServer", "SnapshotPusher", "collect_snapshot",
+    "elastic_state_from_env", "health_report", "live_summary",
+    "publish_elastic_gauges", "push_snapshot",
+]

@@ -1,0 +1,313 @@
+"""Cross-host snapshot aggregation for the live observability plane.
+
+A pod-scale run has one live server (host 0) but N hosts' worth of health:
+each non-zero host periodically pushes a *compact* snapshot — label-free
+gauge values, incident-counter totals, its last completed step — over plain
+HTTP to host 0's ``/push`` endpoint.  The push rides the fault subsystem's
+``@retryable`` backoff (a flaky NIC or a server mid-restart is exactly the
+transient the policy exists for) and never touches the collective path: a
+host that can't push trains on; its series just go stale, which the
+aggregator surfaces as ``live/push_age_s``.
+
+Host 0 folds the snapshots into ``/metrics`` as ``host``-labelled series
+(``cluster_<name>{host="N"}``, kept apart from host 0's own unlabelled
+series so the two can never merge into one stream) plus the cross-host step
+skew — the live analogue of the offline straggler detector's signal.
+"""
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Any, Callable, Dict, List, Optional
+
+from ...runtime.fault.retry import RetryPolicy, retryable
+from ...utils.logging import logger
+from ..events import _jsonable
+from ..metrics import _prom_name
+
+#: pushed restart reasons land in a Prometheus label on host 0 — strip
+#: anything that could break exposition quoting, cap the length (the
+#: legitimate vocabulary is "exit:N" / "signal:N")
+_REASON_SAFE = re.compile(r"[^A-Za-z0-9_:. \-]")
+
+#: counters whose totals ride every snapshot (the incident digest)
+INCIDENT_COUNTERS = ("fault/events", "anomaly/events", "straggler/events")
+
+
+def collect_snapshot(telemetry, host_id: int,
+                     step: Optional[int] = None) -> Dict[str, Any]:
+    """One host's compact push payload: label-free gauges (labelled series
+    are usually high-cardinality per-op detail — the pod view wants health,
+    not a full mirror), incident totals, and the last completed step."""
+    # gauge_values, not the full snapshot(): this runs every push interval
+    # beside the training thread, and snapshot() sorts every histogram
+    # reservoir under the registry lock only for the rows to be discarded
+    gauges: Dict[str, float] = telemetry.metrics.gauge_values()
+    counters: Dict[str, float] = {}
+    for name in INCIDENT_COUNTERS:
+        m = telemetry.metrics.get(name)
+        if m is not None and hasattr(m, "total"):
+            counters[name] = m.total()
+    snap: Dict[str, Any] = {"host": int(host_id), "ts": time.time(),
+                            "step": step, "gauges": gauges,
+                            "counters": counters}
+    # the restart REASON lives in a labelled gauge (which the label-free
+    # filter above drops) — ride it as a dedicated field so host 0 can
+    # still show WHY this host's last incarnation died
+    from .server import elastic_state_from_env
+
+    state = elastic_state_from_env()
+    if state["last_failure"] is not None:
+        snap["elastic"] = state
+    return snap
+
+
+class CrossHostAggregator:
+    """Latest-snapshot-per-host store behind the host-0 server.
+
+    ``local_host`` is the serving host's own id: a push claiming it is
+    rejected, or an unauthenticated POST could override host 0's locally
+    observed step/series and fabricate (or mask) a straggler signal.
+
+    Retention is bounded: snapshots are kept per host id forever (that is
+    the point — a host that stops pushing must stay visible as stale), so
+    without ``max_hosts``/``max_series_per_push`` caps a pusher cycling
+    through fabricated host ids or gauge names could grow host 0's RSS and
+    /metrics cardinality without limit.  Over-cap pushes are rejected (a
+    400, like any other malformed snapshot); known hosts always update in
+    place."""
+
+    def __init__(self, local_host: Optional[int] = None,
+                 max_hosts: int = 1024, max_series_per_push: int = 512):
+        self.local_host = local_host
+        self.max_hosts = int(max_hosts)
+        self.max_series_per_push = int(max_series_per_push)
+        self._lock = threading.Lock()
+        self._hosts: Dict[int, Dict[str, Any]] = {}
+
+    def ingest(self, snapshot: Dict[str, Any]) -> None:
+        """Validate-and-store.  The /push endpoint is an unauthenticated
+        HTTP surface: one malformed value accepted here would make every
+        subsequent /metrics render raise, so non-numeric gauges/counters
+        are dropped and a bad step/host is a rejection, not a 500 factory."""
+        if not isinstance(snapshot, dict):
+            raise ValueError(f"snapshot must be a JSON object, "
+                             f"got {type(snapshot).__name__}")
+        host = int(snapshot.get("host", -1))
+        if host < 0:
+            raise ValueError(f"snapshot missing a valid host id: "
+                             f"{snapshot.get('host')!r}")
+        if self.local_host is not None and host == self.local_host:
+            raise ValueError(f"snapshot claims the serving host's own id "
+                             f"{host}; pushes must carry the sender's")
+        step = snapshot.get("step")
+        clean: Dict[str, Any] = {
+            "host": host,
+            "step": int(step) if isinstance(step, (int, float)) else None,
+            "ts": float(snapshot["ts"])
+            if isinstance(snapshot.get("ts"), (int, float)) else time.time(),
+            "received_ts": time.time(),
+        }
+        for section in ("gauges", "counters"):
+            raw = snapshot.get(section)
+            clean[section] = {
+                str(k): float(v) for k, v in raw.items()
+                if isinstance(v, (int, float))
+            } if isinstance(raw, dict) else {}
+            if len(clean[section]) > self.max_series_per_push:
+                raise ValueError(
+                    f"snapshot {section} carries {len(clean[section])} "
+                    f"series (cap {self.max_series_per_push}); a compact "
+                    f"health push should be far smaller")
+        el = snapshot.get("elastic")
+        if isinstance(el, dict) and isinstance(el.get("last_failure"), str):
+            clean["elastic"] = {
+                "restart_count": int(el["restart_count"])
+                if isinstance(el.get("restart_count"), (int, float)) else 0,
+                "last_failure":
+                    _REASON_SAFE.sub("_", el["last_failure"])[:64],
+            }
+        with self._lock:
+            if host not in self._hosts and \
+                    len(self._hosts) >= self.max_hosts:
+                raise ValueError(
+                    f"aggregator already tracks {self.max_hosts} hosts; "
+                    f"rejecting new host id {host}")
+            self._hosts[host] = clean
+
+    def hosts(self) -> List[int]:
+        with self._lock:
+            return sorted(self._hosts)
+
+    def snapshots(self) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [self._hosts[h] for h in sorted(self._hosts)]
+
+    # ---------------------------------------------------------------- #
+    def step_skew(self, local_step: Optional[int] = None,
+                  local_host: int = 0) -> Dict[str, Any]:
+        """Per-host last-step table and the max-min spread: on a healthy
+        pod every host pushes roughly the same step; a widening spread means
+        one host is stalled/restarting while its peers wait in collectives."""
+        steps: Dict[int, int] = {}
+        if local_step is not None:
+            steps[int(local_host)] = int(local_step)
+        for snap in self.snapshots():
+            if snap.get("step") is not None:
+                steps[int(snap["host"])] = int(snap["step"])
+        out: Dict[str, Any] = {"per_host": {str(h): s
+                                            for h, s in sorted(steps.items())}}
+        if steps:
+            out["skew"] = max(steps.values()) - min(steps.values())
+        return out
+
+    def prometheus_lines(self, local_step: Optional[int] = None,
+                         local_host: int = 0) -> List[str]:
+        """``host``-labelled exposition lines appended to host 0's own
+        ``/metrics`` rendering."""
+        now = time.time()
+        lines: List[str] = []
+        if local_step is not None:
+            # host 0's own step rides the same series as its peers' — a
+            # per-host dashboard/alert must be able to see the serving
+            # host stall too
+            lines.append(f'live_host_step{{host="{int(local_host)}"}} '
+                         f'{int(local_step)}')
+        for snap in self.snapshots():
+            h = snap["host"]
+            for name, value in sorted(snap.get("gauges", {}).items()):
+                lines.append(
+                    f'cluster_{_prom_name(name)}{{host="{h}"}} {value:g}')
+            for name, value in sorted(snap.get("counters", {}).items()):
+                lines.append(
+                    f'cluster_{_prom_name(name)}{{host="{h}"}} {value:g}')
+            if snap.get("step") is not None:
+                lines.append(f'live_host_step{{host="{h}"}} '
+                             f'{int(snap["step"])}')
+            el = snap.get("elastic")
+            if el and el.get("last_failure"):
+                lines.append(
+                    f'cluster_elastic_last_restart{{host="{h}",'
+                    f'reason="{el["last_failure"]}"}} 1')
+            age = now - float(snap.get("received_ts", now))
+            lines.append(f'live_push_age_s{{host="{h}"}} {age:g}')
+        skew = self.step_skew(local_step=local_step, local_host=local_host)
+        if "skew" in skew:
+            lines.append(f'live_step_skew {skew["skew"]}')
+        return lines
+
+
+# ------------------------------------------------------------------- #
+# Push side (non-zero hosts)
+# ------------------------------------------------------------------- #
+def push_snapshot(url: str, snapshot: Dict[str, Any],
+                  timeout_s: float = 5.0) -> None:
+    """POST one snapshot to host 0's ``/push`` (single attempt —
+    :class:`SnapshotPusher` wraps this in ``@retryable``).
+    ``urllib.error.URLError`` subclasses ``OSError``, so the fault
+    subsystem's default retry-on set covers it; a 4xx rejection is
+    re-raised as ValueError so a deterministic misconfiguration (e.g. a
+    host-id clash) fails fast instead of burning the whole backoff budget
+    every push interval."""
+    # _jsonable (the event log's encoder) turns numpy scalars into real
+    # JSON numbers; default=str would stringify them and ingest's numeric
+    # filter on host 0 would then silently drop the series
+    body = json.dumps(snapshot, default=_jsonable).encode()
+    req = urllib.request.Request(
+        url.rstrip("/") + "/push", data=body,
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as resp:
+            resp.read()
+    except urllib.error.HTTPError as e:
+        if 400 <= e.code < 500:
+            raise ValueError(
+                f"push rejected by {url}: HTTP {e.code} {e.reason}") from e
+        raise          # 5xx: the server may recover — stays retryable
+
+
+class SnapshotPusher:
+    """Daemon thread on every non-zero host: every ``interval_s`` collect a
+    compact snapshot and push it.  Exhausted retries are counted
+    (``live/push_failures``) and skipped — the next interval tries again;
+    observability must never take the training loop down with it."""
+
+    def __init__(self, telemetry, url: str, host_id: int,
+                 step_fn: Optional[Callable[[], Optional[int]]] = None,
+                 interval_s: float = 10.0,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 timeout_s: float = 5.0):
+        self.telemetry = telemetry
+        self.url = url
+        self.host_id = int(host_id)
+        self.step_fn = step_fn
+        self.interval_s = float(interval_s)
+        #: consulted by @retryable via the policy_attr seam (_push is a
+        #: bound method, args[0] is this instance) — config.fault shapes
+        #: the backoff exactly as it does for checkpoint I/O
+        self.retry_policy = retry_policy or RetryPolicy.from_env()
+        self.timeout_s = float(timeout_s)
+        self.pushed = 0
+        self.failures = 0
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+
+    def push_now(self, retry: bool = True) -> bool:
+        """One collect+push cycle; True on success.  Public so tests (and a
+        final flush on close) can push without waiting out the interval.
+        ``retry=False`` makes it a single attempt — the final push in
+        ``engine.close()`` must not serially burn the whole backoff budget
+        (tens of seconds) when host 0 is the reason the job is shutting
+        down."""
+        step = None
+        if self.step_fn is not None:
+            try:
+                step = self.step_fn()
+            except Exception:  # noqa: BLE001 — a step probe must not stop pushes
+                step = None
+        snapshot = collect_snapshot(self.telemetry, self.host_id, step=step)
+        try:
+            if retry:
+                self._push(snapshot)
+            else:
+                push_snapshot(self.url, snapshot, timeout_s=self.timeout_s)
+        except Exception as e:  # noqa: BLE001 — retries exhausted; see docstring
+            self.failures += 1
+            self.telemetry.metrics.counter("live/push_failures").inc()
+            logger.warning(
+                f"live snapshot push to {self.url} failed"
+                f"{' (attempt budget exhausted)' if retry else ''}: {e!r}")
+            return False
+        self.pushed += 1
+        return True
+
+    @retryable(op_name="live_push")
+    def _push(self, snapshot: Dict[str, Any]) -> None:
+        push_snapshot(self.url, snapshot, timeout_s=self.timeout_s)
+
+    def _run(self) -> None:
+        # push-then-wait: a freshly (re)started host must land on host 0's
+        # /metrics immediately, not one full interval later — right after
+        # an elastic restart is exactly when an operator is watching
+        while True:
+            self.push_now()
+            if self._stop.wait(self.interval_s):
+                return
+
+    def start(self) -> "SnapshotPusher":
+        if self._thread is None:
+            self._stop.clear()
+            self._thread = threading.Thread(
+                target=self._run, name="dstpu-live-pusher", daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)

@@ -1,0 +1,455 @@
+"""Live observability HTTP plane: stdlib server over the telemetry hub.
+
+One ``ThreadingHTTPServer`` (no dependencies beyond the stdlib) runs on
+host 0 beside the training loop and serves the run *while it is running*:
+
+  * ``GET /metrics``  — Prometheus text exposition: host 0's registry plus
+    the ``host``-labelled series aggregated from non-zero hosts' pushes and
+    the cross-host step skew;
+  * ``GET /healthz``  — machine-checkable liveness: watchdog heartbeat age,
+    last completed step, incident counts, elastic restart state.  Status is
+    ``healthy`` / ``recovering`` / ``degraded`` / ``hung``; anything but
+    ``healthy`` answers HTTP 503 so a dumb prober (k8s, a load balancer, a
+    cron curl) needs zero JSON parsing;
+  * ``GET /events``   — Server-Sent-Events tail of the structured event
+    stream (replay of the newest ring entries, then live follow);
+  * ``GET /summary``  — the ``dstpu-telemetry`` digest computed from live
+    in-memory state (spans, metrics, events), no flush required;
+  * ``POST /push``    — ingest endpoint for non-zero hosts' snapshots
+    (see ``aggregator.py``).
+
+Everything here is read-mostly and already thread-safe underneath (registry
+lock, tracer lock, event-log lock + cursor), so request handlers never
+block the training thread beyond those short critical sections.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+from urllib.parse import parse_qs, urlparse
+
+from ...utils.logging import logger
+from ..events import _jsonable
+from .aggregator import INCIDENT_COUNTERS, CrossHostAggregator
+
+#: /healthz statuses, in decreasing severity
+STATUS_HUNG = "hung"
+STATUS_RECOVERING = "recovering"
+STATUS_DEGRADED = "degraded"
+STATUS_HEALTHY = "healthy"
+
+
+def elastic_state_from_env() -> Dict[str, Any]:
+    """Elastic-agent restart breadcrumbs the agent leaves in the worker
+    env: how many times this gang has been restarted and why the last
+    incarnation died.  Absent env (no agent) reads as a fresh gang."""
+    try:
+        restarts = int(os.environ.get("DSTPU_ELASTIC_RESTART_COUNT", 0))
+    except ValueError:
+        restarts = 0
+    last_rc = os.environ.get("DSTPU_ELASTIC_LAST_RC")
+    reason = None
+    if last_rc is not None:
+        try:
+            rc = int(last_rc)
+            reason = f"signal:{-rc}" if rc < 0 else f"exit:{rc}"
+        except ValueError:
+            reason = str(last_rc)
+    return {"restart_count": restarts, "last_failure": reason}
+
+
+def publish_elastic_gauges(metrics) -> Dict[str, Any]:
+    """Mirror the elastic restart state into the registry so ``/metrics``
+    (and pushed snapshots) carry it: a scrape can distinguish 'recovering
+    after restart 2' from 'healthy since boot' without hitting /healthz."""
+    state = elastic_state_from_env()
+    metrics.gauge("elastic/restart_count").set(state["restart_count"])
+    if state["last_failure"] is not None:
+        # exactly one reason series carries 1 — zero any stale labelset
+        # first (a gang that died as exit:1 then signal:9 must not expose
+        # both as "last")
+        g = metrics.gauge("elastic/last_restart")
+        for key in g.labelsets():
+            g.set(0, **dict(key))
+        g.set(1, reason=state["last_failure"])
+    return state
+
+
+def health_report(telemetry, watchdog=None, anomaly=None,
+                  step_fn: Optional[Callable[[], Optional[int]]] = None,
+                  steps_this_process_fn: Optional[Callable[[], int]] = None,
+                  aggregator: Optional[CrossHostAggregator] = None,
+                  recovered_after_steps: int = 3,
+                  degraded_window_steps: int = 16) -> Dict[str, Any]:
+    """The /healthz body.  Also usable headless (tests, a debugger)."""
+    wd = watchdog.dump() if watchdog is not None else None
+    elastic = elastic_state_from_env()
+    last_step = None
+    if step_fn is not None:
+        try:
+            last_step = step_fn()
+        except Exception:  # noqa: BLE001 — health must render regardless
+            last_step = None
+    if last_step is None and wd is not None:
+        last_step = wd.get("step")
+
+    incidents: Dict[str, float] = {}
+    m = telemetry.metrics
+    for name in INCIDENT_COUNTERS:
+        metric = m.get(name)
+        if metric is not None and hasattr(metric, "total"):
+            incidents[name] = metric.total()
+    if wd is not None:
+        incidents["watchdog_timeouts"] = wd.get("timeouts", 0)
+
+    reasons = []
+    status = STATUS_HEALTHY
+    # Mirror the watchdog's own semantics: a parked run (phase 'idle'
+    # between steps / 'init' before the first) is quiet, not hung — only an
+    # *active* phase past the deadline means a stuck collective/step.
+    quiet = tuple(getattr(watchdog, "quiet_phases", ("init", "idle")))
+    if wd is not None and wd.get("phase") not in quiet and \
+            wd.get("last_heartbeat_age_s", 0) > wd.get(
+                "deadline_s", float("inf")):
+        status = STATUS_HUNG
+        reasons.append(
+            f"no heartbeat for {wd['last_heartbeat_age_s']}s "
+            f"(deadline {wd['deadline_s']}s), phase={wd.get('phase')!r}")
+    elif elastic["restart_count"] > 0 and steps_this_process_fn is not None \
+            and steps_this_process_fn() < recovered_after_steps:
+        status = STATUS_RECOVERING
+        reasons.append(
+            f"restart {elastic['restart_count']} "
+            f"(last failure {elastic['last_failure']}), "
+            f"{steps_this_process_fn()} step(s) into the new incarnation")
+    elif anomaly is not None and anomaly.last_incident_step is not None \
+            and last_step is not None \
+            and last_step - anomaly.last_incident_step <= degraded_window_steps:
+        status = STATUS_DEGRADED
+        reasons.append(
+            f"anomaly {anomaly.last_incident_type!r} at step "
+            f"{anomaly.last_incident_step} (now {last_step})")
+
+    out: Dict[str, Any] = {
+        "status": status,
+        "reasons": reasons,
+        "last_step": last_step,
+        "incidents": incidents,
+        "elastic": elastic,
+        "ts": time.time(),
+    }
+    if wd is not None:
+        out["watchdog"] = wd
+    if aggregator is not None:
+        out["step_skew"] = aggregator.step_skew(local_step=last_step)
+    return out
+
+
+def live_summary(telemetry, xprof: bool = False) -> Dict[str, Any]:
+    """The ``dstpu-telemetry`` digest from *live* in-memory state: tracer
+    ring spans, current registry snapshot, event ring.  Exactly the offline
+    sections, minus the xprof parse (off by default — reading a trace dir
+    mid-run is slow and the breadcrumb may not exist yet)."""
+    from ..summary import (comm_table, incident_summary, memory_summary,
+                           overlap_summary, profile_summary, step_breakdown)
+
+    records, total_spans = telemetry.tracer.snapshot()
+    spans = [r.to_dict() for r in records]
+    metrics = telemetry.metrics.snapshot()
+    events = telemetry.events.recent()
+    profile = profile_summary(events, metrics)
+    device_kind = (profile.get("roofline_gauges") or {}).get("device_kind")
+    out = {
+        "live": True,
+        "n_spans": total_spans,
+        "step_breakdown": step_breakdown(spans),
+        "comm": comm_table(metrics, device_kind=device_kind),
+        "overlap": overlap_summary(metrics),
+        "profile": profile,
+        "memory": memory_summary(metrics, events),
+        "incidents": incident_summary(events),
+    }
+    if xprof:
+        from ..summary import xprof_summary
+
+        out["xprof"] = xprof_summary(events)
+    return out
+
+
+# ------------------------------------------------------------------- #
+class _LiveHandler(BaseHTTPRequestHandler):
+    """One request handler; all state lives on ``self.server`` (the
+    ThreadingHTTPServer subclass below)."""
+
+    server_version = "dstpu-live/1"
+    protocol_version = "HTTP/1.1"
+    #: set once an SSE response's headers are on the wire — after that a
+    #: 500 would inject a second HTTP response mid-stream
+    _streaming = False
+
+    # BaseHTTPRequestHandler prints to stderr by default — route to the
+    # rank-aware logger at debug level (a scrape per second is noise).
+    def log_message(self, format, *args):  # noqa: A002 — stdlib signature
+        logger.debug("live-server: " + format % args)
+
+    # ---------------------------------------------------------------- #
+    def _send(self, code: int, body: bytes, content_type: str) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj: Any) -> None:
+        self._send(code, json.dumps(obj, default=_jsonable,
+                                    sort_keys=True).encode() + b"\n",
+                   "application/json")
+
+    # ---------------------------------------------------------------- #
+    def do_GET(self):  # noqa: N802 — stdlib hook name
+        url = urlparse(self.path)
+        try:
+            if url.path == "/metrics":
+                self._get_metrics()
+            elif url.path == "/healthz":
+                self._get_healthz()
+            elif url.path == "/events":
+                self._get_events(parse_qs(url.query))
+            elif url.path == "/summary":
+                self._get_summary(parse_qs(url.query))
+            elif url.path == "/":
+                self._send_json(200, {"endpoints": [
+                    "/metrics", "/healthz", "/events", "/summary"]})
+            else:
+                self._send_json(404, {"error": f"unknown path {url.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass  # client went away; nothing to answer
+        except Exception as e:  # noqa: BLE001 — a handler bug must not 500 silently
+            logger.warning(f"live-server {url.path} failed: {e!r}")
+            if self._streaming:
+                # the SSE response is already mid-flight; just drop the
+                # connection instead of corrupting the stream
+                self.close_connection = True
+                return
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except (OSError, ValueError):
+                pass
+
+    def do_POST(self):  # noqa: N802 — stdlib hook name
+        url = urlparse(self.path)
+        try:
+            if url.path == "/push":
+                self._post_push()
+            else:
+                self._send_json(404, {"error": f"unknown path {url.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"live-server {url.path} failed: {e!r}")
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except (OSError, ValueError):
+                pass
+
+    # ---------------------------------------------------------------- #
+    def _get_metrics(self) -> None:
+        srv = self.server
+        text = srv.telemetry.metrics.prometheus_text()
+        extra = srv.aggregator.prometheus_lines(
+            local_step=srv.last_step(), local_host=srv.host_id)
+        if extra:
+            text += "\n".join(extra) + "\n"
+        self._send(200, text.encode(), "text/plain; version=0.0.4")
+
+    def _get_healthz(self) -> None:
+        srv = self.server
+        report = health_report(
+            srv.telemetry, watchdog=srv.watchdog, anomaly=srv.anomaly,
+            step_fn=srv.last_step,
+            steps_this_process_fn=srv.steps_this_process,
+            aggregator=srv.aggregator,
+            recovered_after_steps=srv.recovered_after_steps,
+            degraded_window_steps=srv.degraded_window_steps)
+        code = 200 if report["status"] == STATUS_HEALTHY else 503
+        self._send_json(code, report)
+
+    def _get_summary(self, query: Dict[str, Any]) -> None:
+        xprof = query.get("xprof", ["0"])[0] not in ("0", "false", "")
+        self._send_json(200, live_summary(self.server.telemetry,
+                                          xprof=xprof))
+
+    def _post_push(self) -> None:
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0 or length > 4 * 1024 * 1024:
+            self._send_json(400, {"error": "missing/oversized body"})
+            return
+        try:
+            snapshot = json.loads(self.rfile.read(length))
+            self.server.aggregator.ingest(snapshot)
+        except (ValueError, TypeError, AttributeError) as e:
+            self._send_json(400, {"error": repr(e)})
+            return
+        self._send_json(200, {"ok": True,
+                              "hosts": self.server.aggregator.hosts()})
+
+    # ---------------------------------------------------------------- #
+    def _get_events(self, query: Dict[str, Any]) -> None:
+        """SSE tail: replay the newest ``replay`` ring events, then follow
+        the cursor until the client disconnects, ``max`` new events arrive,
+        or the server stops.  ``follow=0`` closes right after the replay
+        (curl-able without hanging a terminal)."""
+        srv = self.server
+        log = srv.telemetry.events
+
+        def _qint(name: str, default: int) -> int:
+            try:
+                return int(query.get(name, [default])[0])
+            except (ValueError, TypeError):
+                return default
+
+        replay = max(_qint("replay", 25), 0)
+        follow = query.get("follow", ["1"])[0] not in ("0", "false", "")
+        max_new = _qint("max", 0)          # 0 = unbounded
+
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        # an SSE stream has no length; hand-managed connection close
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self._streaming = True
+
+        replayed, cursor = log.tail(replay)   # atomic: no dup into follow
+        for rec in replayed:
+            self._write_sse(rec)
+        sent_new = 0
+        while follow and not srv.stopping.is_set():
+            fresh, cursor = log.events_since(cursor)
+            for rec in fresh:
+                self._write_sse(rec)
+                sent_new += 1
+                if max_new and sent_new >= max_new:
+                    return
+            if fresh:
+                self.wfile.flush()
+            if srv.stopping.wait(srv.sse_poll_s):
+                return
+
+    def _write_sse(self, rec: Dict[str, Any]) -> None:
+        payload = json.dumps(rec, default=_jsonable)
+        self.wfile.write(f"event: {rec.get('kind', 'event')}\n"
+                         f"data: {payload}\n\n".encode())
+
+
+class _LiveHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True          # SSE followers must not block exit
+    allow_reuse_address = True
+
+    # typed refs filled by LiveObservabilityServer.start()
+    telemetry = None
+    aggregator: CrossHostAggregator = None
+    watchdog = None
+    anomaly = None
+    host_id = 0
+    last_step: Callable[[], Optional[int]] = staticmethod(lambda: None)
+    steps_this_process: Callable[[], int] = staticmethod(lambda: 0)
+    recovered_after_steps = 3
+    degraded_window_steps = 16
+    sse_poll_s = 0.25
+    stopping: threading.Event = None
+
+
+class LiveObservabilityServer:
+    """Owner object: builds the HTTP server on a daemon thread, exposes the
+    bound port (``port=0`` picks a free one), and tears down cleanly.
+
+    ``step_fn``/``steps_this_process_fn`` are host-side callables so the
+    server never touches device state; the engine passes closures over its
+    python-side counters."""
+
+    def __init__(self, telemetry, port: int = 8790, bind: str = "0.0.0.0",
+                 watchdog=None, anomaly=None, host_id: int = 0,
+                 step_fn: Optional[Callable[[], Optional[int]]] = None,
+                 steps_this_process_fn: Optional[Callable[[], int]] = None,
+                 recovered_after_steps: int = 3,
+                 degraded_window_steps: int = 16, sse_poll_s: float = 0.25):
+        self.telemetry = telemetry
+        self.requested_port = int(port)
+        self.bind = bind
+        self.watchdog = watchdog
+        self.anomaly = anomaly
+        self.host_id = int(host_id)
+        self.step_fn = step_fn or (lambda: None)
+        self.steps_this_process_fn = steps_this_process_fn or (lambda: 0)
+        self.recovered_after_steps = int(recovered_after_steps)
+        self.degraded_window_steps = int(degraded_window_steps)
+        self.sse_poll_s = float(sse_poll_s)
+        self.aggregator = CrossHostAggregator(local_host=self.host_id)
+        self.port: Optional[int] = None
+        self._server: Optional[_LiveHTTPServer] = None
+        self._thread: Optional[threading.Thread] = None
+        self._stopping = threading.Event()
+
+    @classmethod
+    def from_config(cls, lcfg, telemetry, watchdog=None, anomaly=None,
+                    host_id: int = 0, step_fn=None,
+                    steps_this_process_fn=None) -> "LiveObservabilityServer":
+        """Build from a ``telemetry.live`` block (LiveTelemetryConfig)."""
+        return cls(telemetry, port=lcfg.port, bind=lcfg.bind,
+                   watchdog=watchdog, anomaly=anomaly, host_id=host_id,
+                   step_fn=step_fn,
+                   steps_this_process_fn=steps_this_process_fn,
+                   recovered_after_steps=lcfg.recovered_after_steps,
+                   degraded_window_steps=lcfg.degraded_window_steps,
+                   sse_poll_s=lcfg.sse_poll_s)
+
+    # ---------------------------------------------------------------- #
+    @property
+    def running(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def start(self) -> "LiveObservabilityServer":
+        if self._server is not None:
+            return self
+        self._stopping.clear()
+        srv = _LiveHTTPServer((self.bind, self.requested_port), _LiveHandler)
+        srv.telemetry = self.telemetry
+        srv.aggregator = self.aggregator
+        srv.watchdog = self.watchdog
+        srv.anomaly = self.anomaly
+        srv.host_id = self.host_id
+        srv.last_step = self.step_fn
+        srv.steps_this_process = self.steps_this_process_fn
+        srv.recovered_after_steps = self.recovered_after_steps
+        srv.degraded_window_steps = self.degraded_window_steps
+        srv.sse_poll_s = self.sse_poll_s
+        srv.stopping = self._stopping
+        self._server = srv
+        self.port = srv.server_address[1]
+        self._thread = threading.Thread(
+            target=srv.serve_forever, name="dstpu-live-server",
+            kwargs={"poll_interval": 0.2}, daemon=True)
+        self._thread.start()
+        logger.info(f"live observability server on "
+                    f"http://{self.bind}:{self.port} "
+                    f"(/metrics /healthz /events /summary)")
+        if self.telemetry is not None:
+            self.telemetry.event("live_server_start", port=self.port,
+                                 bind=self.bind)
+            publish_elastic_gauges(self.telemetry.metrics)
+        return self
+
+    def stop(self) -> None:
+        self._stopping.set()       # unblocks SSE followers
+        srv, self._server = self._server, None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=5.0)

@@ -13,6 +13,11 @@ Histograms keep exact count/sum/min/max plus a bounded uniform reservoir of
 samples for percentiles (`p50/p90/p95/p99`) — memory stays O(cap) no matter
 how many observations arrive, and the reservoir keeps every observation
 equally likely to be retained (Vitter's algorithm R).
+
+Consistency: every write, every reader accessor, and both exports run under
+the one registry lock, so a concurrent scrape (the live ``/metrics``
+endpoint polls mid-step) can never observe a half-written histogram
+reservoir or a count/sum pair torn across an update.
 """
 from __future__ import annotations
 
@@ -90,7 +95,8 @@ class Metric:
         return series
 
     def labelsets(self) -> List[LabelKey]:
-        return list(self._series.keys())
+        with self._registry._lock:
+            return list(self._series.keys())
 
 
 class Counter(Metric):
@@ -101,8 +107,15 @@ class Counter(Metric):
             self._get(labels, _CounterSeries).value += n
 
     def value(self, **labels) -> float:
-        series = self._series.get(_label_key(labels))
-        return series.value if series else 0.0
+        with self._registry._lock:
+            series = self._series.get(_label_key(labels))
+            return series.value if series else 0.0
+
+    def total(self) -> float:
+        """Sum over every labelset — e.g. all ``fault/events`` regardless of
+        the ``name`` label (the /healthz incident counts)."""
+        with self._registry._lock:
+            return sum(s.value for s in self._series.values())
 
 
 class Gauge(Metric):
@@ -120,12 +133,14 @@ class Gauge(Metric):
                 s.vmax = value
 
     def value(self, **labels) -> Optional[float]:
-        s = self._series.get(_label_key(labels))
-        return s.value if s else None
+        with self._registry._lock:
+            s = self._series.get(_label_key(labels))
+            return s.value if s else None
 
     def high_water(self, **labels) -> Optional[float]:
-        s = self._series.get(_label_key(labels))
-        return s.vmax if s and s.count else None
+        with self._registry._lock:
+            s = self._series.get(_label_key(labels))
+            return s.vmax if s and s.count else None
 
 
 class Histogram(Metric):
@@ -151,24 +166,29 @@ class Histogram(Metric):
                     s.samples[j] = value
 
     def percentile(self, q: float, **labels) -> Optional[float]:
-        s = self._series.get(_label_key(labels))
-        if s is None or not s.samples:
-            return None
-        return _percentile(sorted(s.samples), q)
+        with self._registry._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None or not s.samples:
+                return None
+            svals = sorted(s.samples)
+        return _percentile(svals, q)
 
     def count(self, **labels) -> int:
-        s = self._series.get(_label_key(labels))
-        return s.count if s else 0
+        with self._registry._lock:
+            s = self._series.get(_label_key(labels))
+            return s.count if s else 0
 
     def sum(self, **labels) -> float:
-        s = self._series.get(_label_key(labels))
-        return s.total if s else 0.0
+        with self._registry._lock:
+            s = self._series.get(_label_key(labels))
+            return s.total if s else 0.0
 
     def mean(self, **labels) -> Optional[float]:
-        s = self._series.get(_label_key(labels))
-        if s is None or s.count == 0:
-            return None
-        return s.total / s.count
+        with self._registry._lock:
+            s = self._series.get(_label_key(labels))
+            if s is None or s.count == 0:
+                return None
+            return s.total / s.count
 
 
 def _percentile(sorted_vals: List[float], q: float) -> float:
@@ -225,6 +245,22 @@ class MetricsRegistry:
     def clear(self) -> None:
         with self._lock:
             self._metrics.clear()
+
+    def gauge_values(self) -> Dict[str, float]:
+        """Label-free gauge name → current value, in one lock hold.  Far
+        cheaper than :meth:`snapshot` (no histogram reservoir sorts under
+        the lock) — the live snapshot pusher polls this every push
+        interval on every host, right beside the training thread's metric
+        writes."""
+        out: Dict[str, float] = {}
+        with self._lock:
+            for name in sorted(self._metrics):
+                m = self._metrics[name]
+                if isinstance(m, Gauge):
+                    series = m._series.get(())   # label-free labelset key
+                    if series is not None:
+                        out[name] = series.value
+        return out
 
     # ---------------------------------------------------------------- #
     def snapshot(self) -> List[Dict[str, Any]]:

@@ -22,13 +22,14 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Dict, List, Optional, Sequence
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
-from .events import read_jsonl
+from .events import read_event_segments
 from .metrics import _percentile
 
 EVENT_KINDS_INCIDENT = ("fault", "watchdog_timeout", "elastic_worker_failure",
-                        "elastic_restart", "straggler")
+                        "elastic_restart", "straggler", "anomaly",
+                        "anomaly_checkpoint_failed")
 
 #: roofline table columns, shared between the section renderer and --help
 ROOFLINE_COLUMNS = (
@@ -69,8 +70,11 @@ def load_run(events_path: Optional[str],
     metrics: Dict[tuple, Dict[str, Any]] = {}
     events: List[Dict[str, Any]] = []
     runs = 0
-    if events_path and os.path.exists(events_path):
-        for rec in read_jsonl(events_path):
+    if events_path:
+        # rotation-aware: a size-rotated log's oldest events live in
+        # events.jsonl.N segments — walk them oldest-first so the stream
+        # (and the latest run_start marker) reads exactly as written
+        for rec in read_event_segments(events_path):
             kind = rec.get("kind")
             if kind == "run_start":
                 # append-mode log: summarize only the LATEST run, consistent
@@ -497,7 +501,31 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "event, if any)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit the summary as JSON instead of text")
+    parser.add_argument("--compare", nargs="?", const=".", default=None,
+                        metavar="HISTORY_DIR",
+                        help="cross-run regression check: diff this run "
+                             "(a telemetry dir or a bench JSON) against the "
+                             "BENCH_r*.json history in HISTORY_DIR (default "
+                             "'.'); exits 3 when a metric regressed past "
+                             "the threshold, 2 when either side has "
+                             "nothing comparable")
+    parser.add_argument("--compare-threshold", type=float, default=0.15,
+                        help="relative worsening vs the history median that "
+                             "counts as a regression (default 0.15)")
+    parser.add_argument("--compare-pattern", default=None,
+                        help="history filename glob (default BENCH_r*.json)")
     args = parser.parse_args(argv)
+
+    if args.compare is not None:
+        rc, text = _run_compare(args)
+        try:
+            print(text)
+        except BrokenPipeError:
+            try:
+                sys.stdout.close()
+            except BrokenPipeError:
+                pass
+        return rc
 
     path = args.path
     if os.path.isdir(path):
@@ -506,9 +534,13 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     else:
         events_path = path
         trace_path = args.trace
-    if not os.path.exists(events_path) and not (
+    from .events import event_segments
+
+    # rotation-aware: after a crash mid-rotation the live events.jsonl may
+    # be missing while the .N segments hold the whole pre-crash history
+    if not event_segments(events_path) and not (
             trace_path and os.path.exists(trace_path)):
-        print(f"dstpu-telemetry: no events.jsonl or trace.json at {path}")
+        print(f"dstpu-telemetry: no events.jsonl[.N] or trace.json at {path}")
         return 2
 
     summary = summarize_run(events_path, trace_path, xprof_dir=args.xprof)
@@ -523,6 +555,43 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         except BrokenPipeError:
             pass
     return 0
+
+
+def _run_compare(args) -> Tuple[int, str]:
+    """``--compare`` mode: (exit_code, report_text) — 3 on a regression
+    (so CI gates on the exit code alone), 2 when there is nothing to
+    compare on EITHER side: an unusable current run, or no usable history
+    (a mistyped HISTORY_DIR must not read as a green gate).  ``main`` owns
+    the printing."""
+    from .regression import (DEFAULT_PATTERN, VERDICT_NO_HISTORY,
+                             VERDICT_REGRESSION, compare_runs,
+                             current_metrics_from_path, format_compare,
+                             load_history)
+
+    try:
+        current = current_metrics_from_path(args.path)
+    except (OSError, json.JSONDecodeError) as e:
+        return 2, (f"dstpu-telemetry --compare: cannot read current run "
+                   f"{args.path}: {e}")
+    if not current:
+        return 2, (f"dstpu-telemetry --compare: no comparable metrics in "
+                   f"{args.path} (need a bench JSON or a telemetry dir "
+                   f"with engine/train_batch spans)")
+    history = load_history(args.compare,
+                           args.compare_pattern or DEFAULT_PATTERN,
+                           exclude=args.path)
+    report = compare_runs(current, history,
+                          threshold=args.compare_threshold)
+    report["current_run"] = args.path
+    if args.as_json:
+        text = json.dumps(report, indent=2, sort_keys=True, default=str)
+    else:
+        text = format_compare(report, history_dir=args.compare)
+    if report["verdict"] == VERDICT_REGRESSION:
+        return 3, text
+    if report["verdict"] == VERDICT_NO_HISTORY:
+        return 2, text
+    return 0, text
 
 
 if __name__ == "__main__":

@@ -1,0 +1,269 @@
+"""Anomaly detection: the non-finite guard, the loss-spike z-score, the
+step-time regression check, cooldown/action semantics, and the engine
+integration — an injected NaN loss produces the event, the metric, and the
+configured action (a verified checkpoint)."""
+import math
+import os
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+from deepspeed_tpu.telemetry import Telemetry, set_telemetry
+from deepspeed_tpu.telemetry.live import AnomalyAbort, AnomalyDetector
+
+from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_telemetry():
+    set_telemetry(None)
+    yield
+    set_telemetry(None)
+
+
+@pytest.fixture
+def tel(tmp_path):
+    t = Telemetry(output_dir=str(tmp_path / "tel"), chrome_trace=False)
+    yield t
+    t.close()
+
+
+def make_detector(tel=None, **kw):
+    kw.setdefault("min_steps", 4)
+    kw.setdefault("cooldown_steps", 8)
+    return AnomalyDetector(telemetry=tel, **kw)
+
+
+def warm(det, n=10, loss=1.0, step_time=0.1, start=0):
+    for i in range(start, start + n):
+        assert det.observe(i, loss=loss + 0.001 * i, step_time_s=step_time) \
+            == []
+    return start + n
+
+
+class TestDetectorUnits:
+    def test_nonfinite_loss_fires_immediately(self, tel):
+        det = make_detector(tel)
+        fired = det.observe(0, loss=float("nan"))
+        assert [f["type"] for f in fired] == ["nonfinite_loss"]
+        assert det.incidents == 1 and det.last_incident_step == 0
+        ev = tel.events.recent(kind="anomaly")
+        assert len(ev) == 1 and ev[0]["type"] == "nonfinite_loss"
+        assert tel.metrics.counter("anomaly/events").value(
+            type="nonfinite_loss") == 1
+        assert tel.metrics.gauge("Anomaly/last_step").value() == 0
+
+    def test_nonfinite_grad_norm_guard(self):
+        det = make_detector()
+        fired = det.observe(0, grad_norm=float("inf"))
+        assert [f["type"] for f in fired] == ["nonfinite_grad_norm"]
+
+    def test_loss_spike_zscore(self, tel):
+        det = make_detector(tel, loss_zscore=6.0)
+        step = warm(det, n=12)
+        fired = det.observe(step, loss=100.0)
+        assert [f["type"] for f in fired] == ["loss_spike"]
+        assert fired[0]["zscore"] > 6.0
+        assert math.isclose(fired[0]["window_mean"], 1.0, abs_tol=0.1)
+        assert tel.metrics.gauge("Anomaly/loss_zscore").value() is not None
+
+    def test_no_spike_below_min_steps(self):
+        det = make_detector(min_steps=8)
+        for i in range(5):
+            det.observe(i, loss=1.0)
+        # the window is still arming — even a wild value cannot z-score
+        assert det.observe(5, loss=100.0) == []
+
+    def test_step_time_regression(self, tel):
+        det = make_detector(tel, step_time_threshold=0.5, step_time_recent=2)
+        step = warm(det, n=12, step_time=0.1)
+        fired = []
+        for i in range(step, step + 3):       # sustained 4x step-change
+            fired += det.observe(i, loss=1.0, step_time_s=0.4)
+        kinds = [f["type"] for f in fired]
+        assert "step_time_regression" in kinds
+        reg = next(f for f in fired if f["type"] == "step_time_regression")
+        assert reg["ratio"] > 1.5
+        assert math.isclose(reg["baseline_s"], 0.1, rel_tol=0.2)
+
+    def test_transient_blip_does_not_fire(self):
+        """One slow step (a GC pause, an incidental flush) must not flag a
+        regression — the recent MEDIAN is blind to a single outlier, even a
+        wild one."""
+        det = make_detector(step_time_threshold=0.75, step_time_recent=3)
+        step = warm(det, n=12, step_time=0.1)
+        assert det.observe(step, loss=1.0, step_time_s=5.0) == []
+        assert det.observe(step + 1, loss=1.0, step_time_s=0.1) == []
+        assert det.observe(step + 2, loss=1.0, step_time_s=0.1) == []
+
+    def test_millisecond_steps_are_noise_floor(self):
+        """CPU-sim scale: 3ms steps next to a 50ms host hiccup must not
+        read as a 17x regression (step_time_min_s floor)."""
+        det = make_detector(step_time_threshold=0.5, step_time_recent=1,
+                            step_time_min_s=0.01)
+        step = warm(det, n=12, step_time=0.003)
+        assert det.observe(step, loss=1.0, step_time_s=0.05) == []
+        # ...but a real-scale regime change still fires with recent=1
+        det2 = make_detector(step_time_threshold=0.5, step_time_recent=1)
+        step = warm(det2, n=12, step_time=0.5)
+        fired = det2.observe(step, loss=1.0, step_time_s=2.0)
+        assert [f["type"] for f in fired] == ["step_time_regression"]
+
+    def test_cooldown_suppresses_incident_storm(self, tel):
+        det = make_detector(tel, cooldown_steps=10)
+        det.observe(0, loss=float("nan"))
+        for i in range(1, 10):
+            assert det.observe(i, loss=float("nan")) == []   # cooling
+        fired = det.observe(11, loss=float("nan"))           # cooled off
+        assert len(fired) == 1
+        assert tel.metrics.counter("anomaly/events").value(
+            type="nonfinite_loss") == 2
+
+    def test_action_abort_raises_from_observe(self, tel):
+        det = make_detector(tel, action="abort")
+        with pytest.raises(AnomalyAbort, match="nonfinite_loss"):
+            det.observe(3, loss=float("inf"))
+        # the incident was recorded (and flushed) before the raise
+        assert tel.events.recent(kind="anomaly")
+
+    def test_action_checkpoint_calls_target(self, tel):
+        calls = []
+
+        class Target:
+            def save_checkpoint(self, d, tag=None, client_state=None):
+                calls.append((d, tag, client_state))
+
+        det = make_detector(tel, action="checkpoint", action_target=Target(),
+                            checkpoint_dir="ckpt_here")
+        det.observe(5, loss=float("nan"))
+        assert len(calls) == 1
+        d, tag, client_state = calls[0]
+        assert d == "ckpt_here" and tag == "anomaly_step5"
+        assert client_state["anomaly"][0]["type"] == "nonfinite_loss"
+        assert tel.events.recent(kind="anomaly_checkpoint")
+
+    def test_checkpoint_failure_is_contained(self, tel):
+        class Broken:
+            def save_checkpoint(self, *a, **k):
+                raise OSError("disk full")
+
+        det = make_detector(tel, action="checkpoint", action_target=Broken())
+        det.observe(5, loss=float("nan"))     # must not raise
+        assert tel.events.recent(kind="anomaly_checkpoint_failed")
+
+    def test_bad_action_rejected(self):
+        with pytest.raises(ValueError, match="log|checkpoint|abort"):
+            AnomalyDetector(action="panic")
+
+    def test_config_validates_action(self):
+        from deepspeed_tpu.runtime.config import AnomalyConfig
+
+        with pytest.raises(ValueError, match="anomaly.action"):
+            AnomalyConfig(action="panic")
+        assert AnomalyConfig(action="checkpoint").action == "checkpoint"
+
+    def test_config_rejects_window_that_can_never_arm(self):
+        """A window smaller than min_steps would make the rolling deque
+        permanently short of the arming threshold — the user believes
+        detection is on while it can never fire."""
+        from deepspeed_tpu.runtime.config import AnomalyConfig
+
+        with pytest.raises(ValueError, match="loss_window"):
+            AnomalyConfig(loss_window=4, min_steps=8)
+        with pytest.raises(ValueError, match="step_time_window"):
+            AnomalyConfig(step_time_window=8, min_steps=8,
+                          step_time_recent=3)
+        AnomalyConfig(loss_window=8, step_time_window=10, min_steps=8)
+
+    def test_detector_clamps_short_windows(self, tel):
+        """Direct constructions bypass the config check — the detector
+        floors its deques on min_steps so a short window still arms."""
+        det = AnomalyDetector(loss_window=4, min_steps=8, telemetry=tel,
+                              step_time_min_s=0.0)
+        for i in range(8):
+            det.observe(i, loss=1.0, step_time_s=1.0)
+        fired = det.observe(9, loss=100.0)
+        assert [i["type"] for i in fired] == ["loss_spike"]
+
+
+class TestEngineIntegration:
+    """One engine (one jit compile) serves all three scenarios: the
+    detector's action/cooldown are plain host-side attributes, so the
+    abort case flips them on the same engine instead of paying a second
+    engine build."""
+
+    @pytest.fixture
+    def engine(self, tmp_path):
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "telemetry": {
+                "enabled": True, "output_dir": str(tmp_path / "tel"),
+                # anomaly detection needs no live server — detector only
+                "live": {"anomaly": {
+                    "enabled": True, "action": "checkpoint", "min_steps": 4,
+                    "checkpoint_dir": str(tmp_path / "anomaly_ckpt")}},
+            },
+        }
+        params = init_mlp_params(jax.random.PRNGKey(0))
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn, model_parameters=params, config=config,
+            topology=topo)
+        yield eng
+        eng.close()
+
+    @staticmethod
+    def nan_batch(batch):
+        return jax.tree.map(
+            lambda x: jnp.full_like(x, jnp.nan)
+            if jnp.issubdtype(x.dtype, jnp.floating) else x, batch)
+
+    def test_nan_loss_event_metric_checkpoint_and_abort(self, tmp_path,
+                                                        engine):
+        """Acceptance: an injected non-finite loss produces the structured
+        anomaly event, the Anomaly/* metrics, AND the configured action —
+        first a checkpoint through the fault subsystem's verified commit,
+        then (action flipped) an AnomalyAbort out of train_batch."""
+        batch = random_batch(engine.train_batch_size())
+        for _ in range(2):
+            engine.train_batch(batch)
+        # healthy steps fire nothing
+        assert engine.telemetry.events.recent(kind="anomaly") == []
+        assert engine._anomaly.incidents == 0
+
+        # under fp16 DYNAMIC loss scaling a non-finite loss is a routine
+        # self-healing overflow-skip, not an incident — the guard must
+        # stand down or action=abort would burn elastic restarts on it
+        engine.loss_scaler.dynamic = True
+        engine.train_batch(self.nan_batch(batch))
+        assert engine.telemetry.events.recent(kind="anomaly") == []
+        engine.loss_scaler.dynamic = False
+
+        engine.train_batch(self.nan_batch(batch))
+        ev = engine.telemetry.events.recent(kind="anomaly")
+        assert [e["type"] for e in ev] == ["nonfinite_loss"]
+        step = ev[0]["step"]
+        assert engine.telemetry.metrics.counter("anomaly/events").value(
+            type="nonfinite_loss") == 1
+        assert engine.telemetry.metrics.gauge(
+            "Anomaly/last_step").value() == step
+
+        tag_dir = tmp_path / "anomaly_ckpt" / f"anomaly_step{step}"
+        assert tag_dir.is_dir(), "anomaly checkpoint not written"
+        assert (tag_dir / "manifest.json").exists(), \
+            "checkpoint missing the fault subsystem's integrity manifest"
+        ck = engine.telemetry.events.recent(kind="anomaly_checkpoint")
+        assert ck and ck[0]["tag"] == f"anomaly_step{step}"
+
+        # action=abort must propagate from train_batch (cooldown cleared so
+        # the same incident type may fire again)
+        engine._anomaly.action = "abort"
+        engine._anomaly._cooldown_until.clear()
+        with pytest.raises(AnomalyAbort):
+            engine.train_batch(self.nan_batch(batch))

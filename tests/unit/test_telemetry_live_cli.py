@@ -1,0 +1,49 @@
+"""CI gate for the dstpu-telemetry CLI smoke check
+(tools/check_telemetry_cli.py): --help plus --compare over a fixture run
+dir in both verdict directions — same enforcement pattern as the
+no-bare-print lint."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.telemetry
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CHECK = os.path.join(REPO_ROOT, "tools", "check_telemetry_cli.py")
+
+
+class TestTelemetryCLISmoke:
+    def test_smoke_check_passes(self):
+        """This IS the CI gate: the real executable must serve --help and
+        verdict --compare (summarizing the fixture run dir in-process)
+        with the documented exit codes."""
+        proc = subprocess.run([sys.executable, CHECK],
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, \
+            f"dstpu-telemetry CLI smoke checks failed:\n{proc.stdout}" \
+            f"{proc.stderr[-1000:]}"
+
+    def test_fixture_builders_are_reusable(self, tmp_path):
+        """The tool's fixture builders double as test utilities — they must
+        produce a run dir the summary loader accepts and history the
+        regression tracker can baseline."""
+        sys.path.insert(0, os.path.join(REPO_ROOT, "tools"))
+        try:
+            from check_telemetry_cli import (make_fixture_history,
+                                             make_fixture_run)
+        finally:
+            sys.path.pop(0)
+        from deepspeed_tpu.telemetry.regression import load_history
+        from deepspeed_tpu.telemetry.summary import summarize_run
+
+        run_dir = make_fixture_run(str(tmp_path))
+        summary = summarize_run(os.path.join(run_dir, "events.jsonl"))
+        assert any(r["phase"] == "engine/train_batch"
+                   for r in summary["step_breakdown"])
+        hist = make_fixture_history(str(tmp_path))
+        entries = load_history(hist)
+        assert len(entries) == 3
+        assert all(e["metrics"]["step_time_s"] for e in entries)

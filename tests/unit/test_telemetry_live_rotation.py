@@ -1,0 +1,241 @@
+"""Satellites of the live plane: bounded events.jsonl growth (size-based
+rotation + ordered segment reads), registry snapshot consistency under
+concurrent writers, and the live/rotation config plumbing."""
+import json
+import os
+import threading
+
+import pytest
+
+from deepspeed_tpu.telemetry import Telemetry, set_telemetry
+from deepspeed_tpu.telemetry.events import (EventLog, event_segments,
+                                            read_event_segments)
+from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+from deepspeed_tpu.telemetry.summary import load_run, summarize_run
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_telemetry():
+    set_telemetry(None)
+    yield
+    set_telemetry(None)
+
+
+class TestEventLogRotation:
+    def test_rotation_bounds_disk_and_keeps_last_n(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path=path, max_bytes=2_000, keep=3)
+        for i in range(300):
+            log.emit("tick", i=i, pad="x" * 40)
+        log.close()
+        segs = event_segments(path)
+        names = [os.path.basename(s) for s in segs]
+        assert names == ["events.jsonl.3", "events.jsonl.2",
+                         "events.jsonl.1", "events.jsonl"]
+        # every retained file respects the bound (plus at most one record)
+        for s in segs:
+            assert os.path.getsize(s) <= 2_000 + 200
+        # and nothing older than .keep survives
+        assert not os.path.exists(path + ".4")
+
+    def test_segments_read_in_order_no_gaps(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path=path, max_bytes=1_500, keep=4)
+        for i in range(200):
+            log.emit("tick", i=i)
+        log.close()
+        recs = [r for r in read_event_segments(path) if r["kind"] == "tick"]
+        ids = [r["i"] for r in recs]
+        assert ids[-1] == 199
+        assert ids == list(range(ids[0], 200)), "segment order broke the stream"
+
+    def test_unrotated_log_reads_unchanged(self, tmp_path):
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path=path)       # max_bytes=0: never rotate
+        for i in range(50):
+            log.emit("tick", i=i)
+        log.close()
+        assert event_segments(path) == [path]
+        assert len(list(read_event_segments(path))) == 50
+
+    def test_summary_reads_rotated_run(self, tmp_path):
+        """dstpu-telemetry's loader must see spans that rotated out of the
+        live file — the oldest segments are where a long run's history is."""
+        out = str(tmp_path / "tel")
+        tel = Telemetry(output_dir=out, chrome_trace=False,
+                        events_max_mb=0.002, events_keep=4)  # ~2KB segments
+        assert tel.events.max_bytes == 2097
+        for i in range(100):
+            tel.event("scalars", step=i, values={"loss": 1.0})
+        tel.close()
+        events_path = os.path.join(out, "events.jsonl")
+        assert len(event_segments(events_path)) > 1, "no rotation happened"
+        run = load_run(events_path)
+        steps = [e["step"] for e in run["events"]
+                 if e.get("kind") == "scalars"]
+        assert steps == list(range(steps[0], 100))
+        # run_start lives in the OLDEST segment: runs_in_log still counts it
+        assert run["runs_in_log"] == 1
+        summary = summarize_run(events_path)
+        assert summary["incidents"]["event_counts"]["scalars"] == len(steps)
+
+    def test_config_plumbs_rotation_knobs(self, tmp_path):
+        from deepspeed_tpu.runtime.config import TelemetryConfig
+
+        tcfg = TelemetryConfig(enabled=True,
+                               output_dir=str(tmp_path / "t"),
+                               events_max_mb=1.5, events_keep=7)
+        tel = Telemetry.from_config(tcfg)
+        assert tel.events.max_bytes == int(1.5 * 1024 * 1024)
+        assert tel.events.keep == 7
+        tel.close()
+
+    def test_failed_rotation_reopen_recovers(self, tmp_path, monkeypatch):
+        """A reopen failure mid-rotation (disk full at the worst moment)
+        must not kill on-disk logging forever — the next emit retries."""
+        import builtins
+
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path=path, max_bytes=200, keep=2)
+        real_open = builtins.open
+        fail = {"on": False}
+
+        def flaky_open(file, *a, **kw):
+            if fail["on"] and file == path:
+                raise OSError(28, "No space left on device")
+            return real_open(file, *a, **kw)
+
+        monkeypatch.setattr(builtins, "open", flaky_open)
+        fail["on"] = True
+        for i in range(20):              # trips rotation; reopen fails
+            log.emit("tick", i=i)
+        assert log._fh is None           # handle lost, but not closed
+        fail["on"] = False               # "disk space freed"
+        log.emit("tick", i=99)           # emit retries the reopen
+        log.close()
+        recs = [r["i"] for r in read_event_segments(path)]
+        assert 99 in recs
+
+    def test_tail_is_atomic_with_cursor(self, tmp_path):
+        """tail(n) hands back the replay AND the follow cursor from one
+        critical section — nothing emitted before the tail may also show
+        up in the first events_since (the SSE duplicate bug)."""
+        log = EventLog(path=None)
+        for i in range(10):
+            log.emit("tick", i=i)
+        replayed, cursor = log.tail(4)
+        assert [r["i"] for r in replayed] == [6, 7, 8, 9]
+        fresh, cursor = log.events_since(cursor)
+        assert fresh == []                    # no duplicates
+        log.emit("tick", i=10)
+        fresh, _ = log.events_since(cursor)
+        assert [r["i"] for r in fresh] == [10]
+
+    def test_cursor_survives_rotation(self, tmp_path):
+        """The SSE follower cursor counts events, not file offsets —
+        rotation must not replay or skip."""
+        path = str(tmp_path / "events.jsonl")
+        log = EventLog(path=path, max_bytes=1_000, keep=2)
+        cursor = log.cursor()
+        seen = []
+        for i in range(120):
+            log.emit("tick", i=i)
+            if i % 7 == 0:
+                fresh, cursor = log.events_since(cursor)
+                seen.extend(r["i"] for r in fresh if r["kind"] == "tick")
+        fresh, cursor = log.events_since(cursor)
+        seen.extend(r["i"] for r in fresh if r["kind"] == "tick")
+        log.close()
+        assert seen == list(range(120))
+
+
+class TestRegistryConcurrency:
+    def test_concurrent_writers_vs_scrapers(self):
+        """Hammer the registry from writer threads while scraping both
+        exports and the reader accessors: no exception, no torn series, and
+        the final totals are exact."""
+        reg = MetricsRegistry(histogram_max_samples=128)
+        n_threads, n_iter = 4, 600
+        stop = threading.Event()
+        errors = []
+
+        def writer(tid):
+            try:
+                for i in range(n_iter):
+                    reg.counter("c").inc(src=str(tid))
+                    reg.gauge("g").set(i, src=str(tid))
+                    reg.histogram("h").observe(i * 0.001, src=str(tid))
+            except Exception as e:  # noqa: BLE001 — surfaced via errors
+                errors.append(e)
+
+        def scraper():
+            try:
+                while not stop.is_set():
+                    text = reg.prometheus_text()
+                    assert "# TYPE h summary" in text or "h_count" not in text
+                    for row in reg.snapshot():
+                        if row["type"] == "histogram" and row["count"]:
+                            # count/sum/mean must be mutually consistent —
+                            # a torn read would break this identity
+                            assert row["mean"] == pytest.approx(
+                                row["sum"] / row["count"])
+                    reg.histogram("h").percentile(95, src="0")
+                    reg.histogram("h").mean(src="1")
+                    reg.counter("c").total()
+            except Exception as e:  # noqa: BLE001
+                errors.append(e)
+
+        writers = [threading.Thread(target=writer, args=(t,))
+                   for t in range(n_threads)]
+        scrapers = [threading.Thread(target=scraper) for _ in range(2)]
+        for t in scrapers + writers:
+            t.start()
+        for t in writers:
+            t.join()
+        stop.set()
+        for t in scrapers:
+            t.join()
+        assert errors == []
+        assert reg.counter("c").total() == n_threads * n_iter
+        for t in range(n_threads):
+            assert reg.histogram("h").count(src=str(t)) == n_iter
+
+    def test_snapshot_rows_internally_consistent(self):
+        reg = MetricsRegistry()
+        for i in range(100):
+            reg.histogram("h").observe(float(i))
+        (row,) = reg.snapshot()
+        assert row["count"] == 100
+        assert row["mean"] == pytest.approx(row["sum"] / row["count"])
+        assert row["min"] == 0.0 and row["max"] == 99.0
+
+
+class TestLiveConfig:
+    def test_live_block_parses(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        cfg = DeepSpeedConfig({
+            "train_micro_batch_size_per_gpu": 1,
+            "telemetry": {"enabled": True, "events_max_mb": 64,
+                          "live": {"enabled": True, "port": 0,
+                                   "push_interval_s": 2.5,
+                                   "anomaly": {"action": "checkpoint",
+                                               "loss_zscore": 5.0}}},
+        })
+        live = cfg.telemetry.live
+        assert live.enabled and live.port == 0
+        assert live.push_interval_s == 2.5
+        assert live.anomaly.action == "checkpoint"
+        assert live.anomaly.loss_zscore == 5.0
+        assert cfg.telemetry.events_max_mb == 64
+
+    def test_defaults_keep_plane_off_but_anomaly_armed(self):
+        from deepspeed_tpu.runtime.config import TelemetryConfig
+
+        tcfg = TelemetryConfig()
+        assert tcfg.live.enabled is False
+        assert tcfg.live.anomaly.enabled is True
+        assert tcfg.live.anomaly.action == "log"
+        assert tcfg.events_max_mb == 0.0

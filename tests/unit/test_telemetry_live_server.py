@@ -1,0 +1,445 @@
+"""Live observability plane: the host-0 HTTP server (/metrics /healthz
+/events /summary /push), cross-host snapshot aggregation, and the engine
+integration — endpoints served live during a CPU-sim training run."""
+import http.client
+import json
+import os
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+from deepspeed_tpu.telemetry import Telemetry, set_telemetry
+from deepspeed_tpu.telemetry.live import (CrossHostAggregator,
+                                          LiveObservabilityServer,
+                                          SnapshotPusher, collect_snapshot,
+                                          health_report)
+
+from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+pytestmark = pytest.mark.telemetry
+
+
+@pytest.fixture(autouse=True)
+def _clean_global_telemetry():
+    set_telemetry(None)
+    yield
+    set_telemetry(None)
+
+
+@pytest.fixture
+def tel(tmp_path):
+    t = Telemetry(output_dir=str(tmp_path / "tel"), chrome_trace=False)
+    yield t
+    t.close()
+
+
+@pytest.fixture
+def server(tel):
+    srv = LiveObservabilityServer(tel, port=0, bind="127.0.0.1",
+                                  step_fn=lambda: 7,
+                                  steps_this_process_fn=lambda: 7).start()
+    yield srv
+    srv.stop()
+
+
+def get(srv, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=5) as resp:
+        return resp.status, resp.read().decode()
+
+
+def get_json(srv, path):
+    code, body = get(srv, path)
+    return code, json.loads(body)
+
+
+class TestEndpoints:
+    def test_metrics_prometheus_text(self, tel, server):
+        tel.metrics.gauge("engine/lr").set(0.01)
+        tel.metrics.counter("comm/calls").inc(op="psum")
+        code, body = get(server, "/metrics")
+        assert code == 200
+        assert "engine_lr 0.01" in body
+        assert 'comm_calls{op="psum"} 1' in body
+        # a scrape is a point-in-time snapshot: it must re-render per request
+        tel.metrics.gauge("engine/lr").set(0.02)
+        _, body = get(server, "/metrics")
+        assert "engine_lr 0.02" in body
+
+    def test_healthz_healthy(self, tel, server):
+        tel.metrics.counter("fault/events").inc(name="retries")
+        code, h = get_json(server, "/healthz")
+        assert code == 200
+        assert h["status"] == "healthy"
+        assert h["last_step"] == 7
+        assert h["incidents"]["fault/events"] == 1
+
+    def test_summary_live_sections(self, tel, server):
+        with tel.span("engine/train_batch"):
+            pass
+        tel.metrics.histogram("comm/bytes").observe(1024, op="psum")
+        code, s = get_json(server, "/summary")
+        assert code == 200
+        assert s["live"] is True
+        assert any(r["phase"] == "engine/train_batch"
+                   for r in s["step_breakdown"])
+        assert any(r["op"] == "psum" for r in s["comm"])
+
+    def test_unknown_path_404(self, server):
+        with pytest.raises(urllib.error.HTTPError) as e:
+            get(server, "/nope")
+        assert e.value.code == 404
+
+    def test_root_lists_endpoints(self, server):
+        code, idx = get_json(server, "/")
+        assert code == 200
+        assert "/metrics" in idx["endpoints"]
+
+
+class TestSSE:
+    def test_events_tail_sees_fresh_event(self, tel, server):
+        """Acceptance: an SSE follower receives an event emitted AFTER it
+        connected, without any flush."""
+        tel.event("warmup", step=0)
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+        try:
+            conn.request("GET", "/events?replay=5")
+            resp = conn.getresponse()
+            assert resp.status == 200
+            assert resp.getheader("Content-Type") == "text/event-stream"
+            buf = b""
+            while b"warmup" not in buf:        # replay of the ring
+                buf += resp.fp.readline()
+            tel.event("fresh_incident", step=9, detail="live")
+            deadline = time.time() + 5
+
+            def data_lines():
+                return [l for l in buf.split(b"\n")
+                        if l.startswith(b"data:") and b"fresh_incident" in l]
+
+            while not data_lines() and time.time() < deadline:
+                buf += resp.fp.readline()
+            # SSE framing: the payload line parses back to the event
+            data = data_lines()[0]
+            rec = json.loads(data[len(b"data:"):])
+            assert rec["kind"] == "fresh_incident" and rec["step"] == 9
+        finally:
+            conn.close()
+
+    def test_events_no_follow_closes(self, tel, server):
+        tel.event("only", step=1)
+        conn = http.client.HTTPConnection("127.0.0.1", server.port, timeout=5)
+        try:
+            conn.request("GET", "/events?replay=10&follow=0")
+            resp = conn.getresponse()
+            body = resp.read()                 # must terminate
+            assert b"only" in body
+        finally:
+            conn.close()
+
+
+class TestCrossHostAggregation:
+    def test_push_and_host_labelled_metrics(self, tel, server, tmp_path):
+        """A non-zero host's pusher lands its snapshot on host 0 and the
+        series come back host-labelled, with the cross-host step skew."""
+        tel2 = Telemetry(output_dir=str(tmp_path / "h1"), chrome_trace=False)
+        try:
+            tel2.metrics.gauge("engine/lr").set(0.5)
+            tel2.metrics.counter("anomaly/events").inc(type="loss_spike")
+            pusher = SnapshotPusher(tel2, f"http://127.0.0.1:{server.port}",
+                                    host_id=1, step_fn=lambda: 5,
+                                    interval_s=600)
+            assert pusher.push_now()
+            assert pusher.pushed == 1
+        finally:
+            tel2.close()
+        _, body = get(server, "/metrics")
+        assert 'cluster_engine_lr{host="1"} 0.5' in body
+        assert 'cluster_anomaly_events{host="1"} 1' in body
+        assert 'live_host_step{host="1"} 5' in body
+        assert 'live_host_step{host="0"} 7' in body   # serving host too
+        assert 'live_push_age_s{host="1"}' in body
+        assert "live_step_skew 2" in body      # host0 step 7 vs host1 step 5
+        _, h = get_json(server, "/healthz")
+        assert h["step_skew"]["skew"] == 2
+        assert h["step_skew"]["per_host"] == {"0": 7, "1": 5}
+
+    def test_push_failure_counted_not_raised(self, tel, tmp_path):
+        from deepspeed_tpu.runtime.fault.retry import RetryPolicy
+
+        pusher = SnapshotPusher(
+            tel, "http://127.0.0.1:9", host_id=1, interval_s=600,
+            retry_policy=RetryPolicy(max_retries=1, base_s=0.001,
+                                     cap_s=0.001))
+        assert pusher.push_now() is False
+        assert pusher.failures == 1
+        assert tel.metrics.counter("live/push_failures").value() == 1
+
+    def test_snapshot_is_compact(self, tel):
+        tel.metrics.gauge("engine/lr").set(0.1)
+        tel.metrics.gauge("comm/ranks").set(8, op="psum")   # labelled: out
+        tel.metrics.histogram("step_ms").observe(3.0)       # not a gauge: out
+        snap = collect_snapshot(tel, host_id=3, step=11)
+        assert snap["host"] == 3 and snap["step"] == 11
+        assert snap["gauges"] == {"engine/lr": 0.1}
+
+    def test_live_config_rejects_busy_spin_intervals(self):
+        from deepspeed_tpu.runtime.config import LiveTelemetryConfig
+
+        with pytest.raises(ValueError, match="push_interval_s"):
+            LiveTelemetryConfig(push_interval_s=0)
+        with pytest.raises(ValueError, match="sse_poll_s"):
+            LiveTelemetryConfig(sse_poll_s=0)
+
+    @pytest.mark.parametrize("body", [b'{"no_host": 1}', b'[1, 2]',
+                                      b'{"host": "nope"}'])
+    def test_bad_push_rejected_with_400(self, server, body):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/push", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400     # client error, never a 500
+
+    def test_push_impersonating_serving_host_rejected(self, server):
+        """A push claiming host 0's own id would override the locally
+        observed step in the skew table — reject it."""
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/push",
+            data=b'{"host": 0, "step": 999999}',
+            headers={"Content-Type": "application/json"}, method="POST")
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(req, timeout=5)
+        assert e.value.code == 400
+        _, h = get_json(server, "/healthz")
+        assert h["step_skew"]["per_host"] == {"0": 7}   # local step intact
+
+    def test_restart_reason_rides_pushed_snapshot(self, tel, server,
+                                                  monkeypatch):
+        """The failure reason lives in a labelled gauge, which the
+        label-free snapshot filter drops — it must still reach host 0's
+        /metrics via the dedicated elastic field, or the pod dashboard
+        can never show WHY a restarted host died."""
+        monkeypatch.setenv("DSTPU_ELASTIC_RESTART_COUNT", "2")
+        monkeypatch.setenv("DSTPU_ELASTIC_LAST_RC", "-9")
+        snap = collect_snapshot(tel, host_id=3, step=4)
+        assert snap["elastic"]["last_failure"] == "signal:9"
+        server.aggregator.ingest(snap)
+        _, body = get(server, "/metrics")
+        assert ('cluster_elastic_last_restart{host="3",reason="signal:9"} 1'
+                in body)
+
+    def test_pushed_reason_label_sanitized(self, server):
+        """An unauthenticated push's reason string lands in a Prometheus
+        label — quoting/newline injection must be stripped on ingest."""
+        server.aggregator.ingest({"host": 5, "elastic": {
+            "restart_count": 1,
+            "last_failure": 'evil"} 1\nfake_metric 99'}})
+        _, body = get(server, "/metrics")
+        assert "\nfake_metric" not in body
+        assert 'host="5"' in body and 'reason="evil' in body
+
+    def test_numpy_counter_total_survives_push(self, tel, server, tmp_path):
+        """Counter.inc never coerces its increment; a numpy total must be
+        serialized as a JSON number (via _jsonable), not stringified by
+        default=str and then silently dropped by host 0's numeric filter."""
+        np = pytest.importorskip("numpy")
+        tel2 = Telemetry(output_dir=str(tmp_path / "hn"), chrome_trace=False)
+        try:
+            tel2.metrics.counter("anomaly/events").inc(np.float64(2),
+                                                       type="x")
+            pusher = SnapshotPusher(tel2, f"http://127.0.0.1:{server.port}",
+                                    host_id=2, interval_s=600)
+            assert pusher.push_now()
+        finally:
+            tel2.close()
+        _, body = get(server, "/metrics")
+        assert 'cluster_anomaly_events{host="2"} 2' in body
+
+    def test_host_and_series_retention_bounded(self):
+        """/push is unauthenticated: a pusher cycling fabricated host ids
+        or gauge names must hit the retention caps (a rejection, like any
+        malformed snapshot), not grow host 0's memory and /metrics
+        cardinality forever.  Known hosts keep updating in place."""
+        agg = CrossHostAggregator(local_host=0, max_hosts=4,
+                                  max_series_per_push=8)
+        for h in range(1, 5):
+            agg.ingest({"host": h, "gauges": {"a": 1.0}})
+        with pytest.raises(ValueError, match="tracks 4 hosts"):
+            agg.ingest({"host": 99, "gauges": {"a": 1.0}})
+        agg.ingest({"host": 2, "gauges": {"a": 2.0}})
+        assert agg.hosts() == [1, 2, 3, 4]
+        with pytest.raises(ValueError, match="9 series"):
+            agg.ingest({"host": 1,
+                        "gauges": {f"g{i}": 1.0 for i in range(9)}})
+
+    def test_final_push_on_close_is_single_attempt(self, tel):
+        """The close() flush must not serially burn the retry backoff
+        budget when host 0 is already gone — retry=False is one attempt."""
+        from deepspeed_tpu.runtime.fault.retry import RetryPolicy
+
+        attempts = []
+        pusher = SnapshotPusher(
+            tel, "http://127.0.0.1:9", host_id=1, interval_s=600,
+            retry_policy=RetryPolicy(max_retries=5, base_s=30.0, cap_s=30.0))
+        import deepspeed_tpu.telemetry.live.aggregator as agg_mod
+        orig = agg_mod.push_snapshot
+        try:
+            agg_mod.push_snapshot = \
+                lambda *a, **k: attempts.append(1) or orig(*a, **k)
+            t0 = time.time()
+            assert pusher.push_now(retry=False) is False
+            assert time.time() - t0 < 10   # no 30s backoff sleeps
+        finally:
+            agg_mod.push_snapshot = orig
+        assert len(attempts) == 1
+        assert pusher.failures == 1
+
+    def test_poisoned_snapshot_values_cannot_break_metrics(self, server):
+        """A push carrying non-numeric gauge values must not leave /metrics
+        500ing on every later scrape — bad values are dropped on ingest."""
+        body = json.dumps({"host": 1, "step": "n/a",
+                           "gauges": {"ok": 1.5, "bad": "abc",
+                                      "worse": None}}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/push", data=body,
+            headers={"Content-Type": "application/json"}, method="POST")
+        urllib.request.urlopen(req, timeout=5).read()
+        code, text = get(server, "/metrics")
+        assert code == 200
+        assert 'cluster_ok{host="1"} 1.5' in text
+        assert "bad" not in text and "worse" not in text
+
+
+class TestHealthStates:
+    def test_recovering_after_elastic_restart(self, tel, monkeypatch):
+        """The elastic agent's restart breadcrumbs must flip /healthz to
+        'recovering' until the new incarnation has made progress — and the
+        restart state rides /metrics as gauges."""
+        monkeypatch.setenv("DSTPU_ELASTIC_RESTART_COUNT", "2")
+        monkeypatch.setenv("DSTPU_ELASTIC_LAST_RC", "-9")
+        srv = LiveObservabilityServer(tel, port=0, bind="127.0.0.1",
+                                      step_fn=lambda: 1,
+                                      steps_this_process_fn=lambda: 0).start()
+        try:
+            code, h = get_json(srv, "/healthz")
+        except urllib.error.HTTPError as e:    # 503 carries the body
+            code, h = e.code, json.load(e)
+        finally:
+            srv.stop()
+        assert code == 503
+        assert h["status"] == "recovering"
+        assert h["elastic"] == {"restart_count": 2, "last_failure": "signal:9"}
+        assert tel.metrics.gauge("elastic/restart_count").value() == 2
+        assert tel.metrics.gauge("elastic/last_restart").value(
+            reason="signal:9") == 1
+
+    def test_healthy_once_recovered(self, tel, monkeypatch):
+        monkeypatch.setenv("DSTPU_ELASTIC_RESTART_COUNT", "2")
+        report = health_report(tel, step_fn=lambda: 50,
+                               steps_this_process_fn=lambda: 50,
+                               recovered_after_steps=3)
+        assert report["status"] == "healthy"
+
+    def test_degraded_on_recent_anomaly(self, tel):
+        class Det:
+            last_incident_step = 10
+            last_incident_type = "loss_spike"
+
+        report = health_report(tel, anomaly=Det(), step_fn=lambda: 12,
+                               degraded_window_steps=16)
+        assert report["status"] == "degraded"
+        assert "loss_spike" in report["reasons"][0]
+        report = health_report(tel, anomaly=Det(), step_fn=lambda: 100,
+                               degraded_window_steps=16)
+        assert report["status"] == "healthy"
+
+    def test_hung_on_stale_watchdog(self, tel):
+        class WD:
+            def dump(self):
+                return {"step": 3, "phase": "train_batch",
+                        "last_heartbeat_age_s": 99.0, "deadline_s": 10.0,
+                        "timeouts": 1}
+
+        report = health_report(tel, watchdog=WD())
+        assert report["status"] == "hung"
+        assert report["incidents"]["watchdog_timeouts"] == 1
+
+    def test_idle_run_is_not_hung(self, tel):
+        """A run parked between steps (or done training, server still up)
+        heartbeats 'idle' — the watchdog's quiet phases must not read as a
+        hang no matter how stale, or a liveness prober kills a healthy job."""
+        class WD:
+            quiet_phases = ("init", "idle")
+
+            def dump(self):
+                return {"step": 3, "phase": "idle",
+                        "last_heartbeat_age_s": 9999.0, "deadline_s": 10.0,
+                        "timeouts": 0}
+
+        assert health_report(tel, watchdog=WD())["status"] == "healthy"
+
+    def test_last_restart_reason_is_single_series(self, tel, monkeypatch):
+        """Two restarts with different failure reasons: only the latest
+        reason may carry 1, the stale series drops to 0."""
+        from deepspeed_tpu.telemetry.live import publish_elastic_gauges
+
+        monkeypatch.setenv("DSTPU_ELASTIC_RESTART_COUNT", "1")
+        monkeypatch.setenv("DSTPU_ELASTIC_LAST_RC", "1")
+        publish_elastic_gauges(tel.metrics)
+        monkeypatch.setenv("DSTPU_ELASTIC_RESTART_COUNT", "2")
+        monkeypatch.setenv("DSTPU_ELASTIC_LAST_RC", "-9")
+        publish_elastic_gauges(tel.metrics)
+        g = tel.metrics.gauge("elastic/last_restart")
+        assert g.value(reason="signal:9") == 1
+        assert g.value(reason="exit:1") == 0
+
+
+class TestEngineIntegration:
+    def test_endpoints_served_during_training(self, tmp_path):
+        """Acceptance: /metrics, /healthz, /events, /summary answer while a
+        CPU-sim training run is mid-flight, and close() tears down."""
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        config = {
+            "train_micro_batch_size_per_gpu": 2,
+            "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+            "fault": {"watchdog_enabled": True, "watchdog_deadline_s": 120.0},
+            "telemetry": {
+                "enabled": True, "output_dir": str(tmp_path / "tel"),
+                "live": {"enabled": True, "port": 0, "bind": "127.0.0.1"},
+            },
+        }
+        params = init_mlp_params(jax.random.PRNGKey(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn, model_parameters=params, config=config,
+            topology=topo)
+        try:
+            assert engine._live_server is not None
+            srv = engine._live_server
+            batch = random_batch(engine.train_batch_size())
+            for _ in range(3):
+                engine.train_batch(batch)
+
+            _, body = get(srv, "/metrics")
+            assert "engine_steps" in body
+            code, h = get_json(srv, "/healthz")
+            assert code == 200 and h["status"] == "healthy"
+            assert h["last_step"] == 3
+            assert h["watchdog"]["phase"] == "idle"
+            _, s = get_json(srv, "/summary")
+            assert any(r["phase"] == "engine/train_batch"
+                       for r in s["step_breakdown"])
+            _, code_events = None, get(srv, "/events?replay=3&follow=0")[0]
+            assert code_events == 200
+            port = srv.port
+        finally:
+            engine.close()
+        assert engine._live_server is None
+        with pytest.raises((urllib.error.URLError, ConnectionError, OSError)):
+            urllib.request.urlopen(f"http://127.0.0.1:{port}/healthz",
+                                   timeout=1)

@@ -1,0 +1,128 @@
+#!/usr/bin/env python
+"""Smoke-check the ``dstpu-telemetry`` CLI end to end.
+
+The run-summary CLI is the operator's front door to every telemetry
+artifact, and CLIs rot silently: an import error, a renamed flag, or a
+format_summary crash only surfaces when someone is debugging a dead run at
+2am.  This check drives the real executable the way a user would —
+``--help``, and ``--compare`` over a synthetic-but-realistic telemetry run
+directory (which summarizes it in-process) against synthetic BENCH history
+in both the clean and the regressed direction, asserting the documented
+exit codes 0 and 3 — so CI fails the moment the front door jams.
+Enforced from
+``tests/unit/test_telemetry_live_cli.py`` the same way the no-bare-print
+lint is.
+
+Usage: ``python tools/check_telemetry_cli.py``
+Exit status 1 lists what broke.
+"""
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+CLI = os.path.join(REPO_ROOT, "bin", "dstpu-telemetry")
+
+
+def run_cli(*args):
+    return subprocess.run([sys.executable, CLI, *args],
+                          capture_output=True, text=True,
+                          env={**os.environ, "JAX_PLATFORMS": "cpu"})
+
+
+def make_fixture_run(root: str) -> str:
+    """A minimal telemetry run dir: run_start, a few engine spans, metric
+    snapshot rows — enough for the summary sections and the --compare
+    step-time extraction to engage."""
+    run_dir = os.path.join(root, "telemetry_run")
+    os.makedirs(run_dir, exist_ok=True)
+    events = [{"ts": 1.0, "kind": "run_start", "pid": 1, "output_dir": run_dir}]
+    for i in range(4):
+        events.append({"ts": 2.0 + i, "kind": "span",
+                       "name": "engine/train_batch", "start_s": float(i),
+                       "dur_s": 0.5, "depth": 0, "parent": None, "tid": 1})
+    events.append({"ts": 9.0, "kind": "metric", "name": "engine/steps",
+                   "type": "counter", "labels": {}, "value": 4})
+    events.append({"ts": 9.0, "kind": "metric",
+                   "name": "overlap/exposed_comm_fraction", "type": "gauge",
+                   "labels": {}, "value": 0.10, "min": 0.10, "max": 0.10,
+                   "count": 1})
+    with open(os.path.join(run_dir, "events.jsonl"), "w") as f:
+        for e in events:
+            f.write(json.dumps(e) + "\n")
+    return run_dir
+
+
+def make_fixture_history(root: str, step_times=(0.5, 0.55, 0.45)) -> str:
+    hist = os.path.join(root, "history")
+    os.makedirs(hist, exist_ok=True)
+    for n, st in enumerate(step_times, start=1):
+        doc = {"n": n, "parsed": {
+            "metric": "zero_train_tokens_per_sec_per_chip",
+            "value": 1000.0 / st, "unit": "tokens/s/chip",
+            "extra": {"mfu": 0.4, "step_time_s": st}}}
+        with open(os.path.join(hist, f"BENCH_r{n:02d}.json"), "w") as f:
+            json.dump(doc, f)
+    return hist
+
+
+def main(argv=None) -> int:
+    failures = []
+
+    def check(name: str, ok: bool, detail: str = ""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+
+    proc = run_cli("--help")
+    check("--help exits 0", proc.returncode == 0, proc.stderr[-500:])
+    check("--help documents roofline columns",
+          "roofline columns" in proc.stdout, proc.stdout[-200:])
+    check("--help documents --compare", "--compare" in proc.stdout,
+          "flag missing from help text")
+
+    with tempfile.TemporaryDirectory() as root:
+        run_dir = make_fixture_run(root)
+        hist = make_fixture_history(root)
+
+        # fixture run's 0.5s steps ≈ history median 0.5s → clean verdict;
+        # a telemetry-dir source also exercises the summarize path inside
+        # the executable (current run = summarize_run(events.jsonl))
+        proc = run_cli(run_dir, "--compare", hist)
+        check("--compare (clean) exits 0", proc.returncode == 0,
+              f"rc={proc.returncode}\n{proc.stdout[-400:]}{proc.stderr[-200:]}")
+        check("--compare (clean) says OK", "verdict: OK" in proc.stdout,
+              proc.stdout[-300:])
+
+        # regressed history: the same run is now 5x slower than baseline
+        hist_fast = make_fixture_history(
+            os.path.join(root, "fast"), step_times=(0.1, 0.11, 0.09))
+        proc = run_cli(run_dir, "--compare", hist_fast, "--json")
+        check("--compare (regressed) exits 3", proc.returncode == 3,
+              f"rc={proc.returncode}\n{proc.stdout[-400:]}")
+        check("--compare (regressed) --json flags step_time_s",
+              _parses(proc.stdout) == "regression"
+              and '"step_time_s"' in proc.stdout, proc.stdout[-300:])
+
+    if failures:
+        print("\n".join(failures))
+        print(f"\n{len(failures)} dstpu-telemetry CLI smoke check(s) failed "
+              f"(tools/check_telemetry_cli.py)")
+        return 1
+    return 0
+
+
+def _parses(text: str):
+    """The parsed --json verdict, or None when the output isn't a report."""
+    try:
+        verdict = json.loads(text).get("verdict")
+    except (ValueError, AttributeError):
+        return None
+    return verdict if verdict in ("ok", "regression", "no-history") else None
+
+
+if __name__ == "__main__":
+    sys.exit(main())
