@@ -16,6 +16,7 @@ capture, engine.py:494).
 from __future__ import annotations
 
 import dataclasses
+import time
 from enum import Enum
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -56,6 +57,17 @@ class RaggedInferenceEngineConfig:
     #: double-buffered DMA chunk (see kernels/ragged_ops.py)
     block_q: int = 128
     pages_per_chunk: int = 8
+    #: compile-cache bucketing: pad each forward's token budget to the next
+    #: power-of-two bucket instead of always padding to max_tokens.
+    #: SplitFuse's variable chunk sizes then compile once per BUCKET
+    #: (probe: ``engine.trace_counts``), and decode windows also bucket the
+    #: seq axis so they run at a token budget near the live-sequence count
+    #: instead of dragging max_tokens of padding through every MLP.
+    bucket_tokens: bool = True
+    min_token_bucket: int = 16
+    #: on-device sampling default for fused decode: 0 = full-vocab
+    #: categorical (or argmax at temperature 0), k>0 = top-k sampling
+    top_k: int = 0
 
 
 class InferenceEngineV2:
@@ -88,22 +100,88 @@ class InferenceEngineV2:
             return jnp.asarray(x, c.dtype)
 
         self.params = jax.tree_util.tree_map_with_path(_cast, params)
-        self._wrapper = RaggedBatchWrapper(c.max_tokens, c.max_seqs, c.max_ctx,
-                                           c.block_size,
-                                           pad_page=self.kv.config.pad_page_flag)
-        self._decode_loops: Dict = {}
-        self._rng = jax.random.PRNGKey(0)
-        self._step = build_ragged_step(self.cfg, max_q=c.max_tokens,
-                                       num_blocks=num_blocks,
-                                       attn_impl=c.attn_impl,
-                                       max_seqs=c.max_seqs,
-                                       max_blocks=self._wrapper.max_blocks,
-                                       block_q=c.block_q,
-                                       pages_per_chunk=c.pages_per_chunk)
         self._num_blocks = num_blocks
+        #: per-bucket compiled programs + host-side batch builders; keys are
+        #: (token_budget, seq_budget).  ``trace_counts`` is the retrace
+        #: probe: it increments exactly when XLA traces a program, so a
+        #: steady-state schedule must show one count per bucket touched.
+        self._wrappers: Dict[Tuple[int, int], RaggedBatchWrapper] = {}
+        self._steps: Dict[Tuple[int, int], object] = {}
+        self._decode_loops: Dict = {}
+        self.trace_counts: Dict[Tuple, int] = {}
+        #: device-resident continuous-decode state: the advanced packed
+        #: metadata returned by the last fused window, reusable by the next
+        #: window with NO host repack / H2D upload (see decode_batch_async)
+        self._decode_state: Optional[Dict] = None
+        self.decode_resume_hits = 0
+        self._rng = jax.random.PRNGKey(0)
+        self._param_bytes = sum(
+            x.size * jnp.dtype(x.dtype).itemsize
+            for x in jax.tree_util.tree_leaves(self.params))
+        self.last_decode_roofline: Optional[Dict] = None
         log_dist(f"InferenceEngineV2: blocks={num_blocks}×{c.block_size} "
                  f"budget={c.max_tokens}tok/{c.max_seqs}seq "
-                 f"kv={self.kv.mem_bytes()/1e6:.0f}MB", ranks=[0])
+                 f"kv={self.kv.mem_bytes()/1e6:.0f}MB "
+                 f"bucketing={'on' if c.bucket_tokens else 'off'}", ranks=[0])
+
+    # ------------------------------------------------------------------ #
+    # Compile-cache bucketing
+    # ------------------------------------------------------------------ #
+    def bucket_for(self, n_tokens: int, n_seqs: int) -> Tuple[int, int]:
+        """(token, seq) budgets this batch compiles under: tokens round up
+        to the next power-of-two bucket (SplitFuse chunk sizes vary every
+        forward — THE retrace source), seqs stay at the engine budget
+        (padded seqs carry zero tokens through a prefill, so seq-axis
+        padding is nearly free and bucketing it would double the compile
+        count for batches differing only in width)."""
+        c = self.config
+        if not c.bucket_tokens:
+            return (c.max_tokens, c.max_seqs)
+        t = max(c.min_token_bucket, 1)
+        while t < n_tokens:
+            t *= 2
+        return (min(t, c.max_tokens), c.max_seqs)
+
+    def _seq_bucket(self, n_seqs: int) -> int:
+        """Decode windows DO bucket the seq axis: their flat token budget
+        IS the seq count, so a pow-two seq bucket directly shrinks the
+        compiled program (one token per sequence through every layer)."""
+        c = self.config
+        if not c.bucket_tokens:
+            return c.max_seqs
+        s = 1
+        while s < n_seqs:
+            s *= 2
+        return min(s, c.max_seqs)
+
+    def _wrapper_for(self, key: Tuple[int, int]) -> RaggedBatchWrapper:
+        if key not in self._wrappers:
+            self._wrappers[key] = RaggedBatchWrapper(
+                key[0], key[1], self.config.max_ctx, self.config.block_size,
+                pad_page=self.kv.config.pad_page_flag)
+        return self._wrappers[key]
+
+    def _counted(self, key, fn):
+        """Wrap a traceable fn so each XLA trace bumps ``trace_counts[key]``
+        (the Python body only runs while tracing — cache hits skip it)."""
+        def wrapped(*args):
+            self.trace_counts[key] = self.trace_counts.get(key, 0) + 1
+            return fn(*args)
+
+        return wrapped
+
+    def _step_for(self, key: Tuple[int, int]):
+        if key not in self._steps:
+            c = self.config
+            fn = build_ragged_step(
+                self.cfg, max_q=key[0], num_blocks=self._num_blocks,
+                attn_impl=c.attn_impl, max_seqs=key[1],
+                max_blocks=self._wrapper_for(key).max_blocks,
+                block_q=c.block_q, pages_per_chunk=c.pages_per_chunk,
+                jit=False)
+            self._steps[key] = jax.jit(self._counted(key, fn),
+                                       donate_argnums=(1,))
+        return self._steps[key]
 
     # ------------------------------------------------------------------ #
     # Admission control (reference :158-242)
@@ -140,24 +218,29 @@ class InferenceEngineV2:
         verdict = self.can_schedule(uids, [len(t) for t in tokens_list])
         if verdict != SchedulingResult.Success:
             raise RuntimeError(f"cannot schedule batch: {verdict}")
-        self._wrapper.clear()
+        self._decode_state = None      # host forward invalidates device meta
+        bucket = self.bucket_for(sum(len(t) for t in tokens_list), len(uids))
+        wrapper = self._wrapper_for(bucket)
+        wrapper.clear()
         for uid, toks in zip(uids, tokens_list):
             seq = self.state_manager.get_or_create_sequence(uid)
             ok = self.state_manager.maybe_allocate_kv(seq, len(toks))
             assert ok, "allocator raced"  # can_schedule checked
-            self._wrapper.insert_sequence(seq, list(toks))
-        batch = self._wrapper.finalize()
+            wrapper.insert_sequence(seq, list(toks))
+        batch = wrapper.finalize()
         # ONE metadata transfer per forward: over the TPU relay link the
         # per-array H2D latency dominates decode steps (measured 3 tok/s with
         # ~15 arrays vs one packed buffer)
         dev = jnp.asarray(batch.pack())
-        logits, new_pages = self._step(self.params, self.kv.pages, dev)
+        logits, new_pages = self._step_for(bucket)(self.params,
+                                                   self.kv.pages, dev)
         self.kv.update(new_pages)
         for uid in batch.uids:
             self.state_manager.get_sequence(uid).post_forward()
         return logits[:batch.n_seqs]
 
     def flush(self, uids: Sequence[int]) -> None:
+        self._decode_state = None
         for uid in uids:
             self.state_manager.flush_sequence(uid)
 
@@ -168,53 +251,184 @@ class InferenceEngineV2:
     def decode_batch(self, uids: Sequence[int],
                      seed_tokens: Sequence[int], steps: int,
                      temperature: float = 0.0,
-                     rng: Optional[jax.Array] = None) -> np.ndarray:
-        """Run ``steps`` decode iterations for ``uids`` entirely on device.
+                     rng: Optional[jax.Array] = None,
+                     top_k: Optional[int] = None) -> np.ndarray:
+        """Run ``steps`` decode iterations for ``uids`` entirely on device
+        and block for the tokens [steps, n_seqs] (host numpy); the last
+        generated token is NOT appended to the cache (matching put()
+        semantics — it is the next call's seed).  See
+        :meth:`decode_batch_async` for the non-blocking form."""
+        return self.decode_batch_async(uids, seed_tokens, steps,
+                                       temperature=temperature, rng=rng,
+                                       top_k=top_k).tokens()
+
+    def decode_batch_async(self, uids: Sequence[int],
+                           seed_tokens: Sequence[int], steps: int,
+                           temperature: float = 0.0,
+                           rng: Optional[jax.Array] = None,
+                           top_k: Optional[int] = None) -> "DecodeWindow":
+        """Dispatch a fused decode window WITHOUT waiting for its tokens.
 
         Each sequence starts from its ``seed_tokens[i]`` (the next input
-        token, e.g. the argmax of its prefill logits) and greedily/sampled
-        decodes ``steps`` tokens with NO host synchronisation between steps:
-        KV blocks for the whole window are allocated up front so the block
-        table is static, and the packed metadata advances on device.
+        token, e.g. the argmax of its prefill logits) and decodes ``steps``
+        tokens with NO host synchronisation between steps: sampling
+        (argmax / temperature / top-k) runs on device, KV blocks for the
+        whole window are allocated up front so the block table is static,
+        and the packed metadata advances on device.
 
-        Returns the generated tokens [steps, n_seqs] (host numpy); the last
-        generated token is NOT appended to the cache (matching put()
-        semantics — it is the next call's seed).
+        Device-resident continuation: the loop returns its ADVANCED
+        metadata (next seed token, positions, ctx lengths) and the engine
+        caches it; when the next window targets the same uid set with
+        unchanged KV block tables, the cached device array is reused —
+        no host repack, no H2D upload.  If the previous window was already
+        drained its last tokens are known on the host, and ``seed_tokens``
+        are honored: seeds matching the cached stream resume device-side,
+        different seeds (stop-token rewrites, guided decoding) force a
+        repack.  For a window dispatched BEFORE the previous one was
+        drained the seeds are unknowable and therefore advisory — the
+        on-device state already holds them.  Combined with JAX async
+        dispatch this lets the host schedule window i+1 while window i is
+        still executing: dispatch the next window first, THEN drain the
+        previous handle's ``tokens()``.
         """
         c = self.config
-        verdict = self.can_schedule(uids, [steps] * len(uids))
+        n = len(uids)
+        verdict = self.can_schedule(uids, [steps] * n)
         if verdict != SchedulingResult.Success:
             raise RuntimeError(f"cannot schedule decode window: {verdict}")
-        self._wrapper.clear()
-        for uid, tok in zip(uids, seed_tokens):
+        # decode bucket: one flat token per sequence — the compiled program
+        # carries n-ish tokens of work, not the full max_tokens budget
+        s_b = self._seq_bucket(n)
+        bucket = (s_b, s_b)
+        ctx_before = []
+        grew = False
+        for uid in uids:
             seq = self.state_manager.get_or_create_sequence(uid)
+            ctx_before.append(seq.seen_tokens)
+            prev = seq.cur_allocated_blocks
             ok = self.state_manager.maybe_allocate_kv(seq, steps)
             assert ok, "allocator raced"
-            self._wrapper.insert_sequence(seq, [int(tok)])
-        batch = self._wrapper.finalize()
+            grew |= seq.cur_allocated_blocks != prev
 
-        key = (steps, float(temperature))
-        if key not in self._decode_loops:
+        st = self._decode_state
+        uids_t = tuple(uids)
+        resume = (not grew and st is not None
+                  and st["uids"] == uids_t and st["bucket"] == bucket
+                  and all(st["seen"][u] ==
+                          self.state_manager.get_sequence(u).seen_tokens
+                          for u in uids))
+        if resume and "last_tokens" in st:
+            # the previous window was drained, so the caller KNOWS the
+            # stream — a seed differing from the cached on-device token
+            # (stop-token rewrite, guided decoding) must win over resume
+            resume = tuple(int(t) for t in seed_tokens) == st["last_tokens"]
+        if resume:
+            self.decode_resume_hits += 1
+            meta_dev = st["meta"]
+        else:
+            if (st is not None and st["uids"] == uids_t
+                    and "last_tokens" not in st
+                    and all(st["seen"][u] ==
+                            self.state_manager.get_sequence(u).seen_tokens
+                            for u in uids)):
+                # chaining off an UNDRAINED window that cannot resume
+                # (block growth crossed a page boundary): the caller's
+                # seeds are advisory and unknowable, so packing them would
+                # silently corrupt the stream — the true next tokens are
+                # the advanced meta's tokens field.  Reading it syncs with
+                # the previous window, the price of a growth-boundary
+                # repack.  (Same uids ⟹ same n ⟹ same bucket, so the
+                # slice below is the previous window's seq rows.)
+                seed_tokens = [int(t) for t in np.asarray(st["meta"][:n])]
+            wrapper = self._wrapper_for(bucket)
+            wrapper.clear()
+            for uid, tok in zip(uids, seed_tokens):
+                wrapper.insert_sequence(
+                    self.state_manager.get_sequence(uid), [int(tok)])
+            meta_dev = jnp.asarray(wrapper.finalize().pack())
+
+        top_k = c.top_k if top_k is None else int(top_k)
+        key = (bucket, steps, float(temperature), top_k)
+        first_compile = key not in self._decode_loops
+        if first_compile:
             from .model_runner import build_decode_loop
 
-            self._decode_loops[key] = build_decode_loop(
-                self.cfg, max_q=c.max_tokens, max_seqs=c.max_seqs,
-                max_blocks=self._wrapper.max_blocks, block_size=c.block_size,
-                num_blocks=self._num_blocks, attn_impl=c.attn_impl,
-                steps=steps, temperature=temperature, block_q=c.block_q,
-                pages_per_chunk=c.pages_per_chunk)
+            loop = build_decode_loop(
+                self.cfg, max_q=bucket[0], max_seqs=bucket[1],
+                max_blocks=self._wrapper_for(bucket).max_blocks,
+                block_size=c.block_size, num_blocks=self._num_blocks,
+                attn_impl=c.attn_impl, steps=steps, temperature=temperature,
+                block_q=c.block_q, pages_per_chunk=c.pages_per_chunk,
+                top_k=top_k, jit=False)
+            self._decode_loops[key] = jax.jit(
+                self._counted(("decode",) + key, loop), donate_argnums=(1,))
         if rng is None:
             # persistent engine key: re-seeding each window with a constant
             # would repeat the identical sample stream every call
             self._rng, rng = jax.random.split(self._rng)
-        toks, new_pages = self._decode_loops[key](
-            self.params, self.kv.pages, jnp.asarray(batch.pack()), rng)
+        t0 = time.perf_counter()
+        toks, new_pages, meta_out = self._decode_loops[key](
+            self.params, self.kv.pages, meta_dev, rng)
         self.kv.update(new_pages)
-        for uid in batch.uids:
+        seen = {}
+        for uid in uids:
             seq = self.state_manager.get_sequence(uid)
             seq.in_flight_tokens = steps
             seq.post_forward()
-        return np.asarray(toks[:, :batch.n_seqs])
+            seen[uid] = seq.seen_tokens
+        self._decode_state = {"uids": uids_t, "bucket": bucket,
+                              "meta": meta_out, "seen": seen}
+        mean_ctx = float(np.mean(ctx_before)) + steps / 2.0 if n else 0.0
+        window = DecodeWindow(self, toks, n, steps, mean_ctx, t0,
+                              resumed=resume, compiled=first_compile)
+        window._state = self._decode_state
+        return window
+
+    def _record_decode_roofline(self, window: "DecodeWindow") -> None:
+        """Feed a drained decode window into the analytic HBM roofline
+        (decode is bandwidth-bound, so %-of-peak HBM — not MFU — is its
+        utilization number).  Stores the per-kernel report on
+        ``last_decode_roofline`` and mirrors it into ``serving/*`` gauges
+        when the process-global telemetry hub is installed, so
+        ``dstpu-telemetry`` renders the serving section."""
+        if not window.n_seqs or not window.duration_s:
+            return
+        from ...profiling.serving_roofline import (
+            decode_roofline_report,
+            decode_window_bytes,
+            format_decode_roofline,
+            publish_decode_gauges,
+        )
+
+        cfg = self.cfg
+        kv_cfg = self.kv.config
+        bytes_by_kernel = decode_window_bytes(
+            num_layers=cfg.num_layers, num_kv_heads=cfg.num_kv_heads,
+            head_dim=cfg.head_dim,
+            kv_dtype_bytes=jnp.dtype(kv_cfg.dtype).itemsize,
+            param_bytes=self._param_bytes, n_seqs=window.n_seqs,
+            steps=window.steps, mean_ctx=window.mean_ctx)
+        report = decode_roofline_report(bytes_by_kernel, window.duration_s,
+                                        window.n_seqs, window.steps)
+        report["resumed"] = window.resumed
+        report["compile_polluted"] = window.compiled
+        self.last_decode_roofline = report
+        if window.compiled:
+            # first window per loop key times trace+XLA-compile inside its
+            # wall clock; publishing that as tok/s or HBM %-of-peak would
+            # put a ~100x-low sample on the telemetry plane.  The flagged
+            # report stays on last_decode_roofline for inspection.
+            return
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is not None:
+            publish_decode_gauges(tel.metrics, report)
+            tel.event("decode_window", tok_per_s=report["decode_tok_per_s"],
+                      hbm_pct_peak=report["hbm_pct_peak"],
+                      n_seqs=window.n_seqs, steps=window.steps,
+                      resumed=window.resumed)
+        logger.debug(format_decode_roofline(report))
 
     # ------------------------------------------------------------------ #
     # Dynamic SplitFuse scheduling (MII-layer policy, host-only logic)
@@ -284,6 +498,55 @@ class InferenceEngineV2:
         )
 
         OrbaxCheckpointEngine(path).save(self.params, "model")
+
+
+class DecodeWindow:
+    """Handle for an in-flight fused decode window (JAX async dispatch).
+
+    Created by :meth:`InferenceEngineV2.decode_batch_async`; the device is
+    already executing the window.  :meth:`tokens` blocks for the result and
+    (once) feeds the window's wall time into the decode HBM roofline.
+
+    ``duration_s`` is dispatch→drain WALL time (JAX exposes no per-dispatch
+    device time): host work done between dispatch and :meth:`tokens`
+    inflates it and understates the published tok/s / HBM %-of-peak gauges.
+    Drain promptly when the roofline numbers matter — the benches do; in
+    the dispatch-next-then-drain-previous pipeline the drain happens right
+    after the next dispatch, so the overstatement is one dispatch's host
+    cost, not a window.
+    """
+
+    def __init__(self, engine: "InferenceEngineV2", toks_dev, n_seqs: int,
+                 steps: int, mean_ctx: float, t0: float,
+                 resumed: bool = False, compiled: bool = False):
+        self.engine = engine
+        self.n_seqs = n_seqs
+        self.steps = steps
+        self.mean_ctx = mean_ctx
+        self.resumed = resumed
+        #: True when this window traced+compiled its decode loop — its wall
+        #: time measures XLA compilation, not decode throughput
+        self.compiled = compiled
+        self._toks_dev = toks_dev
+        self._t0 = t0
+        self._toks: Optional[np.ndarray] = None
+        self.duration_s: Optional[float] = None
+        self._state: Optional[dict] = None
+
+    def tokens(self) -> np.ndarray:
+        """Block for the generated tokens [steps, n_seqs]."""
+        if self._toks is None:
+            self._toks = np.asarray(self._toks_dev[:, :self.n_seqs])
+            self.duration_s = time.perf_counter() - self._t0
+            self._toks_dev = None
+            if self._state is not None and \
+                    self.engine._decode_state is self._state:
+                # the last sampled token is the next window's seed: once it
+                # is host-known, resume can honor caller-supplied seeds
+                self._state["last_tokens"] = tuple(
+                    int(t) for t in self._toks[-1])
+            self.engine._record_decode_roofline(self)
+        return self._toks
 
 
 class ContinuousBatcher:

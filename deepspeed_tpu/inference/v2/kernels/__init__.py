@@ -4,6 +4,12 @@ blocked_flash, linear_blocked_kv_rotary, moe_gather/moe_scatter, logits_gather).
 TPU equivalents live here as Pallas kernels + XLA-native ops; see
 ``ragged_ops.py``.
 """
-from .ragged_ops import paged_kv_append, ragged_paged_attention
+from .ragged_ops import (
+    decode_attention,
+    decode_paged_attention,
+    paged_kv_append,
+    ragged_paged_attention,
+)
 
-__all__ = ["ragged_paged_attention", "paged_kv_append"]
+__all__ = ["ragged_paged_attention", "paged_kv_append",
+           "decode_paged_attention", "decode_attention"]

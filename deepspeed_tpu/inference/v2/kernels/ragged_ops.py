@@ -66,12 +66,19 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
                          q_ref, pages_ref, o_ref,        # VMEM block / HBM
                          kv_bufs, sems, acc, m_scr, l_scr,
                          *, scale, ps, P, KV, G, BQ, S, NB,
-                         alibi, alibi_scaled):
+                         alibi, alibi_scaled, use_refs=True):
     """One grid step = one BQ-token block of the flat query axis.
 
     Walks the sequences whose tokens fall in this block; per sequence,
     walks its context in chunks of P pages with double-buffered DMA.
     Online-softmax state lives in VMEM scratch per (kv head, query row).
+
+    ``use_refs=False`` (interpret mode) hoists the scalar-prefetched
+    metadata into values once up front: jax 0.4.x cannot discharge a
+    while-loop/cond whose predicate reads a Ref, so the CPU interpreter
+    needs every control-flow decision made on VALUES.  On TPU the per-
+    element SMEM reads stay (whole-array SMEM loads are not a Mosaic
+    vector op).
     """
     qb = pl.program_id(0)
     blk_start = qb * BQ
@@ -79,8 +86,20 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
     CH = P * ps                      # context tokens per compute chunk
     rows = BQ * G
 
-    def cu(i):
-        return cu_ref[jnp.minimum(i, S)]
+    if use_refs:
+        def cu(i):
+            return cu_ref[jnp.minimum(i, S)]
+
+        def kvl_at(s):
+            return kvl_ref[s]
+    else:
+        cu_v, kvl_v = cu_ref[...], kvl_ref[...]
+
+        def cu(i):
+            return cu_v[jnp.minimum(i, S)]
+
+        def kvl_at(s):
+            return kvl_v[s]
 
     def seq_valid(s):
         """Sequence s exists, has query tokens, and overlaps this block's
@@ -108,7 +127,7 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
         Decode (q_len 1) reduces to kvl; prefill blocks early in a long
         prompt walk only their causal prefix (~2x less work overall)."""
         s_c = jnp.minimum(s, S - 1)
-        kvl = kvl_ref[s_c]
+        kvl = kvl_at(s_c)
         q1 = cu(s_c + 1)
         t_max = jnp.minimum(blk_end, q1) - 1          # last query row here
         p_max = kvl - q1 + t_max                      # its absolute position
@@ -149,7 +168,7 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
 
     # ---- compute on chunk (s, c) from buffer `slot` --------------------- #
     def compute(s, c, slot):
-        kvl = kvl_ref[jnp.minimum(s, S - 1)]
+        kvl = kvl_at(jnp.minimum(s, S - 1))
         q0 = cu(s)
         q1 = cu(s + 1)
         chunk_base = c * CH
@@ -207,8 +226,13 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
         s, c, slot = state
         nch = _cdiv(eff_kvl(s), CH)
         has_next = c + 1 < nch
-        s_next = jnp.where(has_next, s, next_valid(s + 1))
-        c_next = jnp.where(has_next, c + 1, 0)
+        # ADVICE r5: only run the O(S) next_valid scan when the walk
+        # actually leaves the current sequence — steady-state chunk
+        # iterations on a long context stay on the cheap branch
+        s_next, c_next = jax.lax.cond(
+            has_next,
+            lambda: (s, c + 1),
+            lambda: (next_valid(s + 1), jnp.int32(0)))
 
         @pl.when(seq_valid(s_next))
         def _prefetch():
@@ -302,9 +326,11 @@ def ragged_paged_attention(q: jnp.ndarray, kv_pages: jnp.ndarray,
         alibi = tuple(np.asarray(alibi, np.float32).tolist())   # static const
         assert len(alibi) == H, "alibi slopes must be per query head"
 
+    interp = _interpret() if interpret is None else interpret
     kernel = functools.partial(
         _ragged_paged_kernel, scale=scale, ps=ps, P=P, KV=KV, G=G, BQ=BQ,
-        S=S, NB=NB, alibi=alibi, alibi_scaled=alibi_scaled)
+        S=S, NB=NB, alibi=alibi, alibi_scaled=alibi_scaled,
+        use_refs=not interp)
     out = pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
@@ -324,10 +350,285 @@ def ragged_paged_attention(q: jnp.ndarray, kv_pages: jnp.ndarray,
             ],
         ),
         out_shape=jax.ShapeDtypeStruct((T_pad, H, hd), q.dtype),
-        interpret=_interpret() if interpret is None else interpret,
+        interpret=interp,
     )(kv_lens.astype(jnp.int32), page_table.astype(jnp.int32),
       cu_q_lens.astype(jnp.int32), q, kv_pages)
     return out[:T]
+
+
+# ===================================================================== #
+# Decode-specialized paged attention (the serving fast path)
+# ===================================================================== #
+def _decode_paged_kernel(kvl_ref, pt_ref,                # scalar prefetch
+                         q_ref, pages_ref, o_ref,        # VMEM block / HBM
+                         kv_bufs, sems, acc, m_scr, l_scr,
+                         *, scale, ps, P, KV, G, NB, alibi, alibi_scaled):
+    """One grid step = ONE decoding sequence's single query token.
+
+    The ragged kernel spends a ``[block_q·G, chunk]`` MXU tile per chunk even
+    when only one row is a real decode query — ~``block_q``× wasted compute
+    per sequence.  Here the tile is ``[G, chunk]`` (just the query heads that
+    share a KV head), the context walk covers ONLY this sequence's pages, and
+    there is no in-kernel sequence scan at all.  GQA head packing is free:
+    a page holds K and V for every kv head (``[ps, 2KV, hd]``), so the G
+    query heads of each KV group ride the same double-buffered page fetch.
+    """
+    s = pl.program_id(0)
+    kvl = kvl_ref[s]
+    CH = P * ps                               # context tokens per chunk
+    nch = _cdiv(kvl, CH)
+
+    def page_needed(page_idx):
+        return page_idx * ps < kvl
+
+    def chunk_dma(c, slot, p):
+        page_idx = c * P + p
+        pid = pt_ref[s, jnp.minimum(page_idx, NB - 1)]
+        return pltpu.make_async_copy(
+            pages_ref.at[pid], kv_bufs.at[slot, p], sems.at[slot, p])
+
+    def start_chunk(c, slot):
+        for p in range(P):
+            @pl.when(page_needed(c * P + p))
+            def _():
+                chunk_dma(c, slot, p).start()
+
+    def wait_chunk(c, slot):
+        for p in range(P):
+            @pl.when(page_needed(c * P + p))
+            def _():
+                chunk_dma(c, slot, p).wait()
+
+    acc[:] = jnp.zeros_like(acc)
+    m_scr[:] = jnp.full_like(m_scr, _NEG_INF)
+    l_scr[:] = jnp.zeros_like(l_scr)
+
+    @pl.when(kvl > 0)
+    def _walk():
+        start_chunk(0, 0)
+
+        def compute(c, slot):
+            k_pos = c * CH + \
+                jax.lax.broadcasted_iota(jnp.int32, (G, CH), 1)
+            mask = k_pos < kvl                 # decode: attend all cached ctx
+            col_ok = jax.lax.broadcasted_iota(
+                jnp.int32, (CH, 1), 0) + c * CH < kvl
+            kv = kv_bufs[slot]                 # [P, ps, 2KV, hd]
+            for h in range(KV):
+                qh = q_ref[0, h * G:(h + 1) * G, :].astype(jnp.float32)
+                kh = kv[:, :, h, :].reshape(CH, -1).astype(jnp.float32)
+                # never-DMA'd columns hold stale data: scores there are
+                # masked, but V rows must be zeroed so 0·garbage(NaN)
+                # cannot poison the accumulate
+                vh = jnp.where(col_ok, kv[:, :, KV + h, :].reshape(CH, -1),
+                               0.0).astype(jnp.float32)
+                s_mat = jnp.dot(qh, kh.T,
+                                preferred_element_type=jnp.float32) * scale
+                if alibi is not None:
+                    r = jax.lax.broadcasted_iota(jnp.int32, (G, CH), 0)
+                    slope = jnp.zeros((G, CH), jnp.float32)
+                    for g in range(G):         # static per-head slope
+                        slope = jnp.where(r == g,
+                                          jnp.float32(alibi[h * G + g]),
+                                          slope)
+                    if alibi_scaled:           # falcon: bf16 pre-scale bias
+                        bias = (slope.astype(jnp.bfloat16) *
+                                k_pos.astype(jnp.bfloat16)
+                                ).astype(jnp.float32) * scale
+                    else:                      # bloom: unscaled f32 bias
+                        bias = slope * k_pos.astype(jnp.float32)
+                    s_mat = s_mat + bias
+                s_mat = jnp.where(mask, s_mat, _NEG_INF)
+
+                m_prev = m_scr[h][:, :1]
+                m_new = jnp.maximum(m_prev,
+                                    jnp.max(s_mat, axis=1, keepdims=True))
+                alpha = jnp.exp(m_prev - m_new)
+                p_mat = jnp.exp(s_mat - m_new)
+                l_scr[h] = jnp.broadcast_to(
+                    alpha * l_scr[h][:, :1] +
+                    jnp.sum(p_mat, axis=1, keepdims=True), l_scr[h].shape)
+                acc[h] = acc[h] * alpha + \
+                    jnp.dot(p_mat, vh, preferred_element_type=jnp.float32)
+                m_scr[h] = jnp.broadcast_to(m_new, m_scr[h].shape)
+
+        def body(state):
+            c, slot = state
+
+            @pl.when(c + 1 < nch)
+            def _prefetch():
+                start_chunk(c + 1, 1 - slot)
+
+            wait_chunk(c, slot)
+            compute(c, slot)
+            return c + 1, 1 - slot
+
+        jax.lax.while_loop(lambda st: st[0] < nch, body,
+                           (jnp.int32(0), jnp.int32(0)))
+
+    for h in range(KV):
+        l = l_scr[h][:, :1]
+        o = acc[h] / jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, h * G:(h + 1) * G, :] = o.astype(o_ref.dtype)
+
+
+def decode_paged_attention(q: jnp.ndarray, kv_pages: jnp.ndarray,
+                           kv_lens: jnp.ndarray, page_table: jnp.ndarray, *,
+                           num_kv_heads: int, scale: Optional[float] = None,
+                           alibi=None, alibi_scaled: bool = False,
+                           pages_per_chunk: int = 8,
+                           interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Paged attention for pure-decode batches: ONE query token per sequence.
+
+    Args:
+      q:          [S, H, hd] — sequence s's single new-token query at row s.
+      kv_pages:   [num_pages_total, page_size, 2*KV, hd] page pool (the
+                  multi-layer layout of :func:`ragged_paged_attention`).
+      kv_lens:    [S] context length per sequence (seen + the in-flight
+                  token, i.e. the query's own position is kv_lens-1).
+                  Rows with kv_lens == 0 are padding and yield zeros.
+      page_table: [S, NB] int32 physical page ids.
+    Returns [S, H, hd].
+    """
+    S, H, hd = q.shape
+    _, ps, ckv, hd_k = kv_pages.shape
+    assert hd == hd_k, f"head_dim mismatch {hd} vs {hd_k}"
+    KV = num_kv_heads
+    assert ckv == 2 * KV, f"kv_pages combined-head dim {ckv} != 2*{KV}"
+    assert H % KV == 0, "query heads must be a multiple of kv heads"
+    G = H // KV
+    S_t, NB = page_table.shape
+    assert S_t == S and kv_lens.shape == (S,)
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    P = min(pages_per_chunk, NB)
+
+    # same VMEM accounting as the ragged kernel, with the [G, chunk] tile
+    VMEM_BUDGET = 12 * 1024 * 1024
+    kv_itemsize = jnp.dtype(kv_pages.dtype).itemsize
+
+    def _vmem_bytes(p):
+        kv_bufs = 2 * p * ps * ckv * hd * kv_itemsize
+        softmax = KV * G * (hd + 2 * 128) * 4
+        qo = 2 * 2 * H * hd * jnp.dtype(q.dtype).itemsize
+        temps = 3 * G * (p * ps) * 4
+        return kv_bufs + softmax + qo + temps
+
+    while P > 1 and _vmem_bytes(P) > VMEM_BUDGET:
+        P //= 2
+    if _vmem_bytes(P) > VMEM_BUDGET:
+        raise ValueError(
+            f"decode_paged_attention VMEM budget exceeded even at "
+            f"pages_per_chunk=1: {_vmem_bytes(P)/2**20:.1f}MB > "
+            f"{VMEM_BUDGET/2**20:.0f}MB — reduce page_size ({ps}) or "
+            f"kv heads x head_dim ({KV}x{hd})")
+
+    if alibi is not None:
+        import numpy as np
+
+        alibi = tuple(np.asarray(alibi, np.float32).tolist())
+        assert len(alibi) == H, "alibi slopes must be per query head"
+
+    kernel = functools.partial(
+        _decode_paged_kernel, scale=scale, ps=ps, P=P, KV=KV, G=G, NB=NB,
+        alibi=alibi, alibi_scaled=alibi_scaled)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=(S,),
+            in_specs=[
+                pl.BlockSpec((1, H, hd), lambda s, *_: (s, 0, 0)),
+                pl.BlockSpec(memory_space=pl.ANY),
+            ],
+            out_specs=pl.BlockSpec((1, H, hd), lambda s, *_: (s, 0, 0)),
+            scratch_shapes=[
+                pltpu.VMEM((2, P, ps, ckv, hd), kv_pages.dtype),
+                pltpu.SemaphoreType.DMA((2, P)),
+                pltpu.VMEM((KV, G, hd), jnp.float32),
+                pltpu.VMEM((KV, G, 128), jnp.float32),
+                pltpu.VMEM((KV, G, 128), jnp.float32),
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((S, H, hd), q.dtype),
+        interpret=_interpret() if interpret is None else interpret,
+    )(kv_lens.astype(jnp.int32), page_table.astype(jnp.int32), q, kv_pages)
+
+
+def decode_attend_dense(q: jnp.ndarray, kv_pages: jnp.ndarray,
+                        kv_lens: jnp.ndarray, page_table: jnp.ndarray, *,
+                        num_kv_heads: int, scale: Optional[float] = None,
+                        alibi=None, alibi_scaled: bool = False) -> jnp.ndarray:
+    """Decode attention with q_len=1 semantics in plain XLA — the off-TPU
+    lowering of :func:`decode_paged_attention` (bit-compatible numerics).
+
+    Unlike the prefill-shaped gather oracle this never materialises a
+    ``[S, max_q, H, ctx]`` score tensor — scores are ``[S, H, ctx]`` — so
+    even the interpreter-free CPU sim sees the decode win.  ``kv_lens == 0``
+    rows (bucket padding) produce zeros.
+    """
+    S, H, hd = q.shape
+    _, ps, ckv, _ = kv_pages.shape
+    KV = num_kv_heads
+    G = H // KV
+    NB = page_table.shape[1]
+    C = NB * ps
+    if scale is None:
+        scale = 1.0 / math.sqrt(hd)
+    ctx_pos = jnp.arange(C, dtype=jnp.int32)
+    pg = jnp.take_along_axis(page_table,
+                             (ctx_pos // ps)[None, :].repeat(S, 0), axis=1)
+    off = jnp.broadcast_to((ctx_pos % ps)[None, :], (S, C))
+    ctx = kv_pages[pg, off]                              # [S, C, 2KV, hd]
+    k_ctx, v_ctx = ctx[..., :KV, :], ctx[..., KV:, :]
+    # out-of-context columns may hold never-written garbage: scores there
+    # are masked to -inf, but V must be zeroed too so 0·garbage(NaN)
+    # cannot poison the weighted sum (mirrors the Pallas kernel's col_ok)
+    valid = (ctx_pos[None, :] < kv_lens[:, None])[:, :, None, None]
+    v_ctx = jnp.where(valid, v_ctx, 0.0)
+    if KV != H:
+        k_ctx = jnp.repeat(k_ctx, G, axis=2)
+        v_ctx = jnp.repeat(v_ctx, G, axis=2)
+    scores = jnp.einsum("shd,schd->shc", q.astype(jnp.float32),
+                        k_ctx.astype(jnp.float32)) * scale
+    if alibi is not None:
+        slopes = jnp.asarray(alibi, jnp.float32)          # [H]
+        if alibi_scaled:
+            bias = (slopes[:, None].astype(jnp.bfloat16) *
+                    ctx_pos[None, :].astype(jnp.bfloat16)
+                    ).astype(jnp.float32) * scale
+        else:
+            bias = slopes[:, None] * ctx_pos[None, :].astype(jnp.float32)
+        scores = scores + bias[None, :, :]
+    mask = ctx_pos[None, None, :] < kv_lens[:, None, None]
+    scores = jnp.where(mask, scores, _NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1)
+    # fully-masked (padding) rows: softmax over all -inf is uniform garbage
+    probs = jnp.where(jnp.any(mask, axis=-1, keepdims=True), probs, 0.0)
+    out = jnp.einsum("shc,schd->shd", probs, v_ctx.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def decode_attention(q: jnp.ndarray, kv_pages: jnp.ndarray,
+                     kv_lens: jnp.ndarray, page_table: jnp.ndarray, *,
+                     num_kv_heads: int, scale: Optional[float] = None,
+                     alibi=None, alibi_scaled: bool = False,
+                     pages_per_chunk: int = 8,
+                     impl: Optional[str] = None) -> jnp.ndarray:
+    """Decode fast-path dispatch: the Pallas kernel on TPU, the dense
+    q_len=1 XLA path elsewhere (interpreter-mode Pallas is a correctness
+    tool, not a CPU serving path).  ``impl`` forces ``"pallas"`` /
+    ``"dense"`` for tests."""
+    if impl is None:
+        impl = "dense" if _interpret() else "pallas"
+    if impl == "pallas":
+        return decode_paged_attention(
+            q, kv_pages, kv_lens, page_table, num_kv_heads=num_kv_heads,
+            scale=scale, alibi=alibi, alibi_scaled=alibi_scaled,
+            pages_per_chunk=pages_per_chunk)
+    return decode_attend_dense(
+        q, kv_pages, kv_lens, page_table, num_kv_heads=num_kv_heads,
+        scale=scale, alibi=alibi, alibi_scaled=alibi_scaled)
 
 
 # ===================================================================== #

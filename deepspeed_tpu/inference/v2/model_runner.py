@@ -34,7 +34,11 @@ import jax
 import jax.numpy as jnp
 
 from ...models.transformer import TransformerConfig, rms_norm
-from .kernels.ragged_ops import paged_kv_append, ragged_paged_attention
+from .kernels.ragged_ops import (
+    decode_attention,
+    paged_kv_append,
+    ragged_paged_attention,
+)
 from .ragged.ragged_wrapper import pack_layout
 
 
@@ -133,18 +137,35 @@ def _unpack_batch(batch, max_q, max_seqs, max_blocks):
 
 def _ragged_attend(q, kv_pages, batch, *, attn_impl, layer, num_blocks,
                    max_q, scale, alibi=None, alibi_scaled=False,
-                   block_q=128, pages_per_chunk=8):
+                   block_q=128, pages_per_chunk=8, decode_mode=False):
     """Shared ragged attention dispatch: the flat-token Pallas paged kernel,
-    or the dense page-gather oracle.  q: [T, H, hd] → [T, H*hd].
+    the decode-specialized fast path, or the dense page-gather oracle.
+    q: [T, H, hd] → [T, H*hd].
 
     ``kv_pages`` is the FULL multi-layer page pool; ``layer`` (traced) picks
     this layer's pages via table arithmetic — no per-layer slice
     materialization.
+
+    ``decode_mode`` asserts the row-major decode layout (sequence i's single
+    query token at flat index i, rows past n_seqs padded with ctx_len 0 —
+    what the fused decode loop's batches look like by construction) and
+    dispatches the one-token-per-sequence kernel instead of burning a full
+    ``block_q`` query tile per decoding sequence.
     """
     T, H, hd = q.shape
     KV = kv_pages.shape[2] // 2
     q_len, ctx_len = batch["q_len"], batch["ctx_len"]
     pt_l = batch["block_table"] + layer * num_blocks          # [S, NB]
+    if attn_impl == "paged" and decode_mode:
+        S = q_len.shape[0]
+        SW = min(S, T)
+        out = decode_attention(
+            q[:SW], kv_pages, ctx_len[:SW], pt_l[:SW], num_kv_heads=KV,
+            scale=scale, alibi=alibi, alibi_scaled=alibi_scaled,
+            pages_per_chunk=pages_per_chunk)
+        if T > SW:
+            out = jnp.pad(out, ((0, T - SW), (0, 0), (0, 0)))
+        return out.reshape(T, H * hd)
     if attn_impl == "paged":
         out = ragged_paged_attention(
             q, kv_pages, ctx_len, pt_l, batch["cu_q_lens"],
@@ -176,7 +197,7 @@ def ragged_forward(params: Dict, kv_pages: jnp.ndarray, batch,
                    cfg: TransformerConfig, max_q: int, num_blocks: int,
                    attn_impl: str = "paged", max_seqs: int = 0,
                    max_blocks: int = 0, block_q: int = 128,
-                   pages_per_chunk: int = 8
+                   pages_per_chunk: int = 8, decode_mode: bool = False
                    ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """→ (last-token logits [max_seqs, V], new kv_pages)."""
     batch = _unpack_batch(batch, max_q, max_seqs, max_blocks)
@@ -226,7 +247,8 @@ def ragged_forward(params: Dict, kv_pages: jnp.ndarray, batch,
         o_flat = _ragged_attend(q, kv_pages, batch, attn_impl=attn_impl,
                                 layer=l_idx, num_blocks=num_blocks,
                                 max_q=max_q, scale=scale, block_q=block_q,
-                                pages_per_chunk=pages_per_chunk).astype(dtype)
+                                pages_per_chunk=pages_per_chunk,
+                                decode_mode=decode_mode).astype(dtype)
         x = x + o_flat @ lp["o_proj"]["kernel"]
         h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
         if cfg.num_experts > 1:
@@ -263,7 +285,8 @@ def ragged_forward_universal(params: Dict, kv_pages: jnp.ndarray, batch, cfg,
                              max_q: int, num_blocks: int,
                              attn_impl: str = "paged", max_seqs: int = 0,
                              max_blocks: int = 0, block_q: int = 128,
-                             pages_per_chunk: int = 8
+                             pages_per_chunk: int = 8,
+                             decode_mode: bool = False
                              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Paged ragged serving for the universal (ArchConfig) families —
     gpt2/gptj/opt/bloom/falcon/phi serve through the SAME put/query/flush
@@ -332,7 +355,8 @@ def ragged_forward_universal(params: Dict, kv_pages: jnp.ndarray, batch, cfg,
                                 max_q=max_q, scale=scale, alibi=alibi,
                                 alibi_scaled=cfg.alibi_scaled,
                                 block_q=block_q,
-                                pages_per_chunk=pages_per_chunk).astype(dtype)
+                                pages_per_chunk=pages_per_chunk,
+                                decode_mode=decode_mode).astype(dtype)
         attn_out = o_flat @ lp["o_proj"]["kernel"]
         if "bias" in lp["o_proj"]:
             attn_out = attn_out + lp["o_proj"]["bias"]
@@ -378,13 +402,15 @@ def ragged_forward_universal(params: Dict, kv_pages: jnp.ndarray, batch, cfg,
 def build_ragged_step(cfg, max_q: int, num_blocks: int,
                       attn_impl: str = "paged", max_seqs: int = 0,
                       max_blocks: int = 0, block_q: int = 128,
-                      pages_per_chunk: int = 8, jit: bool = True):
+                      pages_per_chunk: int = 8, jit: bool = True,
+                      decode_mode: bool = False):
     """Jitted step with a donated page pool (the CUDA-graph analogue: one
     compiled program reused for every batch; reference engine.py:494
     _create_cuda_graph).  Dispatches on the config type: TransformerConfig →
     native llama-family runner; ArchConfig → universal per-arch runner.
     ``jit=False`` returns the raw traceable fn (for embedding in the fused
-    decode loop)."""
+    decode loop); ``decode_mode=True`` dispatches the one-token-per-sequence
+    decode attention path (requires row-major decode batches)."""
     from ...models.families import ArchConfig
 
     assert attn_impl in ("paged", "gather"), \
@@ -394,14 +420,29 @@ def build_ragged_step(cfg, max_q: int, num_blocks: int,
     fn = partial(body, cfg=cfg, max_q=max_q, num_blocks=num_blocks,
                  attn_impl=attn_impl, max_seqs=max_seqs,
                  max_blocks=max_blocks, block_q=block_q,
-                 pages_per_chunk=pages_per_chunk)
+                 pages_per_chunk=pages_per_chunk, decode_mode=decode_mode)
     return jax.jit(fn, donate_argnums=(1,)) if jit else fn
+
+
+def sample_tokens(logits, rng, temperature: float = 0.0, top_k: int = 0):
+    """On-device token selection: argmax, temperature, or top-k sampling.
+    ``logits`` [S, V] → int32 [S].  ``rng`` may be None for greedy."""
+    if temperature <= 0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    scaled = logits / temperature
+    if top_k and top_k > 0:
+        vals, idx = jax.lax.top_k(scaled, top_k)
+        choice = jax.random.categorical(rng, vals, axis=-1)
+        return jnp.take_along_axis(idx, choice[:, None],
+                                   axis=-1)[:, 0].astype(jnp.int32)
+    return jax.random.categorical(rng, scaled, axis=-1).astype(jnp.int32)
 
 
 def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
                       block_size: int, num_blocks: int, attn_impl: str,
                       steps: int, temperature: float = 0.0,
-                      block_q: int = 128, pages_per_chunk: int = 8):
+                      block_q: int = 128, pages_per_chunk: int = 8,
+                      top_k: int = 0, jit: bool = True):
     """Fused multi-step greedy/sampling decode: ``steps`` forward+select
     iterations in ONE compiled program (lax.scan), with the batch metadata
     advanced on device between iterations.
@@ -410,8 +451,11 @@ def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
     token — over a remote TPU link that latency (not compute) caps decode
     throughput; even colocated it is the kernel-launch overhead the reference
     kills with CUDA graphs (engine.py:494).  Here the whole decode window is
-    device-resident: token i+1's embedding lookup consumes the argmax of
-    step i without ever leaving HBM.
+    device-resident: token i+1's embedding lookup consumes the sampled token
+    of step i without ever leaving HBM, selection (argmax / temperature /
+    top-k — :func:`sample_tokens`) runs on device, and the advanced metadata
+    is RETURNED so the engine can chain the next window off the device state
+    without a host repack (continuous decode).
 
     Requires a DECODE-ONLY batch laid out row-major (sequence i's single
     query token at flat index i — what RaggedBatchWrapper.finalize produces
@@ -421,11 +465,12 @@ def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
     recomputed from the block table on device.
 
     Returns jitted (params, kv_pages, packed_meta, rng) →
-    (tokens [steps, max_seqs] int32, kv_pages)."""
+    (tokens [steps, max_seqs] int32, kv_pages, advanced_meta)."""
     step_fn = build_ragged_step(cfg, max_q=max_q, num_blocks=num_blocks,
                                 attn_impl=attn_impl, max_seqs=max_seqs,
                                 max_blocks=max_blocks, block_q=block_q,
-                                pages_per_chunk=pages_per_chunk, jit=False)
+                                pages_per_chunk=pages_per_chunk, jit=False,
+                                decode_mode=True)
     layout = pack_layout(max_q, max_seqs, max_blocks)
     NB, bs = max_blocks, block_size
     S = max_seqs
@@ -470,15 +515,15 @@ def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
             logits, pages = step_fn(params, pages, meta)
             if temperature > 0:
                 rng, sub = jax.random.split(rng)
-                toks = jax.random.categorical(sub, logits / temperature,
-                                              axis=-1).astype(jnp.int32)
             else:
-                toks = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                sub = rng
+            toks = sample_tokens(logits, sub, temperature=temperature,
+                                 top_k=top_k)
             meta = advance(meta, toks)
             return (pages, meta, rng), toks
 
-        (kv_pages, _, _), toks = jax.lax.scan(
+        (kv_pages, meta, _), toks = jax.lax.scan(
             body, (kv_pages, meta, rng), None, length=steps)
-        return toks, kv_pages
+        return toks, kv_pages, meta
 
-    return jax.jit(loop, donate_argnums=(1,))
+    return jax.jit(loop, donate_argnums=(1,)) if jit else loop

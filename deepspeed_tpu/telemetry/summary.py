@@ -212,6 +212,33 @@ def overlap_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+def serving_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """The ``serving/*`` gauges (decode HBM roofline, published per drained
+    decode window by ``InferenceEngineV2._record_decode_roofline``): total
+    decode tok/s + achieved-vs-peak HBM bandwidth, and the per-kernel
+    %-of-peak breakdown (attention page walk vs weight stream vs cache
+    append)."""
+    out: Dict[str, Any] = {}
+    kernels: Dict[str, Dict[str, Any]] = {}
+    for m in metrics:
+        name = str(m.get("name", ""))
+        if not name.startswith("serving/"):
+            continue
+        key = name.split("/", 1)[1]
+        labels = m.get("labels") or {}
+        if labels.get("device"):
+            out["device_kind"] = labels["device"]
+        if key.startswith("kernel_"):
+            kname = labels.get("kernel", "?")
+            kernels.setdefault(kname, {})[key[len("kernel_"):]] = \
+                m.get("value")
+        else:
+            out[key] = m.get("value")
+    if kernels:
+        out["kernels"] = kernels
+    return out
+
+
 def memory_summary(metrics: Sequence[Dict[str, Any]],
                    events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
@@ -307,6 +334,7 @@ def summarize_run(events_path: Optional[str],
         "step_breakdown": step_breakdown(run["spans"]),
         "comm": comm_table(run["metrics"], device_kind=device_kind),
         "overlap": overlap_summary(run["metrics"]),
+        "serving": serving_summary(run["metrics"]),
         "profile": profile,
         "xprof": xprof_summary(run["events"], explicit_dir=xprof_dir),
         "memory": memory_summary(run["metrics"], run["events"]),
@@ -433,6 +461,35 @@ def format_summary(s: Dict[str, Any]) -> str:
         for line in format_device_table(xp):
             add(line)
     add("")
+
+    srv = s.get("serving") or {}
+    if srv:
+        add("--- serving (decode HBM roofline) ---")
+        dev = srv.get("device_kind", "?")
+        line = f"decode [{dev}]: "
+        if srv.get("decode_tok_per_s") is not None:
+            line += f"{srv['decode_tok_per_s']:.1f} tok/s"
+        if srv.get("decode_hbm_gbps") is not None:
+            line += f", HBM {srv['decode_hbm_gbps']:.1f}"
+            if srv.get("peak_hbm_gbps"):
+                line += f"/{srv['peak_hbm_gbps']:.0f}"
+            line += " GB/s"
+            if srv.get("decode_hbm_pct_peak") is not None:
+                line += f" ({srv['decode_hbm_pct_peak']:.1f}% of peak)"
+        add(line)
+        kernels = srv.get("kernels") or {}
+        if kernels:
+            add(f"{'kernel':<22}{'HBM(GB/s)':>12}{'%peak':>8}")
+            for kname in sorted(kernels,
+                                key=lambda k: kernels[k].get("hbm_gbps")
+                                or 0, reverse=True):
+                row = kernels[kname]
+                gbps = f"{row['hbm_gbps']:.1f}" \
+                    if row.get("hbm_gbps") is not None else "-"
+                pct = f"{row['hbm_pct_peak']:.1f}%" \
+                    if row.get("hbm_pct_peak") is not None else "-"
+                add(f"{kname:<22}{gbps:>12}{pct:>8}")
+        add("")
 
     add("--- memory high-water marks ---")
     mem = s["memory"]

@@ -41,15 +41,19 @@ class TestEmitGates:
 
     def test_cached_tpu_embedded_off_chip(self):
         """Off-TPU emits carry the newest silicon evidence (when any watchdog
-        windows exist in bench_logs/)."""
+        windows exist in bench_logs/).  Metric "m" matches no real window,
+        so only the one-line all_windows summary may be embedded — never a
+        different metric's full window (ADVICE r5, bench.py:129)."""
         bench._ON_TPU = False
         d = _emit("m", 1.0, "x", 0.0, {})
         cached = d["extra"].get("cached_tpu")
         if cached is None:          # clean checkout without bench_logs
             return
-        assert cached["file"].startswith("wd_")
-        assert "recorded_at" in cached and "data" in cached
+        assert cached["metric_mismatch"] is True
+        assert "file" not in cached and "data" not in cached
         assert isinstance(cached["all_windows"], list)
+        assert all(w["file"].startswith("wd_") and "recorded_at" in w
+                   for w in cached["all_windows"])
 
     def test_cached_tpu_not_embedded_on_chip(self):
         bench._ON_TPU = True
@@ -62,7 +66,9 @@ class TestEmitGates:
     def test_cached_selection_prefers_metric_and_rejects_implausible(self):
         """An OLDER window of the emitted metric beats a newer other-metric
         window; implausible windows (the r3 >peak flash artifact) are never
-        featured; a mismatch fallback is flagged."""
+        featured; with NO metric-matched window the artifact carries only
+        the one-line all_windows summary — a different metric's window is
+        never embedded as data (ADVICE r5, bench.py:129)."""
         import json as j
         import os
         import shutil
@@ -94,9 +100,11 @@ class TestEmitGates:
             assert got["file"] == "wd_train.json"      # older but matching
             assert got["metric_mismatch"] is False
             got = bench._newest_cached_tpu("flash")
-            assert got["file"] != "wd_flash.json"      # implausible rejected
-            assert got["metric_mismatch"] is True      # fallback flagged
-            assert "DIFFERENT metric" in got["note"]
+            # the only "flash" window is implausible → nothing featured:
+            # no file/data, just the flagged summaries
+            assert "file" not in got and "data" not in got
+            assert got["metric_mismatch"] is True
+            assert "no cached on-chip window" in got["note"]
             flagged = [w for w in got["all_windows"]
                        if w["file"] == "wd_flash.json"]
             assert flagged[0].get("rejected") == "implausible"

@@ -1,0 +1,400 @@
+"""Serving decode fast path: decode-specialized paged attention parity,
+on-device sampling, device-resident continuous decode, and compile-cache
+bucketing (PR 6; marker: serving).
+
+The decode kernel (one query token per sequence, online softmax over the
+page walk) is tolerance-asserted against the dense q_len=1 lowering and the
+prefill-shaped gather oracle at MHA and GQA head layouts and at
+block-boundary context lengths.  The engine layer is probed for retraces
+(``trace_counts``) across a mixed prefill/decode schedule and for sampling
+determinism under a fixed key.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.kernels.ragged_ops import (
+    decode_attend_dense,
+    decode_attention,
+    decode_paged_attention,
+)
+from deepspeed_tpu.inference.v2.model_runner import (
+    _attend_gather,
+    sample_tokens,
+)
+
+pytestmark = pytest.mark.serving
+
+
+def _decode_case(rng, ctx_lens, KV, G, hd, ps, NB):
+    """One-query-token-per-sequence batch in the page-pool layout."""
+    S = len(ctx_lens)
+    H = KV * G
+    npages = S * NB + 1                      # + never-referenced spare page
+    q = jnp.asarray(rng.normal(size=(S, H, hd)), jnp.float32)
+    pages = jnp.asarray(rng.normal(size=(npages, ps, 2 * KV, hd)),
+                        jnp.float32)
+    pt = np.zeros((S, NB), np.int32)
+    perm = rng.permutation(npages - 1)
+    for s in range(S):
+        pt[s] = perm[s * NB:(s + 1) * NB]
+    return q, pages, jnp.asarray(ctx_lens, jnp.int32), jnp.asarray(pt)
+
+
+def _gather_oracle(q, pages, pt, ctx_lens, hd):
+    """Decode reference via the prefill-shaped gather oracle (q_len = 1)."""
+    S, H, _ = q.shape
+    ones = jnp.ones(S, jnp.int32)
+    o = _attend_gather(q[:, None], pages, pt, ones,
+                       jnp.asarray(ctx_lens, jnp.int32), 1.0 / np.sqrt(hd))
+    return np.asarray(o[:, 0])
+
+
+class TestDecodeKernelParity:
+    @pytest.mark.parametrize("gqa", [1, 4])      # 1 = MHA (KV == H)
+    def test_paged_vs_gather_parity(self, gqa):
+        """Decode kernel (interpret mode) and its dense lowering both match
+        the gather oracle at MHA and GQA head layouts."""
+        rng = np.random.default_rng(20)
+        KV, hd, ps, NB = 2, 32, 8, 6
+        ctx = [44, 17, 1, 30]
+        q, pages, kvl, pt = _decode_case(rng, ctx, KV, gqa, hd, ps, NB)
+        ref = _gather_oracle(q, pages, pt, ctx, hd)
+        out_k = decode_paged_attention(q, pages, kvl, pt, num_kv_heads=KV,
+                                       pages_per_chunk=2, interpret=True)
+        out_d = decode_attend_dense(q, pages, kvl, pt, num_kv_heads=KV)
+        np.testing.assert_allclose(np.asarray(out_k), ref,
+                                   atol=3e-5, rtol=3e-5)
+        np.testing.assert_allclose(np.asarray(out_d), ref,
+                                   atol=3e-5, rtol=3e-5)
+
+    @pytest.mark.parametrize("rem", [0, 1, -1])
+    def test_block_boundary_contexts(self, rem):
+        """ctx % page_size ∈ {0, 1, page_size-1}: the page walk's tail
+        masking must be exact at every boundary alignment."""
+        rng = np.random.default_rng(21)
+        KV, G, hd, ps, NB = 2, 2, 32, 8, 5
+        base = 3 * ps                              # 3 full pages
+        ctx = [base + rem, ps + rem if ps + rem > 0 else ps, 2 * ps + rem]
+        q, pages, kvl, pt = _decode_case(rng, ctx, KV, G, hd, ps, NB)
+        ref = _gather_oracle(q, pages, pt, ctx, hd)
+        out_k = decode_paged_attention(q, pages, kvl, pt, num_kv_heads=KV,
+                                       pages_per_chunk=2, interpret=True)
+        out_d = decode_attend_dense(q, pages, kvl, pt, num_kv_heads=KV)
+        np.testing.assert_allclose(np.asarray(out_k), ref,
+                                   atol=3e-5, rtol=3e-5)
+        np.testing.assert_allclose(np.asarray(out_d), ref,
+                                   atol=3e-5, rtol=3e-5)
+
+    def test_padding_rows_yield_zeros(self):
+        """kv_lens == 0 rows are bucket padding: all-zero output, and no
+        NaN contamination from never-written pages."""
+        rng = np.random.default_rng(22)
+        KV, G, hd, ps, NB = 1, 2, 16, 4, 3
+        ctx = [9, 0, 5]
+        q, pages, kvl, pt = _decode_case(rng, ctx, KV, G, hd, ps, NB)
+        pages = pages.at[int(pt[1, 0])].set(jnp.nan)   # pad row's first page
+        for out in (
+            decode_paged_attention(q, pages, kvl, pt, num_kv_heads=KV,
+                                   pages_per_chunk=2, interpret=True),
+            decode_attend_dense(q, pages, kvl, pt, num_kv_heads=KV),
+        ):
+            out = np.asarray(out)
+            assert np.all(np.isfinite(out))
+            np.testing.assert_allclose(out[1], 0.0)
+
+    def test_pages_per_chunk_invariance(self):
+        """pages_per_chunk is a DMA tuning knob, not semantics."""
+        rng = np.random.default_rng(23)
+        KV, G, hd, ps, NB = 2, 2, 32, 8, 6
+        ctx = [41, 48, 7]
+        q, pages, kvl, pt = _decode_case(rng, ctx, KV, G, hd, ps, NB)
+        outs = [np.asarray(decode_paged_attention(
+            q, pages, kvl, pt, num_kv_heads=KV, pages_per_chunk=p,
+            interpret=True)) for p in (1, 4)]
+        np.testing.assert_allclose(outs[0], outs[1], atol=2e-5, rtol=2e-5)
+
+    def test_alibi_parity(self):
+        """Per-head ALiBi bias rides the decode kernel's [G, chunk] tile."""
+        rng = np.random.default_rng(24)
+        KV, G, hd, ps, NB = 2, 2, 32, 8, 4
+        H = KV * G
+        slopes = [2.0 ** (-(i + 1)) for i in range(H)]
+        ctx = [25, 8]
+        q, pages, kvl, pt = _decode_case(rng, ctx, KV, G, hd, ps, NB)
+        out_k = decode_paged_attention(q, pages, kvl, pt, num_kv_heads=KV,
+                                       alibi=slopes, pages_per_chunk=2,
+                                       interpret=True)
+        out_d = decode_attend_dense(q, pages, kvl, pt, num_kv_heads=KV,
+                                    alibi=slopes)
+        np.testing.assert_allclose(np.asarray(out_k), np.asarray(out_d),
+                                   atol=3e-5, rtol=3e-5)
+
+    def test_dispatch_seam(self):
+        """decode_attention(impl=...) forces either lowering explicitly."""
+        rng = np.random.default_rng(25)
+        q, pages, kvl, pt = _decode_case(rng, [12], 1, 2, 16, 4, 4)
+        a = decode_attention(q, pages, kvl, pt, num_kv_heads=1, impl="dense")
+        b = decode_attend_dense(q, pages, kvl, pt, num_kv_heads=1)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+class TestOnDeviceSampling:
+    def _logits(self):
+        return jax.random.normal(jax.random.PRNGKey(7), (5, 64), jnp.float32)
+
+    def test_greedy_is_argmax(self):
+        logits = self._logits()
+        toks = sample_tokens(logits, None, temperature=0.0)
+        np.testing.assert_array_equal(np.asarray(toks),
+                                      np.asarray(jnp.argmax(logits, -1)))
+
+    def test_fixed_key_is_deterministic(self):
+        logits = self._logits()
+        key = jax.random.PRNGKey(42)
+        a = sample_tokens(logits, key, temperature=0.8, top_k=8)
+        b = sample_tokens(logits, key, temperature=0.8, top_k=8)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        c = sample_tokens(logits, jax.random.PRNGKey(43), temperature=0.8,
+                          top_k=8)
+        assert not np.array_equal(np.asarray(a), np.asarray(c))
+
+    def test_top_k_restricts_support(self):
+        logits = self._logits()
+        k = 4
+        top = np.asarray(jax.lax.top_k(logits, k)[1])
+        for seed in range(8):
+            toks = np.asarray(sample_tokens(
+                logits, jax.random.PRNGKey(seed), temperature=1.5, top_k=k))
+            for row, t in enumerate(toks):
+                assert t in top[row], f"token {t} outside top-{k} of row {row}"
+
+    def test_engine_decode_fixed_rng_deterministic(self, tiny_lm):
+        """Two fresh engines, same params, same explicit window rng → the
+        SAME sampled token stream (on-device sampling determinism)."""
+        model, params = tiny_lm
+        toks = []
+        for _ in range(2):
+            eng = _engine(model, params, attn_impl="gather")
+            logits = eng.put([0], [[3, 5, 7, 11]])
+            seed = int(jnp.argmax(logits[0]))
+            out = eng.decode_batch([0], [seed], steps=6, temperature=0.9,
+                                   top_k=4, rng=jax.random.PRNGKey(123))
+            toks.append(np.asarray(out))
+        np.testing.assert_array_equal(toks[0], toks[1])
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def _engine(model, params, **kw):
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2,
+        RaggedInferenceEngineConfig,
+    )
+
+    base = dict(max_tokens=16, max_seqs=4, max_ctx=64, block_size=8,
+                dtype=jnp.float32, block_q=16, pages_per_chunk=2)
+    base.update(kw)
+    return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        **base))
+
+
+class TestEngineDecodeParity:
+    def test_paged_vs_gather_greedy_decode(self, tiny_lm):
+        """End-to-end fused decode: both attention impls generate the same
+        greedy token stream from the same prefill."""
+        model, params = tiny_lm
+        streams = {}
+        for impl in ("paged", "gather"):
+            eng = _engine(model, params, attn_impl=impl)
+            logits = eng.put([0, 1], [[3, 5, 7, 11, 13], [17, 19]])
+            seeds = [int(t) for t in np.asarray(jnp.argmax(logits, -1))]
+            toks = eng.decode_batch([0, 1], seeds, steps=5)
+            streams[impl] = np.asarray(toks)
+        np.testing.assert_array_equal(streams["paged"], streams["gather"])
+
+    def test_decode_window_chaining_matches_stepwise(self, tiny_lm):
+        """Two chained fused windows (the second resuming from device-
+        resident metadata) reproduce the stepwise put() token stream."""
+        model, params = tiny_lm
+        prompt = [3, 5, 7, 11]
+
+        eng = _engine(model, params, attn_impl="gather")
+        logits = eng.put([0], [prompt])
+        tok = int(jnp.argmax(logits[0]))
+        stepwise = []
+        for _ in range(4):
+            logits = eng.put([0], [[tok]])
+            tok = int(jnp.argmax(logits[0]))
+            stepwise.append(tok)
+
+        # window sizes chosen so window 2 fits the block allocated by
+        # window 1 (4 prompt + 2 + 2 ≤ block_size 8): resume requires an
+        # unchanged block table
+        eng2 = _engine(model, params, attn_impl="gather")
+        logits = eng2.put([0], [prompt])
+        seed = int(jnp.argmax(logits[0]))
+        w1 = eng2.decode_batch([0], [seed], steps=2)
+        w2 = eng2.decode_batch([0], [int(w1[-1, 0])], steps=2)
+        assert eng2.decode_resume_hits == 1, \
+            "second window must resume from device-resident metadata"
+        fused = [int(t) for t in np.concatenate([w1[:, 0], w2[:, 0]])]
+        assert fused == stepwise
+        # a host put() invalidates the cached device metadata (the cache
+        # changed shape under it): the next window must NOT resume
+        eng2.put([1], [[2, 4]])                   # unrelated admission
+        eng2.decode_batch([0], [int(w2[-1, 0])], steps=2)
+        assert eng2.decode_resume_hits == 1
+
+    def test_undrained_growth_chain_uses_device_seeds(self, tiny_lm):
+        """Async chaining (dispatch window 2 BEFORE draining window 1)
+        across a block-growth boundary cannot resume — and the caller's
+        seeds are unknowable then, so the repack must read the true next
+        tokens from the advanced device metadata, not pack the advisory
+        seeds into the stream."""
+        model, params = tiny_lm
+        prompt = [3, 5, 7, 11]
+        # oracle: the same two windows chained with drains in between
+        # (window 2 grows a block: 4 prompt + 2 + 4 > block_size 8)
+        eng = _engine(model, params, attn_impl="gather")
+        logits = eng.put([0], [prompt])
+        seed = int(jnp.argmax(logits[0]))
+        w1 = eng.decode_batch([0], [seed], steps=2)
+        w2 = eng.decode_batch([0], [int(w1[-1, 0])], steps=4)
+        expect = [int(t) for t in np.concatenate([w1[:, 0], w2[:, 0]])]
+
+        eng2 = _engine(model, params, attn_impl="gather")
+        logits = eng2.put([0], [prompt])
+        a1 = eng2.decode_batch_async([0], [seed], steps=2)
+        # window 1 is NOT drained: pass a deliberately wrong advisory seed
+        a2 = eng2.decode_batch_async([0], [0], steps=4)
+        assert eng2.decode_resume_hits == 0
+        got = [int(t) for t in np.concatenate(
+            [a1.tokens()[:, 0], a2.tokens()[:, 0]])]
+        assert got == expect
+
+    def test_drained_seed_override_forces_repack(self, tiny_lm):
+        """Once a window is drained its last tokens are host-known, so a
+        caller-supplied seed that DIFFERS from the cached stream (stop-token
+        rewrite, guided decoding) must be honored via a repack, not silently
+        dropped by the resume path."""
+        model, params = tiny_lm
+        prompt = [3, 5, 7, 11]
+
+        eng = _engine(model, params, attn_impl="gather")
+        logits = eng.put([0], [prompt])
+        seed = int(jnp.argmax(logits[0]))
+        w1 = eng.decode_batch([0], [seed], steps=2)
+        override = (int(w1[-1, 0]) + 1) % model.config.vocab_size
+        w2 = eng.decode_batch([0], [override], steps=2)
+        assert eng.decode_resume_hits == 0, \
+            "a mismatching seed must not resume device-side"
+
+        # oracle: the same override decoded stepwise from the same prefix
+        eng2 = _engine(model, params, attn_impl="gather")
+        eng2.put([0], [prompt])
+        eng2.decode_batch([0], [seed], steps=2)
+        tok, expect = override, []
+        for _ in range(2):
+            lg = eng2.put([0], [[tok]])
+            tok = int(jnp.argmax(lg[0]))
+            expect.append(tok)
+        assert [int(t) for t in w2[:, 0]] == expect
+
+
+class TestDecodeRoofline:
+    def test_window_publishes_serving_gauges(self, tiny_lm, tmp_path):
+        """A drained decode window under installed telemetry publishes the
+        serving/* gauges and `dstpu-telemetry` renders the per-kernel
+        decode HBM %-of-peak table (the roofline acceptance probe)."""
+        from deepspeed_tpu.telemetry import Telemetry, set_telemetry
+        from deepspeed_tpu.telemetry.summary import (
+            format_summary,
+            serving_summary,
+        )
+
+        model, params = tiny_lm
+        tel = Telemetry(output_dir=str(tmp_path))
+        set_telemetry(tel)
+        try:
+            eng = _engine(model, params, attn_impl="gather")
+            logits = eng.put([0], [[3, 5, 7, 11]])
+            w1 = eng.decode_batch([0], [int(jnp.argmax(logits[0]))], steps=4)
+            # window 1 compiled the decode loop: its wall time is XLA
+            # compile, so it must be flagged and kept OFF the gauges
+            assert eng.last_decode_roofline["compile_polluted"]
+            assert "serving/decode_tok_per_s" not in {
+                m["name"] for m in tel.metrics.snapshot()}
+            eng.decode_batch([0], [int(w1[-1, 0])], steps=4)
+            rep = eng.last_decode_roofline
+            assert rep is not None and rep["steps"] == 4
+            assert not rep["compile_polluted"]
+            assert set(rep["kernels"]) == {"decode_attention", "kv_append",
+                                           "param_stream"}
+            srv = serving_summary(tel.metrics.snapshot())
+            assert srv["decode_tok_per_s"] > 0
+            assert "decode_hbm_pct_peak" in srv
+            assert set(srv["kernels"]) == set(rep["kernels"])
+            rendered = format_summary({
+                "run_dir": "x", "wall_s": 1.0, "counts": {},
+                "sources": {"events": "in-memory", "trace": None},
+                "step_breakdown": [], "comm": [], "overlap": {},
+                "serving": srv, "profile": None, "xprof": {}, "memory": {},
+                "incidents": {"event_counts": {}, "checkpoints": [],
+                              "incidents": []},
+                "events_total": 0})
+            assert "serving (decode HBM roofline)" in rendered
+            assert "decode_attention" in rendered and "%peak" in rendered
+        finally:
+            set_telemetry(None)
+
+
+class TestCompileCacheBucketing:
+    def test_bucket_for_rounding(self, tiny_lm):
+        model, params = tiny_lm
+        eng = _engine(model, params, max_tokens=64, max_seqs=8,
+                      min_token_bucket=16)
+        # put() buckets tokens only (seq padding is free for prefill)
+        assert eng.bucket_for(5, 1) == (16, 8)
+        assert eng.bucket_for(16, 2) == (16, 8)
+        assert eng.bucket_for(17, 3) == (32, 8)
+        assert eng.bucket_for(1000, 100) == (64, 8)   # clamped to budget
+        # decode windows bucket the seq axis (flat tokens == seqs there)
+        assert eng._seq_bucket(3) == 4
+        assert eng._seq_bucket(100) == 8
+        eng_off = _engine(model, params, max_tokens=64, max_seqs=8,
+                          bucket_tokens=False)
+        assert eng_off.bucket_for(5, 1) == (64, 8)
+        assert eng_off._seq_bucket(3) == 8
+
+    def test_mixed_schedule_one_compile_per_bucket(self, tiny_lm):
+        """Acceptance probe: a mixed prefill/decode schedule with variable
+        SplitFuse chunk sizes shows exactly ONE compile per (tokens, seqs)
+        bucket and per decode-loop shape."""
+        model, params = tiny_lm
+        eng = _engine(model, params, max_tokens=32)
+        logits = eng.put([0, 1], [[3, 5, 7, 11], [2, 4]])   # 6 tok → (16, 4)
+        seeds = [int(t) for t in np.asarray(jnp.argmax(logits, -1))]
+        toks = eng.decode_batch([0, 1], seeds, steps=2)
+        toks = eng.decode_batch([0, 1], [int(t) for t in toks[-1]], steps=2)
+        eng.put([0], [[9] * 5])                             # 5 tok → (16, 4)
+        eng.put([0, 1], [[4] * 7, [4] * 7])                 # 14 tok → (16, 4)
+        toks2 = eng.decode_batch([0, 1], [3, 4], steps=2)
+        assert toks2 is not None
+        assert eng.trace_counts[(16, 4)] == 1, \
+            "SplitFuse chunk sizes within one bucket must not retrace"
+        eng.put([0], [[6] * 20])                            # 20 tok → (32, 4)
+        for key, count in eng.trace_counts.items():
+            assert count == 1, f"bucket {key} retraced: {count} traces"
+        assert (32, 4) in eng.trace_counts
+        # decode windows of the same shape share ONE compiled loop
+        decode_keys = [k for k in eng.trace_counts if k[0] == "decode"]
+        assert len(decode_keys) == 1

@@ -1,0 +1,27 @@
+"""CI gate for the serving engine smoke check
+(tools/check_serving_smoke.py): `InferenceEngineV2` prefill → fused
+4-token decode on the CPU sim under both attention impls — same
+enforcement pattern as the no-bare-print lint, so the engine cannot rot
+silently while the TPU relay is down."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.serving
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CHECK = os.path.join(REPO_ROOT, "tools", "check_serving_smoke.py")
+
+
+class TestServingSmoke:
+    def test_smoke_check_passes(self):
+        """This IS the CI gate: prefill→decode must work under both attn
+        impls, agree on the greedy stream, and record the decode roofline."""
+        proc = subprocess.run([sys.executable, CHECK],
+                              capture_output=True, text=True, timeout=300)
+        assert proc.returncode == 0, \
+            f"serving smoke checks failed:\n{proc.stdout}" \
+            f"{proc.stderr[-1000:]}"
