@@ -85,7 +85,7 @@ def initialize(
         if mesh_config is not None:
             topology = initialize_mesh(mesh_config, force=True)
         else:
-            topology = _topology_from_config(raw_cfg)
+            topology = _topology_from_env_or_config(raw_cfg)
 
     if isinstance(config, DeepSpeedConfig):
         ds_config = config
@@ -110,6 +110,41 @@ def initialize(
         collate_fn=collate_fn, seed=seed)
 
     return engine, engine.optimizer, engine.training_dataloader, engine.lr_scheduler
+
+
+def _topology_from_env_or_config(cfg: dict):
+    """The elastic agent's re-planned mesh wins over config-derived degrees.
+
+    A worker restarted with ``--allow-reshape`` carries the gang's actual
+    capacity in ``DSTPU_ELASTIC_MESH_SHAPE`` — the DeepSpeed config still
+    describes the LAUNCH-time world, so building from it would reconstruct
+    the stale pre-shrink mesh (or fail outright on fewer chips).  Explicit
+    ``topology=``/``mesh_config=``/``mpu=`` arguments still take precedence
+    over both (the caller hand-wired a mesh on purpose)."""
+    from .runtime.topology import topology_config_from_env
+    from .utils.logging import log_dist
+
+    env_cfg = topology_config_from_env()
+    if env_cfg is None:
+        return _topology_from_config(cfg)
+    import jax
+    import numpy as np
+
+    devices = jax.devices()
+    explicit = [env_cfg.pipe, env_cfg.data, env_cfg.expert, env_cfg.seq,
+                env_cfg.tensor]
+    if all(d > 0 for d in explicit):
+        # the re-planned gang may be smaller than this host's visible pool
+        # (CPU sim; or a worker seeing the full host while the agent planned
+        # a subset): take the leading devices the plan needs
+        needed = int(np.prod(explicit))
+        if needed < len(devices):
+            devices = devices[:needed]
+    log_dist(f"elastic reshape: building mesh from DSTPU_ELASTIC_MESH_SHAPE "
+             f"({env_cfg}) over {len(devices)} device(s); config-derived "
+             f"parallel degrees are superseded for this incarnation",
+             ranks=[0])
+    return initialize_mesh(env_cfg, devices=devices, force=True)
 
 
 def _topology_from_config(cfg: dict):

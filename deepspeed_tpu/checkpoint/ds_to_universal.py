@@ -1,17 +1,24 @@
-"""Universal checkpoint conversion (reference: deepspeed/checkpoint/
+"""Universal checkpoint conversion CLI (reference: deepspeed/checkpoint/
 ds_to_universal.py:469 main; extract :112/:152, TP-slice merge :232).
 
-The reference needs a multi-stage offline pipeline because its ZeRO shards are
-rank-local flat-buffer slices entangled with TP/PP layout.  Orbax checkpoints
-are already layout-agnostic (global-shape arrays + shard metadata), so a
-checkpoint saved on ANY mesh loads on any other — the "universal" property is
-intrinsic.  This module therefore provides:
+The reference needs a multi-stage offline pipeline because its ZeRO shards
+are rank-local flat-buffer slices entangled with TP/PP layout.  Here the
+engine's checkpoints already carry a logical layout manifest
+(``checkpoint/universal/layout.py``) and reshard on load — so ``convert``
+is an *exporter*: it validates the source tag against the PR-1 integrity
+manifest, then materializes the engine checkpoint into the reference's
+offline universal layout (one directory per parameter holding ``fp32.npy``
+plus adam moments named ``exp_avg``/``exp_avg_sq``), each array saved with
+an **explicit dtype contract**: the stored dtype is recorded in
+``index.json`` and re-applied on load, so bf16 leaves survive the numpy
+round trip (a raw ``np.save``/``np.load`` of an ml_dtypes array comes back
+as opaque ``|V2`` bytes).
 
-  * :func:`convert` — normalize any engine checkpoint into the explicit
-    universal layout (one array per param, fp32, plus optimizer moments named
-    ``exp_avg``/``exp_avg_sq`` like the reference's universal shards);
-  * :func:`load_universal` — restore a universal dir into a live engine
-    (the ``load_universal_checkpoint`` path, universal_checkpoint.py:22);
+  * :func:`convert` — engine checkpoint → universal dir (``--tag``
+    verified against ``fault/manifest.py`` before any byte is read);
+  * :func:`load_universal` — universal dir → flat ``{name: ndarray}``
+    with faithful dtypes (``load_universal_checkpoint`` path,
+    universal_checkpoint.py:22);
   * the same CLI surface as the reference script.
 """
 from __future__ import annotations
@@ -24,63 +31,150 @@ from typing import Any, Dict, Optional
 import numpy as np
 
 UNIVERSAL_SUBDIR = "zero"  # reference layout: <dir>/zero/<param>/fp32.pt etc.
+INDEX_FILE = "index.json"
+
+# one tree-flattening convention for the whole universal-checkpoint stack
+from .universal.layout import flat_values as _flatten  # noqa: E402
 
 
-def _flatten(tree, prefix=""):
-    out = {}
-    if isinstance(tree, dict):
-        for k, v in tree.items():
-            out.update(_flatten(v, f"{prefix}/{k}" if prefix else str(k)))
-    else:
-        out[prefix] = tree
-    return out
+def _np_with_dtype(arr: Any) -> np.ndarray:
+    """Host ndarray preserving the logical dtype (bf16 via ml_dtypes)."""
+    import ml_dtypes  # ships with jax
+
+    a = np.asarray(arr)
+    if a.dtype == np.dtype("V2"):  # raw bf16 bytes from a typeless source
+        a = a.view(ml_dtypes.bfloat16)
+    return a
 
 
-def convert(checkpoint_dir: str, output_dir: str, tag: Optional[str] = None) -> None:
-    """Engine checkpoint → universal dir of per-param .npy files."""
+def _save_leaf(pdir: str, fname: str, arr: np.ndarray) -> Dict[str, Any]:
+    """Write one array; bf16/fp8 save as their raw bytes, the dtype
+    contract lives in index.json."""
+    np.save(os.path.join(pdir, fname), arr)
+    return {"file": fname + ".npy", "dtype": arr.dtype.name,
+            "shape": list(arr.shape)}
+
+
+def _load_leaf(pdir: str, rec: Dict[str, Any]) -> np.ndarray:
+    import ml_dtypes
+
+    raw = np.load(os.path.join(pdir, rec["file"]))
+    want = rec.get("dtype")
+    if want and raw.dtype.name != want:
+        try:
+            dt = np.dtype(want)
+        except TypeError:
+            dt = np.dtype(getattr(ml_dtypes, want))
+        # numpy reloads exotic dtypes as void bytes of equal width — a
+        # view restores the logical type losslessly; a genuine dtype
+        # change (legacy fp32 export) casts
+        raw = raw.view(dt) if raw.dtype.itemsize == dt.itemsize and \
+            raw.dtype.kind == "V" else raw.astype(dt)
+    return raw
+
+
+def convert(checkpoint_dir: str, output_dir: str, tag: Optional[str] = None,
+            strict: bool = True) -> str:
+    """Engine checkpoint → universal dir.  Returns the tag converted.
+
+    ``strict`` verifies the source tag against its integrity manifest
+    (``fault/manifest.py``) before conversion — a torn checkpoint must
+    fail here, not produce a silently-wrong universal export."""
     import orbax.checkpoint as ocp
 
+    from ..runtime.fault.manifest import verify_checkpoint
+    from .universal.layout import read_layout
+
     if tag is None:
-        with open(os.path.join(checkpoint_dir, "latest")) as f:
-            tag = f.read().strip()
+        from ..runtime.checkpoint_engine.orbax_checkpoint_engine import \
+            OrbaxCheckpointEngine
+
+        tag = OrbaxCheckpointEngine(checkpoint_dir).latest_tag()
+        if tag is None:
+            raise FileNotFoundError(
+                f"{checkpoint_dir}: no valid committed checkpoint tag")
+    src = os.path.join(checkpoint_dir, str(tag))
+    if strict:
+        verify_checkpoint(src)  # raises CheckpointCorruptError naming the damage
+    layout = read_layout(src)
+
     with ocp.PyTreeCheckpointer() as ckptr:
-        state = ckptr.restore(os.path.join(checkpoint_dir, str(tag), "state"))
+        state = ckptr.restore(os.path.join(src, "state"))
 
     os.makedirs(os.path.join(output_dir, UNIVERSAL_SUBDIR), exist_ok=True)
-    params = _flatten(state["params"])
-    # optax adam-family states: find mu/nu trees by shape-matched names
-    opt_flat = _flatten(state.get("opt_state", {}))
+    params = _flatten(state["params"] if isinstance(state, dict) else state)
+    # optax adam-family states: mu/nu subtrees mirror the param tree; their
+    # flattened suffixes match param names exactly
+    opt_flat = _flatten(state.get("opt_state", {})
+                        if isinstance(state, dict) else {})
     moments: Dict[str, Dict[str, Any]] = {}
     for name, arr in opt_flat.items():
-        low = name.lower()
-        if "/mu/" in low or low.startswith("mu/") or "/mu" == low[-3:]:
-            moments.setdefault(name.split("mu/", 1)[-1], {})["exp_avg"] = arr
-        elif "/nu/" in low or low.startswith("nu/"):
-            moments.setdefault(name.split("nu/", 1)[-1], {})["exp_avg_sq"] = arr
+        for marker, uname in (("mu/", "exp_avg"), ("nu/", "exp_avg_sq")):
+            if f"/{marker}" in f"/{name}":
+                moments.setdefault(name.split(marker, 1)[-1], {})[uname] = arr
 
+    index: Dict[str, Any] = {"version": 2, "source_tag": str(tag),
+                             "params": {}}
     for name, arr in params.items():
         pdir = os.path.join(output_dir, UNIVERSAL_SUBDIR, name.replace("/", "."))
         os.makedirs(pdir, exist_ok=True)
-        np.save(os.path.join(pdir, "fp32.npy"),
-                np.asarray(arr, dtype=np.float32))
+        a = _np_with_dtype(arr)
+        rec = {"leaves": {"param": _save_leaf(pdir, "fp32", a)}}
         for mname, marr in moments.get(name, {}).items():
-            np.save(os.path.join(pdir, f"{mname}.npy"),
-                    np.asarray(marr, dtype=np.float32))
+            rec["leaves"][mname] = _save_leaf(pdir, mname,
+                                              _np_with_dtype(marr))
+        index["params"][name] = rec
 
-    meta = {"step": int(np.asarray(state.get("global_step", 0))),
-            "source_tag": str(tag)}
+    step = 0
+    if isinstance(state, dict) and state.get("global_step") is not None:
+        step = int(np.asarray(state["global_step"]))
+    index["step"] = step
+    if layout is not None:
+        index["source_mesh"] = layout.get("mesh")
+        index["zero_stage"] = layout.get("zero_stage")
+    with open(os.path.join(output_dir, INDEX_FILE), "w") as f:
+        json.dump(index, f, indent=1, sort_keys=True)
+    # kept for readers of the old stub format
     with open(os.path.join(output_dir, "universal_meta.json"), "w") as f:
-        json.dump(meta, f)
+        json.dump({"step": step, "source_tag": str(tag)}, f)
+    return str(tag)
 
 
-def load_universal(universal_dir: str) -> Dict[str, np.ndarray]:
-    """Universal dir → flat {param_name: fp32 ndarray}."""
+def load_universal(universal_dir: str,
+                   include_moments: bool = False) -> Dict[str, Any]:
+    """Universal dir → flat ``{param_name: ndarray}`` with faithful dtypes.
+
+    ``include_moments=True`` returns
+    ``{name: {"param": ..., "exp_avg": ..., "exp_avg_sq": ...}}`` instead.
+    Pre-index (v1) exports load as before (fp32, dtype contract unknown).
+    """
     zdir = os.path.join(universal_dir, UNIVERSAL_SUBDIR)
-    out = {}
-    for pname in sorted(os.listdir(zdir)):
-        fp32 = os.path.join(zdir, pname, "fp32.npy")
-        if os.path.exists(fp32):
-            out[pname.replace(".", "/")] = np.load(fp32)
+    index_path = os.path.join(universal_dir, INDEX_FILE)
+    out: Dict[str, Any] = {}
+    if os.path.exists(index_path):
+        with open(index_path) as f:
+            index = json.load(f)
+        for name, rec in index["params"].items():
+            pdir = os.path.join(zdir, name.replace("/", "."))
+            leaves = {ln: _load_leaf(pdir, lrec)
+                      for ln, lrec in rec["leaves"].items()}
+            out[name] = leaves if include_moments else leaves["param"]
+        return out
+    for pname in sorted(os.listdir(zdir)):               # legacy v1 layout
+        pdir = os.path.join(zdir, pname)
+        fp32 = os.path.join(pdir, "fp32.npy")
+        if not os.path.exists(fp32):
+            continue
+        name = pname.replace(".", "/")
+        if not include_moments:
+            out[name] = np.load(fp32)
+            continue
+        leaves = {"param": np.load(fp32)}
+        for mname in ("exp_avg", "exp_avg_sq"):          # v1 wrote these too
+            mp = os.path.join(pdir, f"{mname}.npy")
+            if os.path.exists(mp):
+                leaves[mname] = np.load(mp)
+        out[name] = leaves
     return out
 
 
@@ -95,16 +189,25 @@ def unflatten(flat: Dict[str, np.ndarray]) -> Dict:
     return tree
 
 
-def main():
-    parser = argparse.ArgumentParser()
+def main(argv=None):
+    parser = argparse.ArgumentParser(
+        description="Export an engine checkpoint to the offline universal "
+                    "layout (per-param fp32 + adam moments, dtype-faithful)")
     parser.add_argument("--input_folder", required=True)
     parser.add_argument("--output_folder", required=True)
-    parser.add_argument("--tag", default=None)
+    parser.add_argument("--tag", default=None,
+                        help="checkpoint tag (default: the committed "
+                             "'latest', falling back to the newest valid "
+                             "tag); verified against the integrity "
+                             "manifest before conversion")
+    parser.add_argument("--no_strict", action="store_true",
+                        help="skip integrity verification of the source tag")
     parser.add_argument("--num_extract_workers", type=int, default=1)  # parity knob
     parser.add_argument("--num_merge_workers", type=int, default=1)
-    args = parser.parse_args()
-    convert(args.input_folder, args.output_folder, args.tag)
-    print(f"universal checkpoint written to {args.output_folder}")
+    args = parser.parse_args(argv)
+    tag = convert(args.input_folder, args.output_folder, args.tag,
+                  strict=not args.no_strict)
+    print(f"universal checkpoint (tag {tag}) written to {args.output_folder}")
 
 
 if __name__ == "__main__":

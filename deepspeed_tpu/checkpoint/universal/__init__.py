@@ -1,0 +1,16 @@
+"""Universal (mesh-shape-agnostic) checkpoints.
+
+Save side (:mod:`.layout`): the orbax checkpoint engine writes a logical
+layout manifest — every param/optimizer leaf's global shape, dtype, and
+partition spec plus the writing mesh — alongside the PR-1 integrity
+manifest.  Load side (:mod:`.planner` + :mod:`.loader`): a resharding
+planner maps saved shards onto ANY target mesh and the loader range-reads
+only the bytes each target shard needs, with torn/partial sources falling
+back to the newest valid tag exactly like same-mesh checkpoints do.
+"""
+from .layout import (LAYOUT_FILE, build_layout, read_layout,  # noqa: F401
+                     write_layout)
+from .loader import (NoLayoutError, load_params_resharded,  # noqa: F401
+                     load_state_resharded)
+from .planner import (LeafPlan, ReshardPlan, ReshardPlanError,  # noqa: F401
+                      plan_reshard)

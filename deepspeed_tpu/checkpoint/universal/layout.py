@@ -1,0 +1,271 @@
+"""Logical layout manifest: the save-side half of universal checkpoints.
+
+A tensorstore checkpoint already stores arrays with their *global* shape, but
+nothing in the directory says how the writing job was sharded, what the tree
+structure was, or which leaves a resuming job may legitimately drop.  The
+layout manifest (``layout.json``, written next to the PR-1 integrity
+``manifest.json`` and covered by it) records exactly that:
+
+  * a JSON **skeleton** of the saved tree in orbax's serialized form (dicts
+    for mappings/named tuples/dataclasses, lists for tuples, ``null`` for
+    empty nodes), with every array leaf replaced by a record of its global
+    logical shape, dtype, and partition spec;
+  * the writing mesh's axis dims + axis order, world size, and zero stage.
+
+With that record a loader on ANY mesh can rebuild a restore template without
+the writing job's python objects — the resharding planner
+(:mod:`.planner`) maps source shards onto the target mesh and tensorstore
+range-reads only the bytes each target shard needs.  This is the
+layout-manifest idea cross-replica weight-update sharding (arXiv:2004.13336)
+uses for sharded optimizer state, applied to the whole engine state.
+
+Reference analogue: ``deepspeed/checkpoint/universal_checkpoint.py`` records
+per-param ``PARAM_SHAPES``/patterns; here the layout *is* the tree.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...runtime.fault.atomic import atomic_write_text
+
+LAYOUT_FILE = "layout.json"
+LAYOUT_VERSION = 1
+LEAF_KEY = "~leaf"
+SEP = "/"
+
+
+# --------------------------------------------------------------------- #
+# serialization: live pytree -> orbax-form skeleton
+# --------------------------------------------------------------------- #
+def serialize_state(state: Any) -> Any:
+    """``state`` in orbax's on-disk tree form: named tuples / flax struct
+    dataclasses become dicts of field names, tuples become lists, empty
+    nodes become None — the same normalization ``PyTreeCheckpointer``
+    applies, so a template built from this skeleton matches the directory
+    key-for-key."""
+    from orbax.checkpoint import utils as _ou
+
+    return _normalize(_ou.serialize_tree(state, keep_empty_nodes=True))
+
+
+def _normalize(node: Any) -> Any:
+    if isinstance(node, dict):
+        return {str(k): _normalize(v) for k, v in node.items()}
+    if isinstance(node, (list, tuple)):
+        return [_normalize(v) for v in node]
+    # serialize_tree keeps zero-field NamedTuples (e.g. optax EmptyState) as
+    # values; on disk they are empty nodes and restore as None
+    if hasattr(node, "_fields") and not getattr(node, "_fields"):
+        return None
+    return node
+
+
+def _spec_to_json(spec: Any) -> Optional[List[Any]]:
+    """PartitionSpec -> JSON (tuple entries become lists)."""
+    if spec is None:
+        return None
+    out: List[Any] = []
+    for entry in spec:
+        if entry is None:
+            out.append(None)
+        elif isinstance(entry, (tuple, list)):
+            out.append([str(a) for a in entry])
+        else:
+            out.append(str(entry))
+    return out
+
+
+def spec_from_json(entries: Optional[List[Any]]) -> Any:
+    """JSON spec entries -> PartitionSpec (None -> replicated)."""
+    from jax.sharding import PartitionSpec
+
+    if entries is None:
+        return PartitionSpec()
+    return PartitionSpec(*[tuple(e) if isinstance(e, list) else e
+                           for e in entries])
+
+
+def _leaf_record(leaf: Any) -> Dict[str, Any]:
+    shape = getattr(leaf, "shape", None)
+    dtype = getattr(leaf, "dtype", None)
+    if shape is None or dtype is None:
+        # python scalar leaf (int step counters etc.)
+        return {LEAF_KEY: 1, "shape": None,
+                "dtype": type(leaf).__name__, "spec": None}
+    rec: Dict[str, Any] = {LEAF_KEY: 1, "shape": [int(d) for d in shape],
+                           "dtype": np.dtype(dtype).name, "spec": None}
+    sharding = getattr(leaf, "sharding", None)
+    spec = getattr(sharding, "spec", None)
+    if spec is not None:
+        rec["spec"] = _spec_to_json(spec)
+    return rec
+
+
+def _mesh_dims_of(state_serialized: Any) -> Optional[Dict[str, int]]:
+    """Axis dims of the mesh the leaves live on (first NamedSharding wins —
+    one training job has one global mesh)."""
+    import jax
+
+    for leaf in jax.tree.leaves(state_serialized):
+        mesh = getattr(getattr(leaf, "sharding", None), "mesh", None)
+        shape = getattr(mesh, "shape", None)
+        if shape:
+            return {str(k): int(v) for k, v in dict(shape).items()}
+    return None
+
+
+def build_layout(state: Any, extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """The layout manifest for a live state pytree about to be saved."""
+    serialized = serialize_state(state)
+
+    def skel(node):
+        if isinstance(node, dict):
+            return {k: skel(v) for k, v in node.items()}
+        if isinstance(node, list):
+            return [skel(v) for v in node]
+        if node is None:
+            return None
+        return _leaf_record(node)
+
+    mesh_dims = _mesh_dims_of(serialized)
+    layout: Dict[str, Any] = {
+        "version": LAYOUT_VERSION,
+        "format": "dstpu-universal",
+        "mesh": mesh_dims,
+        "axis_order": list(mesh_dims) if mesh_dims else None,
+        "tree": skel(serialized),
+    }
+    if extra:
+        layout.update({k: v for k, v in extra.items() if k not in layout})
+    return layout
+
+
+def write_layout(ckpt_path: str, state: Any,
+                 extra: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """Build + atomically persist ``layout.json`` under ``ckpt_path``.
+    Written BEFORE the integrity manifest so the manifest's file sizes
+    cover it — a torn layout fails verification like any other file."""
+    layout = build_layout(state, extra)
+    atomic_write_text(os.path.join(ckpt_path, LAYOUT_FILE),
+                      json.dumps(layout, indent=1, sort_keys=True))
+    return layout
+
+
+def read_layout(ckpt_path: str) -> Optional[Dict[str, Any]]:
+    p = os.path.join(ckpt_path, LAYOUT_FILE)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
+
+
+# --------------------------------------------------------------------- #
+# flattening / templates
+# --------------------------------------------------------------------- #
+def is_leaf_record(node: Any) -> bool:
+    return isinstance(node, dict) and node.get(LEAF_KEY) == 1
+
+
+def flat_records(tree: Any, prefix: str = "") -> Dict[str, Dict[str, Any]]:
+    """Skeleton -> {path: leaf record} (None nodes contribute nothing)."""
+    out: Dict[str, Dict[str, Any]] = {}
+    if is_leaf_record(tree):
+        out[prefix] = tree
+    elif isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(flat_records(v, f"{prefix}{SEP}{k}" if prefix else str(k)))
+    elif isinstance(tree, list):
+        for i, v in enumerate(tree):
+            out.update(flat_records(v, f"{prefix}{SEP}{i}" if prefix else str(i)))
+    return out
+
+
+def flat_values(tree: Any, prefix: str = "") -> Dict[str, Any]:
+    """Serialized tree of live values -> {path: leaf}."""
+    out: Dict[str, Any] = {}
+    if isinstance(tree, dict):
+        for k, v in tree.items():
+            out.update(flat_values(v, f"{prefix}{SEP}{k}" if prefix else str(k)))
+    elif isinstance(tree, (list, tuple)):
+        for i, v in enumerate(tree):
+            out.update(flat_values(v, f"{prefix}{SEP}{i}" if prefix else str(i)))
+    elif tree is not None:
+        out[prefix] = tree
+    return out
+
+
+_PY_SCALARS = {"int": int, "float": float, "bool": bool, "str": str}
+
+
+def template_from_layout(
+    layout: Dict[str, Any],
+    sharding_for: Callable[[str, Dict[str, Any]], Any],
+    dtype_for: Optional[Callable[[str, Dict[str, Any]], Any]] = None,
+    subtree: Optional[str] = None,
+) -> Any:
+    """Rebuild a restore template (ShapeDtypeStruct leaves carrying TARGET
+    shardings) from the layout skeleton alone — no writing-job objects
+    needed.  ``sharding_for(path, record)`` supplies each leaf's target
+    sharding; ``dtype_for`` may override the stored dtype (tensorstore
+    casts during the read).  ``subtree`` restricts the template to one
+    top-level field (partial restore, e.g. params-only for serving) — the
+    paths handed to the callbacks are then RELATIVE to that field, which
+    is what spec trees keyed by param name expect."""
+    import jax
+
+    tree = layout["tree"]
+    if subtree is not None:
+        tree = tree[subtree]
+
+    def build(node, prefix):
+        if is_leaf_record(node):
+            if node["shape"] is None:
+                return _PY_SCALARS.get(node["dtype"], int)()
+            dtype = np.dtype(dtype_for(prefix, node) if dtype_for is not None
+                             else node["dtype"])
+            return jax.ShapeDtypeStruct(tuple(node["shape"]), dtype,
+                                        sharding=sharding_for(prefix, node))
+        if isinstance(node, dict):
+            return {k: build(v, f"{prefix}{SEP}{k}" if prefix else str(k))
+                    for k, v in node.items()}
+        if isinstance(node, list):
+            return [build(v, f"{prefix}{SEP}{i}" if prefix else str(i))
+                    for i, v in enumerate(node)]
+        return None
+
+    return build(tree, "")
+
+
+def graft(target_serialized: Any, restored_serialized: Any) -> Tuple[Any, List[str]]:
+    """Overlay restored leaves onto the target's serialized structure.
+
+    Walks the TARGET structure (the resuming engine defines what exists);
+    wherever the restored tree has a value at the same path, the restored
+    value wins; target-only leaves keep their current value (that is how
+    resettable buffers like ``grad_acc`` survive a source that never saved
+    them).  Returns (merged tree, paths kept from the target)."""
+    kept: List[str] = []
+
+    def merge(tgt, src, prefix):
+        if isinstance(tgt, dict):
+            src = src if isinstance(src, dict) else {}
+            return {k: merge(v, src.get(k),
+                             f"{prefix}{SEP}{k}" if prefix else str(k))
+                    for k, v in tgt.items()}
+        if isinstance(tgt, list):
+            src = src if isinstance(src, list) else []
+            return [merge(v, src[i] if i < len(src) else None,
+                          f"{prefix}{SEP}{i}" if prefix else str(i))
+                    for i, v in enumerate(tgt)]
+        if tgt is None:
+            return None
+        if src is None:
+            kept.append(prefix)
+            return tgt
+        return src
+
+    return merge(target_serialized, restored_serialized, ""), kept

@@ -1,0 +1,289 @@
+"""Mesh-shape-agnostic checkpoint restore.
+
+The load-side half of universal checkpoints: rebuild a restore template
+from the saved layout manifest (:mod:`.layout`), plan the reshard
+(:mod:`.planner`), and let tensorstore range-read only the bytes each
+target shard needs — params and optimizer state land on the resuming
+job's mesh directly, whatever mesh wrote them (chips added or removed,
+zero_stage changed, TP↔DP↔SP re-split).
+
+Fault semantics match PR-1 checkpoints exactly: every candidate tag is
+verified against its integrity manifest before any byte is trusted, and
+when the newest tag is torn — including a *source shard deleted between
+commit and resharded load* (``DSTPU_FAULT_INJECT`` ``shard_missing``) —
+the loader degrades to the newest valid older committed tag instead of
+crashing, counting the incident (``reshard/fallbacks``).
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from ...runtime.fault import injection
+from ...runtime.fault.manifest import (CheckpointCorruptError, STATE_DIR,
+                                       verify_checkpoint)
+from ...runtime.fault.retry import record_fault_event, retryable
+from ...telemetry import emit_event
+from ...utils.logging import logger
+from . import layout as L
+from .planner import ReshardPlan, ReshardPlanError, plan_reshard
+
+META_FILE = "meta.json"
+
+
+class NoLayoutError(RuntimeError):
+    """The checkpoint predates the universal format (no ``layout.json``);
+    callers fall back to the template-structure load path."""
+
+
+def _read_meta(path: str) -> Dict[str, Any]:
+    p = os.path.join(path, META_FILE)
+    if not os.path.exists(p):
+        return {}
+    with open(p) as f:
+        return json.load(f)
+
+
+def _device_resident(tree: Any) -> Any:
+    """Orbax restores land on host memory kind; re-commit each leaf to its
+    sharding's device memory so downstream jit sees ordinary device
+    arrays."""
+    import jax
+
+    def fix(x):
+        sh = getattr(x, "sharding", None)
+        if sh is None:
+            return x
+        try:
+            return jax.device_put(x, sh.with_memory_kind("device"))
+        except (AttributeError, ValueError, TypeError):
+            return jax.device_put(x, sh)
+
+    return jax.tree.map(fix, tree)
+
+
+def _single_device_sharding():
+    """Somewhere to park source-only leaves that will be dropped after the
+    graft — one local device, never a full-mesh replica."""
+    import jax
+
+    return jax.sharding.SingleDeviceSharding(jax.local_devices()[0])
+
+
+@retryable("ckpt_reshard_restore")
+def _restore(state_path: str, template: Any, transforms: Optional[dict] = None):
+    import orbax.checkpoint as ocp
+
+    restore_args = ocp.checkpoint_utils.construct_restore_args(template)
+    kwargs = {}
+    if transforms is not None:
+        kwargs["transforms"] = transforms
+    with ocp.PyTreeCheckpointer() as ckptr:
+        return ckptr.restore(state_path, item=template,
+                             restore_args=restore_args, **kwargs)
+
+
+def _candidate_tags(store, tag: Optional[str]) -> Tuple[List[str], bool]:
+    """(ordered candidates, fallback allowed).  An explicit tag is an
+    explicit trust decision — corrupt means raise, exactly like
+    ``OrbaxCheckpointEngine.load``.  ``tag=None`` resumes: newest committed
+    first, then older committed tags (newest first)."""
+    if tag is not None:
+        return [str(tag)], False
+    first = store.latest_tag()
+    if first is None:
+        return [], True
+    seen = {first}
+    out = [first]
+    for t in reversed(store.committed_tags()):
+        if t not in seen:
+            seen.add(t)
+            out.append(t)
+    return out, True
+
+
+def load_state_resharded(
+    store,
+    target_state: Any,
+    tag: Optional[str] = None,
+    resettable: Tuple[str, ...] = None,
+) -> Tuple[str, Any, Dict[str, Any], ReshardPlan]:
+    """Restore ``store``'s checkpoint onto the layout of ``target_state``.
+
+    ``store`` is an :class:`~...runtime.checkpoint_engine.
+    orbax_checkpoint_engine.OrbaxCheckpointEngine`; ``target_state`` the
+    resuming job's live state pytree (its shardings define the target
+    layout).  Returns ``(tag, state, meta, plan)`` with ``state`` already
+    sharded for the target mesh.  Raises :class:`NoLayoutError` for
+    pre-universal checkpoints and :class:`CheckpointCorruptError` when no
+    loadable candidate remains.
+    """
+    from orbax.checkpoint import utils as ou
+
+    from .planner import RESETTABLE_FIELDS
+    if resettable is None:
+        resettable = RESETTABLE_FIELDS
+
+    candidates, fallback = _candidate_tags(store, tag)
+    if not candidates:
+        raise CheckpointCorruptError(
+            f"{store.ckpt_dir}: no loadable checkpoint tag")
+
+    # one serialization walk of the (possibly huge) target tree, shared by
+    # the plan, the template shardings, and the graft — and by every
+    # fallback candidate
+    tgt_serialized = L.serialize_state(target_state)
+    tgt_flat = L.flat_values(tgt_serialized)
+    park = _single_device_sharding()
+
+    last_err: Optional[Exception] = None
+    for i, cand in enumerate(candidates):
+        path = store._path(cand)
+        try:
+            # the resharded load is the one moment a deleted source shard
+            # can hurt a *different-shape* job; the injection site lives
+            # here so tests can tear exactly this window
+            injection.inject("reshard_load",
+                             path=os.path.join(path, STATE_DIR))
+            if store.verify:
+                # cold verification: the store's cache reflects what it saw
+                # at latest_tag() time, not what is on disk NOW
+                verify_checkpoint(path, require_manifest=(i > 0))
+            lay = L.read_layout(path)
+            if lay is None:
+                raise NoLayoutError(
+                    f"{path}: no layout manifest (pre-universal checkpoint)")
+
+            plan = plan_reshard(lay, target_state, resettable=resettable,
+                                target_serialized=tgt_serialized)
+            plan.raise_on_errors()
+
+            def sharding_for(p, rec):
+                leaf = tgt_flat.get(p)
+                sh = getattr(leaf, "sharding", None)
+                return sh if sh is not None else park
+
+            def dtype_for(p, rec):
+                leaf = tgt_flat.get(p)
+                return getattr(leaf, "dtype", None) or rec["dtype"]
+
+            template = L.template_from_layout(lay, sharding_for, dtype_for)
+            # top-level fields the target has no leaves for (e.g. a gas>1
+            # source's grad_acc resuming into gas=1) would be read in full
+            # just to be discarded at graft time — prune them and switch to
+            # orbax's partial restore so their bytes never leave disk
+            transforms = None
+            if isinstance(template, dict):
+                src_tops = {p.split(L.SEP, 1)[0]
+                            for p, r in L.flat_records(lay["tree"]).items()
+                            if r["shape"] is not None}
+                tgt_tops = {p.split(L.SEP, 1)[0] for p in tgt_flat}
+                for key in src_tops - tgt_tops:
+                    template.pop(key, None)
+                    transforms = {}
+            restored = _restore(os.path.join(path, STATE_DIR), template,
+                                transforms=transforms)
+            merged, kept = L.graft(tgt_serialized, restored)
+            state = ou.deserialize_tree(merged, target_state,
+                                        keep_empty_nodes=True)
+            if plan.dropped:
+                logger.info(f"reshard load {path}: dropped source-only "
+                            f"leaves {plan.dropped}")
+            if kept:
+                logger.info(f"reshard load {path}: re-initialized "
+                            f"target-only leaves {kept}")
+            return cand, state, _read_meta(path), plan
+        except NoLayoutError:
+            raise
+        except ReshardPlanError:
+            raise
+        except CheckpointCorruptError as e:
+            last_err = e
+            if not fallback:
+                raise
+            record_fault_event("reshard/fallbacks")
+            emit_event("checkpoint_reshard_fallback", tag=str(cand),
+                       dir=store.ckpt_dir, error=str(e)[:300])
+            logger.warning(f"resharded load of {path} failed verification "
+                           f"({e}); falling back to an older committed tag")
+    raise last_err if last_err is not None else CheckpointCorruptError(
+        f"{store.ckpt_dir}: no valid checkpoint to reshard from")
+
+
+def load_params_resharded(
+    ckpt_dir: str,
+    tag: Optional[str] = None,
+    sharding_for: Optional[Callable[[str, Dict[str, Any]], Any]] = None,
+    dtype: Any = None,
+    fault_config: Any = None,
+    params_field: str = "params",
+) -> Tuple[str, Any, Dict[str, Any]]:
+    """Partial restore of the parameter subtree only — the train→serve
+    handoff.  A serving job knows nothing of the training optimizer; the
+    layout manifest supplies the params skeleton and orbax's partial
+    restore never touches the optimizer-state bytes.  ``sharding_for``
+    places each leaf on the inference mesh (default: fully replicated on
+    the current global mesh); ``dtype`` casts during the read (fp32 master
+    → bf16 serving).  Returns ``(tag, params, layout)``.
+    """
+    from orbax.checkpoint import utils as ou
+
+    from ...runtime.checkpoint_engine.orbax_checkpoint_engine import \
+        OrbaxCheckpointEngine
+
+    store = OrbaxCheckpointEngine(ckpt_dir, fault_config=fault_config)
+    candidates, fallback = _candidate_tags(store, tag)
+    if not candidates:
+        raise CheckpointCorruptError(f"{ckpt_dir}: no loadable checkpoint tag")
+
+    if sharding_for is None:
+        from ...runtime.topology import get_topology
+
+        replicated = get_topology().replicated()
+
+        def sharding_for(p, rec):  # noqa: F811 — default placement
+            return replicated
+
+    last_err: Optional[Exception] = None
+    for i, cand in enumerate(candidates):
+        path = store._path(cand)
+        try:
+            injection.inject("reshard_load",
+                             path=os.path.join(path, STATE_DIR))
+            if store.verify:
+                verify_checkpoint(path, require_manifest=(i > 0))
+            lay = L.read_layout(path)
+            if lay is None:
+                raise NoLayoutError(
+                    f"{path}: no layout manifest (pre-universal checkpoint)")
+            if params_field not in lay["tree"]:
+                raise ReshardPlanError(
+                    f"{path}: layout has no {params_field!r} subtree")
+
+            def dtype_for(p, rec):
+                return dtype if dtype is not None else rec["dtype"]
+
+            sub = L.template_from_layout(lay, sharding_for, dtype_for,
+                                         subtree=params_field)
+            template = {params_field: sub}
+            restored = _restore(os.path.join(path, STATE_DIR), template,
+                                transforms={})
+            # deserialize back through the subtree skeleton so list nodes
+            # (tuple params containers) regain their saved form
+            params = ou.deserialize_tree(restored[params_field], sub,
+                                         keep_empty_nodes=True)
+            return cand, _device_resident(params), lay
+        except (NoLayoutError, ReshardPlanError):
+            raise
+        except CheckpointCorruptError as e:
+            last_err = e
+            if not fallback:
+                raise
+            record_fault_event("reshard/fallbacks")
+            emit_event("checkpoint_reshard_fallback", tag=str(cand),
+                       dir=ckpt_dir, error=str(e)[:300])
+            logger.warning(f"params reshard load of {path} failed "
+                           f"({e}); falling back to an older committed tag")
+    raise last_err if last_err is not None else CheckpointCorruptError(
+        f"{ckpt_dir}: no valid checkpoint to reshard from")

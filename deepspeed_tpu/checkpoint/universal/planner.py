@@ -1,0 +1,244 @@
+"""Resharding planner: source layout × target mesh → per-leaf load plan.
+
+Given the logical layout a checkpoint was written with (:mod:`.layout`) and
+the sharded template the resuming job wants, classify every leaf:
+
+  ``identical``   same slicing geometry — each target shard range-reads
+                  exactly one source-shard-sized extent (a same-shape
+                  restart, or a mesh whose ZeRO factors happen to agree);
+  ``slice``       source replicated, target sharded — each target host
+                  reads only its slice (shrink never gathers);
+  ``gather``      source sharded, target replicated — every host reads the
+                  full logical array (zero_stage lowered, or serving);
+  ``reslice``     both sharded with different factors (grow/shrink/TP↔DP
+                  re-split) — each target shard reads the covering source
+                  ranges;
+  ``replicated``  replicated on both sides.
+
+The plan also carries a **per-host shard index**: for every target device,
+the index ranges of the global array it will read, deduplicated per host —
+the accounting that proves a reshard never materializes a full replica
+unless the *target* layout is itself replicated.  Validation (shape/
+structure divergence) happens here too, so a mismatched optimizer or model
+fails with the exact diverging paths instead of an orbax tree error.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from .layout import SEP, flat_records, flat_values, serialize_state
+
+#: Top-level fields a resuming engine may legitimately re-initialize when the
+#: source never saved them (and drop when the target has no use for them):
+#: both are zero at every optimizer-step boundary, which is the only place a
+#: checkpoint is ever written.
+RESETTABLE_FIELDS = ("grad_acc", "comm_error")
+
+
+class ReshardPlanError(RuntimeError):
+    """Source checkpoint and target layout diverge in a way resharding
+    cannot bridge (shape mismatch, missing non-resettable leaves)."""
+
+
+def _entry_axes(entry: Any) -> Tuple[str, ...]:
+    if entry is None:
+        return ()
+    if isinstance(entry, (tuple, list)):
+        return tuple(str(a) for a in entry)
+    return (str(entry),)
+
+
+def _dim_factors(spec: Optional[List[Any]], mesh: Optional[Dict[str, int]],
+                 ndim: int) -> Tuple[int, ...]:
+    """Per-dimension shard counts implied by a (serialized) spec on a mesh."""
+    factors = [1] * ndim
+    if spec and mesh:
+        for d, entry in enumerate(spec[:ndim]):
+            for ax in _entry_axes(entry):
+                factors[d] *= int(mesh.get(ax, 1))
+    return tuple(factors)
+
+
+def _spec_of_sharding(sharding: Any) -> Optional[List[Any]]:
+    from .layout import _spec_to_json
+
+    spec = getattr(sharding, "spec", None)
+    return _spec_to_json(spec) if spec is not None else None
+
+
+def _mesh_of_sharding(sharding: Any) -> Optional[Dict[str, int]]:
+    shape = getattr(getattr(sharding, "mesh", None), "shape", None)
+    return {str(k): int(v) for k, v in dict(shape).items()} if shape else None
+
+
+@dataclasses.dataclass
+class LeafPlan:
+    path: str
+    shape: Tuple[int, ...]
+    src_dtype: str
+    dst_dtype: str
+    kind: str                      # identical|slice|gather|reslice|replicated
+    src_factors: Tuple[int, ...]
+    dst_factors: Tuple[int, ...]
+    #: bytes of the global array (at source dtype)
+    nbytes: int
+    #: deduplicated bytes this process will read for the leaf
+    read_bytes: int
+
+
+@dataclasses.dataclass
+class ReshardPlan:
+    source_mesh: Optional[Dict[str, int]]
+    target_mesh: Optional[Dict[str, int]]
+    leaves: Dict[str, LeafPlan]
+    #: source-only paths the target re-initializes (resettable fields)
+    dropped: List[str]
+    #: target-only paths kept at their current value (resettable fields)
+    reset: List[str]
+    errors: List[str]
+
+    @property
+    def reshaped(self) -> bool:
+        """Does the load move any bytes differently than a same-mesh
+        restart would?"""
+        return (self.source_mesh or {}) != (self.target_mesh or {}) or \
+            any(p.kind in ("slice", "gather", "reslice")
+                for p in self.leaves.values())
+
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for p in self.leaves.values():
+            out[p.kind] = out.get(p.kind, 0) + 1
+        return out
+
+    def total_read_bytes(self) -> int:
+        return int(sum(p.read_bytes for p in self.leaves.values()))
+
+    def summary(self) -> Dict[str, Any]:
+        return {
+            "reshaped": self.reshaped,
+            "source_mesh": self.source_mesh,
+            "target_mesh": self.target_mesh,
+            "leaf_kinds": self.counts(),
+            "read_bytes": self.total_read_bytes(),
+            "logical_bytes": int(sum(p.nbytes for p in self.leaves.values())),
+            "dropped": len(self.dropped),
+            "reset": len(self.reset),
+        }
+
+    def raise_on_errors(self) -> None:
+        if self.errors:
+            head = "; ".join(self.errors[:8])
+            more = f" (+{len(self.errors) - 8} more)" if len(self.errors) > 8 else ""
+            raise ReshardPlanError(
+                f"checkpoint cannot be resharded onto this job: {head}{more}")
+
+
+def _local_read_bytes(sharding: Any, shape: Tuple[int, ...],
+                      itemsize: int) -> int:
+    """Deduplicated bytes THIS process reads for one leaf under the target
+    sharding: the union of its addressable devices' index ranges.  Tensor-
+    store reads exactly these ranges — a sharded target never pulls a full
+    replica through any single host."""
+    nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize if shape else itemsize
+    if sharding is None:
+        return nbytes
+    try:
+        index_map = sharding.addressable_devices_indices_map(tuple(shape))
+    except (AttributeError, ValueError):
+        return nbytes
+    seen = set()
+    total = 0
+    for idx in index_map.values():
+        key = tuple((s.start, s.stop, s.step) for s in idx) \
+            if isinstance(idx, tuple) else idx
+        if key in seen:
+            continue
+        seen.add(key)
+        n = itemsize
+        for dim, sl in zip(shape, idx if isinstance(idx, tuple) else ()):
+            start, stop, _ = sl.indices(dim)
+            n *= max(stop - start, 0)
+        total += n
+    return total
+
+
+def _classify(src: Tuple[int, ...], dst: Tuple[int, ...]) -> str:
+    src_sharded = any(f > 1 for f in src)
+    dst_sharded = any(f > 1 for f in dst)
+    if not src_sharded and not dst_sharded:
+        return "replicated"
+    if src == dst:
+        return "identical"
+    if not src_sharded:
+        return "slice"
+    if not dst_sharded:
+        return "gather"
+    return "reslice"
+
+
+def plan_reshard(layout: Dict[str, Any], target_state: Any,
+                 resettable: Tuple[str, ...] = RESETTABLE_FIELDS,
+                 target_serialized: Any = None) -> ReshardPlan:
+    """Map a saved layout onto a live target state pytree.
+
+    ``target_state`` is the resuming job's state (arrays or
+    ShapeDtypeStructs — only shape/dtype/sharding are consulted).
+    ``target_serialized`` lets a caller that already serialized the target
+    (the loader walks it for templates and grafting too) skip the repeat
+    walk."""
+    src_records = flat_records(layout["tree"])
+    src_mesh = layout.get("mesh")
+    if target_serialized is None:
+        target_serialized = serialize_state(target_state)
+    tgt_values = flat_values(target_serialized)
+    tgt_mesh = None
+
+    def is_resettable(path: str) -> bool:
+        head = path.split(SEP, 1)[0]
+        return head in resettable
+
+    leaves: Dict[str, LeafPlan] = {}
+    errors: List[str] = []
+    dropped = [p for p in src_records if p not in tgt_values]
+    reset = [p for p in tgt_values if p not in src_records]
+    for p in dropped:
+        if not is_resettable(p):
+            errors.append(f"checkpoint leaf {p!r} has no home in the "
+                          f"resuming job (optimizer/model changed?)")
+    for p in reset:
+        if not is_resettable(p):
+            errors.append(f"resuming job needs leaf {p!r} the checkpoint "
+                          f"never saved")
+
+    for path, rec in src_records.items():
+        tgt = tgt_values.get(path)
+        if tgt is None or rec["shape"] is None:
+            continue
+        shape = tuple(rec["shape"])
+        tgt_shape = tuple(getattr(tgt, "shape", ()) or ())
+        if shape != tgt_shape:
+            errors.append(f"{path}: global shape {list(shape)} in checkpoint "
+                          f"vs {list(tgt_shape)} in the resuming job")
+            continue
+        sharding = getattr(tgt, "sharding", None)
+        if tgt_mesh is None:
+            tgt_mesh = _mesh_of_sharding(sharding)
+        src_f = _dim_factors(rec.get("spec"), src_mesh, len(shape))
+        dst_f = _dim_factors(_spec_of_sharding(sharding),
+                             _mesh_of_sharding(sharding), len(shape))
+        itemsize = np.dtype(rec["dtype"]).itemsize
+        nbytes = int(np.prod(shape, dtype=np.int64)) * itemsize if shape else itemsize
+        kind = _classify(src_f, dst_f)
+        leaves[path] = LeafPlan(
+            path=path, shape=shape, src_dtype=rec["dtype"],
+            dst_dtype=np.dtype(getattr(tgt, "dtype", rec["dtype"])).name,
+            kind=kind, src_factors=src_f, dst_factors=dst_f, nbytes=nbytes,
+            read_bytes=_local_read_bytes(sharding, shape, itemsize))
+
+    return ReshardPlan(source_mesh=src_mesh, target_mesh=tgt_mesh,
+                       leaves=leaves, dropped=dropped, reset=reset,
+                       errors=errors)

@@ -28,7 +28,7 @@ import subprocess
 import sys
 import threading
 import time
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 from ..runtime.fault.retry import RetryPolicy, record_fault_event
 from ..telemetry import emit_event
@@ -39,6 +39,21 @@ def _free_port() -> int:
     with socket.socket() as s:
         s.bind(("", 0))
         return s.getsockname()[1]
+
+
+def _env_capacity_probe() -> Optional[int]:
+    """Default capacity probe: ``DSTPU_VISIBLE_WORLD_SIZE`` (what the
+    resource manager says is actually attachable right now).  Read at call
+    time, not import time, so a long-lived agent sees updates.  None =
+    unknown, keep the current plan."""
+    raw = os.environ.get("DSTPU_VISIBLE_WORLD_SIZE")
+    if not raw:
+        return None
+    try:
+        n = int(raw)
+    except ValueError:
+        return None
+    return n if n > 0 else None
 
 
 class WorkerGroupFailure(RuntimeError):
@@ -62,7 +77,10 @@ class DSElasticAgent:
                  escalate_kill: bool = True,
                  restart_policy: Optional[RetryPolicy] = None,
                  ckpt_dir: Optional[str] = None,
-                 ckpt_keep_last: int = 0):
+                 ckpt_keep_last: int = 0,
+                 allow_reshape: bool = False,
+                 capacity_probe: Optional[Callable[[], Optional[int]]] = None,
+                 mesh_shape_fn: Optional[Callable[[int], str]] = None):
         self.cmd = list(cmd)
         self.world_size = int(world_size)
         self.max_restarts = int(max_restarts)
@@ -80,6 +98,21 @@ class DSElasticAgent:
         #: OrbaxCheckpointEngine.gc_tags)
         self.ckpt_dir = ckpt_dir
         self.ckpt_keep_last = int(ckpt_keep_last)
+        #: elastic resharding: with ``allow_reshape`` on, a restart probes
+        #: the visible capacity (``capacity_probe``, default the
+        #: ``DSTPU_VISIBLE_WORLD_SIZE`` env var) and re-plans the gang to
+        #: whatever is actually there — a preempted/shrunken slice resumes
+        #: degraded from the (reshardable) universal checkpoint instead of
+        #: blocking on identical capacity.  ``mesh_shape_fn(world)`` names
+        #: the re-planned mesh (default pure-DP ``data:N``); workers read
+        #: it back through ``DSTPU_ELASTIC_MESH_SHAPE`` via
+        #: :func:`~..runtime.topology.topology_config_from_env`.
+        self.allow_reshape = bool(allow_reshape)
+        self.capacity_probe = capacity_probe or _env_capacity_probe
+        self.mesh_shape_fn = mesh_shape_fn or (lambda n: f"data:{n}")
+        self.initial_world_size = int(world_size)
+        self.reshape_count = 0
+        self.current_mesh_shape: Optional[str] = None
         self.restart_count = 0
         #: exit code of the worker that killed the previous incarnation —
         #: exported to restarted workers so their /healthz can report
@@ -103,9 +136,14 @@ class DSElasticAgent:
                 "MASTER_PORT": str(port),
                 "COORDINATOR_ADDRESS": f"localhost:{port}",
                 "DSTPU_ELASTIC_RESTART_COUNT": str(self.restart_count),
+                "DSTPU_ELASTIC_RESHAPE_COUNT": str(self.reshape_count),
             })
             if self.last_failure_rc is not None:
                 env["DSTPU_ELASTIC_LAST_RC"] = str(self.last_failure_rc)
+            if self.current_mesh_shape is not None:
+                # present ONLY while the gang runs on a different shape than
+                # it was launched with — /healthz reads this as "degraded"
+                env["DSTPU_ELASTIC_MESH_SHAPE"] = self.current_mesh_shape
             procs.append(subprocess.Popen(self.cmd, env=env))
         logger.info(f"elastic agent: spawned {self.world_size} workers "
                     f"(restart {self.restart_count}, rendezvous :{port})")
@@ -162,6 +200,41 @@ class DSElasticAgent:
         except Exception as e:  # noqa: BLE001 — housekeeping only
             logger.warning(f"elastic agent: checkpoint gc failed: {e!r}")
 
+    def _maybe_reshape(self) -> None:
+        """Re-plan the gang to the visible capacity before a restart.
+
+        Only consulted between incarnations (workers are down).  A probe
+        that cannot answer keeps the current plan; a changed answer
+        resizes the gang, bumps ``reshape_count``, and records the new
+        mesh shape for the workers' env.  Returning to the launch-time
+        capacity clears ``DSTPU_ELASTIC_MESH_SHAPE`` — the gang is whole
+        again and /healthz stops reporting it degraded."""
+        if not self.allow_reshape:
+            return
+        try:
+            visible = self.capacity_probe()
+        except Exception as e:  # noqa: BLE001 — a broken probe must never
+            # turn a recoverable restart into an agent crash
+            logger.warning(f"elastic agent: capacity probe failed: {e!r}")
+            return
+        if visible is None or int(visible) == self.world_size:
+            return
+        old = self.world_size
+        self.world_size = int(visible)
+        self.reshape_count += 1
+        shape = self.mesh_shape_fn(self.world_size)
+        self.current_mesh_shape = \
+            shape if self.world_size != self.initial_world_size else None
+        record_fault_event("elastic/reshapes")
+        emit_event("elastic_reshape", from_world=old, to_world=self.world_size,
+                   mesh_shape=shape, reshape=self.reshape_count,
+                   restart=self.restart_count + 1)
+        logger.warning(
+            f"elastic agent: visible capacity changed {old} -> "
+            f"{self.world_size}; resharding the gang onto mesh "
+            f"'{shape}' (reshape {self.reshape_count}) — workers resume "
+            f"from the universal checkpoint")
+
     # -------------------------------------------------------------- #
     def shutdown(self, signum: Optional[int] = None, frame=None) -> None:
         """Graceful stop: tear the current gang down and make run() return.
@@ -217,6 +290,7 @@ class DSElasticAgent:
                         f"worker group failed rc={failed} after "
                         f"{self.restart_count} restarts")
                 self._gc_checkpoints()
+                self._maybe_reshape()
                 delay = self.restart_policy.delay(self.restart_count)
                 record_fault_event("elastic/restarts")
                 emit_event("elastic_restart", restart=self.restart_count + 1,
@@ -250,6 +324,12 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                         help="keep only the newest N valid checkpoint tags "
                              "(0 = never delete); the newest verified tag "
                              "and the committed 'latest' are always kept")
+    parser.add_argument("--allow-reshape", action="store_true",
+                        help="on restart, re-plan the gang to the visible "
+                             "capacity (DSTPU_VISIBLE_WORLD_SIZE) instead of "
+                             "waiting for identical capacity — workers "
+                             "resume from the universal checkpoint on the "
+                             "re-planned mesh (DSTPU_ELASTIC_MESH_SHAPE)")
     parser.add_argument("cmd", nargs=argparse.REMAINDER)
     args = parser.parse_args(argv)
     cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
@@ -259,7 +339,8 @@ def main(argv: Optional[Sequence[str]] = None) -> None:
                            term_timeout=args.term_timeout,
                            escalate_kill=not args.no_escalate_kill,
                            ckpt_dir=args.ckpt_dir,
-                           ckpt_keep_last=args.ckpt_keep_last)
+                           ckpt_keep_last=args.ckpt_keep_last,
+                           allow_reshape=args.allow_reshape)
     sys.exit(agent.run())
 
 

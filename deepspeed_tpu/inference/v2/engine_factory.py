@@ -47,17 +47,54 @@ def build_hf_engine(path: str, engine_config: Optional[RaggedInferenceEngineConf
 def build_engine_from_ds_checkpoint(ckpt_dir: str, model: Any,
                                     engine_config=None, tag: Optional[str] = None,
                                     dtype=None) -> InferenceEngineV2:
-    """Serve from a framework training checkpoint."""
-    from ...checkpoint.ds_to_universal import unflatten
-    from ...checkpoint.zero_to_fp32 import get_fp32_state_dict_from_zero_checkpoint
+    """Serve from a framework training checkpoint — the train→serve
+    handoff.
+
+    Universal checkpoints (those carrying a layout manifest) restore the
+    params subtree straight onto the *inference-shaped* mesh through the
+    resharding planner: each serving host range-reads only the param bytes
+    its placement needs (the model's TP ``partition_specs`` when it has
+    them, replicated otherwise), cast to the serving dtype during the read
+    — optimizer-state bytes are never touched, and a torn newest tag falls
+    back to an older valid one exactly like a training resume would.
+    Pre-universal checkpoints fall back to the fp32 gather path."""
+    import jax
+
+    from ...checkpoint.universal.loader import (NoLayoutError,
+                                                load_params_resharded)
 
     if dtype is None:
         dtype = engine_config.dtype if engine_config is not None else jnp.bfloat16
-    flat = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
-    params = unflatten(flat)
-    import jax
+    try:
+        from ...runtime.topology import get_topology
 
-    params = jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
+        topo = get_topology()
+        base_specs = getattr(model, "partition_specs", None)
+        replicated = topo.replicated()
+
+        def sharding_for(path, rec):
+            node = base_specs
+            try:
+                for part in path.split("/"):
+                    node = node[part]
+            except (KeyError, TypeError, IndexError):
+                node = None
+            if node is not None and not isinstance(node, dict):
+                return topo.named_sharding(*node)
+            return replicated
+
+        loaded_tag, params, _layout = load_params_resharded(
+            ckpt_dir, tag, sharding_for=sharding_for, dtype=dtype)
+        log_dist(f"serving from universal checkpoint {ckpt_dir}/{loaded_tag} "
+                 f"(resharded onto the inference mesh)", ranks=[0])
+    except NoLayoutError:
+        from ...checkpoint.ds_to_universal import unflatten
+        from ...checkpoint.zero_to_fp32 import \
+            get_fp32_state_dict_from_zero_checkpoint
+
+        flat = get_fp32_state_dict_from_zero_checkpoint(ckpt_dir, tag)
+        params = unflatten(flat)
+        params = jax.tree.map(lambda x: jnp.asarray(x, dtype), params)
     cfg = engine_config or RaggedInferenceEngineConfig(
         max_ctx=model.config.max_seq_len, dtype=dtype)
     return InferenceEngineV2(model, params, cfg)

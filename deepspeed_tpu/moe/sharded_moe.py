@@ -40,13 +40,32 @@ def _one_hot(idx, n):
     return jax.nn.one_hot(idx, n, dtype=jnp.float32)
 
 
+def _mask_padded_experts(logits: jnp.ndarray,
+                         num_experts_logical: Optional[int]) -> Tuple[jnp.ndarray, int]:
+    """Routing over a padded expert stack (elastic resharding onto an
+    ``ep_size`` that does not divide the expert count pads the stack to the
+    next multiple — see :func:`pad_experts_for_ep`): padding columns get
+    ``-inf`` logits, so softmax/argmax/top-k are bit-identical to the
+    unpadded layer (``exp(-inf) == 0`` leaves every denominator unchanged).
+    Returns (masked logits, logical expert count) — capacity and the
+    load-balance loss must use the LOGICAL count, or padding would shrink
+    per-expert capacity and change routing decisions."""
+    E = logits.shape[1]
+    if num_experts_logical is None or num_experts_logical >= E:
+        return logits, E
+    mask = jnp.where(jnp.arange(E) < num_experts_logical, 0.0, -jnp.inf)
+    return logits + mask[None, :], int(num_experts_logical)
+
+
 def top1gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
                min_capacity: int = 4, noisy_gate_policy: Optional[str] = None,
                rng: Optional[jax.Array] = None, drop_tokens: bool = True,
-               used_capacity: Any = None) -> GateOutput:
+               used_capacity: Any = None,
+               num_experts_logical: Optional[int] = None) -> GateOutput:
     """Switch-style top-1 gating (reference: sharded_moe.py:183)."""
     S, E = logits.shape
-    C = _capacity(S, E, capacity_factor, min_capacity)
+    logits, n_log = _mask_padded_experts(logits, num_experts_logical)
+    C = _capacity(S, n_log, capacity_factor, min_capacity)
     gates = jax.nn.softmax(logits, axis=1)
 
     select_logits = logits
@@ -58,7 +77,7 @@ def top1gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
     # Load-balance loss (Switch):  E * Σ_e mean_tokens(mask_e) * mean(gates_e)
     me = jnp.mean(gates, axis=0)
     ce = jnp.mean(mask, axis=0)
-    l_aux = jnp.sum(me * ce) * E
+    l_aux = jnp.sum(me * ce) * n_log
 
     pos = jnp.cumsum(mask, axis=0) - mask                         # position in expert
     if drop_tokens:
@@ -76,10 +95,12 @@ def top1gating(logits: jnp.ndarray, capacity_factor: float = 1.0,
 def topkgating(logits: jnp.ndarray, k: int = 2, capacity_factor: float = 1.0,
                min_capacity: int = 4, drop_tokens: bool = True,
                rng: Optional[jax.Array] = None,
-               normalize_weights: bool = True) -> GateOutput:
+               normalize_weights: bool = True,
+               num_experts_logical: Optional[int] = None) -> GateOutput:
     """Top-k gating (reference: sharded_moe.py:374; k=2 ≡ top2gating :290)."""
     S, E = logits.shape
-    C = _capacity(S * k, E, capacity_factor, min_capacity)
+    logits, n_log = _mask_padded_experts(logits, num_experts_logical)
+    C = _capacity(S * k, n_log, capacity_factor, min_capacity)
     gates = jax.nn.softmax(logits, axis=1)
 
     topk_val, topk_idx = jax.lax.top_k(gates, k)                  # [S, k]
@@ -106,7 +127,7 @@ def topkgating(logits: jnp.ndarray, k: int = 2, capacity_factor: float = 1.0,
 
     me = jnp.mean(gates, axis=0)
     ce = ce_total / jnp.maximum(jnp.sum(ce_total), 1.0)
-    l_aux = jnp.sum(me * ce) * E
+    l_aux = jnp.sum(me * ce) * n_log
     return GateOutput(l_aux, combine, dispatch, ce_total.astype(jnp.int32))
 
 
@@ -141,10 +162,12 @@ def top1gating_sparse(logits: jnp.ndarray, capacity_factor: float = 1.0,
                       min_capacity: int = 4,
                       noisy_gate_policy: Optional[str] = None,
                       rng: Optional[jax.Array] = None,
-                      drop_tokens: bool = True) -> SparseGateOutput:
+                      drop_tokens: bool = True,
+                      num_experts_logical: Optional[int] = None) -> SparseGateOutput:
     """Sparse-form top-1 gating; routing decisions identical to top1gating."""
     S, E = logits.shape
-    C = _capacity(S, E, capacity_factor, min_capacity)
+    logits, n_log = _mask_padded_experts(logits, num_experts_logical)
+    C = _capacity(S, n_log, capacity_factor, min_capacity)
     gates = jax.nn.softmax(logits, axis=1)
 
     select_logits = logits
@@ -155,7 +178,7 @@ def top1gating_sparse(logits: jnp.ndarray, capacity_factor: float = 1.0,
 
     me = jnp.mean(gates, axis=0)
     ce = jnp.mean(mask, axis=0)
-    l_aux = jnp.sum(me * ce) * E
+    l_aux = jnp.sum(me * ce) * n_log
 
     pos = jnp.cumsum(mask, axis=0) - mask
     if drop_tokens:
@@ -177,14 +200,16 @@ def topkgating_sparse(logits: jnp.ndarray, k: int = 2,
                       drop_tokens: bool = True,
                       rng: Optional[jax.Array] = None,
                       normalize_weights: bool = True,
-                      valid: Optional[jnp.ndarray] = None) -> SparseGateOutput:
+                      valid: Optional[jnp.ndarray] = None,
+                      num_experts_logical: Optional[int] = None) -> SparseGateOutput:
     """Sparse-form top-k gating; routing decisions identical to topkgating.
 
     ``valid`` [S] bool: tokens marked invalid (ragged-batch padding) are
     routed to the trash slot and consume no expert capacity.
     """
     S, E = logits.shape
-    C = _capacity(S * k, E, capacity_factor, min_capacity)
+    logits, n_log = _mask_padded_experts(logits, num_experts_logical)
+    C = _capacity(S * k, n_log, capacity_factor, min_capacity)
     gates = jax.nn.softmax(logits, axis=1)
 
     topk_val, topk_idx = jax.lax.top_k(gates, k)
@@ -214,7 +239,7 @@ def topkgating_sparse(logits: jnp.ndarray, k: int = 2,
 
     me = jnp.mean(gates, axis=0)
     ce = ce_total / jnp.maximum(jnp.sum(ce_total), 1.0)
-    l_aux = jnp.sum(me * ce) * E
+    l_aux = jnp.sum(me * ce) * n_log
     return SparseGateOutput(l_aux, jnp.stack(slots, axis=1),
                             jnp.stack(vals, axis=1),
                             ce_total.astype(jnp.int32), C)
@@ -297,7 +322,8 @@ def combine_from_experts(combine: jnp.ndarray, expert_out: jnp.ndarray,
 def moe_mlp_block(lp: Dict, tokens: jnp.ndarray, k: int = 2,
                   capacity_factor: float = 2.0, dispatch_impl: str = "sparse",
                   rng: Optional[jax.Array] = None,
-                  valid: Optional[jnp.ndarray] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                  valid: Optional[jnp.ndarray] = None,
+                  num_experts_logical: Optional[int] = None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Mixtral-style routed SwiGLU expert MLP over flat tokens [T, D].
 
     ``lp`` carries router [D,E] (f32) + stacked expert weights
@@ -313,13 +339,15 @@ def moe_mlp_block(lp: Dict, tokens: jnp.ndarray, k: int = 2,
     if dispatch_impl == "sparse":
         gate_out = topkgating_sparse(logits_r, k=k,
                                      capacity_factor=capacity_factor, rng=rng,
-                                     valid=valid)
+                                     valid=valid,
+                                     num_experts_logical=num_experts_logical)
         dispatched = dispatch_sparse(gate_out.slot, tokens,
                                      logits_r.shape[1], gate_out.capacity, dtype)
     else:
         assert valid is None, "ragged validity masks need dispatch_impl='sparse'"
         gate_out = topkgating(logits_r, k=k, capacity_factor=capacity_factor,
-                              rng=rng)
+                              rng=rng,
+                              num_experts_logical=num_experts_logical)
         dispatched = dispatch_to_experts(gate_out.dispatch, tokens, dtype)
     act = jax.nn.silu(jnp.einsum("ecd,edf->ecf", dispatched,
                                  lp["gate_proj"]["kernel"]))
@@ -338,7 +366,8 @@ def moe_layer(params: Dict, x: jnp.ndarray, k: int = 1,
               noisy_gate_policy: Optional[str] = None,
               rng: Optional[jax.Array] = None, training: bool = True,
               activation=jax.nn.gelu,
-              dispatch_impl: str = "sparse") -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+              dispatch_impl: str = "sparse",
+              num_experts_logical: Optional[int] = None) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Apply the MoE layer to x [..., D] → (out [..., D], l_aux, exp_counts).
 
     Reference: MOELayer.forward (sharded_moe.py:586): einsum dispatch →
@@ -367,9 +396,12 @@ def moe_layer(params: Dict, x: jnp.ndarray, k: int = 1,
     if dispatch_impl == "sparse":
         if k == 1:
             gate = top1gating_sparse(logits, cf, min_capacity,
-                                     noisy_gate_policy, rng, drop_tokens)
+                                     noisy_gate_policy, rng, drop_tokens,
+                                     num_experts_logical=num_experts_logical)
         else:
-            gate = topkgating_sparse(logits, k, cf, min_capacity, drop_tokens, rng)
+            gate = topkgating_sparse(logits, k, cf, min_capacity, drop_tokens,
+                                     rng,
+                                     num_experts_logical=num_experts_logical)
         E = logits.shape[1]
         dispatched = dispatch_sparse(gate.slot, tokens, E, gate.capacity, dtype)
         expert_out = expert_ffn(dispatched)
@@ -377,10 +409,132 @@ def moe_layer(params: Dict, x: jnp.ndarray, k: int = 1,
     else:
         if k == 1:
             gate = top1gating(logits, cf, min_capacity, noisy_gate_policy, rng,
-                              drop_tokens)
+                              drop_tokens,
+                              num_experts_logical=num_experts_logical)
         else:
-            gate = topkgating(logits, k, cf, min_capacity, drop_tokens, rng)
+            gate = topkgating(logits, k, cf, min_capacity, drop_tokens, rng,
+                              num_experts_logical=num_experts_logical)
         dispatched = dispatch_to_experts(gate.dispatch, tokens, dtype)  # [E, C, D]
         expert_out = expert_ffn(dispatched)
         out = combine_from_experts(gate.combine, expert_out, dtype)
     return out.reshape(orig_shape), gate.l_aux, gate.exp_counts
+
+
+# --------------------------------------------------------------------- #
+# Expert resharding (elastic mesh-shape change, universal checkpoints)
+# --------------------------------------------------------------------- #
+def expert_shard_ranges(num_experts: int, ep_size: int) -> list:
+    """Contiguous logical-expert ranges ``[(start, stop), ...]`` per
+    expert-parallel rank, balanced for uneven remainders (sizes differ by
+    at most one; the first ``num_experts % ep_size`` ranks carry the extra
+    expert).  This is the IDEAL balanced split — what a reader that can
+    address arbitrary rows should fetch per rank."""
+    E, ep = int(num_experts), max(int(ep_size), 1)
+    base, rem = divmod(E, ep)
+    out, start = [], 0
+    for r in range(ep):
+        n = base + (1 if r < rem else 0)
+        out.append((start, start + n))
+        start += n
+    return out
+
+
+def placed_expert_ranges(num_experts: int, ep_size: int) -> list:
+    """The LOGICAL expert rows each rank actually holds after
+    :func:`pad_experts_for_ep` + even NamedSharding chunking of the padded
+    stack: rank ``r`` owns padded rows ``[r*chunk, (r+1)*chunk)`` clipped
+    to the logical count (trailing ranks may hold only padding → empty
+    range).  Divisible counts make this identical to
+    :func:`expert_shard_ranges`."""
+    E, ep = int(num_experts), max(int(ep_size), 1)
+    chunk = padded_expert_count(E, ep) // ep
+    return [(min(r * chunk, E), min((r + 1) * chunk, E)) for r in range(ep)]
+
+
+def padded_expert_count(num_experts: int, ep_size: int) -> int:
+    """Smallest multiple of ``ep_size`` holding ``num_experts`` — the
+    stacked-expert leading dim after :func:`pad_experts_for_ep` (jax
+    NamedSharding requires even divisibility, the GSPMD pad trick)."""
+    ep = max(int(ep_size), 1)
+    return -(-int(num_experts) // ep) * ep
+
+
+def _pad_axis(arr: jnp.ndarray, axis: int, target: int) -> jnp.ndarray:
+    pad = target - arr.shape[axis]
+    if pad <= 0:
+        return arr
+    widths = [(0, 0)] * arr.ndim
+    widths[axis] = (0, pad)
+    return jnp.pad(arr, widths)
+
+
+def pad_experts_for_ep(params: Dict, ep_size: int) -> Tuple[Dict, int]:
+    """Pad a stacked-expert param tree so the expert axis divides
+    ``ep_size`` — expert stacks get zero experts appended (axis 0) and the
+    gate/router kernel gets matching zero columns (axis 1).
+
+    Returns ``(padded params, num_experts_logical)``.  Callers MUST pass
+    the logical count to the gating functions (``num_experts_logical=``):
+    padded experts route ``-inf`` logits, so outputs are bit-identical to
+    the unpadded layer while the weights shard evenly.  Supports both
+    param families: ``gate``+``experts`` (:func:`moe_layer`) and
+    ``router``+``*_proj`` (:func:`moe_mlp_block`).
+    """
+    gate_key = "gate" if "gate" in params else "router"
+    if gate_key not in params:
+        raise ValueError("not a MoE param tree: no 'gate' or 'router' entry")
+    E = int(params[gate_key]["kernel"].shape[1])
+    E_pad = padded_expert_count(E, ep_size)
+    if E_pad == E:
+        return params, E
+    out = dict(params)
+    out[gate_key] = {"kernel": _pad_axis(params[gate_key]["kernel"], 1, E_pad)}
+    if "experts" in params:
+        out["experts"] = {k: _pad_axis(v, 0, E_pad)
+                          for k, v in params["experts"].items()}
+    for k in ("gate_proj", "up_proj", "down_proj"):
+        if k in params:
+            out[k] = {"kernel": _pad_axis(params[k]["kernel"], 0, E_pad)}
+    return out, E
+
+
+def reshard_expert_params(params: Dict, topology=None) -> Tuple[Dict, Dict]:
+    """Lay a stacked-expert MoE param tree out for the CURRENT mesh's
+    expert axis — the MoE leg of a mesh-shape change (chips lost, ep_size
+    re-planned, train→serve).
+
+    When the logical expert count divides the new ``ep_size`` this is a
+    plain re-placement onto ``moe_partition_specs``; when it does not
+    (e.g. 6 experts onto ep=4 after losing a host), the stack is padded to
+    the next multiple (:func:`pad_experts_for_ep`) and sharded evenly.
+    Returns ``(params, info)`` where ``info["num_experts_logical"]`` must
+    be forwarded to the gating call whenever ``info["padded"]`` is true.
+    """
+    topo = topology or get_topology()
+    ep = int(topo.dims[EXPERT])
+    gate_key = "gate" if "gate" in params else "router"
+    E = int(params[gate_key]["kernel"].shape[1])
+    params, E_logical = pad_experts_for_ep(params, ep)
+    info = {"num_experts_logical": E_logical,
+            "num_experts_padded": int(params[gate_key]["kernel"].shape[1]),
+            "ep_size": ep, "padded": E_logical !=
+            int(params[gate_key]["kernel"].shape[1]),
+            # the rows each rank ACTUALLY holds (even chunks of the padded
+            # stack, clipped to logical experts) — not the ideal balanced
+            # split, which padding cannot realize
+            "shard_ranges": placed_expert_ranges(E, ep)}
+    specs = moe_partition_specs()
+    placed = {}
+    for key, sub in params.items():
+        spec_sub = specs.get(key) if key in ("gate", "experts") else None
+        placed[key] = {}
+        for name, arr in sub.items():
+            if key == "experts" or key.endswith("_proj"):
+                spec = P(EXPERT, *([None] * (arr.ndim - 1)))
+            elif spec_sub is not None and name in spec_sub:
+                spec = spec_sub[name]
+            else:
+                spec = P(*([None] * arr.ndim))
+            placed[key][name] = jax.device_put(
+                arr, jax.sharding.NamedSharding(topo.mesh, spec))
+    return placed, info

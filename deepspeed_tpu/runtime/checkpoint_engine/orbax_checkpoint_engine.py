@@ -79,6 +79,23 @@ class OrbaxCheckpointEngine(CheckpointEngine):
         finally:
             if is_dict:
                 payload["state"] = state  # restore caller's dict on ALL paths
+        # logical layout manifest (universal checkpoints): global shape/
+        # dtype/partition spec per leaf + the writing mesh, so a job on ANY
+        # mesh can reshard this checkpoint.  Written before the integrity
+        # manifest so its size is covered by it.
+        try:
+            from ...checkpoint.universal.layout import write_layout
+
+            extra = {"tag": str(tag), "step": _tag_step(tag)}
+            if is_dict and isinstance(payload.get("config"), dict):
+                extra.update({k: v for k, v in payload["config"].items()
+                              if k in ("zero_stage", "world_size", "mesh")})
+            write_layout(path, state, extra=extra)
+        except Exception as e:  # noqa: BLE001 — layout is additive metadata;
+            # a save must never fail because a leaf defeated introspection
+            logger.warning(f"checkpoint {path}: could not write layout "
+                           f"manifest ({e!r}); resharded load disabled "
+                           f"for this tag")
         # written last: its presence certifies a complete checkpoint
         write_manifest(path, extra={"tag": str(tag), "step": _tag_step(tag)},
                        meta_hash=hash_job)

@@ -35,6 +35,7 @@ from flax import struct
 from jax.sharding import NamedSharding, PartitionSpec
 
 from ..accelerator import get_accelerator
+from ..telemetry import emit_event
 from ..telemetry.trace import NULL_SPAN
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -1142,7 +1143,9 @@ class DeepSpeedEngine:
             "lr_scheduler": (self.lr_scheduler.state_dict()
                              if hasattr(self.lr_scheduler, "state_dict") else None),
             "config": {"zero_stage": self.zero_stage,
-                       "world_size": self.topology.world_size()},
+                       "world_size": self.topology.world_size(),
+                       "mesh": {k: int(v)
+                                for k, v in self.topology.dims.items()}},
         }
         with self._span("engine/save_checkpoint", tag=str(tag)):
             engine.save(payload, tag)
@@ -1161,14 +1164,47 @@ class DeepSpeedEngine:
         self._heartbeat("checkpoint_load")
         engine = OrbaxCheckpointEngine(load_dir,
                                        fault_config=getattr(self.config, "fault", None))
-        if tag is None:
-            tag = engine.latest_tag()  # falls back to the newest VALID tag
+        # Universal path: checkpoints carrying a layout manifest reshard
+        # onto THIS engine's mesh (grow/shrink/re-split/zero restage) —
+        # the planner validates structure and tensorstore range-reads only
+        # the bytes each target shard needs.  Pre-universal checkpoints
+        # fall back to the template-structure load below (same mesh only).
+        from ..checkpoint.universal.loader import (NoLayoutError,
+                                                   load_state_resharded)
+
+        from .fault.manifest import CheckpointCorruptError
+
+        payload = None
+        try:
+            with self._span("engine/load_checkpoint", tag=str(tag)):
+                tag, restored, meta, plan = load_state_resharded(
+                    engine, self.state, tag)
+            payload = {"state": restored}
+            payload.update(meta)
+            if plan.reshaped:
+                emit_event("checkpoint_reshard", tag=str(tag), dir=load_dir,
+                           **plan.summary())
+                log_dist(
+                    f"resharded checkpoint {load_dir}/{tag}: "
+                    f"{plan.source_mesh} -> {plan.target_mesh}, "
+                    f"leaves {plan.counts()}, "
+                    f"read {plan.total_read_bytes() / 1e6:.2f} MB", ranks=[0])
+        except CheckpointCorruptError:
+            if tag is not None:
+                raise                      # explicit tag: never load elsewhere
+            # resume-anything semantics: an empty/unrecoverable store means
+            # "start fresh", exactly as the pre-universal path behaved
+            logger.warning(f"no (valid) checkpoint found under {load_dir}")
+            return None, {}
+        except NoLayoutError:
+            if tag is None:
+                tag = engine.latest_tag()  # falls back to the newest VALID tag
             if tag is None:
                 logger.warning(f"no (valid) checkpoint found under {load_dir}")
                 return None, {}
-        with self._span("engine/load_checkpoint", tag=str(tag)):
-            payload = engine.load({"state": self.state, "client_state": None,
-                                   "lr_scheduler": None, "config": None}, tag)
+            with self._span("engine/load_checkpoint", tag=str(tag)):
+                payload = engine.load({"state": self.state, "client_state": None,
+                                       "lr_scheduler": None, "config": None}, tag)
         restored = payload["state"]
         # Re-place on this engine's target shardings (restore may commit
         # scalar leaves to a single device, which conflicts under jit).

@@ -16,7 +16,10 @@ Fields: ``site`` (required), ``kind`` — one of
   * ``slow``      sleep ``delay`` seconds (hung collective / straggler),
   * ``truncate``  truncate the file passed by the call site to
                   ``truncate_to`` bytes (torn write),
-  * ``kill``      ``os._exit(exit_code)`` (worker death / preemption) —
+  * ``kill``      ``os._exit(exit_code)`` (worker death / preemption),
+  * ``shard_missing``  delete one file (first in sorted order) under the
+                  directory passed by the call site — a tensorstore shard
+                  lost between commit and a (resharded) load —
 
 plus ``p`` (fire probability, default 1), ``times`` (max fires per process),
 ``steps`` (only fire at these step numbers: ``3`` | ``3-5`` | ``3|7|9``),
@@ -52,13 +55,22 @@ except ImportError:  # loaded standalone, outside the package
             pass
 
 ENV_VAR = "DSTPU_FAULT_INJECT"
-KINDS = ("io_error", "slow", "truncate", "kill")
+KINDS = ("io_error", "slow", "truncate", "kill", "shard_missing")
 
 
 def truncate_file(path: str, nbytes: int = 0) -> None:
     """Simulate a torn write: keep only the first ``nbytes`` of ``path``."""
     with open(path, "rb+") as f:
         f.truncate(nbytes)
+
+
+def first_file_under(root: str) -> Optional[str]:
+    """Lexicographically first regular file under ``root`` (deterministic
+    victim for ``shard_missing``); None when there is nothing to delete."""
+    out = []
+    for cur, _dirs, files in os.walk(root):
+        out.extend(os.path.join(cur, fn) for fn in files)
+    return min(out) if out else None
 
 
 def _parse_steps(text: str) -> FrozenSet[int]:
@@ -148,6 +160,19 @@ class FaultInjector:
             logger.warning(f"fault injection: truncating {path} to "
                            f"{spec.truncate_to}B at {where}")
             truncate_file(path, spec.truncate_to)
+            return
+        if spec.kind == "shard_missing":
+            if path is None:
+                raise ValueError(f"shard_missing fault at {where} but call "
+                                 f"site passed no path")
+            victim = first_file_under(path)
+            if victim is None:
+                logger.warning(f"fault injection: shard_missing at {where} "
+                               f"found no files under {path}")
+                return
+            logger.warning(f"fault injection: deleting shard {victim} "
+                           f"at {where}")
+            os.remove(victim)
             return
         if spec.kind == "kill":
             logger.warning(f"fault injection: killing process at {where}")

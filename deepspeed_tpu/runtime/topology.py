@@ -334,6 +334,69 @@ def shard_map_context(topo: "MeshTopology"):
     return topo.mesh, set()
 
 
+def mesh_shape_str(dims: Dict[str, int]) -> str:
+    """Mesh dims -> compact ``axis:size`` string (``data:4,tensor:2``) —
+    the wire format of ``DSTPU_ELASTIC_MESH_SHAPE``.  Trivial axes are
+    elided; an all-trivial mesh renders its world size on ``data``.  A
+    MiCS mesh (``data_outer`` > 1) renders as the FULL data extent plus
+    ``zero_shard:<inner>``, mirroring how :class:`TopologyConfig` spells
+    it (``zero_shard_size``), so the string parses back losslessly."""
+    data_outer = int(dims.get(DATA_OUTER, 1))
+    parts = []
+    for a, n in dims.items():
+        n = int(n)
+        if a == DATA_OUTER or a not in AXIS_ORDER or n <= 1:
+            continue
+        if a == DATA and data_outer > 1:
+            parts.append(f"{DATA}:{n * data_outer}")
+            parts.append(f"zero_shard:{n}")
+        else:
+            parts.append(f"{a}:{n}")
+    if data_outer > 1 and not any(p.startswith(f"{DATA}:") for p in parts):
+        # outer replication over a trivial inner data axis
+        parts.insert(0, f"zero_shard:{int(dims.get(DATA, 1))}")
+        parts.insert(0, f"{DATA}:{data_outer * int(dims.get(DATA, 1))}")
+    if not parts:
+        total = int(np.prod([int(n) for n in dims.values()])) if dims else 1
+        parts = [f"{DATA}:{total}"]
+    return ",".join(parts)
+
+
+def parse_mesh_shape(text: str) -> TopologyConfig:
+    """``data:4,tensor:2`` (or a bare world size ``8``) -> TopologyConfig.
+
+    The inverse of :func:`mesh_shape_str`; how a restarted worker turns the
+    elastic agent's re-planned shape into its mesh."""
+    text = (text or "").strip()
+    if not text:
+        raise ValueError("empty mesh shape")
+    if text.isdigit():
+        return TopologyConfig(data=int(text))
+    field_by_axis = {PIPE: "pipe", DATA: "data", EXPERT: "expert",
+                     SEQ: "seq", TENSOR: "tensor",
+                     "zero_shard": "zero_shard_size"}
+    kw: Dict[str, int] = {}
+    for part in text.split(","):
+        axis, _, size = part.partition(":")
+        axis = axis.strip()
+        if axis not in field_by_axis:
+            raise ValueError(f"unknown mesh axis {axis!r} in {text!r}; "
+                             f"known: {sorted(field_by_axis)}")
+        kw[field_by_axis[axis]] = int(size)
+    if "data" not in kw:
+        kw["data"] = -1   # absorb the remaining devices, as usual
+    return TopologyConfig(**kw)
+
+
+def topology_config_from_env() -> Optional[TopologyConfig]:
+    """The elastic agent's re-planned mesh, if this worker was restarted
+    with ``--allow-reshape`` onto different capacity (None otherwise)."""
+    import os
+
+    text = os.environ.get("DSTPU_ELASTIC_MESH_SHAPE")
+    return parse_mesh_shape(text) if text else None
+
+
 _TOPOLOGY: Optional[MeshTopology] = None
 
 

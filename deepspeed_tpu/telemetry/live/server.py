@@ -59,7 +59,16 @@ def elastic_state_from_env() -> Dict[str, Any]:
             reason = f"signal:{-rc}" if rc < 0 else f"exit:{rc}"
         except ValueError:
             reason = str(last_rc)
-    return {"restart_count": restarts, "last_failure": reason}
+    try:
+        reshapes = int(os.environ.get("DSTPU_ELASTIC_RESHAPE_COUNT", 0))
+    except ValueError:
+        reshapes = 0
+    # set ONLY while the agent runs the gang on a different shape than it
+    # was launched with (--allow-reshape); cleared when capacity returns
+    mesh_shape = os.environ.get("DSTPU_ELASTIC_MESH_SHAPE") or None
+    return {"restart_count": restarts, "last_failure": reason,
+            "reshape_count": reshapes, "mesh_shape": mesh_shape,
+            "reshaped": mesh_shape is not None}
 
 
 def publish_elastic_gauges(metrics) -> Dict[str, Any]:
@@ -68,6 +77,12 @@ def publish_elastic_gauges(metrics) -> Dict[str, Any]:
     after restart 2' from 'healthy since boot' without hitting /healthz."""
     state = elastic_state_from_env()
     metrics.gauge("elastic/restart_count").set(state["restart_count"])
+    metrics.gauge("elastic/reshape_count").set(state["reshape_count"])
+    if state["reshaped"]:
+        g = metrics.gauge("elastic/degraded")
+        for key in g.labelsets():
+            g.set(0, **dict(key))
+        g.set(1, reason="reshaped")
     if state["last_failure"] is not None:
         # exactly one reason series carries 1 — zero any stale labelset
         # first (a gang that died as exit:1 then signal:9 must not expose
@@ -126,6 +141,15 @@ def health_report(telemetry, watchdog=None, anomaly=None,
             f"restart {elastic['restart_count']} "
             f"(last failure {elastic['last_failure']}), "
             f"{steps_this_process_fn()} step(s) into the new incarnation")
+    elif elastic["reshaped"]:
+        # the gang runs on a reshaped (usually shrunken) mesh: it makes
+        # progress, but at changed capacity — degraded for the whole
+        # incarnation, until the agent restores the launch-time shape
+        status = STATUS_DEGRADED
+        reasons.append(
+            f'reshaped: gang re-planned to mesh {elastic["mesh_shape"]!r} '
+            f'(reshape {elastic["reshape_count"]}, '
+            f'restart {elastic["restart_count"]})')
     elif anomaly is not None and anomaly.last_incident_step is not None \
             and last_step is not None \
             and last_step - anomaly.last_incident_step <= degraded_window_steps:

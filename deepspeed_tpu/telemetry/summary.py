@@ -28,8 +28,9 @@ from .events import read_event_segments
 from .metrics import _percentile
 
 EVENT_KINDS_INCIDENT = ("fault", "watchdog_timeout", "elastic_worker_failure",
-                        "elastic_restart", "straggler", "anomaly",
-                        "anomaly_checkpoint_failed")
+                        "elastic_restart", "elastic_reshape", "straggler",
+                        "anomaly", "anomaly_checkpoint_failed",
+                        "checkpoint_reshard_fallback")
 
 #: roofline table columns, shared between the section renderer and --help
 ROOFLINE_COLUMNS = (

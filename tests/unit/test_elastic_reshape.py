@@ -1,0 +1,250 @@
+"""Elastic resharding: the agent re-plans a gang to the visible capacity
+(--allow-reshape), exports the mesh shape to workers, and the live plane
+reports the reshaped gang as degraded{reason="reshaped"}."""
+import os
+import sys
+
+import pytest
+
+from deepspeed_tpu.elasticity.elastic_agent import DSElasticAgent
+from deepspeed_tpu.runtime.fault import injection
+from deepspeed_tpu.runtime.fault.retry import (RetryPolicy, fault_counters,
+                                               reset_fault_counters)
+from deepspeed_tpu.runtime.topology import (TopologyConfig, mesh_shape_str,
+                                            parse_mesh_shape,
+                                            topology_config_from_env)
+
+pytestmark = pytest.mark.elastic
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+FAST_RESTART = RetryPolicy(max_retries=10, base_s=0.01, cap_s=0.02, jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_state():
+    injection.clear()
+    reset_fault_counters()
+    yield
+    injection.clear()
+    reset_fault_counters()
+
+
+def agent_env(**extra):
+    env = {"PATH": os.environ.get("PATH", ""), "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO_ROOT, "HOME": os.environ.get("HOME", "/tmp")}
+    env.update(extra)
+    return env
+
+
+FAIL_ONCE_THEN_DUMP_ENV = (
+    "import os, sys\n"
+    "log = os.environ['WORKER_LOG']\n"
+    "with open(log, 'a') as f:\n"
+    "    f.write('%s %s %s %s\\n' % ("
+    "os.environ['WORLD_SIZE'], os.environ['RANK'],"
+    "os.environ.get('DSTPU_ELASTIC_MESH_SHAPE', '-'),"
+    "os.environ.get('DSTPU_ELASTIC_RESHAPE_COUNT', '-')))\n"
+    "sys.exit(1 if os.environ['DSTPU_ELASTIC_RESTART_COUNT'] == '0' else 0)\n"
+)
+
+
+class TestMeshShapeWire:
+    def test_roundtrip(self):
+        cfg = parse_mesh_shape("data:4,tensor:2")
+        assert cfg.data == 4 and cfg.tensor == 2
+        dims = cfg.resolve(8)
+        assert mesh_shape_str(dims) == "data:4,tensor:2"
+
+    def test_bare_world_size(self):
+        assert parse_mesh_shape("6").data == 6
+
+    def test_mics_mesh_roundtrips_via_zero_shard(self):
+        """data_outer (MiCS replica groups) has no TopologyConfig field of
+        its own — the wire format spells it data:<full>,zero_shard:<inner>
+        and must parse back to the identical mesh."""
+        cfg = TopologyConfig(data=8, zero_shard_size=4)
+        dims = cfg.resolve(8)
+        assert dims["data_outer"] == 2 and dims["data"] == 4
+        wire = mesh_shape_str(dims)
+        assert wire == "data:8,zero_shard:4"
+        assert parse_mesh_shape(wire).resolve(8) == dims
+
+    def test_trivial_mesh_renders_world_on_data(self):
+        assert mesh_shape_str({"pipe": 1, "data": 1, "tensor": 1}) == "data:1"
+
+    def test_unknown_axis_rejected(self):
+        with pytest.raises(ValueError, match="unknown mesh axis"):
+            parse_mesh_shape("bogus:4")
+
+    def test_env_reader(self, monkeypatch):
+        monkeypatch.delenv("DSTPU_ELASTIC_MESH_SHAPE", raising=False)
+        assert topology_config_from_env() is None
+        monkeypatch.setenv("DSTPU_ELASTIC_MESH_SHAPE", "data:2,tensor:2")
+        cfg = topology_config_from_env()
+        assert isinstance(cfg, TopologyConfig)
+        assert cfg.resolve(4) == {"pipe": 1, "data_outer": 1, "data": 2,
+                                  "expert": 1, "seq": 1, "tensor": 2}
+
+
+class TestAgentReshape:
+    def run_agent(self, tmp_path, allow_reshape, probe):
+        log = tmp_path / "workers.log"
+        agent = DSElasticAgent(
+            [sys.executable, "-c", FAIL_ONCE_THEN_DUMP_ENV],
+            world_size=4, max_restarts=3, monitor_interval=0.02,
+            env=agent_env(WORKER_LOG=str(log)), term_timeout=0.5,
+            restart_policy=FAST_RESTART, allow_reshape=allow_reshape,
+            capacity_probe=probe)
+        rc = agent.run()
+        lines = [ln.split() for ln in log.read_text().splitlines()]
+        return agent, rc, lines
+
+    def test_reshape_shrinks_gang_and_exports_mesh_shape(self, tmp_path):
+        agent, rc, lines = self.run_agent(tmp_path, True, lambda: 2)
+        assert rc == 0
+        assert agent.reshape_count == 1
+        assert agent.world_size == 2
+        assert agent.current_mesh_shape == "data:2"
+        # first incarnation: world 4, no mesh-shape override.  The agent
+        # tears the gang down as soon as ONE worker fails, so slower
+        # workers may never reach their log line — assert on whoever did.
+        first = [ln for ln in lines if ln[0] == "4"]
+        assert first and all(ln[2] == "-" and ln[3] == "0" for ln in first)
+        # restarted incarnation: 2 workers, reshaped env visible
+        second = [ln for ln in lines if ln[0] == "2"]
+        assert len(second) == 2
+        assert all(ln[2] == "data:2" and ln[3] == "1" for ln in second)
+        assert fault_counters()["elastic/reshapes"] == 1
+
+    def test_capacity_restored_clears_mesh_shape(self, tmp_path):
+        """Growing back to the launch-time capacity clears the reshaped
+        breadcrumb: the gang is whole again, not degraded."""
+        answers = iter([2, 4, 4, 4])
+        script = (
+            "import os, sys\n"
+            "log = os.environ['WORKER_LOG']\n"
+            "with open(log, 'a') as f:\n"
+            "    f.write('%s %s\\n' % (os.environ['WORLD_SIZE'],"
+            "os.environ.get('DSTPU_ELASTIC_MESH_SHAPE', '-')))\n"
+            "sys.exit(1 if int(os.environ['DSTPU_ELASTIC_RESTART_COUNT']) < 2"
+            " else 0)\n")
+        log = tmp_path / "w.log"
+        agent = DSElasticAgent(
+            [sys.executable, "-c", script], world_size=4, max_restarts=4,
+            monitor_interval=0.02, env=agent_env(WORKER_LOG=str(log)),
+            term_timeout=0.5, restart_policy=FAST_RESTART,
+            allow_reshape=True, capacity_probe=lambda: next(answers))
+        assert agent.run() == 0
+        assert agent.reshape_count == 2        # 4→2, then 2→4
+        assert agent.current_mesh_shape is None
+        final = [ln for ln in log.read_text().splitlines()
+                 if ln.startswith("4 ")]
+        assert any(ln.endswith(" -") for ln in final)
+
+    def test_without_allow_reshape_capacity_is_ignored(self, tmp_path):
+        agent, rc, lines = self.run_agent(tmp_path, False, lambda: 2)
+        assert rc == 0
+        assert agent.reshape_count == 0 and agent.world_size == 4
+        assert all(ln[0] == "4" for ln in lines)
+
+    def test_broken_probe_never_blocks_restart(self, tmp_path):
+        def probe():
+            raise RuntimeError("resource manager down")
+
+        agent, rc, lines = self.run_agent(tmp_path, True, probe)
+        assert rc == 0
+        assert agent.reshape_count == 0 and agent.world_size == 4
+
+
+class TestInitializeHonorsReshapedEnv:
+    def test_initialize_builds_env_mesh_over_config(self, monkeypatch):
+        """A worker restarted by a reshaping agent must get the re-planned
+        mesh from deepspeed_tpu.initialize() itself — the DeepSpeed config
+        still describes the stale launch-time world."""
+        import jax
+
+        import deepspeed_tpu
+
+        from .simple_model import init_mlp_params, mlp_loss_fn
+
+        monkeypatch.setenv("DSTPU_ELASTIC_MESH_SHAPE", "data:4")
+        config = {"train_micro_batch_size_per_gpu": 2,
+                  "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                  "zero_optimization": {"stage": 1},
+                  "bf16": {"enabled": False}}
+        params = init_mlp_params(jax.random.PRNGKey(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn, model_parameters=params, config=config)
+        # 8 visible sim devices, but the gang was re-planned to 4
+        assert engine.topology.world_size() == 4
+        assert engine.topology.dims["data"] == 4
+
+    def test_explicit_topology_still_wins(self, monkeypatch):
+        import jax
+
+        import deepspeed_tpu
+        from deepspeed_tpu.runtime.topology import (TopologyConfig,
+                                                    initialize_mesh)
+
+        from .simple_model import init_mlp_params, mlp_loss_fn
+
+        monkeypatch.setenv("DSTPU_ELASTIC_MESH_SHAPE", "data:4")
+        topo = initialize_mesh(TopologyConfig(), force=True)   # 8-dev
+        params = init_mlp_params(jax.random.PRNGKey(0))
+        engine, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+                    "bf16": {"enabled": False}},
+            topology=topo)
+        assert engine.topology.world_size() == 8
+
+
+class TestHealthzReshaped:
+    def test_reshaped_env_reports_degraded(self, monkeypatch, tmp_path):
+        from deepspeed_tpu.telemetry import Telemetry
+        from deepspeed_tpu.telemetry.live.server import (
+            STATUS_DEGRADED, elastic_state_from_env, health_report,
+            publish_elastic_gauges)
+
+        monkeypatch.setenv("DSTPU_ELASTIC_RESTART_COUNT", "2")
+        monkeypatch.setenv("DSTPU_ELASTIC_RESHAPE_COUNT", "1")
+        monkeypatch.setenv("DSTPU_ELASTIC_MESH_SHAPE", "data:2")
+        tel = Telemetry(output_dir=str(tmp_path), jsonl=False)
+        state = elastic_state_from_env()
+        assert state["reshaped"] and state["mesh_shape"] == "data:2"
+        # past the recovering window, a reshaped gang is degraded
+        report = health_report(tel, step_fn=lambda: 50,
+                               steps_this_process_fn=lambda: 50)
+        assert report["status"] == STATUS_DEGRADED
+        assert any("reshaped" in r for r in report["reasons"])
+        publish_elastic_gauges(tel.metrics)
+        assert tel.metrics.gauge("elastic/reshape_count").value() == 1
+        assert tel.metrics.gauge("elastic/degraded").value(
+            reason="reshaped") == 1
+
+    def test_recovering_takes_precedence_right_after_restart(self, monkeypatch, tmp_path):
+        from deepspeed_tpu.telemetry import Telemetry
+        from deepspeed_tpu.telemetry.live.server import (STATUS_RECOVERING,
+                                                         health_report)
+
+        monkeypatch.setenv("DSTPU_ELASTIC_RESTART_COUNT", "1")
+        monkeypatch.setenv("DSTPU_ELASTIC_MESH_SHAPE", "data:2")
+        report = health_report(Telemetry(output_dir=str(tmp_path),
+                                          jsonl=False), step_fn=lambda: 1,
+                               steps_this_process_fn=lambda: 0)
+        assert report["status"] == STATUS_RECOVERING
+
+    def test_unreshaped_gang_stays_healthy(self, monkeypatch, tmp_path):
+        from deepspeed_tpu.telemetry import Telemetry
+        from deepspeed_tpu.telemetry.live.server import (STATUS_HEALTHY,
+                                                         health_report)
+
+        monkeypatch.delenv("DSTPU_ELASTIC_MESH_SHAPE", raising=False)
+        monkeypatch.setenv("DSTPU_ELASTIC_RESTART_COUNT", "1")
+        monkeypatch.setenv("DSTPU_ELASTIC_RESHAPE_COUNT", "0")
+        report = health_report(Telemetry(output_dir=str(tmp_path),
+                                          jsonl=False), step_fn=lambda: 50,
+                               steps_this_process_fn=lambda: 50)
+        assert report["status"] == STATUS_HEALTHY

@@ -1,0 +1,215 @@
+"""MoE routing/dispatch invariants + expert resharding (moe/sharded_moe.py).
+
+The ROADMAP flags the MoE layer as needing hardening; these tests pin the
+gating contracts the elastic-resharding work relies on: capacity-factor
+edge cases, zero-token experts, deterministic tie-breaks, and the uneven
+expert÷ep padding path (bit-identical routing through a padded stack)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.moe.sharded_moe import (
+    _capacity, combine_sparse, dispatch_sparse, expert_shard_ranges,
+    init_moe_params, moe_layer, pad_experts_for_ep, padded_expert_count,
+    placed_expert_ranges, reshard_expert_params, top1gating,
+    top1gating_sparse, topkgating, topkgating_sparse)
+from deepspeed_tpu.runtime.topology import (EXPERT, TopologyConfig,
+                                            initialize_mesh)
+
+pytestmark = pytest.mark.moe
+
+HID = 8
+
+
+def skewed_logits(S=16, E=4, to_expert=0, seed=0):
+    """Logits that route every token to one expert (zero-token experts
+    everywhere else)."""
+    rng = np.random.default_rng(seed)
+    logits = rng.normal(size=(S, E)).astype(np.float32) * 0.01
+    logits[:, to_expert] += 10.0
+    return jnp.asarray(logits)
+
+
+class TestCapacityEdgeCases:
+    def test_min_capacity_clamps_tiny_factors(self):
+        # ceil(16/4 * 0.01) = 1, clamped up to min_capacity
+        assert _capacity(16, 4, 0.01, 4) == 4
+        assert _capacity(16, 4, 0.01, 1) == 1
+
+    def test_capacity_rounds_up(self):
+        assert _capacity(10, 4, 1.0, 1) == 3      # ceil(2.5)
+
+    @pytest.mark.parametrize("gating,kw", [
+        (top1gating, {}), (topkgating, {"k": 2})])
+    def test_overflow_tokens_are_dropped_not_misrouted(self, gating, kw):
+        """All tokens want expert 0; beyond capacity they are dropped —
+        never silently routed into another expert's rows."""
+        S, E = 16, 4
+        out = gating(skewed_logits(S, E), capacity_factor=0.25,
+                     min_capacity=1, **kw)
+        C = out.dispatch.shape[2]
+        # dispatch is one-hot per (token, expert): each expert receives at
+        # most C tokens, and only expert 0 receives the top-1 routes
+        per_expert = np.asarray(out.dispatch.sum(axis=(0, 2)))
+        assert per_expert[0] <= C
+        got = np.asarray(out.dispatch.sum(axis=(1, 2)))
+        assert got.max() <= kw.get("k", 1)        # a token rides ≤ k slots
+
+    def test_sparse_overflow_goes_to_trash_slot(self):
+        S, E = 16, 4
+        out = top1gating_sparse(skewed_logits(S, E), capacity_factor=0.25,
+                                min_capacity=1)
+        C = out.capacity
+        dropped = np.asarray(out.slot[:, 0]) == E * C
+        assert dropped.sum() == S - C             # overflow beyond capacity
+        # dropped tokens carry zero combine weight
+        assert np.all(np.asarray(out.gate_val)[dropped] == 0.0)
+
+
+class TestZeroTokenExperts:
+    @pytest.mark.parametrize("impl", ["dense", "sparse"])
+    def test_starved_experts_contribute_nothing_and_nothing_breaks(self, impl):
+        params = init_moe_params(jax.random.PRNGKey(0), HID, 2 * HID, 4)
+        # force router: every token to expert 1
+        gate = np.zeros((HID, 4), np.float32)
+        gate[:, 1] = 0.0
+        params["gate"]["kernel"] = jnp.asarray(gate)
+        x = jnp.ones((8, HID), jnp.float32)       # identical tokens, tied logits
+        out, l_aux, counts = moe_layer(params, x, k=1, capacity_factor=8.0,
+                                       dispatch_impl=impl)
+        assert np.isfinite(np.asarray(out)).all()
+        assert np.isfinite(float(l_aux))
+        counts = np.asarray(counts)
+        assert counts.sum() == 8 and (counts > 0).sum() == 1  # one hot expert
+
+    def test_zero_token_expert_counts_are_zero(self):
+        out = top1gating(skewed_logits(16, 4, to_expert=2))
+        counts = np.asarray(out.exp_counts)
+        assert counts[2] == 16
+        assert counts[[0, 1, 3]].sum() == 0
+
+
+class TestDeterministicTieBreaks:
+    def test_top1_tie_picks_lowest_index_stably(self):
+        logits = jnp.zeros((8, 4), jnp.float32)   # full tie
+        a = top1gating(logits)
+        b = top1gating(logits)
+        idx = np.asarray(a.dispatch).sum(axis=2).argmax(axis=1)
+        assert (idx == 0).all()                   # argmax: first index wins
+        np.testing.assert_array_equal(np.asarray(a.dispatch),
+                                      np.asarray(b.dispatch))
+
+    def test_topk_tie_order_matches_lax_top_k_and_is_repeatable(self):
+        logits = jnp.asarray(np.tile([1.0, 1.0, 1.0, 0.0], (6, 1)),
+                             jnp.float32)
+        runs = [topkgating(logits, k=2, capacity_factor=4.0)
+                for _ in range(2)]
+        np.testing.assert_array_equal(np.asarray(runs[0].dispatch),
+                                      np.asarray(runs[1].dispatch))
+        chosen = np.asarray(runs[0].dispatch).sum(axis=2)
+        # lax.top_k breaks ties by lowest index: experts 0 and 1
+        assert (chosen[:, :2] == 1).all() and (chosen[:, 2:] == 0).all()
+
+    def test_sparse_and_dense_route_identically_under_ties(self):
+        logits = jnp.asarray(np.tile([0.5, 0.5, 0.5, 0.5], (8, 1)),
+                             jnp.float32)
+        dense = topkgating(logits, k=2)
+        sparse = topkgating_sparse(logits, k=2)
+        dense_assign = np.asarray(dense.dispatch)          # [S, E, C]
+        E, C = dense_assign.shape[1], dense_assign.shape[2]
+        sparse_assign = np.zeros_like(dense_assign)
+        slots = np.asarray(sparse.slot)
+        for s in range(slots.shape[0]):
+            for c in range(slots.shape[1]):
+                sl = slots[s, c]
+                if sl < E * C:
+                    sparse_assign[s, sl // C, sl % C] = 1
+        np.testing.assert_array_equal(dense_assign, sparse_assign)
+
+
+class TestExpertResharding:
+    def test_shard_ranges_balanced_with_remainder(self):
+        assert expert_shard_ranges(6, 4) == [(0, 2), (2, 4), (4, 5), (5, 6)]
+        assert expert_shard_ranges(8, 4) == [(0, 2), (2, 4), (4, 6), (6, 8)]
+        assert expert_shard_ranges(3, 1) == [(0, 3)]
+        sizes = [b - a for a, b in expert_shard_ranges(13, 5)]
+        assert sum(sizes) == 13 and max(sizes) - min(sizes) <= 1
+
+    def test_placed_ranges_match_even_padded_chunks(self):
+        assert placed_expert_ranges(8, 4) == expert_shard_ranges(8, 4)
+        assert placed_expert_ranges(6, 4) == [(0, 2), (2, 4), (4, 6), (6, 6)]
+        assert placed_expert_ranges(5, 3) == [(0, 2), (2, 4), (4, 5)]
+
+    def test_padded_expert_count(self):
+        assert padded_expert_count(6, 4) == 8
+        assert padded_expert_count(8, 4) == 8
+        assert padded_expert_count(5, 3) == 6
+        assert padded_expert_count(4, 1) == 4
+
+    @pytest.mark.parametrize("impl", ["dense", "sparse"])
+    def test_padded_stack_routes_bit_identically(self, impl):
+        """6 experts padded onto an ep=4-friendly stack of 8: outputs match
+        the unpadded layer exactly — padding columns route -inf logits and
+        capacity/l_aux use the logical count."""
+        E = 6
+        params = init_moe_params(jax.random.PRNGKey(1), HID, 2 * HID, E)
+        x = jax.random.normal(jax.random.PRNGKey(2), (16, HID), jnp.float32)
+        ref_out, ref_aux, ref_counts = moe_layer(params, x, k=2,
+                                                 capacity_factor=2.0,
+                                                 dispatch_impl=impl)
+        padded, e_logical = pad_experts_for_ep(params, 4)
+        assert e_logical == E
+        assert padded["gate"]["kernel"].shape == (HID, 8)
+        assert padded["experts"]["w1"].shape[0] == 8
+        out, aux, counts = moe_layer(padded, x, k=2, capacity_factor=2.0,
+                                     dispatch_impl=impl,
+                                     num_experts_logical=e_logical)
+        np.testing.assert_array_equal(np.asarray(ref_out), np.asarray(out))
+        assert float(ref_aux) == float(aux)
+        np.testing.assert_array_equal(np.asarray(ref_counts),
+                                      np.asarray(counts)[:E])
+        assert np.asarray(counts)[E:].sum() == 0   # padding never routed
+
+    def test_reshard_divisible_places_on_expert_axis(self):
+        topo = initialize_mesh(TopologyConfig(expert=4), force=True)
+        params = init_moe_params(jax.random.PRNGKey(0), HID, 2 * HID, 8)
+        placed, info = reshard_expert_params(params, topo)
+        assert not info["padded"]
+        assert info["num_experts_logical"] == 8
+        w1 = placed["experts"]["w1"]
+        assert EXPERT in (w1.sharding.spec[0] if isinstance(
+            w1.sharding.spec[0], tuple) else (w1.sharding.spec[0],))
+        assert w1.sharding.shard_shape(w1.shape)[0] == 2   # 8 experts / ep 4
+
+    def test_reshard_uneven_pads_and_preserves_outputs(self):
+        topo = initialize_mesh(TopologyConfig(expert=4), force=True)
+        E = 6
+        params = init_moe_params(jax.random.PRNGKey(3), HID, 2 * HID, E)
+        x = jax.random.normal(jax.random.PRNGKey(4), (16, HID), jnp.float32)
+        ref = moe_layer(params, x, k=1, capacity_factor=2.0)[0]
+        placed, info = reshard_expert_params(params, topo)
+        assert info["padded"] and info["num_experts_padded"] == 8
+        # actual placement: even chunks of the PADDED stack clipped to the
+        # logical count — rank 3 holds only padding
+        assert info["shard_ranges"] == [(0, 2), (2, 4), (4, 6), (6, 6)]
+        assert info["shard_ranges"] == placed_expert_ranges(6, 4)
+        out = moe_layer(placed, x, k=1, capacity_factor=2.0,
+                        num_experts_logical=info["num_experts_logical"])[0]
+        np.testing.assert_allclose(np.asarray(ref), np.asarray(out),
+                                   rtol=1e-6, atol=1e-6)
+
+
+class TestSparseDispatchCombine:
+    def test_dispatch_combine_roundtrip_with_trash_slot(self):
+        S, E, C, D = 6, 2, 3, 4
+        tokens = jnp.asarray(np.arange(S * D, dtype=np.float32).reshape(S, D))
+        slot = jnp.asarray([[0], [1], [3], [E * C], [4], [2]], jnp.int32)
+        gate_val = jnp.ones((S, 1), jnp.float32)
+        ecd = dispatch_sparse(slot, tokens, E, C, jnp.float32)
+        assert ecd.shape == (E, C, D)
+        back = combine_sparse(slot, gate_val, ecd, jnp.float32)
+        kept = np.asarray(slot[:, 0]) < E * C
+        np.testing.assert_array_equal(np.asarray(back)[kept],
+                                      np.asarray(tokens)[kept])
+        assert np.all(np.asarray(back)[~kept] == 0.0)      # dropped → zeros

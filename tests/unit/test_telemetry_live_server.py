@@ -334,7 +334,9 @@ class TestHealthStates:
             srv.stop()
         assert code == 503
         assert h["status"] == "recovering"
-        assert h["elastic"] == {"restart_count": 2, "last_failure": "signal:9"}
+        assert h["elastic"] == {"restart_count": 2, "last_failure": "signal:9",
+                                "reshape_count": 0, "mesh_shape": None,
+                                "reshaped": False}
         assert tel.metrics.gauge("elastic/restart_count").value() == 2
         assert tel.metrics.gauge("elastic/last_restart").value(
             reason="signal:9") == 1

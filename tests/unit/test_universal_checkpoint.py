@@ -1,0 +1,434 @@
+"""Elastic resharding + universal checkpoints (checkpoint/universal/).
+
+The reshard matrix: save on CPU-sim mesh A, load on mesh B for grow,
+shrink, and re-split (dp×tp re-split + zero_stage restage) — the restored
+global state must be BITWISE identical to a same-mesh resume, and the
+continuation loss on the target mesh bitwise equal to resuming on that
+mesh from a natively-saved checkpoint.  Plus: layout-manifest contracts,
+planner classification/byte accounting, the shard_missing fault-injection
+fallback, the dtype-faithful ds_to_universal CLI, and the train→serve
+params-only handoff."""
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu
+from deepspeed_tpu.checkpoint import ds_to_universal
+from deepspeed_tpu.checkpoint.universal import (
+    NoLayoutError, ReshardPlanError, load_params_resharded,
+    load_state_resharded, plan_reshard, read_layout)
+from deepspeed_tpu.checkpoint.universal.layout import (
+    LAYOUT_FILE, flat_records, template_from_layout)
+from deepspeed_tpu.runtime.checkpoint_engine.orbax_checkpoint_engine import \
+    OrbaxCheckpointEngine
+from deepspeed_tpu.runtime.config import FaultConfig
+from deepspeed_tpu.runtime.fault import injection
+from deepspeed_tpu.runtime.fault.injection import truncate_file
+from deepspeed_tpu.runtime.fault.manifest import (CheckpointCorruptError,
+                                                  verify_checkpoint)
+from deepspeed_tpu.runtime.fault.retry import (fault_counters,
+                                               reset_fault_counters)
+from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
+
+pytestmark = pytest.mark.elastic
+
+HIDDEN = 16
+FAST_FAULT = FaultConfig(max_retries=2, retry_base_s=0.001, retry_cap_s=0.002,
+                         retry_jitter=0.0)
+
+
+@pytest.fixture(autouse=True)
+def _reset_fault_state():
+    injection.clear()
+    reset_fault_counters()
+    yield
+    injection.clear()
+    reset_fault_counters()
+
+
+def make_engine(zero_stage=3, ndev=8, tensor=1, gas=1, seed=0):
+    topo = initialize_mesh(TopologyConfig(tensor=tensor),
+                           devices=jax.devices()[:ndev], force=True)
+    config = {
+        "train_micro_batch_size_per_gpu": 4,
+        "gradient_accumulation_steps": gas,
+        "optimizer": {"type": "Adam", "params": {"lr": 1e-2}},
+        "zero_optimization": {"stage": zero_stage,
+                              "stage3_param_persistence_threshold": 0},
+        "bf16": {"enabled": False},
+    }
+    params = init_mlp_params(jax.random.PRNGKey(seed), hidden=HIDDEN)
+    engine, _, _, _ = deepspeed_tpu.initialize(
+        model=mlp_loss_fn, model_parameters=params, config=config,
+        topology=topo)
+    return engine
+
+
+def trained_checkpoint(tmp_path, steps=2, **kw):
+    eng = make_engine(**kw)
+    batch = random_batch(eng.train_batch_size())
+    for _ in range(steps):
+        eng.train_batch(batch)
+    eng.save_checkpoint(str(tmp_path))
+    return eng
+
+
+@pytest.fixture(scope="module")
+def ckpt_cache(tmp_path_factory):
+    """Trained checkpoints are the slow part (one train-step compile per
+    mesh shape); share them across read-only tests.  Tests that corrupt
+    or delete files take a private copy via ``.mutable()``."""
+    import shutil
+
+    root = tmp_path_factory.mktemp("ckpts")
+    dirs = {}
+
+    def get(**kw):
+        key = tuple(sorted(kw.items()))
+        if key not in dirs:
+            d = root / ("ck_" + "_".join(f"{k}{v}" for k, v in key))
+            trained_checkpoint(d, **kw)
+            dirs[key] = str(d)
+        return dirs[key]
+
+    def mutable(tmp_path, **kw):
+        dst = tmp_path / "ck_copy"
+        shutil.copytree(get(**kw), dst)
+        return str(dst)
+
+    get.mutable = mutable
+    return get
+
+
+def state_dicts_bitwise_equal(a, b):
+    eq = jax.tree.map(lambda x, y: bool(np.array_equal(np.asarray(x),
+                                                       np.asarray(y))), a, b)
+    return all(jax.tree.leaves(eq))
+
+
+class TestLayoutManifest:
+    def test_save_writes_layout_with_mesh_and_specs(self, ckpt_cache):
+        lay = read_layout(os.path.join(ckpt_cache(zero_stage=3, ndev=4),
+                                       "global_step2"))
+        assert lay is not None and lay["format"] == "dstpu-universal"
+        assert lay["mesh"]["data"] == 4
+        assert lay["zero_stage"] == 3 and lay["world_size"] == 4
+        recs = flat_records(lay["tree"])
+        kernel = recs["params/layer_0/kernel"]
+        assert kernel["shape"] == [HIDDEN, HIDDEN]
+        assert kernel["dtype"] == "float32"
+        # stage 3: params carry the ZeRO axis in their saved spec
+        assert any(e for e in (kernel["spec"] or []) if e)
+        # optimizer moments recorded too (mu mirrors the param tree)
+        assert any("/mu/" in f"/{p}/" for p in recs)
+
+    def test_layout_is_covered_by_integrity_manifest(self, ckpt_cache,
+                                                     tmp_path):
+        ck = ckpt_cache.mutable(tmp_path, zero_stage=1, ndev=4)
+        p = os.path.join(ck, "global_step2")
+        verify_checkpoint(p)
+        truncate_file(os.path.join(p, LAYOUT_FILE), 7)
+        with pytest.raises(CheckpointCorruptError, match="layout.json"):
+            verify_checkpoint(p)
+
+    def test_template_rebuilds_without_writer_objects(self, ckpt_cache):
+        """A process that never saw the engine's python state can rebuild a
+        full restore template from layout.json alone."""
+        lay = read_layout(os.path.join(ckpt_cache(zero_stage=2, ndev=4),
+                                       "global_step2"))
+        park = jax.sharding.SingleDeviceSharding(jax.devices()[0])
+        tpl = template_from_layout(lay, lambda p, r: park)
+        recs = flat_records(lay["tree"])
+        leaves = [x for x in jax.tree.leaves(tpl)
+                  if getattr(x, "shape", None) is not None]
+        arrays = [r for r in recs.values() if r["shape"] is not None]
+        assert len(leaves) >= len(arrays) > 0
+
+
+class TestReshardMatrix:
+    """save mesh A → load mesh B; every cell bitwise vs same-mesh resume."""
+
+    CELLS = [
+        # (save kw, load kw, name)
+        (dict(zero_stage=3, ndev=4), dict(zero_stage=3, ndev=8), "grow"),
+        (dict(zero_stage=3, ndev=8), dict(zero_stage=3, ndev=4), "shrink"),
+        (dict(zero_stage=3, ndev=8, tensor=2),
+         dict(zero_stage=2, ndev=8, tensor=4), "resplit_restage"),
+    ]
+
+    @pytest.mark.parametrize("save_kw,load_kw,name", CELLS,
+                             ids=[c[-1] for c in CELLS])
+    def test_cell_bitwise_vs_same_mesh_resume(self, ckpt_cache, tmp_path,
+                                              save_kw, load_kw, name):
+        ck_a = ckpt_cache(**save_kw)
+
+        # same-mesh (source) resume = the reference trajectory
+        ref = make_engine(seed=11, **save_kw)
+        ref.load_checkpoint(ck_a)
+        ref_state = ref.get_fp32_state_dict()
+
+        # reshard resume on mesh B
+        tgt = make_engine(seed=12, **load_kw)
+        path, _ = tgt.load_checkpoint(ck_a)
+        assert path.endswith("global_step2")
+        assert tgt.global_steps == 2
+        assert state_dicts_bitwise_equal(ref_state, tgt.get_fp32_state_dict())
+
+        # resumed loss: continuing on mesh B from the resharded load must be
+        # bitwise what a same-mesh(B) resume of the same state produces
+        tgt.save_checkpoint(str(tmp_path / "B"), tag="handoff")
+        native = make_engine(seed=13, **load_kw)
+        native.load_checkpoint(str(tmp_path / "B"), tag="handoff")
+        batch = random_batch(tgt.train_batch_size(), seed=3)
+        loss_resharded = float(tgt.train_batch(batch))
+        loss_native = float(native.train_batch(batch))
+        assert loss_resharded == loss_native
+        assert np.isfinite(loss_resharded)
+
+    def test_gas_mismatch_resets_grad_acc_buffer(self, ckpt_cache):
+        """gas=1 source (grad_acc=None) resumes into a gas=2 target: the
+        accumulation buffer is target-only and re-initializes to zeros."""
+        ck = ckpt_cache(zero_stage=1, ndev=4)
+        tgt = make_engine(zero_stage=1, ndev=8, gas=2, seed=9)
+        tgt.load_checkpoint(ck)
+        assert tgt.global_steps == 2
+        acc = jax.tree.leaves(tgt.state.grad_acc)
+        assert acc and all(float(np.abs(np.asarray(a)).max()) == 0.0
+                           for a in acc)
+
+    def test_gas2_source_drops_grad_acc_into_gas1_target(self, ckpt_cache):
+        """The reverse: a gas=2 source saved a model-sized grad_acc buffer
+        the gas=1 target has no home for — the leaf is pruned from the
+        restore (its bytes never read) and everything else lands bitwise."""
+        ck = ckpt_cache(zero_stage=1, ndev=4, gas=2)
+        ref = make_engine(zero_stage=1, ndev=4, gas=2, seed=20)
+        ref.load_checkpoint(ck)
+        tgt = make_engine(zero_stage=1, ndev=8, gas=1, seed=21)
+        path, _ = tgt.load_checkpoint(ck)
+        assert path.endswith("global_step2")
+        assert tgt.state.grad_acc is None
+        assert state_dicts_bitwise_equal(ref.get_fp32_state_dict(),
+                                         tgt.get_fp32_state_dict())
+
+    def test_structure_divergence_fails_with_paths(self, ckpt_cache):
+        """A different optimizer cannot silently adopt mismatched moments —
+        the planner names the diverging leaves."""
+        ck = ckpt_cache(zero_stage=1, ndev=4)
+        topo = initialize_mesh(TopologyConfig(), force=True)
+        config = {"train_micro_batch_size_per_gpu": 4,
+                  "optimizer": {"type": "Lamb", "params": {"lr": 1e-2}},
+                  "zero_optimization": {"stage": 1}, "bf16": {"enabled": False}}
+        params = init_mlp_params(jax.random.PRNGKey(1), hidden=HIDDEN)
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=mlp_loss_fn, model_parameters=params, config=config,
+            topology=topo)
+        store = OrbaxCheckpointEngine(ck, fault_config=FAST_FAULT)
+        with pytest.raises(ReshardPlanError, match="opt_state"):
+            load_state_resharded(store, eng.state)
+
+
+class TestPlanner:
+    def test_same_mesh_plan_is_identical_or_replicated(self, ckpt_cache):
+        lay = read_layout(os.path.join(ckpt_cache(zero_stage=3, ndev=8),
+                                       "global_step2"))
+        eng = make_engine(zero_stage=3, ndev=8)
+        plan = plan_reshard(lay, eng.state)
+        assert not plan.reshaped
+        assert set(plan.counts()) <= {"identical", "replicated"}
+        plan.raise_on_errors()
+
+    def test_grow_plan_reslices_and_never_full_reads_sharded_leaves(
+            self, ckpt_cache):
+        lay = read_layout(os.path.join(ckpt_cache(zero_stage=3, ndev=4),
+                                       "global_step2"))
+        tgt = make_engine(zero_stage=3, ndev=8, seed=4)
+        plan = plan_reshard(lay, tgt.state)
+        assert plan.reshaped
+        assert plan.counts().get("reslice", 0) > 0
+        for leaf in plan.leaves.values():
+            if leaf.kind == "reslice":
+                # sharded target: this host reads the leaf once, not a
+                # replica per device (8 devices would read 8x)
+                assert leaf.read_bytes <= leaf.nbytes
+        s = plan.summary()
+        assert {"reshaped", "source_mesh", "target_mesh", "leaf_kinds",
+                "read_bytes", "logical_bytes"} <= set(s)
+
+    def test_zero_restage_gather_reads_full_array(self, ckpt_cache):
+        lay = read_layout(os.path.join(ckpt_cache(zero_stage=3, ndev=8),
+                                       "global_step2"))
+        tgt = make_engine(zero_stage=0, ndev=8, seed=4)
+        plan = plan_reshard(lay, tgt.state)
+        gathered = [l for l in plan.leaves.values() if l.kind == "gather"]
+        assert gathered
+        assert all(l.read_bytes == l.nbytes for l in gathered)
+
+
+class TestShardMissingFallback:
+    def test_missing_shard_degrades_to_newest_valid_tag(self, tmp_path):
+        """DSTPU_FAULT_INJECT shard_missing drops one source shard during
+        the resharded load: the loader must fall back to the older valid
+        tag — exactly the PR-1 torn-checkpoint behavior — and count it."""
+        eng = make_engine(zero_stage=3, ndev=4)
+        batch = random_batch(eng.train_batch_size())
+        eng.train_batch(batch)
+        eng.save_checkpoint(str(tmp_path))            # global_step1
+        step1_state = eng.get_fp32_state_dict()
+        eng.train_batch(batch)
+        eng.save_checkpoint(str(tmp_path))            # global_step2 (latest)
+
+        injection.configure("site=reshard_load,kind=shard_missing,times=1")
+        tgt = make_engine(zero_stage=3, ndev=8, seed=2)
+        path, _ = tgt.load_checkpoint(str(tmp_path))
+        assert path.endswith("global_step1")          # fell back
+        assert tgt.global_steps == 1
+        assert state_dicts_bitwise_equal(step1_state,
+                                         tgt.get_fp32_state_dict())
+        c = fault_counters()
+        assert c["injected/reshard_load"] == 1
+        assert c["reshard/fallbacks"] == 1
+
+    def test_explicit_tag_raises_instead_of_falling_back(self, ckpt_cache,
+                                                         tmp_path):
+        ck = ckpt_cache.mutable(tmp_path, zero_stage=1, ndev=4)
+        injection.configure("site=reshard_load,kind=shard_missing,times=1")
+        tgt = make_engine(zero_stage=1, ndev=8, seed=2)
+        store = OrbaxCheckpointEngine(ck, fault_config=FAST_FAULT)
+        with pytest.raises(CheckpointCorruptError):
+            load_state_resharded(store, tgt.state, tag="global_step2")
+
+
+class TestDsToUniversalCLI:
+    def test_convert_validates_tag_against_manifest(self, ckpt_cache,
+                                                    tmp_path):
+        ck = ckpt_cache.mutable(tmp_path, zero_stage=1, ndev=4)
+        truncate_file(os.path.join(ck, "global_step2", "meta.json"), 2)
+        with pytest.raises(CheckpointCorruptError):
+            ds_to_universal.convert(ck, str(tmp_path / "u"),
+                                    tag="global_step2")
+        # --no_strict escape hatch still converts
+        ds_to_universal.convert(ck, str(tmp_path / "u2"),
+                                tag="global_step2", strict=False)
+        assert os.path.exists(str(tmp_path / "u2" / "index.json"))
+
+    def test_convert_roundtrips_params_and_moments(self, ckpt_cache,
+                                                   tmp_path):
+        ck = ckpt_cache(zero_stage=2, ndev=4)
+        ref = make_engine(zero_stage=2, ndev=4, seed=6)
+        ref.load_checkpoint(ck)
+        out = str(tmp_path / "u")
+        tag = ds_to_universal.convert(ck, out)
+        assert tag == "global_step2"
+        flat = ds_to_universal.load_universal(out, include_moments=True)
+        np.testing.assert_array_equal(
+            flat["layer_0/kernel"]["param"],
+            np.asarray(ref.get_fp32_state_dict()["layer_0"]["kernel"]))
+        assert {"param", "exp_avg", "exp_avg_sq"} <= set(flat["layer_0/kernel"])
+        # CLI meta
+        with open(os.path.join(out, "index.json")) as f:
+            index = json.load(f)
+        assert index["source_tag"] == "global_step2"
+        assert index["source_mesh"]["data"] == 4
+
+    def test_bf16_dtype_contract_roundtrips(self, tmp_path):
+        """bf16 leaves come back as bf16, not as opaque void bytes and not
+        silently as fp32."""
+        import ml_dtypes
+
+        store = OrbaxCheckpointEngine(str(tmp_path / "ck"),
+                                      fault_config=FAST_FAULT)
+        w = jnp.asarray(np.linspace(-2, 2, 16, dtype=np.float32),
+                        jnp.bfloat16)
+        store.save({"state": {"params": {"w": w},
+                              "global_step": jnp.zeros((), jnp.int32)},
+                    "client_state": {}}, "global_step0")
+        store.commit("global_step0")
+        out = str(tmp_path / "u")
+        ds_to_universal.convert(str(tmp_path / "ck"), out)
+        flat = ds_to_universal.load_universal(out)
+        assert flat["w"].dtype == ml_dtypes.bfloat16
+        np.testing.assert_array_equal(flat["w"], np.asarray(w))
+
+    def test_unflatten(self):
+        tree = ds_to_universal.unflatten({"a/b": 1, "a/c": 2, "d": 3})
+        assert tree == {"a": {"b": 1, "c": 2}, "d": 3}
+
+
+class TestTrainServeHandoff:
+    def test_params_only_restore_onto_serving_layout(self, ckpt_cache):
+        """The serving side restores ONLY the params subtree, resharded
+        onto its own mesh and cast to the serving dtype — optimizer bytes
+        untouched, values bitwise (modulo the requested cast)."""
+        ck = ckpt_cache(zero_stage=3, ndev=4)
+        ref = make_engine(zero_stage=3, ndev=4, seed=7)
+        ref.load_checkpoint(ck)
+        ref_kernel = np.asarray(
+            jnp.asarray(ref.get_fp32_state_dict()["layer_0"]["kernel"],
+                        jnp.bfloat16))
+
+        initialize_mesh(TopologyConfig(), force=True)   # serving mesh: 8 dev
+        seen_paths = []
+
+        def sharding_for(path, rec):
+            seen_paths.append(path)
+            from deepspeed_tpu.runtime.topology import get_topology
+
+            return get_topology().replicated()
+
+        tag, params, lay = load_params_resharded(
+            ck, sharding_for=sharding_for, dtype=jnp.bfloat16)
+        assert tag == "global_step2"
+        # paths are RELATIVE to the params subtree — what spec trees keyed
+        # by param name (model.partition_specs) expect
+        assert "layer_0/kernel" in seen_paths
+        assert not any(p.startswith("params/") for p in seen_paths)
+        assert params["layer_0"]["kernel"].dtype == jnp.bfloat16
+        np.testing.assert_array_equal(
+            np.asarray(params["layer_0"]["kernel"]), ref_kernel)
+        assert params["layer_0"]["kernel"].sharding.is_fully_replicated
+
+    def test_engine_factory_serves_training_checkpoint(self, tmp_path):
+        """End to end: a training checkpoint of the serving model loads
+        through build_engine_from_ds_checkpoint and answers a prefill."""
+        from deepspeed_tpu.inference.v2.engine_factory import \
+            build_engine_from_ds_checkpoint
+        from deepspeed_tpu.inference.v2.engine_v2 import \
+            RaggedInferenceEngineConfig
+        from deepspeed_tpu.models.transformer import (CausalLM,
+                                                      TransformerConfig)
+
+        initialize_mesh(TopologyConfig(), force=True)
+        model = CausalLM(TransformerConfig.tiny(use_flash=False))
+        params = model.init_params(jax.random.PRNGKey(0))
+        store = OrbaxCheckpointEngine(str(tmp_path), fault_config=FAST_FAULT)
+        store.save({"state": {"params": params,
+                              "global_step": jnp.zeros((), jnp.int32)},
+                    "client_state": {}}, "global_step5")
+        store.commit("global_step5")
+
+        eng = build_engine_from_ds_checkpoint(
+            str(tmp_path), model,
+            engine_config=RaggedInferenceEngineConfig(
+                max_tokens=16, max_seqs=2, max_ctx=32, block_size=8,
+                dtype=jnp.float32, attn_impl="gather", block_q=16))
+        logits = eng.put([0], [[3, 5, 7]])
+        assert np.isfinite(np.asarray(logits)).all()
+        eng.flush([0])
+
+    def test_no_layout_raises_nolayout_for_legacy_dirs(self, tmp_path):
+        import orbax.checkpoint as ocp
+
+        with ocp.PyTreeCheckpointer() as c:
+            c.save(str(tmp_path / "t0" / "state"),
+                   {"params": {"w": jnp.zeros((4,))}}, force=True)
+        (tmp_path / "latest").write_text("t0")
+        with pytest.raises(NoLayoutError):
+            load_params_resharded(str(tmp_path), tag="t0",
+                                  fault_config=FaultConfig(
+                                      verify_checkpoints=False))

@@ -1,0 +1,27 @@
+"""CI gate for the universal-checkpoint reshard smoke check
+(tools/check_ckpt_roundtrip.py): save on a 4-dev mesh, reshard-load on an
+8-dev mesh, bitwise state + bitwise continuation loss, and a torn source
+shard degrading to the older valid tag — same enforcement pattern as
+check_serving_smoke.py, so the elastic-resume path cannot rot silently."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.elastic
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CHECK = os.path.join(REPO_ROOT, "tools", "check_ckpt_roundtrip.py")
+
+
+class TestCkptRoundtripSmoke:
+    def test_roundtrip_check_passes(self):
+        """This IS the CI gate: mesh A → mesh B resume must be bitwise and
+        fault-tolerant on the CPU sim."""
+        proc = subprocess.run([sys.executable, CHECK],
+                              capture_output=True, text=True, timeout=540)
+        assert proc.returncode == 0, \
+            f"checkpoint roundtrip checks failed:\n{proc.stdout}" \
+            f"{proc.stderr[-1500:]}"
