@@ -1,0 +1,17 @@
+"""FastGen-style serving engine v2.
+
+Submodules (imported directly to keep this package import-light):
+
+  * ``engine_v2``   — ragged continuous-batching engine
+    (InferenceEngineV2.put/query/flush, fused decode windows,
+    ContinuousBatcher).
+  * ``lifecycle``   — the serving survivability layer: bounded admission +
+    overload shedding, per-request deadlines / TTFT timeouts, client
+    cancellation, KV-pressure preemption with prefill-recompute resume,
+    decode watchdog (NaN isolation + hang incidents).
+  * ``server``      — the ``dstpu-serve`` HTTP front end (POST
+    /v1/generate with optional SSE streaming, /metrics, /healthz serving
+    states, graceful drain on SIGTERM).
+  * ``model_runner``/``kernels``/``ragged`` — compiled forward, paged
+    attention kernels, and the paged KV-cache substrate.
+"""

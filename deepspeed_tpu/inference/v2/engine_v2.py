@@ -25,6 +25,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from ...models.transformer import CausalLM, TransformerConfig
+from ...runtime.fault.injection import InjectedNaN, inject
 from ...utils.logging import log_dist, logger
 from .model_runner import build_ragged_step
 from .ragged.kv_cache import BlockedKVCache, KVCacheConfig
@@ -100,6 +101,19 @@ class InferenceEngineV2:
             return jnp.asarray(x, c.dtype)
 
         self.params = jax.tree_util.tree_map_with_path(_cast, params)
+        # TP-sharded params need the KV page pool pinned replicated through
+        # the append scatter (GSPMD otherwise rewrites the row-set into a
+        # summed per-replica-group scatter — see paged_kv_append); detect
+        # once from the params' own shardings so plain single-device
+        # serving never pays a constraint.
+        self._kv_replicate = None
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            sh = getattr(leaf, "sharding", None)
+            if (isinstance(sh, jax.sharding.NamedSharding)
+                    and sh.mesh.size > 1 and not sh.is_fully_replicated):
+                self._kv_replicate = jax.sharding.NamedSharding(
+                    sh.mesh, jax.sharding.PartitionSpec())
+                break
         self._num_blocks = num_blocks
         #: per-bucket compiled programs + host-side batch builders; keys are
         #: (token_budget, seq_budget).  ``trace_counts`` is the retrace
@@ -114,6 +128,9 @@ class InferenceEngineV2:
         #: window with NO host repack / H2D upload (see decode_batch_async)
         self._decode_state: Optional[Dict] = None
         self.decode_resume_hits = 0
+        #: monotonically increasing fused-window index — the ``step``
+        #: passed to the ``decode_window`` fault-injection site
+        self.decode_windows_dispatched = 0
         self._rng = jax.random.PRNGKey(0)
         self._param_bytes = sum(
             x.size * jnp.dtype(x.dtype).itemsize
@@ -178,7 +195,7 @@ class InferenceEngineV2:
                 attn_impl=c.attn_impl, max_seqs=key[1],
                 max_blocks=self._wrapper_for(key).max_blocks,
                 block_q=c.block_q, pages_per_chunk=c.pages_per_chunk,
-                jit=False)
+                jit=False, kv_replicate=self._kv_replicate)
             self._steps[key] = jax.jit(self._counted(key, fn),
                                        donate_argnums=(1,))
         return self._steps[key]
@@ -243,6 +260,24 @@ class InferenceEngineV2:
         self._decode_state = None
         for uid in uids:
             self.state_manager.flush_sequence(uid)
+
+    def kv_used_fraction(self) -> float:
+        """Fraction of the KV block pool currently allocated — the
+        scheduler's KV-pressure signal (preemption fires above its high
+        watermark)."""
+        total = self.state_manager.allocator.total_blocks
+        return 1.0 - self.state_manager.free_blocks / total
+
+    def lifetime_reservation(self, prompt_len: int,
+                             max_new: int) -> Tuple[int, int]:
+        """Whole-lifetime KV reservation for a request: (tokens, blocks).
+        Capped at max_ctx — with an eos an early stop can keep
+        prompt+max_new under the cap, so the cap, not the sum, is the
+        reservation bound.  THE one definition of the admission formula;
+        both ContinuousBatcher and LifecycleScheduler reserve through it
+        so their admission behavior cannot desynchronize."""
+        need = min(prompt_len + max_new, self.config.max_ctx)
+        return need, -(-need // self.config.block_size)
 
     # ------------------------------------------------------------------ #
     # Fused multi-step decode (device-resident loop; the CUDA-graph-decode
@@ -359,15 +394,41 @@ class InferenceEngineV2:
                 block_size=c.block_size, num_blocks=self._num_blocks,
                 attn_impl=c.attn_impl, steps=steps, temperature=temperature,
                 block_q=c.block_q, pages_per_chunk=c.pages_per_chunk,
-                top_k=top_k, jit=False)
+                top_k=top_k, jit=False, kv_replicate=self._kv_replicate)
             self._decode_loops[key] = jax.jit(
                 self._counted(("decode",) + key, loop), donate_argnums=(1,))
         if rng is None:
             # persistent engine key: re-seeding each window with a constant
             # would repeat the identical sample stream every call
             self._rng, rng = jax.random.split(self._rng)
+        # fault-injection site (DSTPU_FAULT_INJECT site=decode_window):
+        # `slow` sleeps here (hung window), `kill` dies here (worker loss
+        # mid-decode), `nan` poisons the FIRST scheduled sequence's cached
+        # context so the compiled loop genuinely produces non-finite logits
+        # for that row — exercising the same isolation path a hardware
+        # NaN would.  t0 starts BEFORE the site so an injected stall lands
+        # inside the window's wall time, where the decode watchdog looks.
         t0 = time.perf_counter()
-        toks, new_pages, meta_out = self._decode_loops[key](
+        self.decode_windows_dispatched += 1
+        poisoned = False
+        try:
+            inject("decode_window", step=self.decode_windows_dispatched)
+        except InjectedNaN:
+            poisoned = True
+            if resume:
+                # the resume path skipped the repack; recover the true next
+                # tokens from the advanced device meta and rebuild host-side
+                # so the window still dispatches against valid metadata
+                seed_tokens = [int(t) for t in np.asarray(meta_dev[:n])]
+                wrapper = self._wrapper_for(bucket)
+                wrapper.clear()
+                for uid, tok in zip(uids, seed_tokens):
+                    wrapper.insert_sequence(
+                        self.state_manager.get_sequence(uid), [int(tok)])
+                meta_dev = jnp.asarray(wrapper.finalize().pack())
+                resume = False
+            self._poison_kv(uids[0])
+        toks, new_pages, meta_out, nonfinite = self._decode_loops[key](
             self.params, self.kv.pages, meta_dev, rng)
         self.kv.update(new_pages)
         seen = {}
@@ -376,13 +437,33 @@ class InferenceEngineV2:
             seq.in_flight_tokens = steps
             seq.post_forward()
             seen[uid] = seq.seen_tokens
-        self._decode_state = {"uids": uids_t, "bucket": bucket,
-                              "meta": meta_out, "seen": seen}
+        # a NaN-poisoned window must NOT leave resumable device state: the
+        # advanced meta was computed over poisoned pages, and a follow-up
+        # window resuming it would silently keep decoding garbage even if
+        # the caller never drains/flushes the victim
+        self._decode_state = None if poisoned else {
+            "uids": uids_t, "bucket": bucket, "meta": meta_out,
+            "seen": seen}
         mean_ctx = float(np.mean(ctx_before)) + steps / 2.0 if n else 0.0
         window = DecodeWindow(self, toks, n, steps, mean_ctx, t0,
-                              resumed=resume, compiled=first_compile)
+                              resumed=resume, compiled=first_compile,
+                              uids=list(uids), nonfinite_dev=nonfinite)
         window._state = self._decode_state
         return window
+
+    def _poison_kv(self, uid: int) -> None:
+        """Write NaN over every cached page of ``uid`` across all layers
+        (the ``decode_window``/``nan`` injection payload).  Rows past the
+        sequence's context length are masked out by attention, and no other
+        sequence references these pages, so the poison is confined to
+        ``uid`` — the kernel-level NaN-isolation property the serving
+        watchdog's per-sequence flag builds on."""
+        seq = self.state_manager.get_sequence(uid)
+        if seq is None or not seq.blocks:
+            return
+        phys = [b + layer * self._num_blocks
+                for layer in range(self.cfg.num_layers) for b in seq.blocks]
+        self.kv.update(self.kv.pages.at[jnp.asarray(phys)].set(jnp.nan))
 
     def _record_decode_roofline(self, window: "DecodeWindow") -> None:
         """Feed a drained decode window into the analytic HBM roofline
@@ -518,7 +599,8 @@ class DecodeWindow:
 
     def __init__(self, engine: "InferenceEngineV2", toks_dev, n_seqs: int,
                  steps: int, mean_ctx: float, t0: float,
-                 resumed: bool = False, compiled: bool = False):
+                 resumed: bool = False, compiled: bool = False,
+                 uids: Optional[List[int]] = None, nonfinite_dev=None):
         self.engine = engine
         self.n_seqs = n_seqs
         self.steps = steps
@@ -527,9 +609,15 @@ class DecodeWindow:
         #: True when this window traced+compiled its decode loop — its wall
         #: time measures XLA compilation, not decode throughput
         self.compiled = compiled
+        #: uids in seq-row order, so nonfinite flags map back to requests
+        self.uids = list(uids) if uids is not None else []
         self._toks_dev = toks_dev
+        self._nonfinite_dev = nonfinite_dev
         self._t0 = t0
         self._toks: Optional[np.ndarray] = None
+        #: per-sequence poison flags [n_seqs], populated at drain: True
+        #: when that sequence's logits went non-finite during the window
+        self.nonfinite: Optional[np.ndarray] = None
         self.duration_s: Optional[float] = None
         self._state: Optional[dict] = None
 
@@ -537,8 +625,14 @@ class DecodeWindow:
         """Block for the generated tokens [steps, n_seqs]."""
         if self._toks is None:
             self._toks = np.asarray(self._toks_dev[:, :self.n_seqs])
+            if self._nonfinite_dev is not None:
+                self.nonfinite = np.asarray(
+                    self._nonfinite_dev[:self.n_seqs])
+            else:
+                self.nonfinite = np.zeros(self.n_seqs, bool)
             self.duration_s = time.perf_counter() - self._t0
             self._toks_dev = None
+            self._nonfinite_dev = None
             if self._state is not None and \
                     self.engine._decode_state is self._state:
                 # the last sampled token is the next window's seed: once it
@@ -547,6 +641,12 @@ class DecodeWindow:
                     int(t) for t in self._toks[-1])
             self.engine._record_decode_roofline(self)
         return self._toks
+
+    def nonfinite_uids(self) -> List[int]:
+        """uids whose logits went non-finite during this window (drains
+        the window if needed)."""
+        self.tokens()
+        return [u for u, bad in zip(self.uids, self.nonfinite) if bad]
 
 
 class ContinuousBatcher:
@@ -648,13 +748,11 @@ class ContinuousBatcher:
         while (self._waiting and budget > 0 and len(picked) < c.max_seqs):
             uid = self._waiting[0]
             self.touched += 1
-            # reserve up to the context cap: with eos an early stop makes
-            # prompt+max_new > max_ctx servable, so the cap — not the sum —
-            # is the reservation bound (a capless overrun still raises at
-            # the put/decode boundary, matching put()'s own contract)
-            need = min(len(self._prompts[uid]) + self.max_new_tokens,
-                       c.max_ctx)
-            need_blocks = -(-need // c.block_size)
+            # whole-lifetime reservation (engine.lifetime_reservation);
+            # a capless eos-less overrun still raises at the put/decode
+            # boundary, matching put()'s own contract
+            need, need_blocks = self.eng.lifetime_reservation(
+                len(self._prompts[uid]), self.max_new_tokens)
             if (len(self._prompts[uid]) > c.max_ctx
                     or need_blocks > self.eng.kv.config.num_blocks):
                 # impossible under any load: reject, don't stall the queue

@@ -636,7 +636,7 @@ def decode_attention(q: jnp.ndarray, kv_pages: jnp.ndarray,
 # ===================================================================== #
 def paged_kv_append(kv_pages: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                     page_of_token: jnp.ndarray,
-                    off_of_token: jnp.ndarray) -> jnp.ndarray:
+                    off_of_token: jnp.ndarray, replicate=None) -> jnp.ndarray:
     """Scatter new K/V rows into their cache pages.
 
     kv_pages: [num_pages_total, page_size, 2*KV, hd]; k/v: [T, KV, hd];
@@ -645,6 +645,20 @@ def paged_kv_append(kv_pages: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     in-place dynamic-update on TPU — the idiomatic equivalent of the
     reference's pointer-chasing CUDA append.  Writing the combined
     [T, 2KV, hd] rows costs O(T) HBM regardless of cache size.
+
+    ``replicate`` (a replicated ``NamedSharding``) pins the scatter's
+    operands and result when the surrounding program carries TP-sharded
+    params: without the constraint GSPMD rewrites this row-set into a
+    scatter applied per replica group and SUMS the groups' contributions,
+    multiplying every cached K/V row by the group count (observed 4x on a
+    dp4×tp2 mesh — serving under a TP mesh produced garbage logits).  Pass
+    it whenever any model param is non-trivially sharded.
     """
     comb = jnp.concatenate([k, v], axis=1).astype(kv_pages.dtype)
-    return kv_pages.at[page_of_token, off_of_token].set(comb)
+    if replicate is not None:
+        comb = jax.lax.with_sharding_constraint(comb, replicate)
+        kv_pages = jax.lax.with_sharding_constraint(kv_pages, replicate)
+    out = kv_pages.at[page_of_token, off_of_token].set(comb)
+    if replicate is not None:
+        out = jax.lax.with_sharding_constraint(out, replicate)
+    return out

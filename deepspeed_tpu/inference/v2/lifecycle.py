@@ -1,0 +1,735 @@
+"""Serving request lifecycle: admission, deadlines, cancellation,
+KV-pressure preemption, and the decode watchdog.
+
+The :class:`ContinuousBatcher` (engine_v2.py) drives a CLOSED set of
+requests to completion; a server faces an OPEN stream where requests die
+mid-flight: clients disconnect, deadlines pass, the KV pool saturates, a
+decode window hangs or goes NaN.  :class:`LifecycleScheduler` owns that
+survivability layer on top of the engine primitives:
+
+  * **Bounded admission + overload shedding** — ``submit`` rejects when the
+    waiting queue is full (or the server is draining) and computes a
+    ``Retry-After`` from the decode roofline's predicted drain rate, so an
+    overloaded server answers in O(1) instead of queueing unboundedly
+    (``serving/shed``).
+  * **Deadlines and TTFT timeouts** — checked every scheduler iteration,
+    which is at most one bounded decode window (``window_steps`` tokens)
+    long: an expired request is flushed and its KV blocks reclaimed at the
+    next window boundary — mid-stream, never "after it finishes"
+    (``serving/deadline_expired``, ``serving/ttft_timeout``).
+  * **Cancellation** — ``cancel(uid)`` (client disconnect) flushes the
+    sequence and returns its blocks to the pool; the freed blocks are
+    immediately re-admittable (``serving/cancelled``).
+  * **KV-pressure preemption** — when the pool is above the high watermark
+    and the queue head cannot reserve blocks, the lowest-priority decoding
+    request is preempted: its generated tokens are spilled host-side (they
+    already live there), its blocks are flushed, and it re-queues for
+    **prefill recompute** — the resume prompt is ``prompt + produced[:-1]``
+    and the next decode seed is ``produced[-1]``, which rebuilds exactly
+    the KV state the interrupted stream had, so greedy decode continues
+    bit-identically (``serving/preempted``; test-asserted under both attn
+    impls).
+  * **Decode watchdog** — every drained window reports per-sequence
+    non-finite flags (model_runner.build_decode_loop): poisoned requests
+    are flushed ALONE (kernel-level NaN isolation extended to the
+    scheduler, ``serving/nan_isolated``) and a window whose wall time blows
+    the hang deadline raises a ``serving_window_hang`` incident — both
+    reported through the PR-5 anomaly/event path and reflected in
+    ``/healthz`` as ``degraded``.
+
+Whole-lifetime block reservation at admission (as in ContinuousBatcher)
+means a live request can never hit out-of-blocks mid-flight; the only
+allocation point is admission, which is exactly where the ``kv_alloc``
+fault-injection site fires.
+
+Thread safety: ``submit``/``cancel`` are called from HTTP handler threads,
+``step``/``drain`` from the driver thread; all state is guarded by one
+reentrant lock.  Request callbacks (``on_event``) run inline under that
+lock and must only hand off (enqueue) — the HTTP server's callbacks do.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import threading
+import time
+from enum import Enum
+from typing import Callable, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ...utils.logging import logger
+from .engine_v2 import InferenceEngineV2
+
+
+class RequestState(Enum):
+    QUEUED = "queued"          # admitted to the waiting queue
+    PREFILL = "prefill"        # holds KV blocks, prompt chunks in flight
+    DECODE = "decode"          # generating
+    FINISHED = "finished"      # terminal: completed normally
+    CANCELLED = "cancelled"    # terminal: client cancelled / disconnected
+    EXPIRED = "expired"        # terminal: deadline / TTFT timeout / drain
+    SHED = "shed"              # terminal: rejected at admission (overload)
+    FAILED = "failed"          # terminal: poisoned window, engine error
+
+TERMINAL_STATES = (RequestState.FINISHED, RequestState.CANCELLED,
+                   RequestState.EXPIRED, RequestState.SHED,
+                   RequestState.FAILED)
+
+
+@dataclasses.dataclass
+class ServeRequest:
+    """One request's full lifecycle record.
+
+    ``deadline_s`` / ``ttft_timeout_s`` are RELATIVE seconds at submit time
+    and converted to absolute monotonic deadlines on admission.  ``priority``
+    is higher-wins (preemption victims are picked lowest-priority first).
+    ``on_event(event, request)`` fires on: ``tokens`` (new tokens appended —
+    the streaming hook), ``finished``, ``cancelled``, ``expired``,
+    ``preempted``, ``failed``.
+    """
+
+    uid: int
+    prompt: List[int]
+    max_new_tokens: int = 32
+    priority: int = 0
+    deadline_s: Optional[float] = None
+    ttft_timeout_s: Optional[float] = None
+    on_event: Optional[Callable[[str, "ServeRequest"], None]] = None
+
+    # -- runtime state (scheduler-owned) --
+    state: RequestState = RequestState.QUEUED
+    produced: List[int] = dataclasses.field(default_factory=list)
+    finish_reason: Optional[str] = None
+    arrival_t: float = 0.0
+    first_token_t: Optional[float] = None
+    finished_t: Optional[float] = None
+    preempt_count: int = 0
+    deadline_t: Optional[float] = None       # absolute, from deadline_s
+    ttft_deadline_t: Optional[float] = None  # absolute, from ttft_timeout_s
+    _admit_order: int = 0
+    _prefill_pos: int = 0
+    _resume_seed: Optional[int] = None       # set while resuming a preempt
+
+    @property
+    def remaining(self) -> int:
+        return self.max_new_tokens - len(self.produced)
+
+    @property
+    def resume_prompt(self) -> List[int]:
+        """Tokens to (re)prefill: the original prompt, plus — after a
+        preemption — every produced token except the last, which becomes
+        the decode seed instead (rebuilding the exact pre-preemption KV
+        state)."""
+        if self._resume_seed is None:
+            return self.prompt
+        return self.prompt + self.produced[:-1]
+
+    def ttft_s(self) -> Optional[float]:
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.arrival_t
+
+    def tpot_s(self) -> Optional[float]:
+        """Time per output token over the decode phase."""
+        if self.first_token_t is None or self.finished_t is None \
+                or len(self.produced) < 2:
+            return None
+        return (self.finished_t - self.first_token_t) / \
+            (len(self.produced) - 1)
+
+    def _fire(self, event: str) -> None:
+        if self.on_event is not None:
+            try:
+                self.on_event(event, self)
+            except Exception as e:  # noqa: BLE001 — a sink bug must not kill scheduling
+                logger.warning(f"request {self.uid} on_event({event}) "
+                               f"failed: {e!r}")
+
+
+@dataclasses.dataclass
+class AdmissionVerdict:
+    admitted: bool
+    reason: Optional[str] = None       # "queue_full" | "draining"
+    retry_after_s: Optional[float] = None
+
+
+class LifecycleScheduler:
+    """Open-world serving scheduler over :class:`InferenceEngineV2`.
+
+    One ``step()`` runs either a mixed prefill/admission forward (``put``)
+    or one bounded fused decode window, after processing cancellations and
+    deadline expiries — so no request ever waits more than one window for
+    its lifecycle events to take effect.
+    """
+
+    def __init__(self, engine: InferenceEngineV2, max_queue: int = 64,
+                 window_steps: int = 8, kv_high_watermark: float = 0.9,
+                 preempt: bool = True, hang_deadline_s: float = 30.0,
+                 eos_token_id: Optional[int] = None,
+                 fallback_tok_per_s: float = 32.0,
+                 degraded_window_s: float = 60.0,
+                 clock: Callable[[], float] = time.monotonic):
+        self.eng = engine
+        self.max_queue = int(max_queue)
+        self.window_steps = int(window_steps)
+        self.kv_high_watermark = float(kv_high_watermark)
+        self.preempt_enabled = bool(preempt)
+        self.hang_deadline_s = float(hang_deadline_s)
+        self.eos_token_id = eos_token_id
+        self.fallback_tok_per_s = float(fallback_tok_per_s)
+        self.degraded_window_s = float(degraded_window_s)
+        self.clock = clock
+
+        self._lock = threading.RLock()
+        self._reqs: Dict[int, ServeRequest] = {}
+        self._waiting: "collections.deque[int]" = collections.deque()
+        self._prefilling: "collections.OrderedDict[int, None]" = \
+            collections.OrderedDict()
+        self._decodes: "collections.OrderedDict[int, int]" = \
+            collections.OrderedDict()          # uid -> next seed token
+        self._cancel_requested: set = set()
+        self._admit_seq = 0
+        self.draining = False
+        self.counters: "collections.Counter[str]" = collections.Counter()
+        self.last_incident_t: Optional[float] = None
+        self.last_incident_kind: Optional[str] = None
+        self.last_shed_t: Optional[float] = None
+
+    # ------------------------------------------------------------------ #
+    # Ingress (HTTP handler threads)
+    # ------------------------------------------------------------------ #
+    def submit(self, req: ServeRequest) -> AdmissionVerdict:
+        """Admit to the bounded queue, or shed with a Retry-After."""
+        with self._lock:
+            now = self.clock()
+            req.arrival_t = now
+            if req.deadline_s is not None:
+                req.deadline_t = now + req.deadline_s
+            if req.ttft_timeout_s is not None:
+                req.ttft_deadline_t = now + req.ttft_timeout_s
+            if req.uid in self._reqs:
+                raise ValueError(f"uid {req.uid} already submitted")
+            if not req.prompt:
+                # nothing to condition on: trivially complete
+                req.state = RequestState.FINISHED
+                req.finish_reason = "empty_prompt"
+                req.finished_t = now
+                self._reqs[req.uid] = req
+                req._fire("finished")
+                return AdmissionVerdict(True)
+            if self.draining:
+                req.state = RequestState.SHED
+                req.finish_reason = "draining"
+                self._count("serving/shed")
+                self._event("serving_shed", uid=req.uid, reason="draining")
+                return AdmissionVerdict(False, "draining",
+                                        self.predicted_drain_s())
+            if len(self._waiting) >= self.max_queue:
+                req.state = RequestState.SHED
+                req.finish_reason = "queue_full"
+                self.last_shed_t = now
+                self._count("serving/shed")
+                self._event("serving_shed", uid=req.uid, reason="queue_full",
+                            queue_depth=len(self._waiting))
+                return AdmissionVerdict(False, "queue_full",
+                                        self.retry_after_s())
+            self._reqs[req.uid] = req
+            self._waiting.append(req.uid)
+            self._count("serving/requests")
+            self._publish_gauges()
+            return AdmissionVerdict(True)
+
+    def cancel(self, uid: int) -> bool:
+        """Request cancellation (client disconnect); takes effect at the
+        next scheduler iteration — at most one decode window away."""
+        with self._lock:
+            if uid not in self._reqs or \
+                    self._reqs[uid].state in TERMINAL_STATES:
+                return False
+            self._cancel_requested.add(uid)
+            return True
+
+    def request(self, uid: int) -> Optional[ServeRequest]:
+        with self._lock:
+            return self._reqs.get(uid)
+
+    @property
+    def pending(self) -> int:
+        """Live (non-terminal) request count."""
+        with self._lock:
+            return (len(self._waiting) + len(self._prefilling)
+                    + len(self._decodes))
+
+    # ------------------------------------------------------------------ #
+    # Load prediction (Retry-After / drain estimates)
+    # ------------------------------------------------------------------ #
+    def predicted_tok_per_s(self) -> float:
+        """Decode drain rate from the last clean decode-window roofline;
+        the configured fallback before any window has been measured."""
+        r = self.eng.last_decode_roofline
+        if r and not r.get("compile_polluted") and \
+                r.get("decode_tok_per_s", 0) > 0:
+            return float(r["decode_tok_per_s"])
+        return self.fallback_tok_per_s
+
+    def outstanding_tokens(self) -> int:
+        with self._lock:
+            return sum(self._reqs[u].remaining
+                       for bucket in (self._waiting, self._prefilling,
+                                      self._decodes)
+                       for u in bucket)
+
+    def retry_after_s(self) -> float:
+        """Seconds until one queue slot is predicted to free: the whole
+        backlog's remaining tokens over the predicted drain rate, scaled to
+        one slot."""
+        backlog = self.outstanding_tokens()
+        slots = max(len(self._waiting) + len(self._prefilling)
+                    + len(self._decodes), 1)
+        per_slot = backlog / slots / self.predicted_tok_per_s()
+        return float(min(max(per_slot, 1.0), 120.0))
+
+    def predicted_drain_s(self) -> float:
+        """Predicted seconds to drain every live request (the Retry-After
+        while draining, and the basis for drain-deadline sizing)."""
+        return float(min(max(
+            self.outstanding_tokens() / self.predicted_tok_per_s(),
+            1.0), 600.0))
+
+    # ------------------------------------------------------------------ #
+    # Lifecycle passes
+    # ------------------------------------------------------------------ #
+    def _retire(self, req: ServeRequest, state: RequestState, reason: str,
+                event: str, counter: Optional[str] = None) -> None:
+        """Move a request to a terminal state, reclaiming its KV blocks."""
+        uid = req.uid
+        holds_blocks = uid in self._prefilling or uid in self._decodes
+        self._waiting = collections.deque(
+            u for u in self._waiting if u != uid)
+        self._prefilling.pop(uid, None)
+        self._decodes.pop(uid, None)
+        if holds_blocks:
+            self.eng.flush([uid])
+        req.state = state
+        req.finish_reason = reason
+        req.finished_t = self.clock()
+        if counter:
+            self._count(counter)
+        self._event(event, uid=uid, reason=reason,
+                    produced=len(req.produced))
+        req._fire(event.replace("serving_", ""))
+        self._publish_gauges()
+
+    def _process_cancellations(self) -> List[int]:
+        done = []
+        for uid in sorted(self._cancel_requested):
+            req = self._reqs.get(uid)
+            if req is not None and req.state not in TERMINAL_STATES:
+                self._retire(req, RequestState.CANCELLED, "cancelled",
+                             "serving_cancelled", "serving/cancelled")
+                done.append(uid)
+        self._cancel_requested.clear()
+        return done
+
+    def _process_expiries(self) -> List[int]:
+        now = self.clock()
+        done = []
+        for req in list(self._reqs.values()):
+            if req.state in TERMINAL_STATES:
+                continue
+            if req.deadline_t is not None and now >= req.deadline_t:
+                self._retire(req, RequestState.EXPIRED, "deadline",
+                             "serving_expired", "serving/deadline_expired")
+                done.append(req.uid)
+            elif (req.ttft_deadline_t is not None
+                    and req.first_token_t is None
+                    and now >= req.ttft_deadline_t):
+                self._retire(req, RequestState.EXPIRED, "ttft_timeout",
+                             "serving_expired", "serving/ttft_timeout")
+                done.append(req.uid)
+        return done
+
+    # ------------------------------------------------------------------ #
+    # KV-pressure preemption
+    # ------------------------------------------------------------------ #
+    def _maybe_preempt_for(self, head: ServeRequest) -> bool:
+        """Preempt the lowest-priority decoding request so ``head`` can be
+        admitted — only above the KV high watermark, and never a victim
+        with strictly higher priority than the starved head."""
+        if not self.preempt_enabled or not self._decodes:
+            return False
+        if self.eng.kv_used_fraction() < self.kv_high_watermark:
+            return False
+        victims = [self._reqs[u] for u in self._decodes]
+        # anti-ping-pong: among equal priorities, a head that has itself
+        # been preempted N times may only evict victims preempted >= N
+        # times — two requests can then never evict each other in a cycle
+        # (observed livelock: a 3-block and an 8-block request alternately
+        # preempting each other forever on a 10-block pool)
+        victims = [v for v in victims
+                   if v.priority < head.priority
+                   or (v.priority == head.priority
+                       and v.preempt_count >= head.preempt_count)]
+        if not victims:
+            return False
+        # lowest priority first; among equals the latest-admitted loses
+        # (least work thrown away for FIFO arrival orders)
+        victim = min(victims, key=lambda r: (r.priority, -r._admit_order))
+        uid = victim.uid
+        del self._decodes[uid]
+        self.eng.flush([uid])                 # spill: produced stays host-side
+        victim.state = RequestState.QUEUED
+        victim.preempt_count += 1
+        victim._resume_seed = victim.produced[-1]
+        victim._prefill_pos = 0
+        self._waiting.append(uid)             # re-admitted behind the head
+        self._count("serving/preempted")
+        self._event("serving_preempted", uid=uid, for_uid=head.uid,
+                    produced=len(victim.produced),
+                    kv_used=round(self.eng.kv_used_fraction(), 4))
+        victim._fire("preempted")
+        logger.info(f"KV pressure: preempted uid {uid} "
+                    f"({len(victim.produced)} tokens spilled) to admit "
+                    f"uid {head.uid}")
+        return True
+
+    # ------------------------------------------------------------------ #
+    # Scheduling
+    # ------------------------------------------------------------------ #
+    def _reserve_for(self, req: ServeRequest) -> Optional[bool]:
+        """Whole-lifetime KV reservation for admission.  Returns True on
+        success, False on transient exhaustion (backpressure), None when
+        the request can never fit (rejected)."""
+        c = self.eng.config
+        need, need_blocks = self.eng.lifetime_reservation(
+            len(req.resume_prompt), req.remaining)
+        if (len(req.resume_prompt) > c.max_ctx
+                or (self.eos_token_id is None
+                    and len(req.resume_prompt) + req.remaining > c.max_ctx)
+                or need_blocks > self.eng.kv.config.num_blocks):
+            # impossible under ANY load (an eos can cut a long generation
+            # short, so only the eos-less overrun is deterministic): reject
+            # now instead of wedging the queue head
+            return None
+        seq = self.eng.state_manager.get_or_create_sequence(req.uid)
+        if not self.eng.state_manager.maybe_allocate_kv(seq, need):
+            # roll back the empty descriptor so a shed/preempted retry
+            # starts clean (an allocated-blocks descriptor must NOT be
+            # flushed here — there are none)
+            if not seq.blocks and seq.seen_tokens == 0:
+                self.eng.state_manager._seqs.pop(req.uid, None)
+            return False
+        return True
+
+    def _build_prefill_batch(self) -> List[Tuple[int, List[int]]]:
+        """Chunks for one ``put``: in-flight prefills first, then admit
+        from the queue head (with preemption when starved under
+        pressure)."""
+        c = self.eng.config
+        budget = c.max_tokens
+        picked: List[Tuple[int, List[int]]] = []
+        for uid in list(self._prefilling):
+            if budget <= 0 or len(picked) >= c.max_seqs:
+                break
+            req = self._reqs[uid]
+            chunk = req.resume_prompt[req._prefill_pos:
+                                      req._prefill_pos + budget]
+            picked.append((uid, chunk))
+            budget -= len(chunk)
+        preempted_this_pass = False
+        while self._waiting and budget > 0 and len(picked) < c.max_seqs:
+            head = self._reqs[self._waiting[0]]
+            verdict = self._reserve_for(head)
+            if verdict is None:
+                self._waiting.popleft()
+                self._retire(head, RequestState.FAILED, "impossible",
+                             "serving_rejected", "serving/rejected")
+                continue
+            if verdict is False:
+                # backpressure: try one preemption, then re-check; a
+                # second failure this pass means the pool genuinely cannot
+                # host the head yet — it keeps its place in the queue
+                if not preempted_this_pass and self._maybe_preempt_for(head):
+                    preempted_this_pass = True
+                    continue
+                break
+            self._waiting.popleft()
+            head.state = RequestState.PREFILL
+            self._prefilling[head.uid] = None
+            self._admit_seq += 1
+            head._admit_order = self._admit_seq
+            chunk = head.resume_prompt[:budget]
+            picked.append((head.uid, chunk))
+            budget -= len(chunk)
+        return picked
+
+    def _run_prefill(self, batch: List[Tuple[int, List[int]]]) -> List[int]:
+        logits = self.eng.put([u for u, _ in batch], [t for _, t in batch])
+        finished: List[int] = []
+        now = self.clock()
+        for row, (uid, chunk) in enumerate(batch):
+            req = self._reqs[uid]
+            req._prefill_pos += len(chunk)
+            if req._prefill_pos < len(req.resume_prompt):
+                continue                       # mid-prompt; logits unused
+            del self._prefilling[uid]
+            req.state = RequestState.DECODE
+            if req._resume_seed is not None:
+                # preemption resume: KV is rebuilt, the next decode seed is
+                # the spilled stream's last token — NOT a fresh argmax
+                # (which would re-derive the token it already produced)
+                seed = int(req._resume_seed)
+                req._resume_seed = None
+            else:
+                seed = int(np.argmax(np.asarray(logits[row])))
+                req.produced.append(seed)
+                req.first_token_t = now
+                self._observe("serving/ttft_s", req.ttft_s())
+                req._fire("tokens")
+                if self._finished_by(req, seed):
+                    self._finish(req)
+                    finished.append(uid)
+                    continue
+            self._decodes[uid] = seed
+        self._publish_gauges()
+        return finished
+
+    def _finished_by(self, req: ServeRequest, tok: int) -> bool:
+        return ((self.eos_token_id is not None and tok == self.eos_token_id)
+                or req.remaining <= 0)
+
+    def _finish(self, req: ServeRequest) -> None:
+        self._decodes.pop(req.uid, None)
+        self.eng.flush([req.uid])
+        req.state = RequestState.FINISHED
+        req.finish_reason = "eos" if (
+            self.eos_token_id is not None and req.produced
+            and req.produced[-1] == self.eos_token_id) else "length"
+        req.finished_t = self.clock()
+        self._count("serving/completed")
+        self._observe("serving/tpot_s", req.tpot_s())
+        self._event("serving_finished", uid=req.uid,
+                    produced=len(req.produced), reason=req.finish_reason)
+        req._fire("finished")
+        self._publish_gauges()
+
+    def _run_decode_window(self) -> List[int]:
+        """One bounded fused decode window over up to max_seqs decoding
+        requests (round-robin rotated), with watchdog + NaN isolation at
+        drain."""
+        c = self.eng.config
+        n = min(len(self._decodes), c.max_seqs, c.max_tokens)
+        uids = []
+        for _ in range(n):
+            uid, seed = self._decodes.popitem(last=False)
+            uids.append(uid)
+            self._decodes[uid] = seed          # rotate to the back
+        # context-cap guard (eos-expected requests reserve less than
+        # prompt+max_new): a sequence with no KV room left cannot decode —
+        # retire it instead of wedging the window
+        room = {}
+        for uid in list(uids):
+            seq = self.eng.state_manager.get_sequence(uid)
+            room[uid] = c.max_ctx - seq.seen_tokens
+            if room[uid] <= 0:
+                uids.remove(uid)
+                self._retire(self._reqs[uid], RequestState.FAILED,
+                             "ctx_overflow", "serving_rejected",
+                             "serving/rejected")
+        if not uids:
+            return []
+        steps = min(self.window_steps,
+                    min(self._reqs[u].remaining for u in uids),
+                    min(room[u] for u in uids))
+        if steps > 2:       # pow2 quantize: one compiled loop per window size
+            steps = 1 << (steps.bit_length() - 1)
+        seeds = [self._decodes[u] for u in uids]
+        window = self.eng.decode_batch_async(uids, seeds, steps)
+        toks = window.tokens()
+        finished: List[int] = []
+
+        if not window.compiled and window.duration_s is not None \
+                and window.duration_s > self.hang_deadline_s:
+            # post-hoc hang detection: the window drained, but took longer
+            # than the deadline — a stuck DMA / pathological host stall.
+            self.last_incident_t = self.clock()
+            self.last_incident_kind = "window_hang"
+            self._count("serving/window_hang")
+            self._event("serving_window_hang", uids=list(uids),
+                        duration_s=round(window.duration_s, 3),
+                        deadline_s=self.hang_deadline_s)
+
+        poisoned = set(window.nonfinite_uids())
+        if poisoned:
+            self.last_incident_t = self.clock()
+            self.last_incident_kind = "nan"
+        for col, uid in enumerate(uids):
+            req = self._reqs[uid]
+            if uid in poisoned:
+                # flush ONLY the poisoned request; batchmates are clean by
+                # the kernel-level isolation property and keep decoding
+                self._count("serving/nan_isolated")
+                self._retire(req, RequestState.FAILED, "nan",
+                             "serving_nan_isolated")
+                finished.append(uid)
+                continue
+            stream = [int(t) for t in toks[:, col]]
+            if self.eos_token_id is not None and \
+                    self.eos_token_id in stream:
+                stream = stream[:stream.index(self.eos_token_id) + 1]
+            req.produced.extend(stream)
+            req._fire("tokens")
+            if self._finished_by(req, req.produced[-1]):
+                self._finish(req)
+                finished.append(uid)
+            else:
+                self._decodes[uid] = req.produced[-1]
+        self._publish_gauges()
+        return finished
+
+    def step(self) -> List[int]:
+        """One scheduler iteration; returns uids that reached a terminal
+        state.  Lifecycle passes (cancel, expiry) run FIRST, so no request
+        outlives its deadline by more than one bounded window."""
+        with self._lock:
+            done = self._process_cancellations()
+            done += self._process_expiries()
+            # prefill/admission first — finishing prefills frees the decode
+            # path to run fused windows over the full live set.  A BLOCKED
+            # queue head (reservation failed, no eligible preemption
+            # victim) yields an empty batch: fall through to the decode
+            # window so the live set keeps draining toward the capacity
+            # the head is waiting for.
+            batch = self._build_prefill_batch() \
+                if (self._prefilling or self._waiting) else []
+            if batch:
+                done += self._run_prefill(batch)
+            elif self._decodes:
+                done += self._run_decode_window()
+            return done
+
+    def run_until_idle(self, max_iters: int = 10_000) -> None:
+        """Drive until no live work remains (tests / batch mode)."""
+        idle_guard = 0
+        for _ in range(max_iters):
+            if not self.pending:
+                return
+            before = self._progress_mark()
+            self.step()
+            idle_guard = idle_guard + 1 \
+                if self._progress_mark() == before else 0
+            if idle_guard > 3:
+                raise RuntimeError(
+                    f"scheduler made no progress ({self.pending} pending)")
+        raise RuntimeError(f"not idle after {max_iters} iterations")
+
+    def _progress_mark(self) -> Tuple[int, int]:
+        return (sum(len(r.produced) for r in self._reqs.values())
+                + sum(r._prefill_pos for r in self._reqs.values()),
+                self.pending)
+
+    # ------------------------------------------------------------------ #
+    # Drain (SIGTERM path)
+    # ------------------------------------------------------------------ #
+    def start_drain(self) -> None:
+        with self._lock:
+            if not self.draining:
+                self.draining = True
+                self._event("serving_drain_start",
+                            pending=self.pending,
+                            predicted_s=self.predicted_drain_s())
+
+    def drain(self, deadline_s: float = 30.0) -> Dict[str, int]:
+        """Stop admitting, finish in-flight work bounded by the deadline;
+        whatever is still live at the deadline is expired and flushed.
+        Returns {completed, expired} counts for this drain."""
+        self.start_drain()
+        t_end = self.clock() + deadline_s
+        completed = 0
+        while self.pending and self.clock() < t_end:
+            try:
+                finished = self.step()
+            except Exception as e:  # noqa: BLE001 — a raising step must not
+                # wedge the drain: whatever is still live gets expired and
+                # flushed by the mop-up below, and the server still exits
+                logger.error(f"drain step failed: {e!r}")
+                break
+            for uid in finished:
+                if self._reqs[uid].state == RequestState.FINISHED:
+                    completed += 1
+        expired = 0
+        with self._lock:
+            for req in list(self._reqs.values()):
+                if req.state not in TERMINAL_STATES:
+                    self._retire(req, RequestState.EXPIRED, "drain_deadline",
+                                 "serving_expired", "serving/drain_expired")
+                    expired += 1
+            self._event("serving_drain_done", completed=completed,
+                        expired=expired)
+        return {"completed": completed, "expired": expired}
+
+    # ------------------------------------------------------------------ #
+    # Health / telemetry plumbing
+    # ------------------------------------------------------------------ #
+    def health_state(self) -> Tuple[str, List[str]]:
+        """Serving status for /healthz: ``draining`` > ``degraded``
+        (recent NaN/hang incident) > ``saturated`` (queue full or recent
+        shed) > ``healthy``."""
+        with self._lock:
+            now = self.clock()
+            if self.draining:
+                return "draining", [f"{self.pending} request(s) in flight"]
+            if self.last_incident_t is not None and \
+                    now - self.last_incident_t <= self.degraded_window_s:
+                return "degraded", [
+                    f"{self.last_incident_kind} incident "
+                    f"{now - self.last_incident_t:.0f}s ago"]
+            reasons = []
+            if len(self._waiting) >= self.max_queue:
+                reasons.append(f"queue full ({len(self._waiting)}"
+                               f"/{self.max_queue})")
+            if self.last_shed_t is not None and \
+                    now - self.last_shed_t <= self.degraded_window_s:
+                reasons.append(
+                    f"shed traffic {now - self.last_shed_t:.0f}s ago")
+            if reasons:
+                return "saturated", reasons
+            return "healthy", []
+
+    def _count(self, name: str, n: int = 1) -> None:
+        self.counters[name] += n
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is not None:
+            tel.metrics.counter(name).inc(n)
+
+    def _observe(self, name: str, value: Optional[float]) -> None:
+        if value is None:
+            return
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is not None:
+            tel.metrics.histogram(name).observe(float(value))
+
+    def _event(self, kind: str, **fields) -> None:
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is not None:
+            tel.event(kind, **fields)
+
+    def _publish_gauges(self) -> None:
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is None:
+            return
+        m = tel.metrics
+        m.gauge("serving/queue_depth").set(len(self._waiting))
+        m.gauge("serving/active_seqs").set(
+            len(self._prefilling) + len(self._decodes))
+        m.gauge("serving/kv_pressure").set(
+            round(self.eng.kv_used_fraction(), 4))

@@ -94,6 +94,14 @@ def _attend_gather(q_seq, kv_pages, page_table, q_len, ctx_len,
     off = jnp.broadcast_to((ctx_pos % ps)[None, :], (S, C))
     ctx = kv_pages[pg, off]                           # [S, C, 2KV, hd]
     k_ctx, v_ctx = ctx[..., :KV, :], ctx[..., KV:, :]
+    # zero V at out-of-context columns: masked scores become -1e30 (so K
+    # garbage can't leak) but probs*V still multiplies 0-weight columns —
+    # and 0*NaN = NaN.  A sequence's UNUSED block-table slots are 0 and
+    # alias page 0, so a NaN-poisoned page 0 would contaminate every
+    # sequence through its padding columns without this (same hardening
+    # the dense decode lowering already has).
+    valid_col = ctx_pos[None, :] < ctx_len[:, None]   # [S, C]
+    v_ctx = jnp.where(valid_col[:, :, None, None], v_ctx, 0)
     if KV != H:
         k_ctx = jnp.repeat(k_ctx, H // KV, axis=2)
         v_ctx = jnp.repeat(v_ctx, H // KV, axis=2)
@@ -197,8 +205,8 @@ def ragged_forward(params: Dict, kv_pages: jnp.ndarray, batch,
                    cfg: TransformerConfig, max_q: int, num_blocks: int,
                    attn_impl: str = "paged", max_seqs: int = 0,
                    max_blocks: int = 0, block_q: int = 128,
-                   pages_per_chunk: int = 8, decode_mode: bool = False
-                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                   pages_per_chunk: int = 8, decode_mode: bool = False,
+                   kv_replicate=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """→ (last-token logits [max_seqs, V], new kv_pages)."""
     batch = _unpack_batch(batch, max_q, max_seqs, max_blocks)
     tokens = batch["tokens"]              # [T]
@@ -242,7 +250,8 @@ def ragged_forward(params: Dict, kv_pages: jnp.ndarray, batch,
         k = _apply_rope_flat(k, cos, sin)
         kv_pages = paged_kv_append(
             kv_pages, k, v,
-            _layer_pages(page_of, l_idx, num_blocks, trash_page), off_of)
+            _layer_pages(page_of, l_idx, num_blocks, trash_page), off_of,
+            replicate=kv_replicate)
 
         o_flat = _ragged_attend(q, kv_pages, batch, attn_impl=attn_impl,
                                 layer=l_idx, num_blocks=num_blocks,
@@ -286,7 +295,7 @@ def ragged_forward_universal(params: Dict, kv_pages: jnp.ndarray, batch, cfg,
                              attn_impl: str = "paged", max_seqs: int = 0,
                              max_blocks: int = 0, block_q: int = 128,
                              pages_per_chunk: int = 8,
-                             decode_mode: bool = False
+                             decode_mode: bool = False, kv_replicate=None
                              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Paged ragged serving for the universal (ArchConfig) families —
     gpt2/gptj/opt/bloom/falcon/phi serve through the SAME put/query/flush
@@ -348,7 +357,8 @@ def ragged_forward_universal(params: Dict, kv_pages: jnp.ndarray, batch, cfg,
             k = _apply_rope_flat(k, cos, sin, cfg.rotary_dim, cfg.rope_style)
         kv_pages = paged_kv_append(
             kv_pages, k, v,
-            _layer_pages(page_of, l_idx, num_blocks, trash_page), off_of)
+            _layer_pages(page_of, l_idx, num_blocks, trash_page), off_of,
+            replicate=kv_replicate)
 
         o_flat = _ragged_attend(q, kv_pages, batch, attn_impl=attn_impl,
                                 layer=l_idx, num_blocks=num_blocks,
@@ -403,14 +413,16 @@ def build_ragged_step(cfg, max_q: int, num_blocks: int,
                       attn_impl: str = "paged", max_seqs: int = 0,
                       max_blocks: int = 0, block_q: int = 128,
                       pages_per_chunk: int = 8, jit: bool = True,
-                      decode_mode: bool = False):
+                      decode_mode: bool = False, kv_replicate=None):
     """Jitted step with a donated page pool (the CUDA-graph analogue: one
     compiled program reused for every batch; reference engine.py:494
     _create_cuda_graph).  Dispatches on the config type: TransformerConfig →
     native llama-family runner; ArchConfig → universal per-arch runner.
     ``jit=False`` returns the raw traceable fn (for embedding in the fused
     decode loop); ``decode_mode=True`` dispatches the one-token-per-sequence
-    decode attention path (requires row-major decode batches)."""
+    decode attention path (requires row-major decode batches);
+    ``kv_replicate`` (replicated NamedSharding) must be passed when params
+    are TP-sharded — see :func:`paged_kv_append`."""
     from ...models.families import ArchConfig
 
     assert attn_impl in ("paged", "gather"), \
@@ -420,7 +432,8 @@ def build_ragged_step(cfg, max_q: int, num_blocks: int,
     fn = partial(body, cfg=cfg, max_q=max_q, num_blocks=num_blocks,
                  attn_impl=attn_impl, max_seqs=max_seqs,
                  max_blocks=max_blocks, block_q=block_q,
-                 pages_per_chunk=pages_per_chunk, decode_mode=decode_mode)
+                 pages_per_chunk=pages_per_chunk, decode_mode=decode_mode,
+                 kv_replicate=kv_replicate)
     return jax.jit(fn, donate_argnums=(1,)) if jit else fn
 
 
@@ -442,7 +455,7 @@ def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
                       block_size: int, num_blocks: int, attn_impl: str,
                       steps: int, temperature: float = 0.0,
                       block_q: int = 128, pages_per_chunk: int = 8,
-                      top_k: int = 0, jit: bool = True):
+                      top_k: int = 0, jit: bool = True, kv_replicate=None):
     """Fused multi-step greedy/sampling decode: ``steps`` forward+select
     iterations in ONE compiled program (lax.scan), with the batch metadata
     advanced on device between iterations.
@@ -465,12 +478,18 @@ def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
     recomputed from the block table on device.
 
     Returns jitted (params, kv_pages, packed_meta, rng) →
-    (tokens [steps, max_seqs] int32, kv_pages, advanced_meta)."""
+    (tokens [steps, max_seqs] int32, kv_pages, advanced_meta,
+    nonfinite [max_seqs] bool).  ``nonfinite[i]`` is True when sequence
+    i's logits went non-finite at ANY step of the window — the signal the
+    serving decode watchdog uses to flush ONLY the poisoned requests
+    (kernel-level NaN isolation guarantees a poisoned sequence cannot
+    contaminate its batchmates; this flag extends the isolation to the
+    scheduler, which would otherwise keep decoding garbage)."""
     step_fn = build_ragged_step(cfg, max_q=max_q, num_blocks=num_blocks,
                                 attn_impl=attn_impl, max_seqs=max_seqs,
                                 max_blocks=max_blocks, block_q=block_q,
                                 pages_per_chunk=pages_per_chunk, jit=False,
-                                decode_mode=True)
+                                decode_mode=True, kv_replicate=kv_replicate)
     layout = pack_layout(max_q, max_seqs, max_blocks)
     NB, bs = max_blocks, block_size
     S = max_seqs
@@ -511,8 +530,11 @@ def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
 
     def loop(params, kv_pages, meta, rng):
         def body(carry, _):
-            pages, meta, rng = carry
+            pages, meta, rng, bad = carry
             logits, pages = step_fn(params, pages, meta)
+            # per-sequence poison flag: a NaN/Inf logit row marks ONLY its
+            # own sequence (sticky across the window's steps)
+            bad = bad | ~jnp.all(jnp.isfinite(logits), axis=-1)
             if temperature > 0:
                 rng, sub = jax.random.split(rng)
             else:
@@ -520,10 +542,11 @@ def build_decode_loop(cfg, *, max_q: int, max_seqs: int, max_blocks: int,
             toks = sample_tokens(logits, sub, temperature=temperature,
                                  top_k=top_k)
             meta = advance(meta, toks)
-            return (pages, meta, rng), toks
+            return (pages, meta, rng, bad), toks
 
-        (kv_pages, meta, _), toks = jax.lax.scan(
-            body, (kv_pages, meta, rng), None, length=steps)
-        return toks, kv_pages, meta
+        bad0 = jnp.zeros(max_seqs, jnp.bool_)
+        (kv_pages, meta, _, bad), toks = jax.lax.scan(
+            body, (kv_pages, meta, rng, bad0), None, length=steps)
+        return toks, kv_pages, meta, bad
 
     return jax.jit(loop, donate_argnums=(1,)) if jit else loop

@@ -7,6 +7,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ....runtime.fault.injection import InjectedExhausted, inject
 from ....utils.logging import logger
 from .blocked_allocator import BlockedAllocator
 
@@ -67,6 +68,15 @@ class DSStateManager:
         need = self.blocks_needed(seq, new_tokens)
         if need == 0:
             return True
+        # injection site: `exhausted` makes a GENUINE allocation (need > 0)
+        # report failure, so whole-lifetime-reserving schedulers (which only
+        # allocate at admission) see transient KV exhaustion exactly where
+        # their backpressure/preemption logic must handle it — no-op allocs
+        # from already-reserved sequences can never fire.
+        try:
+            inject("kv_alloc")
+        except InjectedExhausted:
+            return False
         if need > self.allocator.free_blocks:
             return False
         seq.blocks.extend(int(b) for b in self.allocator.allocate(need))

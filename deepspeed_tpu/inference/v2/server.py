@@ -1,0 +1,547 @@
+"""``dstpu-serve``: HTTP ingest front end over the lifecycle scheduler.
+
+Built on the same stdlib ``ThreadingHTTPServer`` machinery as the PR-5 live
+observability plane (telemetry/live/server.py), one server exposes:
+
+  * ``POST /v1/generate`` — submit a request (JSON body; token-id prompts).
+    Non-streaming answers once the request reaches a terminal state;
+    ``"stream": true`` answers as Server-Sent Events (``tokens`` events as
+    they are produced, then one terminal event), reusing the live plane's
+    SSE plumbing.  Overload shedding maps to HTTP: ``429`` (queue full) /
+    ``503`` (draining), both with a ``Retry-After`` computed from the
+    decode roofline's predicted drain rate.  A client disconnect mid-stream
+    cancels the request — its KV blocks return to the pool at the next
+    scheduler iteration.
+  * ``GET /metrics`` — Prometheus text (the telemetry registry, which the
+    scheduler mirrors its ``serving/*`` counters/gauges/histograms into;
+    without a telemetry hub the scheduler's counters are rendered
+    directly).
+  * ``GET /healthz`` — serving states ``healthy`` | ``saturated`` (queue
+    full / recent shedding) | ``draining`` (SIGTERM received) |
+    ``degraded`` (recent NaN-poisoned or hung decode window); anything but
+    ``healthy`` answers 503 so a dumb prober needs zero JSON parsing —
+    matching the live plane's contract.
+
+Graceful drain: SIGTERM (or :meth:`ServingServer.drain_and_stop`) flips
+``/healthz`` to ``draining`` immediately, sheds new submissions with 503,
+finishes (or deadline-expires) in-flight requests bounded by the drain
+deadline, then stops the HTTP server and returns — ``bin/dstpu-serve``
+exits 0.
+"""
+from __future__ import annotations
+
+import json
+import queue
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, List, Optional
+from urllib.parse import urlparse
+
+from ...utils.logging import logger
+from .lifecycle import (
+    TERMINAL_STATES,
+    AdmissionVerdict,
+    LifecycleScheduler,
+    RequestState,
+    ServeRequest,
+)
+
+#: terminal request state → HTTP status for the non-streaming answer
+_TERMINAL_HTTP = {
+    RequestState.FINISHED: 200,
+    RequestState.EXPIRED: 504,     # deadline / TTFT passed server-side
+    RequestState.CANCELLED: 499,   # client closed (nginx convention)
+    RequestState.FAILED: 500,
+}
+
+
+def _jsonable(o):
+    try:
+        from ...telemetry.events import _jsonable as _tj
+
+        return _tj(o)
+    except ImportError:  # pragma: no cover — telemetry is in-tree
+        return str(o)
+
+
+class _ServingHandler(BaseHTTPRequestHandler):
+    server_version = "dstpu-serve/1"
+    protocol_version = "HTTP/1.1"
+    _streaming = False
+
+    def log_message(self, format, *args):  # noqa: A002 — stdlib signature
+        logger.debug("dstpu-serve: " + format % args)
+
+    # ---------------------------------------------------------------- #
+    def _send(self, code: int, body: bytes, content_type: str,
+              headers: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj: Any,
+                   headers: Optional[Dict[str, str]] = None) -> None:
+        self._send(code, json.dumps(obj, default=_jsonable,
+                                    sort_keys=True).encode() + b"\n",
+                   "application/json", headers)
+
+    # ---------------------------------------------------------------- #
+    def do_GET(self):  # noqa: N802 — stdlib hook name
+        url = urlparse(self.path)
+        try:
+            if url.path == "/metrics":
+                self._get_metrics()
+            elif url.path == "/healthz":
+                self._get_healthz()
+            elif url.path == "/":
+                self._send_json(200, {"endpoints": [
+                    "/v1/generate (POST)", "/metrics", "/healthz"]})
+            else:
+                self._send_json(404, {"error": f"unknown path {url.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001 — a handler bug must not 500 silently
+            logger.warning(f"dstpu-serve {url.path} failed: {e!r}")
+            if self._streaming:
+                self.close_connection = True
+                return
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except (OSError, ValueError):
+                pass
+
+    def do_POST(self):  # noqa: N802 — stdlib hook name
+        url = urlparse(self.path)
+        try:
+            if url.path == "/v1/generate":
+                self._post_generate()
+            else:
+                self._send_json(404, {"error": f"unknown path {url.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"dstpu-serve {url.path} failed: {e!r}")
+            if self._streaming:
+                self.close_connection = True
+                return
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except (OSError, ValueError):
+                pass
+
+    # ---------------------------------------------------------------- #
+    def _get_metrics(self) -> None:
+        srv: "_ServingHTTPServer" = self.server
+        tel = srv.owner.telemetry
+        if tel is not None:
+            text = tel.metrics.prometheus_text()
+        else:
+            lines = []
+            for name, value in sorted(srv.owner.scheduler.counters.items()):
+                prom = name.replace("/", "_")
+                lines.append(f"# TYPE {prom} counter")
+                lines.append(f"{prom} {value}")
+            text = "\n".join(lines) + ("\n" if lines else "")
+        self._send(200, text.encode(), "text/plain; version=0.0.4")
+
+    def _get_healthz(self) -> None:
+        srv: "_ServingHTTPServer" = self.server
+        sched = srv.owner.scheduler
+        status, reasons = sched.health_state()
+        body = {
+            "status": status,
+            "reasons": reasons,
+            "pending": sched.pending,
+            "queue_depth": len(sched._waiting),
+            "kv_pressure": round(sched.eng.kv_used_fraction(), 4),
+            "counters": dict(sched.counters),
+            "ts": time.time(),
+        }
+        self._send_json(200 if status == "healthy" else 503, body)
+
+    # ---------------------------------------------------------------- #
+    def _post_generate(self) -> None:
+        srv: "_ServingHTTPServer" = self.server
+        owner = srv.owner
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0 or length > 8 * 1024 * 1024:
+            self._send_json(400, {"error": "missing/oversized body"})
+            return
+        try:
+            payload = json.loads(self.rfile.read(length))
+            prompt = [int(t) for t in payload["prompt"]]
+        except (ValueError, TypeError, KeyError) as e:
+            self._send_json(400, {"error": f"bad request body: {e!r}"})
+            return
+        stream = bool(payload.get("stream", False))
+
+        events: "queue.Queue" = queue.Queue()
+        req, verdict = owner.submit_request(
+            prompt=prompt,
+            max_new_tokens=int(payload.get("max_new_tokens", 32)),
+            priority=int(payload.get("priority", 0)),
+            deadline_s=payload.get("deadline_s"),
+            ttft_timeout_s=payload.get("ttft_timeout_s"),
+            sink=events)
+        if not verdict.admitted:
+            code = 503 if verdict.reason == "draining" else 429
+            self._send_json(code, {
+                "error": "overloaded", "reason": verdict.reason,
+                "retry_after_s": verdict.retry_after_s,
+            }, headers={"Retry-After":
+                        str(int(round(verdict.retry_after_s or 1)))})
+            return
+        if stream:
+            self._stream_response(owner, req, events)
+        else:
+            self._blocking_response(owner, req, events)
+
+    def _blocking_response(self, owner: "ServingServer", req: ServeRequest,
+                           events: "queue.Queue") -> None:
+        while True:
+            try:
+                event, tokens, reason, state = events.get(
+                    timeout=owner.request_poll_s)
+            except queue.Empty:
+                if owner.stopping.is_set():
+                    self._send_json(503, {"error": "server stopping"})
+                    return
+                continue
+            if state in TERMINAL_STATES:
+                break
+        self._send_json(_TERMINAL_HTTP.get(state, 200), {
+            "uid": req.uid, "tokens": tokens, "finish_reason": reason,
+            "state": state.value, "ttft_s": req.ttft_s(),
+            "tpot_s": req.tpot_s(),
+        })
+
+    def _client_gone(self) -> bool:
+        """Prompt disconnect detection: an SSE client never sends more
+        bytes, so a readable socket returning EOF means it closed.  Write
+        failure alone is NOT enough — small event payloads buffer into the
+        kernel without error and a short generation can finish before the
+        first RST comes back."""
+        import select
+        import socket as _socket
+
+        try:
+            r, _, _ = select.select([self.connection], [], [], 0)
+            if not r:
+                return False
+            return self.connection.recv(1, _socket.MSG_PEEK) == b""
+        except OSError:
+            return True
+
+    def _stream_response(self, owner: "ServingServer", req: ServeRequest,
+                         events: "queue.Queue") -> None:
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        self.send_header("Connection", "close")
+        self.end_headers()
+        self._streaming = True
+        sent = 0
+        try:
+            while True:
+                if self._client_gone():
+                    raise BrokenPipeError
+                try:
+                    event, tokens, reason, state = events.get(
+                        timeout=owner.request_poll_s)
+                except queue.Empty:
+                    if owner.stopping.is_set():
+                        return
+                    continue
+                fresh = tokens[sent:]
+                if fresh or state in TERMINAL_STATES:
+                    payload = {"uid": req.uid, "tokens": fresh,
+                               "n_total": len(tokens)}
+                    if state in TERMINAL_STATES:
+                        payload["finish_reason"] = reason
+                        payload["state"] = state.value
+                    self.wfile.write(
+                        f"event: {event}\ndata: "
+                        f"{json.dumps(payload)}\n\n".encode())
+                    self.wfile.flush()
+                    sent = len(tokens)
+                if state in TERMINAL_STATES:
+                    return
+        except (BrokenPipeError, ConnectionResetError):
+            # client went away mid-stream: cancel → the scheduler flushes
+            # the sequence and its blocks return to the pool
+            owner.scheduler.cancel(req.uid)
+            owner.kick()
+
+
+class _ServingHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    owner: "ServingServer" = None
+
+
+class ServingServer:
+    """Owner object: HTTP thread + scheduler driver thread + drain logic.
+
+    The driver thread single-threads every engine interaction (the
+    scheduler lock makes submit/cancel safe from handler threads, but
+    compiled-program dispatch stays on one thread).  ``port=0`` binds a
+    free port (tests)."""
+
+    def __init__(self, scheduler: LifecycleScheduler, telemetry=None,
+                 port: int = 8791, bind: str = "0.0.0.0",
+                 drain_deadline_s: float = 30.0,
+                 driver_idle_s: float = 0.02, request_poll_s: float = 0.1):
+        self.scheduler = scheduler
+        self.telemetry = telemetry
+        self.requested_port = int(port)
+        self.bind = bind
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.driver_idle_s = float(driver_idle_s)
+        self.request_poll_s = float(request_poll_s)
+        self.port: Optional[int] = None
+        self.stopping = threading.Event()
+        self.drained = threading.Event()
+        self._work = threading.Event()
+        self._uid_lock = threading.Lock()
+        self._next_uid = 0
+        self._server: Optional[_ServingHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._driver_thread: Optional[threading.Thread] = None
+
+    # ---------------------------------------------------------------- #
+    def submit_request(self, prompt: List[int], max_new_tokens: int = 32,
+                       priority: int = 0, deadline_s=None,
+                       ttft_timeout_s=None, sink: "queue.Queue" = None
+                       ) -> "tuple[ServeRequest, AdmissionVerdict]":
+        """Build + submit one request; lifecycle events are copied into
+        ``sink`` as ``(event, tokens_copy, finish_reason, state)`` tuples
+        (the handler threads consume them without touching scheduler
+        state)."""
+        with self._uid_lock:
+            uid = self._next_uid
+            self._next_uid += 1
+
+        def on_event(event: str, r: ServeRequest) -> None:
+            if sink is not None:
+                sink.put((event, list(r.produced), r.finish_reason, r.state))
+
+        req = ServeRequest(
+            uid=uid, prompt=prompt, max_new_tokens=max_new_tokens,
+            priority=priority,
+            deadline_s=float(deadline_s) if deadline_s is not None else None,
+            ttft_timeout_s=(float(ttft_timeout_s)
+                            if ttft_timeout_s is not None else None),
+            on_event=on_event)
+        verdict = self.scheduler.submit(req)
+        self.kick()
+        return req, verdict
+
+    def kick(self) -> None:
+        """Wake the driver (new work / cancellation)."""
+        self._work.set()
+
+    # ---------------------------------------------------------------- #
+    def _drive(self) -> None:
+        while not self.stopping.is_set():
+            if self.scheduler.pending:
+                try:
+                    self.scheduler.step()
+                except Exception as e:  # noqa: BLE001 — driver must survive
+                    logger.error(f"scheduler step failed: {e!r}")
+                    time.sleep(self.driver_idle_s)
+            else:
+                self._work.wait(self.driver_idle_s)
+                self._work.clear()
+
+    # ---------------------------------------------------------------- #
+    def start(self) -> "ServingServer":
+        if self._server is not None:
+            return self
+        srv = _ServingHTTPServer((self.bind, self.requested_port),
+                                 _ServingHandler)
+        srv.owner = self
+        self._server = srv
+        self.port = srv.server_address[1]
+        self._http_thread = threading.Thread(
+            target=srv.serve_forever, name="dstpu-serve-http",
+            kwargs={"poll_interval": 0.2}, daemon=True)
+        self._http_thread.start()
+        self._driver_thread = threading.Thread(
+            target=self._drive, name="dstpu-serve-driver", daemon=True)
+        self._driver_thread.start()
+        logger.info(f"dstpu-serve on http://{self.bind}:{self.port} "
+                    f"(/v1/generate /metrics /healthz)")
+        if self.telemetry is not None:
+            self.telemetry.event("serving_server_start", port=self.port,
+                                 bind=self.bind)
+        return self
+
+    def drain_and_stop(self, deadline_s: Optional[float] = None) -> Dict:
+        """SIGTERM path: shed new work immediately, let the driver finish
+        in-flight requests bounded by the deadline, flush what remains,
+        stop.  Idempotent."""
+        deadline_s = self.drain_deadline_s if deadline_s is None \
+            else float(deadline_s)
+        self.scheduler.start_drain()   # /healthz → draining; submits → 503
+        completed0 = self.scheduler.counters["serving/completed"]
+        t_end = time.monotonic() + deadline_s
+        # the driver thread keeps stepping while we wait; the tail drain()
+        # call only mops up whatever is still live at the deadline
+        while self.scheduler.pending and time.monotonic() < t_end:
+            time.sleep(min(self.driver_idle_s, 0.05))
+        tail = self.scheduler.drain(
+            deadline_s=max(t_end - time.monotonic(), 0.0))
+        summary = {"completed": int(
+            self.scheduler.counters["serving/completed"] - completed0),
+            "expired": tail["expired"]}
+        self.drained.set()
+        self.stop()
+        return summary
+
+    def stop(self) -> None:
+        self.stopping.set()
+        self._work.set()
+        srv, self._server = self._server, None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        for t in (self._http_thread, self._driver_thread):
+            if t is not None:
+                t.join(timeout=5.0)
+        self._http_thread = self._driver_thread = None
+
+
+# ------------------------------------------------------------------- #
+# CLI (bin/dstpu-serve)
+# ------------------------------------------------------------------- #
+def build_tiny_engine(args):
+    """CPU-sim engine for smoke tests and local bring-up."""
+    import jax
+    import jax.numpy as jnp
+
+    from ...models.transformer import CausalLM, TransformerConfig
+    from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        max_tokens=args.max_tokens, max_seqs=args.max_seqs,
+        max_ctx=args.max_ctx, block_size=args.block_size,
+        num_blocks=args.num_blocks, dtype=jnp.float32,
+        attn_impl=args.attn_impl))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="dstpu-serve",
+        description="Serving front end: request lifecycle, overload "
+                    "shedding, KV-pressure preemption, graceful drain.")
+    p.add_argument("--port", type=int, default=8791)
+    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--model", default="tiny",
+                   help="'tiny' (CPU-sim bring-up) or an HF model dir/name "
+                        "(routed through engine_factory.build_hf_engine)")
+    p.add_argument("--ckpt", default=None,
+                   help="serve params from a framework training checkpoint "
+                        "(train→serve handoff; --model supplies the arch)")
+    p.add_argument("--attn-impl", default="paged",
+                   choices=["paged", "gather"])
+    p.add_argument("--max-tokens", type=int, default=256)
+    p.add_argument("--max-seqs", type=int, default=16)
+    p.add_argument("--max-ctx", type=int, default=2048)
+    p.add_argument("--block-size", type=int, default=64)
+    p.add_argument("--num-blocks", type=int, default=None)
+    p.add_argument("--queue-cap", type=int, default=64,
+                   help="admission queue bound; beyond it requests are "
+                        "shed with 429 + Retry-After")
+    p.add_argument("--window-steps", type=int, default=8,
+                   help="fused decode window bound — the lifecycle "
+                        "(deadline/cancel/preempt) reaction granularity")
+    p.add_argument("--kv-watermark", type=float, default=0.9,
+                   help="KV pool high watermark above which a starved "
+                        "queue head may preempt the lowest-priority decode")
+    p.add_argument("--no-preempt", action="store_true")
+    p.add_argument("--hang-deadline", type=float, default=30.0,
+                   help="decode-window wall-time budget before a "
+                        "serving_window_hang incident is raised")
+    p.add_argument("--drain-deadline", type=float, default=30.0,
+                   help="SIGTERM → exit budget: in-flight requests get "
+                        "this long to finish before being expired")
+    p.add_argument("--eos", type=int, default=None)
+    p.add_argument("--telemetry-dir", default="telemetry_serve")
+    args = p.parse_args(argv)
+
+    from ...telemetry import Telemetry, set_telemetry
+
+    tel = Telemetry(output_dir=args.telemetry_dir)
+    set_telemetry(tel)
+
+    if args.model == "tiny":
+        engine = build_tiny_engine(args)
+        if args.ckpt:
+            raise SystemExit("--ckpt needs a real --model architecture")
+    else:
+        import jax.numpy as jnp
+
+        from .engine_factory import (
+            build_engine_from_ds_checkpoint,
+            build_hf_engine,
+        )
+        from .engine_v2 import RaggedInferenceEngineConfig
+
+        ecfg = RaggedInferenceEngineConfig(
+            max_tokens=args.max_tokens, max_seqs=args.max_seqs,
+            max_ctx=args.max_ctx, block_size=args.block_size,
+            num_blocks=args.num_blocks, dtype=jnp.bfloat16,
+            attn_impl=args.attn_impl)
+        if args.ckpt:
+            from ...models.hf import from_pretrained_config
+
+            model = from_pretrained_config(args.model)
+            engine = build_engine_from_ds_checkpoint(
+                args.ckpt, model, engine_config=ecfg)
+        else:
+            engine = build_hf_engine(args.model, engine_config=ecfg)
+
+    scheduler = LifecycleScheduler(
+        engine, max_queue=args.queue_cap, window_steps=args.window_steps,
+        kv_high_watermark=args.kv_watermark, preempt=not args.no_preempt,
+        hang_deadline_s=args.hang_deadline, eos_token_id=args.eos)
+    server = ServingServer(scheduler, telemetry=tel, port=args.port,
+                           bind=args.bind,
+                           drain_deadline_s=args.drain_deadline)
+    server.start()
+
+    done = threading.Event()
+    rc = {"code": 0}
+
+    def _drain_then_exit():
+        try:
+            server.drain_and_stop()
+        except Exception as e:  # noqa: BLE001 — a failed drain must still exit
+            logger.error(f"drain failed: {e!r}")
+            rc["code"] = 1
+        finally:
+            done.set()          # never leave main() blocked on SIGTERM
+
+    def _term(signum, frame):
+        logger.info(f"signal {signum}: draining "
+                    f"(deadline {args.drain_deadline}s)")
+        threading.Thread(target=_drain_then_exit, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    print(f"dstpu-serve listening on http://{args.bind}:{server.port}",
+          flush=True)
+    done.wait()
+    tel.close()
+    return rc["code"]

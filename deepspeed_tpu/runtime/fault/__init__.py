@@ -19,7 +19,8 @@ elastic agent / comm bootstrap:
     ``DSTPU_FAULT_INJECT`` so recovery paths are provable in tests.
 """
 from .atomic import atomic_write_text, fsync_dir  # noqa: F401
-from .injection import FaultInjector, FaultSpec, inject, truncate_file  # noqa: F401
+from .injection import (FaultInjector, FaultSpec, InjectedExhausted,  # noqa: F401
+                        InjectedNaN, inject, truncate_file)
 from .manifest import (CheckpointCorruptError, is_valid_checkpoint,  # noqa: F401
                        read_manifest, verify_checkpoint, write_manifest)
 from .retry import (RetryPolicy, fault_counters, record_fault_event,  # noqa: F401

@@ -13,18 +13,37 @@ Spec string grammar (``;`` separates specs, ``,`` separates fields)::
 Fields: ``site`` (required), ``kind`` — one of
 
   * ``io_error``  raise ``OSError(EIO)`` (transient storage failure),
-  * ``slow``      sleep ``delay`` seconds (hung collective / straggler),
+  * ``slow``      sleep ``delay`` seconds (hung collective / straggler /
+                  stuck decode window),
   * ``truncate``  truncate the file passed by the call site to
                   ``truncate_to`` bytes (torn write),
   * ``kill``      ``os._exit(exit_code)`` (worker death / preemption),
   * ``shard_missing``  delete one file (first in sorted order) under the
                   directory passed by the call site — a tensorstore shard
-                  lost between commit and a (resharded) load —
+                  lost between commit and a (resharded) load,
+  * ``nan``       raise :class:`InjectedNaN`; the call site poisons its
+                  numerics (the serving engine NaNs the first scheduled
+                  sequence's KV pages so the decode watchdog sees a
+                  poisoned window),
+  * ``exhausted`` raise :class:`InjectedExhausted`; the call site treats
+                  the resource as transiently gone (the KV block allocator
+                  reports allocation failure so schedulers exercise their
+                  backpressure / preemption paths) —
 
 plus ``p`` (fire probability, default 1), ``times`` (max fires per process),
 ``steps`` (only fire at these step numbers: ``3`` | ``3-5`` | ``3|7|9``),
 ``delay``, ``truncate_to``, ``exit_code``, ``seed``.  Probability draws use a
 per-spec ``random.Random(seed)`` so runs are reproducible.
+
+Serving sites (wired through ``inference/v2``; ``step`` is the engine's
+monotonically increasing decode-window index):
+
+  * ``decode_window`` (kinds ``slow``/``nan``/``kill``) — fires when a
+    fused decode window is dispatched: a hung window, a NaN-poisoned
+    window, or worker death mid-decode;
+  * ``kv_alloc`` (kind ``exhausted``) — fires when the block allocator is
+    asked for NEW blocks (no-op allocations never fire), simulating a
+    transiently exhausted KV pool.
 
 Stdlib-only and loadable standalone (fault-injection worker scripts).
 """
@@ -55,7 +74,18 @@ except ImportError:  # loaded standalone, outside the package
             pass
 
 ENV_VAR = "DSTPU_FAULT_INJECT"
-KINDS = ("io_error", "slow", "truncate", "kill", "shard_missing")
+KINDS = ("io_error", "slow", "truncate", "kill", "shard_missing", "nan",
+         "exhausted")
+
+
+class InjectedNaN(ArithmeticError):
+    """Raised by the ``nan`` kind: the call site must poison its own
+    numerics (the injector cannot reach device buffers)."""
+
+
+class InjectedExhausted(RuntimeError):
+    """Raised by the ``exhausted`` kind: the call site must report its
+    resource (KV blocks, queue slots) as transiently unavailable."""
 
 
 def truncate_file(path: str, nbytes: int = 0) -> None:
@@ -174,6 +204,12 @@ class FaultInjector:
                            f"at {where}")
             os.remove(victim)
             return
+        if spec.kind == "nan":
+            logger.warning(f"fault injection: NaN poison at {where}")
+            raise InjectedNaN(f"injected NaN at {where}")
+        if spec.kind == "exhausted":
+            logger.warning(f"fault injection: resource exhausted at {where}")
+            raise InjectedExhausted(f"injected exhaustion at {where}")
         if spec.kind == "kill":
             logger.warning(f"fault injection: killing process at {where}")
             os._exit(spec.exit_code)

@@ -35,7 +35,8 @@ from ..metrics import _prom_name
 _REASON_SAFE = re.compile(r"[^A-Za-z0-9_:. \-]")
 
 #: counters whose totals ride every snapshot (the incident digest)
-INCIDENT_COUNTERS = ("fault/events", "anomaly/events", "straggler/events")
+INCIDENT_COUNTERS = ("fault/events", "anomaly/events", "straggler/events",
+                     "serving/nan_isolated", "serving/window_hang")
 
 
 def collect_snapshot(telemetry, host_id: int,

@@ -30,7 +30,8 @@ from .metrics import _percentile
 EVENT_KINDS_INCIDENT = ("fault", "watchdog_timeout", "elastic_worker_failure",
                         "elastic_restart", "elastic_reshape", "straggler",
                         "anomaly", "anomaly_checkpoint_failed",
-                        "checkpoint_reshard_fallback")
+                        "checkpoint_reshard_fallback",
+                        "serving_nan_isolated", "serving_window_hang")
 
 #: roofline table columns, shared between the section renderer and --help
 ROOFLINE_COLUMNS = (
@@ -213,14 +214,29 @@ def overlap_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+#: request-lifecycle counters surfaced in the serving section / incident
+#: digest (LifecycleScheduler mirrors these into the registry)
+SERVING_LIFECYCLE_COUNTERS = (
+    "serving/requests", "serving/completed", "serving/shed",
+    "serving/preempted", "serving/cancelled", "serving/deadline_expired",
+    "serving/ttft_timeout", "serving/nan_isolated", "serving/window_hang",
+    "serving/rejected", "serving/drain_expired")
+
+#: serving latency histograms: TTFT (arrival → first generated token) and
+#: TPOT (decode-phase seconds per output token)
+SERVING_LATENCY_HISTOGRAMS = ("serving/ttft_s", "serving/tpot_s")
+
+
 def serving_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
-    """The ``serving/*`` gauges (decode HBM roofline, published per drained
-    decode window by ``InferenceEngineV2._record_decode_roofline``): total
-    decode tok/s + achieved-vs-peak HBM bandwidth, and the per-kernel
-    %-of-peak breakdown (attention page walk vs weight stream vs cache
-    append)."""
+    """The ``serving/*`` series: decode-HBM-roofline gauges (published per
+    drained decode window by ``InferenceEngineV2._record_decode_roofline``)
+    with the per-kernel %-of-peak breakdown, plus the request-lifecycle
+    layer — shed/preempt/cancel/expiry counters and TTFT/TPOT percentiles
+    (published by ``LifecycleScheduler``)."""
     out: Dict[str, Any] = {}
     kernels: Dict[str, Dict[str, Any]] = {}
+    lifecycle: Dict[str, float] = {}
+    latency: Dict[str, Dict[str, Any]] = {}
     for m in metrics:
         name = str(m.get("name", ""))
         if not name.startswith("serving/"):
@@ -229,7 +245,14 @@ def serving_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         labels = m.get("labels") or {}
         if labels.get("device"):
             out["device_kind"] = labels["device"]
-        if key.startswith("kernel_"):
+        if name in SERVING_LIFECYCLE_COUNTERS:
+            lifecycle[key] = m.get("value")
+        elif name in SERVING_LATENCY_HISTOGRAMS:
+            if m.get("count"):
+                latency[key] = {k: m.get(k) for k in
+                                ("count", "mean", "p50", "p90", "p95",
+                                 "p99", "max")}
+        elif key.startswith("kernel_"):
             kname = labels.get("kernel", "?")
             kernels.setdefault(kname, {})[key[len("kernel_"):]] = \
                 m.get("value")
@@ -237,6 +260,10 @@ def serving_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
             out[key] = m.get("value")
     if kernels:
         out["kernels"] = kernels
+    if lifecycle:
+        out["lifecycle"] = lifecycle
+    if latency:
+        out["latency"] = latency
     return out
 
 
@@ -490,6 +517,20 @@ def format_summary(s: Dict[str, Any]) -> str:
                 pct = f"{row['hbm_pct_peak']:.1f}%" \
                     if row.get("hbm_pct_peak") is not None else "-"
                 add(f"{kname:<22}{gbps:>12}{pct:>8}")
+        lat = srv.get("latency") or {}
+        for hname, label in (("ttft_s", "TTFT"), ("tpot_s", "TPOT")):
+            row = lat.get(hname)
+            if row:
+                add(f"{label}: p50 {_fmt_ms(row.get('p50') or 0)}ms, "
+                    f"p95 {_fmt_ms(row.get('p95') or 0)}ms, "
+                    f"p99 {_fmt_ms(row.get('p99') or 0)}ms "
+                    f"(n={int(row.get('count') or 0)})")
+        lc = srv.get("lifecycle") or {}
+        if lc:
+            parts = [f"{k}={int(v)}" for k, v in sorted(lc.items())
+                     if v]
+            if parts:
+                add("lifecycle: " + ", ".join(parts))
         add("")
 
     add("--- memory high-water marks ---")

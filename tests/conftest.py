@@ -22,6 +22,19 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 
+def pytest_collection_modifyitems(config, items):
+    """Marker lint: every test in a chaos-suite file must carry the
+    ``serving_chaos`` marker — with ``--strict-markers`` (pytest.ini) a
+    misspelled marker already fails collection; this closes the remaining
+    hole of a chaos file with NO marker silently joining every run."""
+    bad = [item.nodeid for item in items
+           if "chaos" in os.path.basename(str(item.fspath))
+           and item.get_closest_marker("serving_chaos") is None]
+    if bad:
+        raise pytest.UsageError(
+            "chaos tests must be marked serving_chaos: " + ", ".join(bad))
+
+
 @pytest.fixture(autouse=True)
 def _reset_global_state():
     """Each test gets a fresh global topology."""

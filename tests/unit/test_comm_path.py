@@ -68,6 +68,7 @@ class TestQuantizedGradients:
         assert not any(("all_to_all" in l or "all_gather" in l) and "xi8>" in l
                        for l in hlo_b.splitlines())
 
+    @pytest.mark.slow  # 10s; LoCo coverage continues in test_comm_path_quant
     def test_loco_error_state_updates(self):
         eng = _engine(2, {"zero_quantized_gradients": True, "zeropp_loco": True})
         batch = _batch()
@@ -251,6 +252,7 @@ class TestImperativeWireParity:
         assert lq[-1] < lq[0] - 0.5          # trains
         assert abs(lq[-1] - lb[-1]) < 0.3    # close to the fused wire
 
+    @pytest.mark.slow  # 12s at tier-1 profile; the wire-parity class keeps faster cases in tier-1
     def test_wire_fires_at_boundary_not_backward(self):
         from deepspeed_tpu.runtime.comm_path import (build_explicit_micro_fn,
                                                      build_explicit_step_fn)

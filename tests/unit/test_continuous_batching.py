@@ -14,6 +14,7 @@ from deepspeed_tpu.inference.v2.engine_v2 import (
     ContinuousBatcher,
     InferenceEngineV2,
     RaggedInferenceEngineConfig,
+    SchedulingResult,
 )
 from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
@@ -118,3 +119,52 @@ class TestSchedulingCost:
                 break
             b.step()
             assert b.touched <= cap, (b.touched, cap)
+
+
+class TestEvictionEdgeCases:
+    """Scheduler eviction paths that existed untested: flushing a uid whose
+    async DecodeWindow has not been drained yet, and admission of a request
+    whose whole-lifetime block reservation can never fit the pool."""
+
+    def test_flush_of_uid_inside_undrained_window(self, tiny):
+        """flush() while the uid's fused window is still in flight: the
+        window must still drain cleanly, the blocks must be back in the
+        pool immediately, the engine's device-resume state must be
+        invalidated (a later window repacks instead of resuming the
+        flushed stream), and the freed blocks must be re-admittable."""
+        model, params = tiny
+        eng = _engine(model, params, num_blocks=6)
+        logits = eng.put([0], [[3, 5, 7, 11]])
+        seed = int(jnp.argmax(logits[0]))
+        window = eng.decode_batch_async([0], [seed], steps=4)
+        eng.flush([0])                          # mid-flight eviction
+        assert eng.state_manager.free_blocks == 6
+        assert eng._decode_state is None        # resume state invalidated
+        toks = window.tokens()                  # drains without error
+        assert toks.shape == (4, 1)
+        assert window.nonfinite is not None and not window.nonfinite.any()
+        # freed blocks are re-admittable: a new request prefills + decodes
+        logits = eng.put([1], [[2] * 14])
+        seed = int(jnp.argmax(logits[0]))
+        toks2 = eng.decode_batch([1], [seed], steps=4)
+        assert toks2.shape == (4, 1)
+        # the flushed uid's stale stream was NOT resumed into uid 1
+        assert eng.decode_resume_hits == 0
+        eng.flush([1])
+        assert eng.state_manager.free_blocks == 6
+
+    def test_whole_lifetime_reservation_exceeding_pool_rejects(self, tiny):
+        """A request whose prompt+decode reservation exceeds the pool must
+        be rejected at admission — NOT hold the queue head hostage while
+        the allocator waits for blocks that can never exist."""
+        model, params = tiny
+        eng = _engine(model, params, num_blocks=4)   # 32-token pool
+        assert eng.can_schedule([0], [40]) is not SchedulingResult.Success
+        b = ContinuousBatcher(eng, max_new_tokens=16)
+        b.add_request(0, [2] * 30)          # 30+16 = 46 tokens > pool
+        b.add_request(1, [3, 5, 7])         # fits easily behind it
+        done = b.run()
+        assert b.rejected == [0]
+        assert done[0] == []                # rejected, empty stream
+        assert len(done[1]) == 16           # the head never wedged
+        assert eng.state_manager.free_blocks == 4

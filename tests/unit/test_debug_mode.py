@@ -44,6 +44,7 @@ class TestDebugMode:
         finally:
             jax.config.update("jax_default_matmul_precision", None)
 
+    @pytest.mark.slow  # 14s: checked-mode recompiles; test_nan_check_off_tolerates keeps the path in tier-1
     def test_nan_check_raises_on_poisoned_params(self):
         try:
             eng = _engine({"nan_check": True})

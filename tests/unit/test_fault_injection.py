@@ -127,3 +127,47 @@ class TestGlobalInjector:
         with pytest.raises(OSError):
             injection.inject("b")
         assert inj.fires["b:io_error"] == 1
+
+
+class TestServingKinds:
+    """The serving sites' kinds: `nan` and `exhausted` raise typed
+    exceptions the call site converts into poisoned numerics / transient
+    allocation failure (see the serving-sites section of the module
+    docstring)."""
+
+    def test_nan_kind_raises_typed_error(self):
+        injection.configure("site=decode_window,kind=nan,times=1")
+        with pytest.raises(injection.InjectedNaN):
+            injection.inject("decode_window", step=3)
+        injection.inject("decode_window", step=4)     # times=1 spent
+
+    def test_exhausted_kind_raises_typed_error(self):
+        injection.configure("site=kv_alloc,kind=exhausted,times=2")
+        for _ in range(2):
+            with pytest.raises(injection.InjectedExhausted):
+                injection.inject("kv_alloc")
+        injection.inject("kv_alloc")
+
+    def test_kv_alloc_site_reports_allocation_failure(self):
+        """The wired call site: a genuine allocation fails under the
+        injector, a no-op (already-reserved) allocation never fires."""
+        from deepspeed_tpu.inference.v2.ragged.sequence_descriptor import \
+            DSStateManager
+
+        sm = DSStateManager(num_blocks=8, block_size=4)
+        seq = sm.get_or_create_sequence(0)
+        assert sm.maybe_allocate_kv(seq, 8)           # 2 blocks reserved
+        injection.configure("site=kv_alloc,kind=exhausted,times=1")
+        # no NEW blocks needed (whole-lifetime reservation already made)
+        # -> the site must not fire
+        assert sm.maybe_allocate_kv(seq, 8)
+        # a genuine allocation reports transient exhaustion once
+        seq2 = sm.get_or_create_sequence(1)
+        assert not sm.maybe_allocate_kv(seq2, 8)
+        assert sm.maybe_allocate_kv(seq2, 8)
+        assert sm.free_blocks == 4
+
+    def test_serving_sites_documented_in_grammar(self):
+        doc = injection.__doc__
+        for needle in ("decode_window", "kv_alloc", "nan", "exhausted"):
+            assert needle in doc

@@ -82,6 +82,7 @@ class TestDeferredAccumulatorUnit:
 
 
 class TestFusedPathBitExact:
+    @pytest.mark.slow  # 10s; test_eager_vs_deferred_micro_exchange keeps the bit-exactness claim in tier-1
     def test_overlap_on_off_identical_update(self):
         """The tentpole acceptance bar: same data, same seeds — the
         deferred schedule's post-step params and loss are bitwise equal to

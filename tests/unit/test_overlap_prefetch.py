@@ -121,6 +121,7 @@ class TestImperativeWindowPrefetch:
             rng.integers(0, 64, size=(16, 32)), jnp.int32)}
             for _ in range(gas)]
 
+    @pytest.mark.slow  # 12s: HLO text inspection; test_grads_bit_exact_vs_uncached remains in tier-1
     def test_window_cache_mechanics_and_hlo(self):
         """One stage-3 qwZ engine covers the whole mechanism: (1) the
         pregathered micro-step program carries NO all-gather (the qwZ int8

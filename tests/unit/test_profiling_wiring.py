@@ -180,6 +180,7 @@ class TestMarkerRegistration:
 
 
 class TestXprofBreadcrumb:
+    @pytest.mark.slow  # 32s: jax.profiler trace capture; xprof parsing stays covered by test_profiling_xprof
     def test_xprof_trace_event_emitted(self, tmp_path):
         xdir = os.path.join(tmp_path, "xprof")
         eng = make_engine(

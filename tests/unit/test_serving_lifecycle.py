@@ -1,0 +1,371 @@
+"""Request lifecycle layer (marker: serving): admission + overload
+shedding, deadlines / TTFT timeouts, cancellation with block reclaim,
+KV-pressure preemption with bit-exact prefill-recompute resume, and the
+decode watchdog (NaN isolation, hang incidents) — all on the CPU sim.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (
+    InferenceEngineV2,
+    RaggedInferenceEngineConfig,
+)
+from deepspeed_tpu.inference.v2.lifecycle import (
+    LifecycleScheduler,
+    RequestState,
+    ServeRequest,
+)
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+from deepspeed_tpu.runtime.fault import injection
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    injection.clear()
+    yield
+    injection.clear()
+
+
+def _engine(tiny_lm, **kw):
+    model, params = tiny_lm
+    defaults = dict(max_tokens=16, max_seqs=4, max_ctx=64, block_size=8,
+                    dtype=jnp.float32, attn_impl="gather")
+    defaults.update(kw)
+    return InferenceEngineV2(model, params,
+                             RaggedInferenceEngineConfig(**defaults))
+
+
+class FakeClock:
+    """Deterministic clock: deadlines fire exactly when the test says."""
+
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+class TestAdmissionAndShedding:
+    def test_matches_generate(self, tiny_lm):
+        """The lifecycle path produces the exact same greedy streams as
+        the engine's own generate loop."""
+        eng = _engine(tiny_lm)
+        s = LifecycleScheduler(eng, window_steps=4)
+        prompts = [[3, 5, 7, 11], [4, 5, 7, 11], [5, 5, 7, 11]]
+        for uid, p in enumerate(prompts):
+            assert s.submit(ServeRequest(uid=uid, prompt=p,
+                                         max_new_tokens=6)).admitted
+        s.run_until_idle()
+        ref = eng.generate(prompts, max_new_tokens=6)
+        assert [s.request(u).produced for u in range(3)] == ref
+        assert all(s.request(u).state == RequestState.FINISHED
+                   for u in range(3))
+        assert s.counters["serving/completed"] == 3
+
+    def test_queue_cap_sheds_with_retry_after(self, tiny_lm):
+        eng = _engine(tiny_lm)
+        s = LifecycleScheduler(eng, max_queue=2)
+        for uid in range(2):
+            assert s.submit(ServeRequest(uid=uid, prompt=[3, 5],
+                                         max_new_tokens=8)).admitted
+        v = s.submit(ServeRequest(uid=9, prompt=[3, 5], max_new_tokens=8))
+        assert not v.admitted and v.reason == "queue_full"
+        # Retry-After from the predicted drain rate, clamped sane
+        assert 1.0 <= v.retry_after_s <= 120.0
+        assert s.counters["serving/shed"] == 1
+        # shed request is NOT tracked — the queue stays bounded
+        assert s.request(9) is None
+        s.run_until_idle()
+        assert all(s.request(u).state == RequestState.FINISHED
+                   for u in range(2))
+
+    def test_draining_sheds_immediately(self, tiny_lm):
+        eng = _engine(tiny_lm)
+        s = LifecycleScheduler(eng)
+        s.start_drain()
+        v = s.submit(ServeRequest(uid=0, prompt=[3], max_new_tokens=4))
+        assert not v.admitted and v.reason == "draining"
+        assert s.health_state()[0] == "draining"
+
+    def test_impossible_request_rejected_not_wedged(self, tiny_lm):
+        """A whole-lifetime reservation that exceeds the pool is rejected
+        at the queue head; requests behind it still complete."""
+        eng = _engine(tiny_lm, num_blocks=4)      # pool holds 32 tokens
+        s = LifecycleScheduler(eng, window_steps=4)
+        s.submit(ServeRequest(uid=0, prompt=[2] * 30,
+                              max_new_tokens=16))  # needs 6 > 4 blocks
+        s.submit(ServeRequest(uid=1, prompt=[3, 5], max_new_tokens=4))
+        s.run_until_idle()
+        assert s.request(0).state == RequestState.FAILED
+        assert s.request(0).finish_reason == "impossible"
+        assert s.counters["serving/rejected"] == 1
+        assert s.request(1).state == RequestState.FINISHED
+        assert eng.state_manager.free_blocks == 4
+
+
+class TestDeadlinesAndCancellation:
+    def test_deadline_expires_mid_decode_and_reclaims_blocks(self, tiny_lm):
+        """A decoding request whose deadline passes is flushed at the next
+        window boundary — not when its generation would have finished —
+        and its blocks are immediately re-admittable."""
+        eng = _engine(tiny_lm, num_blocks=8)
+        clock = FakeClock()
+        s = LifecycleScheduler(eng, window_steps=2, clock=clock)
+        s.submit(ServeRequest(uid=0, prompt=[3, 5, 7, 11],
+                              max_new_tokens=32, deadline_s=5.0))
+        s.step()                                   # prefill → decoding
+        s.step()                                   # one 2-token window
+        produced_at_expiry = len(s.request(0).produced)
+        assert s.request(0).state == RequestState.DECODE
+        free_before = eng.state_manager.free_blocks
+        clock.advance(10.0)                        # past the deadline
+        s.step()                                   # expiry pass fires
+        req = s.request(0)
+        assert req.state == RequestState.EXPIRED
+        assert req.finish_reason == "deadline"
+        # flushed mid-stream: nowhere near the 32 requested tokens
+        assert len(req.produced) == produced_at_expiry < 32
+        assert s.counters["serving/deadline_expired"] == 1
+        assert eng.state_manager.free_blocks == 8 > free_before
+        # the freed blocks are re-admittable: a new request fills the pool
+        s.submit(ServeRequest(uid=1, prompt=[2] * 30, max_new_tokens=16))
+        s.run_until_idle()
+        assert s.request(1).state == RequestState.FINISHED
+
+    def test_ttft_timeout_expires_queued_request(self, tiny_lm):
+        eng = _engine(tiny_lm)
+        clock = FakeClock()
+        s = LifecycleScheduler(eng, clock=clock)
+        s.submit(ServeRequest(uid=0, prompt=[3, 5], max_new_tokens=4,
+                              ttft_timeout_s=2.0))
+        clock.advance(5.0)
+        s.step()
+        assert s.request(0).state == RequestState.EXPIRED
+        assert s.request(0).finish_reason == "ttft_timeout"
+        assert s.counters["serving/ttft_timeout"] == 1
+
+    def test_cancel_frees_blocks_for_readmission(self, tiny_lm):
+        """Client disconnect: flush + block reclaim, test-asserted that the
+        freed blocks are re-admittable."""
+        eng = _engine(tiny_lm, num_blocks=6)
+        # preemption off: this test isolates the cancel → reclaim →
+        # re-admit path (preemption would free the pool by itself)
+        s = LifecycleScheduler(eng, window_steps=2, preempt=False)
+        # 40 + 8 tokens → 6 blocks: the whole pool
+        s.submit(ServeRequest(uid=0, prompt=[2] * 40, max_new_tokens=8))
+        while s.request(0).state != RequestState.DECODE:
+            s.step()
+        assert eng.state_manager.free_blocks == 0
+        # a second request cannot be admitted while 0 holds the pool
+        s.submit(ServeRequest(uid=1, prompt=[3] * 40, max_new_tokens=8))
+        s.step()
+        assert s.request(1).state == RequestState.QUEUED
+        assert s.cancel(0)
+        s.step()                                  # cancellation pass fires
+        assert s.request(0).state == RequestState.CANCELLED
+        assert s.counters["serving/cancelled"] == 1
+        s.run_until_idle()                        # uid 1 reuses the blocks
+        assert s.request(1).state == RequestState.FINISHED
+        assert len(s.request(1).produced) == 8
+        assert eng.state_manager.free_blocks == 6
+
+    def test_cancel_unknown_or_terminal_is_noop(self, tiny_lm):
+        eng = _engine(tiny_lm)
+        s = LifecycleScheduler(eng)
+        assert not s.cancel(123)
+        s.submit(ServeRequest(uid=0, prompt=[3], max_new_tokens=2))
+        s.run_until_idle()
+        assert not s.cancel(0)
+
+
+class TestKVPressurePreemption:
+    @pytest.mark.parametrize("impl", ["gather", "paged"])
+    def test_preempt_and_resume_bit_exact(self, tiny_lm, impl):
+        """THE survivability acceptance property: a request preempted
+        under KV pressure and re-admitted via prefill recompute yields the
+        same greedy token stream as the same request run uninterrupted —
+        under both attention impls."""
+        def mk():
+            return _engine(tiny_lm, num_blocks=10, attn_impl=impl)
+
+        eng = mk()
+        s = LifecycleScheduler(eng, window_steps=4)
+        s.submit(ServeRequest(uid=0, prompt=[3, 5, 7, 11, 13],
+                              max_new_tokens=16))
+        s.run_until_idle()
+        ref = list(s.request(0).produced)
+
+        eng = mk()
+        s = LifecycleScheduler(eng, window_steps=4, kv_high_watermark=0.2)
+        s.submit(ServeRequest(uid=0, prompt=[3, 5, 7, 11, 13],
+                              max_new_tokens=16))
+        s.step()
+        s.step()                    # uid 0 decoding, holds 3 of 10 blocks
+        assert len(s.request(0).produced) > 1
+        # needs 8 blocks > 7 free → head blocked above the watermark
+        s.submit(ServeRequest(uid=1, prompt=[2] * 40, max_new_tokens=24))
+        s.run_until_idle()
+        assert s.counters["serving/preempted"] == 1
+        assert s.request(0).preempt_count == 1
+        assert s.request(0).state == RequestState.FINISHED
+        assert s.request(1).state == RequestState.FINISHED
+        assert list(s.request(0).produced) == ref     # bit-exact resume
+        assert eng.state_manager.free_blocks == 10    # pool fully reclaimed
+
+    def test_no_pingpong_livelock(self, tiny_lm):
+        """Two requests that cannot coexist must serialize, not evict each
+        other forever (the preempt_count anti-ping-pong rule)."""
+        eng = _engine(tiny_lm, num_blocks=10)
+        s = LifecycleScheduler(eng, window_steps=4, kv_high_watermark=0.2)
+        s.submit(ServeRequest(uid=0, prompt=[3] * 30, max_new_tokens=16))
+        s.step()
+        s.step()
+        s.submit(ServeRequest(uid=1, prompt=[2] * 40, max_new_tokens=24))
+        s.run_until_idle()          # raises on livelock / no progress
+        assert {s.request(u).state for u in (0, 1)} == \
+            {RequestState.FINISHED}
+        # bounded mutual eviction: strictly fewer preemptions than windows
+        assert s.counters["serving/preempted"] <= 2
+
+    def test_higher_priority_never_preempted_by_lower(self, tiny_lm):
+        eng = _engine(tiny_lm, num_blocks=10)
+        s = LifecycleScheduler(eng, window_steps=4, kv_high_watermark=0.2)
+        s.submit(ServeRequest(uid=0, prompt=[3] * 20, max_new_tokens=16,
+                              priority=5))
+        s.step()
+        s.step()
+        s.submit(ServeRequest(uid=1, prompt=[2] * 40, max_new_tokens=24,
+                              priority=0))
+        s.run_until_idle()
+        assert s.counters["serving/preempted"] == 0
+        assert s.request(0).preempt_count == 0
+        assert {s.request(u).state for u in (0, 1)} == \
+            {RequestState.FINISHED}
+
+
+class TestDecodeWatchdog:
+    @pytest.mark.parametrize("impl", ["gather", "paged"])
+    def test_nan_window_flushes_only_poisoned_request(self, tiny_lm, impl):
+        """decode_window/nan injection: ONE request is poisoned; it alone
+        is flushed (kernel-level NaN isolation extended to the scheduler),
+        the survivors' streams are bit-identical to an unperturbed run,
+        and the pool drains back to full."""
+        def run(fault=None):
+            injection.clear()
+            eng = _engine(tiny_lm, attn_impl=impl)
+            s = LifecycleScheduler(eng, window_steps=4)
+            for uid in range(3):
+                s.submit(ServeRequest(uid=uid, prompt=[3 + uid, 5, 7, 11],
+                                      max_new_tokens=8))
+            if fault:
+                injection.configure(fault)
+            s.run_until_idle()
+            injection.clear()
+            return s, eng
+
+        s_ref, _ = run()
+        refs = {u: list(s_ref.request(u).produced) for u in range(3)}
+        s, eng = run("site=decode_window,kind=nan,times=1")
+        failed = [u for u in range(3)
+                  if s.request(u).state == RequestState.FAILED]
+        assert len(failed) == 1
+        assert s.request(failed[0]).finish_reason == "nan"
+        assert s.counters["serving/nan_isolated"] == 1
+        assert s.last_incident_kind == "nan"
+        assert s.health_state()[0] == "degraded"
+        for u in range(3):
+            if u not in failed:
+                assert s.request(u).state == RequestState.FINISHED
+                assert list(s.request(u).produced) == refs[u]
+        assert eng.state_manager.free_blocks == \
+            eng.state_manager.allocator.total_blocks
+
+    def test_slow_window_raises_hang_incident(self, tiny_lm):
+        eng = _engine(tiny_lm)
+        s = LifecycleScheduler(eng, window_steps=4, hang_deadline_s=0.2)
+        # 13 = 1 (prefill) + 4 + 4 + 2 + 1 + 1: the SECOND 4-step window
+        # reuses the first's compiled loop, so it is hang-eligible
+        s.submit(ServeRequest(uid=0, prompt=[3, 5, 7], max_new_tokens=13))
+        s.step()                                  # prefill (no window yet)
+        s.step()                                  # first window: compile-
+        # polluted windows are exempt — only steady-state hangs count
+        assert s.counters["serving/window_hang"] == 0
+        injection.configure("site=decode_window,kind=slow,delay=0.4,times=1")
+        s.run_until_idle()
+        assert s.counters["serving/window_hang"] >= 1
+        assert s.last_incident_kind == "window_hang"
+        assert s.health_state()[0] == "degraded"
+
+    def test_kv_alloc_exhausted_is_transient_backpressure(self, tiny_lm):
+        """kv_alloc/exhausted injection: the admission reservation fails
+        once, the request stays queued, and the next iteration admits it —
+        the queue head is never wedged and the stream completes."""
+        eng = _engine(tiny_lm)
+        s = LifecycleScheduler(eng, window_steps=4)
+        s.submit(ServeRequest(uid=0, prompt=[3, 5, 7, 11],
+                              max_new_tokens=6))
+        injection.configure("site=kv_alloc,kind=exhausted,times=1")
+        s.step()
+        assert s.request(0).state == RequestState.QUEUED   # blocked once
+        s.run_until_idle()
+        ref = eng.generate([[3, 5, 7, 11]], max_new_tokens=6)[0]
+        assert s.request(0).state == RequestState.FINISHED
+        assert list(s.request(0).produced) == ref
+
+
+class TestDrain:
+    def test_drain_completes_inflight_and_expires_stragglers(self, tiny_lm):
+        eng = _engine(tiny_lm)
+        s = LifecycleScheduler(eng, window_steps=4)
+        s.submit(ServeRequest(uid=0, prompt=[3, 5, 7], max_new_tokens=4))
+        s.step()                                   # in flight
+        summary = s.drain(deadline_s=60.0)
+        assert summary["completed"] == 1 and summary["expired"] == 0
+        assert s.request(0).state == RequestState.FINISHED
+        assert not s.pending
+
+    def test_drain_deadline_expires_remaining(self, tiny_lm):
+        eng = _engine(tiny_lm)
+        clock = FakeClock()
+        s = LifecycleScheduler(eng, window_steps=2, clock=clock)
+        s.submit(ServeRequest(uid=0, prompt=[3, 5, 7],
+                              max_new_tokens=32))
+        s.step()
+        summary = s.drain(deadline_s=0.0)          # already past deadline
+        assert summary["expired"] == 1
+        assert s.request(0).state == RequestState.EXPIRED
+        assert s.request(0).finish_reason == "drain_deadline"
+        assert s.counters["serving/drain_expired"] == 1
+        assert eng.state_manager.free_blocks == \
+            eng.state_manager.allocator.total_blocks
+
+
+class TestMarkerRegistration:
+    def test_serving_chaos_marker_registered(self):
+        """serving_chaos is declared in tests/pytest.ini so the chaos
+        suite is selectable/excludable and --strict-markers runs stay
+        green (unmarked chaos files additionally fail collection via the
+        conftest marker lint)."""
+        import os
+
+        ini = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))),
+            "tests", "pytest.ini")
+        with open(ini) as f:
+            content = f.read()
+        assert "serving_chaos:" in content
+        assert "--strict-markers" in content

@@ -1,0 +1,226 @@
+"""dstpu-serve HTTP front end (marker: serving): /v1/generate blocking +
+SSE streaming, overload shedding as 429/503 + Retry-After, client
+disconnect → cancellation + block reclaim, /metrics counters, /healthz
+serving states, and in-process graceful drain."""
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import http.client
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (
+    InferenceEngineV2,
+    RaggedInferenceEngineConfig,
+)
+from deepspeed_tpu.inference.v2.lifecycle import (
+    LifecycleScheduler,
+    RequestState,
+)
+from deepspeed_tpu.inference.v2.server import ServingServer
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+pytestmark = pytest.mark.serving
+
+
+@pytest.fixture(scope="module")
+def serving():
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        max_tokens=16, max_seqs=4, max_ctx=96, block_size=8,
+        dtype=jnp.float32, attn_impl="gather"))
+    sched = LifecycleScheduler(eng, window_steps=4, max_queue=16,
+                               degraded_window_s=1.0)
+    srv = ServingServer(sched, port=0, bind="127.0.0.1").start()
+    yield srv, sched, eng
+    srv.stop()
+
+
+def _post(srv, body, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _get(srv, path, timeout=10):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}{path}", timeout=timeout) as r:
+            return r.status, r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+class TestGenerate:
+    def test_blocking_generate_matches_engine(self, serving):
+        srv, sched, eng = serving
+        code, _, out = _post(srv, {"prompt": [3, 5, 7, 11],
+                                   "max_new_tokens": 6})
+        assert code == 200
+        assert out["state"] == "finished"
+        assert out["finish_reason"] == "length"
+        ref = eng.generate([[3, 5, 7, 11]], max_new_tokens=6)[0]
+        assert out["tokens"] == ref
+        assert out["ttft_s"] is not None
+
+    def test_streaming_sse_yields_tokens_then_terminal(self, serving):
+        srv, sched, eng = serving
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/generate",
+            data=json.dumps({"prompt": [4, 5, 7, 11], "max_new_tokens": 9,
+                             "stream": True}).encode())
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers["Content-Type"].startswith("text/event-stream")
+            body = r.read().decode()
+        events = [json.loads(line[len("data: "):])
+                  for line in body.splitlines()
+                  if line.startswith("data: ")]
+        assert len(events) >= 2                      # chunks + terminal
+        streamed = [t for e in events for t in e["tokens"]]
+        ref = eng.generate([[4, 5, 7, 11]], max_new_tokens=9)[0]
+        assert streamed == ref
+        assert events[-1]["finish_reason"] == "length"
+        assert events[-1]["state"] == "finished"
+
+    def test_bad_body_is_400(self, serving):
+        srv, _, _ = serving
+        code, _, out = _post(srv, {"max_new_tokens": 4})
+        assert code == 400
+
+    def test_deadline_expiry_maps_to_504(self, serving):
+        srv, _, _ = serving
+        code, _, out = _post(srv, {"prompt": [3, 5], "max_new_tokens": 64,
+                                   "deadline_s": 0.0})
+        assert code == 504
+        assert out["state"] == "expired"
+
+    def test_client_disconnect_cancels_and_reclaims(self, serving):
+        """Dropping an SSE connection mid-stream cancels the request; its
+        KV blocks return to the pool."""
+        srv, sched, eng = serving
+        free0 = eng.state_manager.allocator.total_blocks
+        conn = http.client.HTTPConnection("127.0.0.1", srv.port, timeout=30)
+        conn.request("POST", "/v1/generate", body=json.dumps(
+            {"prompt": [5, 6, 7], "max_new_tokens": 80, "stream": True}),
+            headers={"Content-Type": "application/json"})
+        resp = conn.getresponse()
+        resp.read(64)                     # first bytes arrived; mid-stream
+        resp.close()                      # BOTH holders of the fd must
+        conn.close()                      # close for the FIN to go out
+        uid = max(sched._reqs)
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            req = sched.request(uid)
+            if req.state == RequestState.CANCELLED:
+                break
+            time.sleep(0.1)
+        assert sched.request(uid).state == RequestState.CANCELLED
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline and \
+                eng.state_manager.free_blocks != free0:
+            time.sleep(0.05)
+        assert eng.state_manager.free_blocks == free0
+        assert sched.counters["serving/cancelled"] >= 1
+
+
+class TestOverloadAndHealth:
+    def test_healthz_healthy(self, serving):
+        srv, _, _ = serving
+        code, body = _get(srv, "/healthz")
+        assert code == 200
+        assert json.loads(body)["status"] == "healthy"
+
+    def test_queue_full_is_429_with_retry_after(self, serving):
+        srv, sched, _ = serving
+        old_cap = sched.max_queue
+        sched.max_queue = 0               # every submission sheds
+        try:
+            code, headers, out = _post(srv, {"prompt": [3, 5],
+                                             "max_new_tokens": 4})
+            assert code == 429
+            assert out["reason"] == "queue_full"
+            assert int(headers["Retry-After"]) >= 1
+            # shedding flips /healthz to saturated (503 for dumb probers)
+            code, body = _get(srv, "/healthz")
+            assert code == 503
+            assert json.loads(body)["status"] == "saturated"
+        finally:
+            sched.max_queue = old_cap
+        time.sleep(1.2)                   # saturation decays (window 1s)
+        assert _get(srv, "/healthz")[0] == 200
+
+    def test_metrics_carries_serving_counters(self, serving):
+        srv, sched, _ = serving
+        code, text = _get(srv, "/metrics")
+        assert code == 200
+        # no telemetry hub in this fixture: counters rendered directly
+        assert "serving_requests" in text
+        assert "serving_shed" in text
+
+
+class TestDrainLast:
+    """Runs last in the module: draining is terminal for the fixture."""
+
+    def test_drain_completes_inflight_then_sheds_new(self, serving):
+        srv, sched, eng = serving
+        results = queue.Queue()
+
+        def long_request():
+            results.put(_post(srv, {"prompt": [6, 7, 8],
+                                    "max_new_tokens": 80}))
+
+        completed0 = sched.counters["serving/completed"]
+        requests0 = sched.counters["serving/requests"]
+        t = threading.Thread(target=long_request, daemon=True)
+        t.start()
+        # admission is observed via the monotonic requests counter — a
+        # fast request can finish BETWEEN polls of the transient `pending`
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and \
+                sched.counters["serving/requests"] == requests0:
+            time.sleep(0.02)
+        assert sched.counters["serving/requests"] > requests0
+
+        # flip draining synchronously BEFORE starting the stop thread:
+        # probing 503 against a racing drain_and_stop can land after the
+        # HTTP server already closed (connection reset instead of 503)
+        sched.start_drain()
+        code, _, out = _post(srv, {"prompt": [1, 2], "max_new_tokens": 4})
+        assert code == 503
+        assert out["reason"] == "draining"
+
+        drain_summary = {}
+
+        def drain():
+            drain_summary.update(srv.drain_and_stop(deadline_s=120))
+
+        dt = threading.Thread(target=drain, daemon=True)
+        dt.start()
+        dt.join(timeout=120)
+        assert not dt.is_alive()
+        # the in-flight request completed with its full stream
+        code, _, out = results.get(timeout=30)
+        assert code == 200
+        assert out["state"] == "finished"
+        assert len(out["tokens"]) == 80
+        # the in-flight request may finish in the gap between start_drain
+        # and drain_and_stop's own counter snapshot — measure the drain's
+        # effect at the test level, not from its summary alone
+        assert sched.counters["serving/completed"] - completed0 >= 1
+        assert drain_summary["expired"] == 0
+        assert eng.state_manager.free_blocks == \
+            eng.state_manager.allocator.total_blocks

@@ -61,6 +61,7 @@ class TestTwinFlow:
             assert len(diff) <= 1
             assert h.size > 0 and d.size > 0  # genuinely split, not moved
 
+    @pytest.mark.slow  # 15s: full twin-flow step; test_stage3_composes remains the tier-1 representative
     def test_step_parity_with_no_offload(self):
         batch = _batch()
         tf = _engine({"device": "cpu", "ratio": 0.3})

@@ -1,24 +1,41 @@
 #!/usr/bin/env python
-"""Smoke-check the serving engine end to end on the CPU sim.
+"""Smoke-check the serving stack end to end on the CPU sim.
 
-The TPU relay is frequently down, so `InferenceEngineV2` can rot for whole
+The TPU relay is frequently down, so the serving stack can rot for whole
 rounds without any silicon window noticing: an import error in the decode
-loop, a broken bucket key, or a kernel-dispatch regression only surfaces
-when someone finally gets a chip.  This check drives the real engine the
-way a server would — prefill a prompt through ``put()``, then a fused
-device-resident ``decode_batch`` window of 4 tokens — under BOTH attention
-impls (``paged`` fast path and the ``gather`` numerics oracle), asserting
-the two greedy token streams agree and the decode HBM roofline was
-recorded.  Enforced from ``tests/unit/test_serving_decode_smoke.py`` the
-same way the no-bare-print lint is.
+loop, a broken bucket key, a kernel-dispatch regression, or a lifecycle/
+drain regression only surfaces when someone finally gets a chip.  Three
+scenarios, all enforced from ``tests/unit/test_serving_decode_smoke.py``
+the same way the no-bare-print lint is:
 
-Usage: ``python tools/check_serving_smoke.py``
+  * ``decode``    — prefill through ``put()`` then a fused device-resident
+    4-token ``decode_batch`` window under BOTH attention impls (``paged``
+    fast path and the ``gather`` numerics oracle), asserting the greedy
+    streams agree and the decode HBM roofline was recorded.
+  * ``lifecycle`` — two requests through the LifecycleScheduler; one
+    deadline-expires mid-window (fake clock) and is flushed with its KV
+    blocks reclaimed; the survivor drains the exact token stream an
+    unperturbed run produces; the pool's free count returns to initial.
+  * ``drain``     — the real ``bin/dstpu-serve`` process: SIGTERM during
+    an active decode returns the in-flight request's completed response,
+    rejects new requests with 503 (Retry-After), reports ``draining`` on
+    ``/healthz``, and exits 0 within the drain deadline.
+
+Usage: ``python tools/check_serving_smoke.py [--scenario all|decode|lifecycle|drain]``
 Exit status 1 lists what broke.
 """
 from __future__ import annotations
 
+import argparse
+import json
 import os
+import signal
+import subprocess
 import sys
+import threading
+import time
+import urllib.error
+import urllib.request
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -28,26 +45,16 @@ if REPO_ROOT not in sys.path:
 DECODE_STEPS = 4
 
 
-def main(argv=None) -> int:
-    failures = []
+def scenario_decode(check):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
 
-    def check(name: str, ok: bool, detail: str = ""):
-        if not ok:
-            failures.append(f"{name}: {detail}")
-
-    try:
-        import jax
-        import jax.numpy as jnp
-        import numpy as np
-
-        from deepspeed_tpu.inference.v2.engine_v2 import (
-            InferenceEngineV2,
-            RaggedInferenceEngineConfig,
-        )
-        from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
-    except Exception as exc:  # noqa: BLE001
-        print(f"serving stack import failed: {exc!r}")
-        return 1
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2,
+        RaggedInferenceEngineConfig,
+    )
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 
     cfg = TransformerConfig.tiny(use_flash=False)
     model = CausalLM(cfg)
@@ -82,6 +89,213 @@ def main(argv=None) -> int:
         check("paged and gather decode the same greedy stream",
               streams["paged"] == streams["gather"],
               f"paged={streams.get('paged')} gather={streams.get('gather')}")
+
+
+def scenario_lifecycle(check):
+    """Admit two → deadline-expire one mid-window → survivor drains the
+    unperturbed token stream → every block reclaimed."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2,
+        RaggedInferenceEngineConfig,
+    )
+    from deepspeed_tpu.inference.v2.lifecycle import (
+        LifecycleScheduler,
+        RequestState,
+        ServeRequest,
+    )
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+
+    def mk():
+        return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            max_tokens=16, max_seqs=4, max_ctx=64, block_size=8,
+            dtype=jnp.float32, attn_impl="gather"))
+
+    clock = {"t": 1000.0}
+
+    try:
+        # unperturbed survivor stream
+        eng = mk()
+        s = LifecycleScheduler(eng, window_steps=2,
+                               clock=lambda: clock["t"])
+        s.submit(ServeRequest(uid=1, prompt=[4, 6, 8], max_new_tokens=8))
+        s.run_until_idle()
+        ref = list(s.request(1).produced)
+
+        eng = mk()
+        pool = eng.state_manager.free_blocks
+        s = LifecycleScheduler(eng, window_steps=2,
+                               clock=lambda: clock["t"])
+        s.submit(ServeRequest(uid=0, prompt=[3, 5, 7, 11],
+                              max_new_tokens=32, deadline_s=5.0))
+        s.submit(ServeRequest(uid=1, prompt=[4, 6, 8], max_new_tokens=8))
+        s.step()                                  # both prefill → decode
+        s.step()                                  # one shared window
+        check("lifecycle: victim decoding before expiry",
+              s.request(0).state == RequestState.DECODE,
+              f"state={s.request(0).state}")
+        clock["t"] += 10.0                        # blow the deadline
+        s.run_until_idle()
+        check("lifecycle: victim expired mid-stream",
+              s.request(0).state == RequestState.EXPIRED
+              and len(s.request(0).produced) < 32,
+              f"state={s.request(0).state} "
+              f"produced={len(s.request(0).produced)}")
+        check("lifecycle: deadline counter",
+              s.counters.get("serving/deadline_expired") == 1,
+              f"counters={dict(s.counters)}")
+        check("lifecycle: survivor stream matches unperturbed run",
+              s.request(1).state == RequestState.FINISHED
+              and list(s.request(1).produced) == ref,
+              f"got={s.request(1).produced} want={ref}")
+        check("lifecycle: all blocks reclaimed",
+              eng.state_manager.free_blocks == pool,
+              f"free={eng.state_manager.free_blocks} want={pool}")
+    except Exception as exc:  # noqa: BLE001
+        check("lifecycle scenario", False, repr(exc)[-300:])
+
+
+def _http(method, url, body=None, timeout=30):
+    req = urllib.request.Request(url, method=method,
+                                 data=json.dumps(body).encode()
+                                 if body is not None else None)
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def scenario_drain(check):
+    """SIGTERM the real dstpu-serve during an active decode."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO_ROOT, "bin", "dstpu-serve"),
+         "--port", "0", "--bind", "127.0.0.1", "--max-tokens", "16",
+         "--max-seqs", "4", "--max-ctx", "96", "--block-size", "8",
+         "--window-steps", "4", "--drain-deadline", "60",
+         "--telemetry-dir", "/tmp/dstpu_serve_smoke_tel"],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    port = None
+    try:
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break
+            if "dstpu-serve listening on" in line:
+                port = int(line.rsplit(":", 1)[1])
+                break
+        check("drain: server came up", port is not None)
+        if port is None:
+            return
+        # keep draining the child's stdout: a full pipe buffer blocks the
+        # child's next log write — including the drain handler's own log
+        # line — wedging the very shutdown path under test
+        tail = []
+
+        def _pump():
+            for line in proc.stdout:
+                tail.append(line)
+                del tail[:-50]
+
+        threading.Thread(target=_pump, daemon=True).start()
+        base = f"http://127.0.0.1:{port}"
+        code, body = _http("GET", f"{base}/healthz")
+        check("drain: healthz healthy before", code == 200
+              and body.get("status") == "healthy", f"{code} {body}")
+
+        result = {}
+
+        def long_request():
+            result["resp"] = _http(
+                "POST", f"{base}/v1/generate",
+                {"prompt": [5, 6, 7], "max_new_tokens": 64}, timeout=150)
+
+        t = threading.Thread(target=long_request, daemon=True)
+        t.start()
+        # wait until the request is genuinely in flight (admitted counter)
+        deadline = time.monotonic() + 60
+        inflight = False
+        while time.monotonic() < deadline and not inflight:
+            code, body = _http("GET", f"{base}/healthz")
+            inflight = (body.get("pending") or 0) >= 1
+            time.sleep(0.1)
+        check("drain: request in flight before SIGTERM", inflight)
+
+        proc.send_signal(signal.SIGTERM)
+        # /healthz flips to draining (503) while the decode finishes
+        saw_draining = False
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and not saw_draining:
+            try:
+                code, body = _http("GET", f"{base}/healthz", timeout=5)
+            except Exception:  # noqa: BLE001 — server may already be gone
+                break
+            saw_draining = code == 503 and body.get("status") == "draining"
+            time.sleep(0.05)
+        check("drain: healthz reported draining", saw_draining)
+        # new requests are shed with 503 + Retry-After while draining
+        try:
+            code, body = _http("POST", f"{base}/v1/generate",
+                               {"prompt": [1, 2], "max_new_tokens": 4},
+                               timeout=10)
+            check("drain: new request shed with 503",
+                  code == 503 and body.get("reason") == "draining",
+                  f"{code} {body}")
+        except Exception as exc:  # noqa: BLE001
+            check("drain: new request shed with 503", False,
+                  f"server unreachable during drain: {exc!r}")
+
+        rc = proc.wait(timeout=90)
+        check("drain: exit 0 within the drain deadline", rc == 0,
+              f"rc={rc}")
+        t.join(timeout=30)
+        code, resp = result.get("resp", (None, None))
+        check("drain: in-flight request completed",
+              code == 200 and resp and resp.get("state") == "finished"
+              and len(resp.get("tokens") or []) == 64,
+              f"code={code} resp={str(resp)[:200]}")
+    except Exception as exc:  # noqa: BLE001
+        check("drain scenario", False, repr(exc)[-300:])
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--scenario", default="all",
+                   choices=["all", "decode", "lifecycle", "drain"])
+    args = p.parse_args(argv)
+
+    failures = []
+
+    def check(name: str, ok: bool, detail: str = ""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+
+    try:
+        import jax  # noqa: F401 — fail fast with a clear import error
+
+        import deepspeed_tpu.inference.v2.engine_v2  # noqa: F401
+    except Exception as exc:  # noqa: BLE001
+        print(f"serving stack import failed: {exc!r}")
+        return 1
+
+    if args.scenario in ("all", "decode"):
+        scenario_decode(check)
+    if args.scenario in ("all", "lifecycle"):
+        scenario_lifecycle(check)
+    if args.scenario in ("all", "drain"):
+        scenario_drain(check)
 
     if failures:
         print("\n".join(failures))
