@@ -14,7 +14,7 @@ a parseable JSON result instead of a crash.
 
 Env knobs: DSTPU_BENCH_LAYERS / HIDDEN / SEQ / BATCH / STEPS,
 DSTPU_BENCH_MODE (train | flash_sweep | serving | serving_load |
-decode_sweep | overlap_sweep | ...), DSTPU_BENCH_FORCE_CPU=1,
+decode_sweep | overlap_sweep | comm_sweep | ...), DSTPU_BENCH_FORCE_CPU=1,
 DSTPU_BENCH_PROBE_TIMEOUT (seconds, default 300); serving modes also read
 DSTPU_BENCH_CTX (context length), DSTPU_BENCH_CHUNK (splitfuse chunk) and
 DSTPU_BENCH_SEQS (decode batch width); decode_sweep reads
@@ -31,7 +31,7 @@ import sys
 import time
 
 if os.environ.get("DSTPU_BENCH_MODE") == "pipeline" or (
-        os.environ.get("DSTPU_BENCH_MODE") == "overlap_sweep"
+        os.environ.get("DSTPU_BENCH_MODE") in ("overlap_sweep", "comm_sweep")
         and os.environ.get("DSTPU_BENCH_FORCE_CPU") == "1"):
     # pipeline bubbles (and the CPU fallback of the overlap sweep) are
     # schedule properties measured on the CPU-sim mesh (the chip tunnel is
@@ -1081,6 +1081,226 @@ def run_overlap_sweep(on_tpu: bool) -> None:
           "n_devices": len(jax.devices())})
 
 
+def run_comm_sweep(on_tpu: bool) -> None:
+    """DSTPU_BENCH_MODE=comm_sweep — flat-vs-2hop × wire-format ×
+    bucket-size grid over the production gradient-exchange seam
+    (``runtime/comm/hierarchical.exchange_leaves`` / ``two_hop_allreduce``
+    — the same functions comm_path's explicit wire calls), CPU-safe on the
+    8-virtual-device sim like overlap_sweep/decode_sweep.
+
+    Per point: ms/step of the jitted shard_map exchange plus
+    predicted-vs-measured collective operand bytes (measured = jaxpr
+    inspection via ``fused_wire.wire_ops``; predicted =
+    ``hierarchical.predict_operand_bytes``).  The CollectiveAlgoSelector
+    then picks a config twice per bucket size — analytically from the
+    roofline table, and re-tuned from the measured table — and the emitted
+    extra records whether the re-tuned pick is the measured-fastest
+    (``selector_agrees``; the check_comm_sweep gate asserts it).
+
+    Env: DSTPU_BENCH_SWEEP_MB (payload, default 8), DSTPU_BENCH_SWEEP_ALGOS,
+    DSTPU_BENCH_SWEEP_WIRES, DSTPU_BENCH_SWEEP_BUCKETS_MB (comma lists),
+    DSTPU_BENCH_SWEEP_STEPS, DSTPU_BENCH_SWEEP_SHARD (intra-slice size of
+    the simulated 2-slice mesh), DSTPU_BENCH_SWEEP_FRAC (exposed-comm
+    fraction fed to the analytic selection, default 0.5)."""
+    from jax.sharding import PartitionSpec as P
+
+    from deepspeed_tpu.runtime.comm import hierarchical as hier
+    from deepspeed_tpu.runtime.comm.fused_wire import (
+        fused_quantized_allreduce, wire_ops)
+    from deepspeed_tpu.runtime.comm_path import loco_partition_size
+    from deepspeed_tpu.runtime.topology import (DATA, DATA_OUTER,
+                                                TopologyConfig,
+                                                compat_shard_map,
+                                                initialize_mesh)
+    from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+
+    n_dev = len(jax.devices())
+    shard = env_int("DSTPU_BENCH_SWEEP_SHARD", max(n_dev // 2, 1))
+    if n_dev > 1 and shard > 0 and n_dev % shard == 0 and n_dev // shard > 1:
+        # simulate a 2-slice job: data_outer crosses "DCN"
+        topo = initialize_mesh(TopologyConfig(zero_shard_size=shard),
+                               force=True)
+        topo.set_cross_slice_axes((DATA_OUTER,))
+    else:
+        topo = initialize_mesh(TopologyConfig(), force=True)
+    data_axes = tuple(a for a in (DATA_OUTER, DATA) if topo.dims[a] > 1)
+    if not data_axes:
+        emit("comm_sweep_exchange_ms", 0.0, "ms/step", 0.0,
+             {"error": "comm_sweep needs a multi-device mesh "
+                       f"(found {n_dev} devices)"})
+        return
+    intra, inter = hier.hop_axes(topo, data_axes)
+    n_i = int(np.prod([topo.dims[a] for a in intra])) if intra else 1
+    n_x = int(np.prod([topo.dims[a] for a in inter])) if inter else 1
+    n = n_i * n_x
+    log(f"comm_sweep mesh {dict(topo.dims)} intra={intra}({n_i}) "
+        f"inter={inter}({n_x})")
+
+    mb = float(os.environ.get("DSTPU_BENCH_SWEEP_MB", "8"))
+    total = max(int(mb * (1 << 20) / 4), 8192)
+    # transformer-ish leaf mix: one big stacked-layer leaf, a few medium,
+    # many small norm/bias leaves
+    sizes = [total // 2, total // 4] + [total // 16] * 3 + \
+        [max(total // 64, 256)] * 4
+    rng_l = np.random.default_rng(0)
+    leaves = [jnp.asarray(rng_l.normal(size=(s,)), jnp.float32)
+              for s in sizes]
+    payload = sum(int(x.size) * 4 for x in leaves)
+
+    algos = [a for a in os.environ.get(
+        "DSTPU_BENCH_SWEEP_ALGOS", "flat,2hop").split(",") if a]
+    if not (intra and inter):
+        algos = [a for a in algos if a != "2hop"]
+    wires = [w for w in os.environ.get(
+        "DSTPU_BENCH_SWEEP_WIRES", "fp,int8,int4_loco").split(",") if w]
+    buckets = [int(float(b) * (1 << 20)) for b in os.environ.get(
+        "DSTPU_BENCH_SWEEP_BUCKETS_MB", "1,4").split(",") if b]
+    steps = env_int("DSTPU_BENCH_SWEEP_STEPS", 3)
+    manual = set(data_axes)
+
+    def build(algo, wire, bucket):
+        bits = hier.WIRE_BITS[wire]
+        if wire == "int4_loco":
+            errors = []
+            for x in leaves:
+                if algo == "2hop":
+                    wlen, slen = hier.two_hop_loco_sizes(int(x.size), n_i,
+                                                         n_x)
+                else:
+                    wlen = int(x.size)
+                    slen = loco_partition_size(int(x.size), n)
+                errors.append((jnp.zeros((wlen,), jnp.float32),
+                               jnp.zeros((slen,), jnp.float32)))
+
+            def body(ls, errs):
+                outs, new_errs = [], []
+                for g, (ew, es) in zip(ls, errs):
+                    if algo == "2hop":
+                        out, ne, nse = hier.two_hop_allreduce(
+                            g, intra, inter, wire_bits=bits,
+                            error=ew, server_error=es)
+                    else:
+                        out, ne, nse = fused_quantized_allreduce(
+                            g, data_axes, bits=bits, error=ew,
+                            server_error=es)
+                    outs.append(out)
+                    new_errs.append((ne, nse))
+                return outs, new_errs
+
+            mapped = compat_shard_map(
+                body, mesh=topo.mesh, in_specs=(P(), P()),
+                out_specs=(P(), P()), manual_axes=manual)
+            return mapped, (leaves, errors)
+
+        def body(ls):
+            outs, _ = hier.exchange_leaves(
+                ls, data_axes, intra, inter, algo, bits,
+                bucket_bytes=bucket, n=n)
+            return outs
+
+        mapped = compat_shard_map(body, mesh=topo.mesh, in_specs=(P(),),
+                                  out_specs=P(), manual_axes=manual)
+        return mapped, (leaves,)
+
+    points = []
+    for algo in algos:
+        for wire in wires:
+            # the LoCo wire runs per-leaf (residual state per leaf), so
+            # bucket size never reaches its program — measure it once and
+            # record bucket_bytes=0 (bucket-independent) instead of
+            # re-compiling an identical computation per bucket size
+            for bucket in ([0] if wire == "int4_loco" else buckets):
+                try:
+                    mapped, args = build(algo, wire, bucket)
+                    fn = jax.jit(mapped)
+                    traced = jax.make_jaxpr(mapped)(*args)
+                    measured_bytes = sum(
+                        o["bytes"] for o in wire_ops(traced))
+                    out = fn(*args)          # compile + warmup
+                    jax.block_until_ready(out)
+                    t0 = time.perf_counter()
+                    for _ in range(steps):
+                        out = fn(*args)
+                    jax.block_until_ready(out)
+                    dt = (time.perf_counter() - t0) / steps
+                except Exception as exc:  # noqa: BLE001 — record, keep going
+                    log(f"comm_sweep point {algo}/{wire}/{bucket}: "
+                        f"FAILED {exc!r}")
+                    points.append({"algo": algo, "wire": wire,
+                                   "bucket_bytes": bucket,
+                                   "error": str(exc)[-200:]})
+                    continue
+                predicted_bytes = hier.predict_operand_bytes(
+                    payload, algo, wire, n_i, n_x)["total"]
+                points.append({
+                    "algo": algo, "wire": wire, "bucket_bytes": bucket,
+                    "ms": round(dt * 1e3, 3),
+                    "measured_wire_bytes": int(measured_bytes),
+                    "predicted_wire_bytes": int(predicted_bytes),
+                })
+                log(f"comm_sweep {algo}/{wire} bucket={bucket>>20}MiB: "
+                    f"{dt*1e3:.2f} ms, wire bytes measured="
+                    f"{measured_bytes} predicted={int(predicted_bytes)}")
+
+    ok = [p for p in points if "ms" in p]
+    if not ok:
+        emit("comm_sweep_exchange_ms", 0.0, "ms/step", 0.0,
+             {"error": "every sweep point failed", "points": points})
+        return
+
+    sel = hier.CollectiveAlgoSelector.from_topology(
+        topo, data_axes, allow_quantized=("int8" in wires),
+        allow_loco=("int4_loco" in wires))
+    frac = float(os.environ.get("DSTPU_BENCH_SWEEP_FRAC", "0.5"))
+    selections = []
+    for bucket in buckets:
+        # bucket-independent (bucket_bytes=0, the per-leaf LoCo wire)
+        # points join every bucket's table
+        tbl = {f"{p['algo']}/{p['wire']}": p["ms"] for p in ok
+               if p["bucket_bytes"] in (bucket, 0)}
+        if not tbl:
+            continue
+        analytic = sel.select(bucket, exposed_comm_fraction=frac)
+        retuned = sel.select(bucket, measured_ms=tbl)
+        fastest = min(tbl, key=tbl.get)
+        selections.append({
+            "bucket_bytes": bucket,
+            "analytic": f"{analytic.algo}/{analytic.wire}",
+            "retuned": f"{retuned.algo}/{retuned.wire}",
+            "measured_fastest": fastest,
+            "selector_agrees":
+                f"{retuned.algo}/{retuned.wire}" == fastest,
+            "measured_ms": tbl,
+        })
+
+    # publish the re-tuned choice the way the overlap manager does
+    reg = MetricsRegistry()
+    final = selections[-1] if selections else None
+    if final is not None:
+        algo, wire = final["retuned"].split("/")
+        reg.gauge("comm/algo_2hop").set(1.0 if algo == "2hop" else 0.0)
+        reg.gauge("comm/wire_bits").set(float(hier.WIRE_BITS[wire]))
+        reg.gauge("comm/predicted_exchange_ms").set(
+            float(sel.predict_ms(final["bucket_bytes"], algo, wire)))
+        reg.gauge("comm/predicted_wire_bytes").set(
+            float(sel.predict_wire_bytes(final["bucket_bytes"], algo,
+                                         wire)))
+
+    base = min((p["ms"] for p in ok
+                if p["algo"] == "flat" and p["wire"] == "fp"),
+               default=None)
+    best = min(ok, key=lambda p: p["ms"])
+    emit("comm_sweep_exchange_ms", best["ms"], "ms/step",
+         round((base or best["ms"]) / max(best["ms"], 1e-9), 4),
+         {"points": points, "selections": selections,
+          "payload_bytes": payload,
+          "mesh": {k: int(v) for k, v in topo.dims.items()},
+          "intra": list(intra), "inter": list(inter),
+          "comm_gauges": reg.gauge_values(),
+          "best_config": f"{best['algo']}/{best['wire']}",
+          "backend": jax.default_backend(), "n_devices": n_dev})
+
+
 def main():
     global _ON_TPU
     mode = os.environ.get("DSTPU_BENCH_MODE", "train")
@@ -1103,6 +1323,7 @@ def main():
         "pipeline": ("pipeline_bubble_fraction", "fraction"),
         "offload": ("offload_step_ms", "ms/step"),
         "overlap_sweep": ("overlap_step_ms", "ms/step"),
+        "comm_sweep": ("comm_sweep_exchange_ms", "ms/step"),
     }.get(mode, ("zero_train_tokens_per_sec_per_chip", "tokens/s/chip"))
     try:
         backend = jax.default_backend()
@@ -1128,6 +1349,8 @@ def main():
             run_offload_bench(on_tpu)
         elif mode == "overlap_sweep":
             run_overlap_sweep(on_tpu)
+        elif mode == "comm_sweep":
+            run_comm_sweep(on_tpu)
         else:
             run_train_bench(on_tpu, reason)
     except Exception as exc:  # noqa: BLE001

@@ -257,6 +257,36 @@ def dispatch_sparse(slot: jnp.ndarray, tokens: jnp.ndarray, num_experts: int,
     return flat[:EC].reshape(num_experts, capacity, D)
 
 
+def _pin_replicated(x: jnp.ndarray) -> jnp.ndarray:
+    """Constrain ``x`` fully replicated on the global mesh (no-op on a
+    trivial mesh or inside a manual shard_map region).
+
+    Guards the sparse combine's gather against a GSPMD miscompile: with
+    ``expert_out`` sharded on the expert axis and slots/tokens carrying a
+    batch sharding, GSPMD partitions ``jnp.take`` into per-shard gathers
+    and sums the partial contributions over EVERY replica group — including
+    the pure data-replica groups — so the combined output comes back
+    multiplied by the data-axis size (observed exactly 4x on an 8-device
+    data4×expert2 mesh; same bug class PR 8 fixed in ``paged_kv_append``'s
+    row-scatter).  Replicating the gather operand first makes the gather
+    local and keeps the cross-expert exchange as one explicit all-gather.
+    """
+    from ..runtime import topology as _topo
+
+    topo = _topo._TOPOLOGY
+    if topo is None or topo.mesh.size <= 1:
+        return x
+    _, manual = _topo.shard_map_context(topo)
+    if manual:
+        # inside a partial-manual region constraint specs may not name
+        # manual axes; the manual body already owns its collectives
+        return x
+    from jax.sharding import NamedSharding
+
+    return jax.lax.with_sharding_constraint(
+        x, NamedSharding(topo.mesh, P()))
+
+
 def combine_sparse(slot: jnp.ndarray, gate_val: jnp.ndarray,
                    expert_out: jnp.ndarray, dtype) -> jnp.ndarray:
     """[S,k] slots + weights × [E,C,D] expert outputs → [S,D] via gather."""
@@ -264,6 +294,7 @@ def combine_sparse(slot: jnp.ndarray, gate_val: jnp.ndarray,
     flat = jnp.concatenate(
         [expert_out.reshape(E * C, D),
          jnp.zeros((1, D), expert_out.dtype)], axis=0)
+    flat = _pin_replicated(flat)
     out = None
     for choice in range(slot.shape[1]):
         contrib = gate_val[:, choice, None].astype(dtype) * \

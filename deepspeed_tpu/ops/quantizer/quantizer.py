@@ -120,6 +120,152 @@ def dequantize_int4(packed: jnp.ndarray, scales: jnp.ndarray, shape=None,
     return flat.astype(dtype)
 
 
+# --------------------------------------------------------------------- #
+# Fused wire kernels (EQuARX-style: scale + quantize + nibble-pack in ONE
+# Pallas kernel so the collective's operand is produced directly as wire
+# bytes — no intermediate full-precision materialization between the
+# quantize and the exchange, and no separate jnp-level pack pass that XLA
+# won't fuse on TPU).  int4 uses a HALF-SPLIT pack (element i pairs with
+# i + group_size/2) instead of the even/odd interleave above: contiguous
+# lane slices lower cleanly in Mosaic where a stride-2 lane gather does
+# not.  Pack∘unpack is the identity either way, so dequantized VALUES are
+# bit-identical to the unfused path; only the wire byte layout differs.
+# --------------------------------------------------------------------- #
+def wire_width(bits: int, group_size: int) -> int:
+    """Wire bytes per group (int8: one byte per value; int4: two values
+    per byte)."""
+    return group_size if bits == 8 else group_size // 2
+
+
+def _quant_pack8_kernel(x_ref, w_ref, s_ref):
+    x = x_ref[:].astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x), axis=1, keepdims=True) / 127.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    w_ref[:] = jnp.clip(jnp.round(x / scale), -127, 127).astype(jnp.int8)
+    s_ref[:] = scale
+
+
+def _quant_pack4_kernel(x_ref, w_ref, s_ref):
+    x = x_ref[:].astype(jnp.float32)
+    scale = jnp.max(jnp.abs(x), axis=1, keepdims=True) / 7.0
+    scale = jnp.where(scale == 0, 1.0, scale)
+    q = jnp.clip(jnp.round(x / scale), -7, 7).astype(jnp.int8)
+    half = q.shape[1] // 2
+    lo = q[:, :half] & 0x0F
+    hi = (q[:, half:] & 0x0F) << 4
+    w_ref[:] = (lo | hi).astype(jnp.int8)
+    s_ref[:] = scale
+
+
+def _unpack_wire(w: jnp.ndarray, bits: int) -> jnp.ndarray:
+    """Wire bytes [rows, W] → int8 values [rows, group_size] (half-split
+    layout for int4; identity for int8)."""
+    if bits == 8:
+        return w
+    lo = (w << 4).astype(jnp.int8) >> 4          # sign-extend low nibble
+    hi = w >> 4                                  # arithmetic shift keeps sign
+    return jnp.concatenate([lo, hi], axis=1)
+
+
+def _block_rows(groups: int, group_size: int) -> int:
+    return min(groups, max(8, 4096 // max(group_size // 128, 1)))
+
+
+def quant_pack_wire(x: jnp.ndarray, bits: int,
+                    group_size: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """x (any shape) → (wire int8 [groups, wire_width], scales f32
+    [groups, 1]) in ONE kernel.  Flattens; pads the tail group with zeros.
+    Scale/round math is identical to :func:`quantize_int8` /
+    :func:`quantize_int4`, so dequantized values round-trip bit-identically
+    to the unfused pair."""
+    assert bits in (4, 8), bits
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    groups = -(-n // group_size)
+    pad = groups * group_size - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    xg = flat.reshape(groups, group_size)
+    W = wire_width(bits, group_size)
+    block_rows = _block_rows(groups, group_size)
+    kernel = _quant_pack8_kernel if bits == 8 else _quant_pack4_kernel
+    return pl.pallas_call(
+        kernel,
+        grid=(-(-groups // block_rows),),
+        in_specs=[pl.BlockSpec((block_rows, group_size), lambda i: (i, 0))],
+        out_specs=[pl.BlockSpec((block_rows, W), lambda i: (i, 0)),
+                   pl.BlockSpec((block_rows, 1), lambda i: (i, 0))],
+        out_shape=[jax.ShapeDtypeStruct((groups, W), jnp.int8),
+                   jax.ShapeDtypeStruct((groups, 1), jnp.float32)],
+        interpret=_interpret(),
+    )(xg)
+
+
+def unpack_dequant_wire(w: jnp.ndarray, scales: jnp.ndarray, bits: int,
+                        shape=None, dtype=jnp.float32) -> jnp.ndarray:
+    """(wire [groups, W], scales [groups, 1]) → values, unpack + dequant in
+    one kernel.  Inverse of :func:`quant_pack_wire`."""
+    assert bits in (4, 8), bits
+    groups, W = w.shape
+    group_size = W if bits == 8 else W * 2
+
+    def kernel(w_ref, s_ref, out_ref):
+        out_ref[:] = _unpack_wire(w_ref[:], bits).astype(jnp.float32) * s_ref[:]
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(-(-groups // _block_rows(groups, group_size)),),
+        in_specs=[pl.BlockSpec((_block_rows(groups, group_size), W),
+                               lambda i: (i, 0)),
+                  pl.BlockSpec((_block_rows(groups, group_size), 1),
+                               lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((_block_rows(groups, group_size), group_size),
+                               lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((groups, group_size), jnp.float32),
+        interpret=_interpret(),
+    )(w, scales)
+    flat = out.reshape(-1)
+    if shape is not None:
+        flat = flat[:int(np.prod(shape))].reshape(shape)
+    return flat.astype(dtype)
+
+
+def unpack_dequant_mean(w: jnp.ndarray, scales: jnp.ndarray, bits: int,
+                        n: int) -> jnp.ndarray:
+    """Fused unpack + dequant + mean over the peer axis: (wire
+    [n, groups, W], scales [n, groups, 1]) → f32 [groups * group_size].
+
+    This is the receive side of a quantized reduce-scatter — each of the
+    ``n`` peers contributed a quantized copy of MY partition; one kernel
+    dequantizes and mean-reduces them without materializing the n
+    full-precision copies in HBM.  The reduction is ``sum(axis=0) / n``,
+    the same lax reduction ``jnp.mean`` lowers to, so the result is
+    bit-identical to dequantize-then-``jnp.mean``."""
+    assert bits in (4, 8), bits
+    n_, groups, W = w.shape
+    assert n_ == n, (n_, n)
+    group_size = W if bits == 8 else W * 2
+    block_rows = _block_rows(groups, group_size)
+
+    def kernel(w_ref, s_ref, out_ref):
+        wv = w_ref[:]                              # [n, rows, W]
+        rows = wv.shape[1]
+        vals = _unpack_wire(wv.reshape(n * rows, W), bits).astype(jnp.float32)
+        vals = vals * s_ref[:].reshape(n * rows, 1)
+        out_ref[:] = jnp.sum(vals.reshape(n, rows, group_size), axis=0) / n
+
+    out = pl.pallas_call(
+        kernel,
+        grid=(-(-groups // block_rows),),
+        in_specs=[pl.BlockSpec((n, block_rows, W), lambda i: (0, i, 0)),
+                  pl.BlockSpec((n, block_rows, 1), lambda i: (0, i, 0))],
+        out_specs=pl.BlockSpec((block_rows, group_size), lambda i: (i, 0)),
+        out_shape=jax.ShapeDtypeStruct((groups, group_size), jnp.float32),
+        interpret=_interpret(),
+    )(w, scales)
+    return out.reshape(-1)
+
+
 def get_quant_fns(bits: int):
     """(quantize, dequantize) pair for a bit width — the ONE dispatch table
     (used by ZeRO++ comm, weight-only serving, and the Quantizer class)."""

@@ -29,6 +29,11 @@ class DeviceSpec:
     #: direction) — the denominator for per-collective bus-bandwidth
     #: "% of peak" in the comm table
     ici_bandwidth: float = 0.0
+    #: approximate DCN bytes/s per chip (cross-slice data-center network;
+    #: the slow domain of the 2-hop hierarchical collectives).  Order of
+    #: magnitude below ICI on every generation — which is exactly why the
+    #: CollectiveAlgoSelector quantizes the inter-slice hop.
+    dcn_bandwidth: float = 0.0
 
     @property
     def ridge_intensity(self) -> float:
@@ -38,18 +43,18 @@ class DeviceSpec:
 
 #: ordered: first substring match against device_kind wins
 DEVICE_SPECS = (
-    DeviceSpec("TPU v6 lite", 918e12, 1640e9, 448e9),   # Trillium
-    DeviceSpec("TPU v6", 918e12, 1640e9, 448e9),
-    DeviceSpec("TPU v5p", 459e12, 2765e9, 600e9),
-    DeviceSpec("TPU v5 lite", 197e12, 819e9, 200e9),    # v5e → "v5 lite"
-    DeviceSpec("TPU v5e", 197e12, 819e9, 200e9),
-    DeviceSpec("TPU v4", 275e12, 1228e9, 300e9),
-    DeviceSpec("TPU v3", 123e12, 900e9, 82e9),
+    DeviceSpec("TPU v6 lite", 918e12, 1640e9, 448e9, 25e9),   # Trillium
+    DeviceSpec("TPU v6", 918e12, 1640e9, 448e9, 25e9),
+    DeviceSpec("TPU v5p", 459e12, 2765e9, 600e9, 25e9),
+    DeviceSpec("TPU v5 lite", 197e12, 819e9, 200e9, 12.5e9),  # v5e → "v5 lite"
+    DeviceSpec("TPU v5e", 197e12, 819e9, 200e9, 12.5e9),
+    DeviceSpec("TPU v4", 275e12, 1228e9, 300e9, 12.5e9),
+    DeviceSpec("TPU v3", 123e12, 900e9, 82e9, 6e9),
 )
 
 #: conservative stand-in so CPU smoke runs produce finite (clearly labelled)
 #: utilization numbers instead of dividing by zero
-CPU_FALLBACK = DeviceSpec("cpu", 1e12, 100e9, 10e9)
+CPU_FALLBACK = DeviceSpec("cpu", 1e12, 100e9, 10e9, 1e9)
 
 
 def spec_for_kind(kind: str) -> DeviceSpec:

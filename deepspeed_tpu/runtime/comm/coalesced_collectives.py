@@ -53,16 +53,29 @@ def reduce_scatter_coalesced(tensors: Sequence[jnp.ndarray], axes=("data",)
 
 
 def quantized_reduce_scatter(tensor: jnp.ndarray, axes=("data",),
-                             bits: int = 4, group_size: int = 256) -> jnp.ndarray:
+                             bits: int = 4, group_size: int = 256,
+                             fused: bool = True) -> jnp.ndarray:
     """qgZ-style quantized gradient reduction (reference all_to_all_quant_reduce).
 
     Wire format: each rank quantizes its local shard-contributions to
     int4/int8, exchanges via all-to-all, dequantizes and reduces locally.
     Returns this rank's reduced partition (mean).
+
+    ``fused=True`` (default) runs the EQuARX-style pipeline: one Pallas
+    scale+quantize+pack kernel feeds the all-to-all directly and one
+    unpack+dequant+mean kernel consumes it (``comm/fused_wire.py``) — no
+    full-precision intermediates between quantize and exchange.
+    ``fused=False`` keeps the legacy jnp-composed wire (bit-identical
+    values under jit; the parity tests compare the two).
     """
     n = _axis_size(axes)
     if n <= 1:
         return tensor.reshape(-1)
+    if fused:
+        from .fused_wire import fused_quantized_reduce_scatter
+
+        return fused_quantized_reduce_scatter(tensor, axes, bits=bits,
+                                              group_size=group_size)
     flat = tensor.reshape(-1)
     pad = (-flat.shape[0]) % (n * group_size)
     if pad:
@@ -90,13 +103,15 @@ def quantized_reduce_scatter(tensor: jnp.ndarray, axes=("data",),
 
 def quantized_all_gather_params(param_shard: jnp.ndarray, axes=("data",),
                                 bits: int = 8, group_size: int = 256,
-                                out_dtype=jnp.bfloat16) -> jnp.ndarray:
+                                out_dtype=jnp.bfloat16,
+                                fused: bool = True) -> jnp.ndarray:
     """qwZ: quantized weight allgather (reference ZeRO++ quantized weights —
     ½ the allgather volume of bf16 at int8, ¼ at int4).
 
     Operates on this rank's FLAT shard; returns the flat concatenation of all
     ranks' shards (caller reshapes to the full parameter).  Shard lengths must
-    be equal and divisible by ``group_size``.
+    be equal and divisible by ``group_size``.  ``fused`` as in
+    :func:`quantized_reduce_scatter`.
     """
     n = _axis_size(axes)
     flat = param_shard.reshape(-1)
@@ -104,6 +119,12 @@ def quantized_all_gather_params(param_shard: jnp.ndarray, axes=("data",),
         return flat.astype(out_dtype)
     assert flat.shape[0] % group_size == 0, \
         f"shard length {flat.shape[0]} must divide by group_size {group_size}"
+    if fused:
+        from .fused_wire import fused_quantized_all_gather
+
+        return fused_quantized_all_gather(flat, axes, bits=bits,
+                                          group_size=group_size,
+                                          out_dtype=out_dtype)
     quant = quantize_int4 if bits == 4 else quantize_int8
     dequant = dequantize_int4 if bits == 4 else dequantize_int8
     q, s = quant(flat, group_size)
@@ -147,11 +168,24 @@ def bucketed_allreduce_coalesced(tensors: Sequence[jnp.ndarray],
 
 def loco_quantized_reduce_scatter(tensor: jnp.ndarray, error: jnp.ndarray,
                                   axes=("data",), bits: int = 4,
-                                  group_size: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+                                  group_size: int = 256,
+                                  fused: bool = True) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """LoCo variant (reference :81): error-feedback added before quantization,
-    new error returned for the next step."""
+    new error returned for the next step.
+
+    Fused path quantizes ONCE — the same Pallas quant+pack output feeds
+    both the all-to-all and the residual reconstruction, instead of the
+    legacy path's second independent quantization pass."""
     corrected = tensor.reshape(-1) + error.reshape(-1)
-    reduced = quantized_reduce_scatter(corrected, axes, bits, group_size)
+    if fused and _axis_size(axes) > 1:
+        from .fused_wire import fused_quantized_reduce_scatter
+
+        reduced, sent = fused_quantized_reduce_scatter(
+            corrected, axes, bits=bits, group_size=group_size,
+            return_sent=True)
+        return reduced, (corrected - sent).reshape(tensor.shape)
+    reduced = quantized_reduce_scatter(corrected, axes, bits, group_size,
+                                       fused=fused)
     # reconstruct what was actually transmitted for MY contribution
     quant = quantize_int4 if bits == 4 else quantize_int8
     dequant = dequantize_int4 if bits == 4 else dequantize_int8

@@ -1,0 +1,363 @@
+"""Hierarchical (2-hop) slice-aware collectives + topology-driven
+algorithm/wire selection.
+
+ZeRO++ (arXiv:2306.10209) observes that the big collective wins on
+multi-domain fabrics come from a hierarchical schedule: reduce in full
+precision inside the fast domain (ICI), cross the slow domain (DCN) once —
+and quantized.  "The Big Send-off" (arXiv:2504.18658) supplies the roofline
+framing: pick the algorithm per bucket from the per-domain bandwidth peaks.
+This module implements both halves for the explicit-comm train path:
+
+  * :func:`two_hop_allreduce` — full-precision ``psum_scatter`` intra-slice
+    → (optionally quantized, via the fused EQuARX wire in
+    ``fused_wire.py``) exchange inter-slice → ``all_gather`` back.  LoCo
+    error feedback rides both hops of the quantized inter-slice exchange.
+  * :class:`CollectiveAlgoSelector` — picks {flat, 2hop} × {fp, int8,
+    int4+LoCo} per bucket from the ICI/DCN rooflines
+    (``profiling/roofline.py`` DeviceSpec) and the measured exposed-comm
+    fraction, with an optional measured-ms table override (the comm_sweep
+    re-tune).  Deterministic: same inputs → same choice.
+  * :func:`exchange_leaves` — the bucketed exchange comm_path and the
+    comm_sweep bench share, so the benched code IS the production wire.
+
+Which mesh axes are "intra-slice" vs "cross-slice" comes from
+``MeshTopology.slice_axes()`` / ``cross_slice_axes()`` (device
+``slice_index`` derivation, with ``DSTPU_CROSS_SLICE_AXES`` /
+``overlap.cross_slice_axes`` overrides for the CPU sim).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .fused_wire import fused_quantized_allreduce
+
+#: wire-format names → bits on the wire (0 = full precision)
+WIRE_BITS = {"fp": 0, "int8": 8, "int4_loco": 4}
+ALGOS = ("flat", "2hop")
+
+
+def hop_axes(topology, data_axes: Sequence[str]
+             ) -> Tuple[Tuple[str, ...], Tuple[str, ...]]:
+    """Partition the exchange axes into (intra-slice, cross-slice) from the
+    topology's slice model.  An empty cross tuple means the whole group
+    rides ICI — 2-hop degenerates to flat and the selector won't offer it."""
+    cross = set(topology.cross_slice_axes())
+    intra = tuple(a for a in data_axes if a not in cross)
+    inter = tuple(a for a in data_axes if a in cross)
+    return intra, inter
+
+
+def two_hop_loco_sizes(numel: int, n_intra: int, n_inter: int,
+                       group_size: int = 256) -> Tuple[int, int]:
+    """(worker, server) LoCo residual lengths for the 2-hop exchange: the
+    quantized hop runs on the intra-reduced partition, so the worker
+    residual lives there and the server residual on its inter-partition."""
+    pad = (-numel) % (max(n_intra, 1) * max(n_inter, 1) * group_size)
+    per_i = (numel + pad) // max(n_intra, 1)
+    return per_i, per_i // max(n_inter, 1)
+
+
+def two_hop_allreduce(grad: jnp.ndarray, intra_axes, inter_axes,
+                      wire_bits: int = 0, group_size: int = 256,
+                      error: Optional[jnp.ndarray] = None,
+                      server_error: Optional[jnp.ndarray] = None,
+                      ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
+                                 Optional[jnp.ndarray]]:
+    """2-hop hierarchical mean-allreduce (must run inside shard_map with
+    both axis groups manual).
+
+    Hop 1 reduce-scatters in full precision inside the slice (ICI is fast
+    and fp keeps the large-magnitude intra sums exact); hop 2 exchanges
+    only the 1/n_intra partition across slices — quantized when
+    ``wire_bits`` is 4/8 (the DCN hop is where the wire savings pay, per
+    ZeRO++) — and hop 3 all-gathers the mean back inside the slice.
+
+    LoCo (``error``/``server_error`` not None, requires ``wire_bits``):
+    residuals are carried in intra-sum units on the partition —
+    :func:`two_hop_loco_sizes` gives their lengths — and cover BOTH hops of
+    the quantized inter-slice exchange (stage-1 a2a + stage-2 allgather).
+    """
+    n_i = jax.lax.psum(1, intra_axes) if intra_axes else 1
+    n_x = jax.lax.psum(1, inter_axes) if inter_axes else 1
+    flat = grad.reshape(-1).astype(jnp.float32)
+    size = flat.shape[0]
+    pad = (-size) % (max(n_i, 1) * max(n_x, 1) * group_size)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+
+    # hop 1: fp reduce-scatter inside the slice (sum; normalized below)
+    part = jax.lax.psum_scatter(flat, intra_axes, scatter_dimension=0,
+                                tiled=True) if n_i > 1 else flat
+
+    # hop 2: cross-slice exchange of the partition
+    new_error = error
+    new_server_error = server_error
+    if n_x > 1:
+        if wire_bits:
+            part, new_error, new_server_error = fused_quantized_allreduce(
+                part, inter_axes, bits=wire_bits, group_size=group_size,
+                error=error, server_error=server_error)
+        else:
+            part = jax.lax.psum(part, inter_axes) / n_x
+
+    part = part / n_i                        # overall mean over n_i * n_x
+
+    # hop 3: gather the mean partition back inside the slice
+    full = jax.lax.all_gather(part, intra_axes, axis=0, tiled=True) \
+        if n_i > 1 else part
+    return (full[:size].reshape(grad.shape).astype(grad.dtype),
+            new_error, new_server_error)
+
+
+def exchange_leaves(leaves: Sequence[jnp.ndarray], axes,
+                    intra_axes, inter_axes, algo: str, wire_bits: int,
+                    group_size: int = 256, bucket_bytes: int = 0,
+                    n: Optional[int] = None) -> Tuple[List[jnp.ndarray], dict]:
+    """Bucketed mean-allreduce of gradient leaves with the selected
+    algorithm and wire — the one exchange seam the engine's explicit-comm
+    step (``comm_path.exchange_grads``) and the comm_sweep bench share.
+    Must run inside shard_map with ``axes`` bound; returns (exchanged
+    leaves, bucket stats for the ``overlap/*`` gauges)."""
+    from ..overlap.bucketing import apply_bucketed, bucket_stats, plan_buckets
+
+    if n is None:
+        n = jax.lax.psum(1, axes) if axes else 1
+    if n <= 1:
+        return list(leaves), {"bucket_count": 0, "fused_buckets": 0,
+                              "fused_leaves": 0, "max_bucket_bytes": 0,
+                              "total_bytes": 0}
+    use_2hop = algo == "2hop" and inter_axes and intra_axes
+
+    def exchange(x):
+        if use_2hop:
+            out, _, _ = two_hop_allreduce(x, intra_axes, inter_axes,
+                                          wire_bits=wire_bits,
+                                          group_size=group_size)
+            return out
+        if wire_bits:
+            out, _, _ = fused_quantized_allreduce(x, axes, bits=wire_bits,
+                                                  group_size=group_size)
+            return out
+        return jax.lax.psum(x, axes) / n
+
+    plans = plan_buckets(leaves, bucket_bytes)
+    return apply_bucketed(list(leaves), plans, exchange), bucket_stats(plans)
+
+
+# --------------------------------------------------------------------- #
+# Cost model + selection
+# --------------------------------------------------------------------- #
+def _wire_bytes_per_elem(bits: int, group_size: int) -> float:
+    """Wire bytes per fp32 element at a quantized format (payload + the
+    f32 scale amortized over its group)."""
+    return bits / 8.0 + 4.0 / group_size
+
+
+def predict_operand_bytes(bucket_bytes: int, algo: str, wire: str,
+                          n_intra: int, n_inter: int,
+                          group_size: int = 256) -> Dict[str, float]:
+    """Per-device collective OPERAND bytes of one bucket exchange, by
+    primitive — the statically checkable counterpart of what
+    ``fused_wire.wire_ops`` measures from the traced program, which the
+    comm_sweep emits as predicted-vs-measured."""
+    bits = WIRE_BITS[wire]
+    elems = bucket_bytes / 4.0
+    n = max(n_intra, 1) * max(n_inter, 1)
+    out: Dict[str, float] = {}
+    if algo == "flat":
+        if bits == 0:
+            out["psum"] = float(bucket_bytes)
+        else:
+            wb = _wire_bytes_per_elem(bits, group_size)
+            out["all_to_all"] = elems * wb
+            out["all_gather"] = elems / n * wb
+    else:
+        out["psum_scatter"] = float(bucket_bytes)
+        part = bucket_bytes / max(n_intra, 1)
+        if bits == 0:
+            out["psum"] = part
+        else:
+            wb = _wire_bytes_per_elem(bits, group_size)
+            out["all_to_all"] = part / 4.0 * wb
+            out["all_gather_wire"] = part / 4.0 / max(n_inter, 1) * wb
+        out["all_gather"] = out.get("all_gather", 0.0) + part
+    out["total"] = sum(v for k, v in out.items() if k != "total")
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class CommAlgoChoice:
+    """One (algorithm, wire) pick with its evidence — published as the
+    ``comm/*`` gauges and logged by the overlap manager."""
+
+    algo: str                      # "flat" | "2hop"
+    wire: str                      # "fp" | "int8" | "int4_loco"
+    predicted_ms: float            # cost-model ms for the chosen config
+    predicted_ms_all: Dict[str, float]   # "algo/wire" → ms, every candidate
+    predicted_wire_bytes: float    # slow-domain bytes of the chosen config
+    measured: bool                 # True when a measured-ms table decided
+    reason: str
+
+    @property
+    def wire_bits(self) -> int:
+        return WIRE_BITS[self.wire]
+
+    @property
+    def loco(self) -> bool:
+        return self.wire == "int4_loco"
+
+    def as_event(self) -> Dict[str, object]:
+        return {"algo": self.algo, "wire": self.wire,
+                "predicted_ms": self.predicted_ms,
+                "predicted_ms_all": dict(self.predicted_ms_all),
+                "predicted_wire_bytes": self.predicted_wire_bytes,
+                "measured": self.measured, "reason": self.reason}
+
+
+class CollectiveAlgoSelector:
+    """Topology-driven per-bucket algorithm/wire selection.
+
+    Inputs are all static (group sizes from the mesh slice model, per-chip
+    ICI/DCN/HBM peaks from the roofline table, config allowances), so the
+    choice is deterministic — test-asserted under a fixed roofline table.
+    The measured exposed-comm fraction gates the QUANTIZED wires: lossy
+    formats are only worth their accuracy cost when communication is
+    actually exposed (no trace / below threshold → full precision).  A
+    ``measured_ms`` table (the comm_sweep's per-config timings) overrides
+    the analytic model — the "re-tuned once" path.
+    """
+
+    def __init__(self, n_intra: int, n_inter: int, ici_bw: float,
+                 dcn_bw: float, hbm_bw: float = 1e12,
+                 group_size: int = 256, allow_quantized: bool = True,
+                 allow_loco: bool = False, quant_threshold: float = 0.15):
+        self.n_intra = max(int(n_intra), 1)
+        self.n_inter = max(int(n_inter), 1)
+        self.ici_bw = float(ici_bw)
+        self.dcn_bw = float(dcn_bw)
+        self.hbm_bw = float(hbm_bw)
+        self.group_size = int(group_size)
+        self.allow_quantized = bool(allow_quantized)
+        self.allow_loco = bool(allow_loco)
+        self.quant_threshold = float(quant_threshold)
+
+    @classmethod
+    def from_topology(cls, topology, data_axes: Sequence[str],
+                      device_kind: Optional[str] = None,
+                      **kw) -> "CollectiveAlgoSelector":
+        from ...profiling.roofline import device_spec, spec_for_kind
+
+        spec = spec_for_kind(device_kind) if device_kind else device_spec()
+        intra, inter = hop_axes(topology, data_axes)
+        n_intra = 1
+        for a in intra:
+            n_intra *= topology.dims[a]
+        n_inter = 1
+        for a in inter:
+            n_inter *= topology.dims[a]
+        return cls(n_intra, n_inter, spec.ici_bandwidth or 1e9,
+                   spec.dcn_bandwidth or 1e9, spec.hbm_bandwidth, **kw)
+
+    # ------------------------------------------------------------------ #
+    def candidates(self) -> List[Tuple[str, str]]:
+        algos = ["flat"]
+        if self.n_inter > 1 and self.n_intra > 1:
+            algos.append("2hop")
+        wires = ["fp"]
+        if self.allow_quantized:
+            wires.append("int8")
+        if self.allow_loco:
+            wires.append("int4_loco")
+        return [(a, w) for a in algos for w in wires]
+
+    def _domain_bytes(self, bucket_bytes: float, algo: str, wire: str
+                      ) -> Tuple[float, float, float]:
+        """(ici, dcn, hbm) bytes per device for one bucket exchange."""
+        bits = WIRE_BITS[wire]
+        n = self.n_intra * self.n_inter
+        elems = bucket_bytes / 4.0
+        wb = _wire_bytes_per_elem(bits, self.group_size) if bits else 4.0
+        if algo == "flat":
+            # the whole ring crosses the slow domain when the group spans it
+            ring = 2.0 * (n - 1) / n * elems * wb
+            hbm = 2.0 * bucket_bytes + (3.0 * bucket_bytes if bits else 0.0)
+            if self.n_inter > 1:
+                return 0.0, ring, hbm
+            return ring, 0.0, hbm
+        part_elems = elems / self.n_intra
+        ici = 2.0 * (self.n_intra - 1) / self.n_intra * bucket_bytes
+        dcn = 2.0 * (self.n_inter - 1) / self.n_inter * part_elems * wb
+        hbm = 2.0 * bucket_bytes + (3.0 * part_elems * 4.0 if bits else 0.0)
+        return ici, dcn, hbm
+
+    def predict_ms(self, bucket_bytes: float, algo: str, wire: str) -> float:
+        ici, dcn, hbm = self._domain_bytes(bucket_bytes, algo, wire)
+        return 1e3 * (ici / self.ici_bw + dcn / self.dcn_bw
+                      + hbm / self.hbm_bw)
+
+    def predict_wire_bytes(self, bucket_bytes: float, algo: str,
+                           wire: str) -> float:
+        """Slow-domain (DCN when the group spans slices, else ICI) bytes —
+        the headline the 2-hop + quantized combination shrinks."""
+        ici, dcn, _ = self._domain_bytes(bucket_bytes, algo, wire)
+        return dcn if self.n_inter > 1 else ici
+
+    # ------------------------------------------------------------------ #
+    def select(self, bucket_bytes: float,
+               exposed_comm_fraction: Optional[float] = None,
+               measured_ms: Optional[Dict[str, float]] = None
+               ) -> CommAlgoChoice:
+        """Pick the cheapest admissible (algo, wire) for a bucket.
+
+        ``measured_ms`` maps ``"algo/wire"`` to a measured exchange time;
+        when given it decides directly (every measured candidate is
+        admissible — the measurement already paid the quantization cost).
+        Otherwise the analytic model decides and quantized wires must be
+        justified by ``exposed_comm_fraction >= quant_threshold``.
+        """
+        cands = self.candidates()
+        if measured_ms:
+            table = {f"{a}/{w}": self.predict_ms(bucket_bytes, a, w)
+                     for a, w in cands}
+            admissible = [(a, w) for a, w in cands
+                          if f"{a}/{w}" in measured_ms]
+            scores = {k: float(measured_ms[k]) for k in measured_ms
+                      if k in table}
+            reason = "measured re-tune over the comm_sweep grid"
+        else:
+            frac = exposed_comm_fraction
+            quant_ok = frac is not None and frac >= self.quant_threshold
+            admissible = [(a, w) for a, w in cands
+                          if w == "fp" or quant_ok]
+            table = {f"{a}/{w}": self.predict_ms(bucket_bytes, a, w)
+                     for a, w in cands}
+            scores = {f"{a}/{w}": table[f"{a}/{w}"] for a, w in admissible}
+            if frac is None:
+                reason = ("no exposed-comm measurement: full-precision "
+                          "wires only, algorithm from the roofline model")
+            elif not quant_ok:
+                reason = (f"exposed comm {frac:.3f} < "
+                          f"{self.quant_threshold}: quantization not worth "
+                          f"its accuracy cost")
+            else:
+                reason = (f"exposed comm {frac:.3f} >= "
+                          f"{self.quant_threshold}: quantized wires "
+                          f"admitted, picking roofline-cheapest")
+        if not admissible:
+            admissible = [("flat", "fp")]
+            scores.setdefault("flat/fp",
+                              self.predict_ms(bucket_bytes, "flat", "fp"))
+        # deterministic: primary score, then stable candidate order
+        order = {f"{a}/{w}": i for i, (a, w) in enumerate(cands)}
+        best = min(scores, key=lambda k: (scores[k], order.get(k, 99)))
+        algo, wire = best.split("/")
+        return CommAlgoChoice(
+            algo=algo, wire=wire, predicted_ms=float(table[best]),
+            predicted_ms_all=table,
+            predicted_wire_bytes=self.predict_wire_bytes(bucket_bytes, algo,
+                                                         wire),
+            measured=bool(measured_ms), reason=reason)

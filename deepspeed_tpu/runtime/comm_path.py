@@ -65,7 +65,8 @@ def loco_partition_size(numel: int, n: int, group_size: int = 256) -> int:
 def quantized_allreduce(grad: jnp.ndarray, axes, bits: int = 8,
                         group_size: int = 256,
                         error: Optional[jnp.ndarray] = None,
-                        server_error: Optional[jnp.ndarray] = None
+                        server_error: Optional[jnp.ndarray] = None,
+                        fused: bool = True
                         ) -> Tuple[jnp.ndarray, Optional[jnp.ndarray],
                                    Optional[jnp.ndarray]]:
     """Mean-allreduce with a fully quantized wire (qgZ analogue).
@@ -76,10 +77,24 @@ def quantized_allreduce(grad: jnp.ndarray, axes, bits: int = 8,
     loco variant): ``error`` holds the stage-1 residual of my local
     contribution, ``server_error`` the stage-2 residual of my reduced
     partition.
+
+    ``fused=True`` (default) runs both hops through the EQuARX-style fused
+    kernels (``comm/fused_wire.py``): one Pallas scale+quantize+pack pass
+    produces each collective's operand directly and a fused
+    unpack+dequant+mean consumes the stage-1 exchange — bit-identical
+    values under jit, no full-precision intermediates between quantize and
+    exchange.  ``fused=False`` keeps the legacy jnp-composed wire (the
+    parity baseline).
     """
     n = jax.lax.psum(1, axes)
     if n <= 1:
         return grad, error, server_error
+    if fused:
+        from .comm.fused_wire import fused_quantized_allreduce
+
+        return fused_quantized_allreduce(grad, axes, bits=bits,
+                                         group_size=group_size, error=error,
+                                         server_error=server_error)
     quant, dequant = get_quant_fns(bits)
     flat = grad.reshape(-1).astype(jnp.float32)
     if error is not None:
@@ -123,12 +138,22 @@ def quantized_allreduce(grad: jnp.ndarray, axes, bits: int = 8,
 
 def quantized_all_gather_shard(shard: jnp.ndarray, axes, dim: int,
                                bits: int = 8, group_size: int = 256,
-                               out_dtype=jnp.bfloat16) -> jnp.ndarray:
+                               out_dtype=jnp.bfloat16,
+                               fused: bool = True) -> jnp.ndarray:
     """qwZ: reconstruct a full parameter from its ZeRO-3 shard over an int8
-    wire.  ``dim`` is the sharded dimension; shards must be equal-size."""
+    wire.  ``dim`` is the sharded dimension; shards must be equal-size.
+    ``fused`` as in :func:`quantized_allreduce`."""
     n = jax.lax.psum(1, axes)
     if n <= 1:
         return shard.astype(out_dtype)
+    if fused:
+        from .comm.fused_wire import fused_quantized_all_gather
+
+        vals = fused_quantized_all_gather(
+            shard, axes, bits=bits, group_size=group_size,
+            out_dtype=out_dtype)
+        pieces = vals.reshape((n,) + shard.shape)
+        return jnp.concatenate([pieces[i] for i in range(n)], axis=dim)
     quant, dequant = get_quant_fns(bits)
     flat = shard.reshape(-1)
     q, s = quant(flat, group_size)
@@ -212,6 +237,22 @@ class _WireContext:
         overlap_on = bool(mgr is not None and mgr.enabled)
         self.bucket_bytes = int(mgr.bucket_bytes) if overlap_on else 0
         self.overlap_deferred = overlap_on and bool(mgr.deferred)
+
+        # collective algorithm/wire (runtime/comm/hierarchical.py): the
+        # manager resolves {flat, 2hop} from the topology slice model +
+        # rooflines (or the config forces it); quantized wire bits for the
+        # PLAIN-grad leaves come from overlap.wire_bits / the auto
+        # selector — config qgZ keeps its own per-leaf wire below.
+        from .comm.hierarchical import hop_axes
+
+        self.group_size = 256
+        if overlap_on:
+            mgr.resolve_comm(engine)
+        self.wire_bits = int(mgr.comm_wire_bits) if overlap_on else 0
+        self.intra_axes, self.inter_axes = hop_axes(topo, self.data_axes)
+        algo = mgr.comm_algo if (overlap_on and mgr.comm_algo) else "flat"
+        self.algo_2hop = bool(algo == "2hop" and self.intra_axes
+                              and self.inter_axes)
 
         self.params_t = engine.state.params
         self.stage3 = engine.zero_stage >= 3
@@ -305,10 +346,20 @@ class _WireContext:
                 outs[idx] = sparse_embedding_allreduce(g, ids, data_axes)
                 errs.append(e)
             elif self.qgz and data_axes:
-                out, new_w, new_s = quantized_allreduce(
-                    g, data_axes, bits=self.grad_bits,
-                    error=e["worker"][0] if loco else None,
-                    server_error=e["server"][0] if loco else None)
+                if self.algo_2hop:
+                    from .comm.hierarchical import two_hop_allreduce
+
+                    out, new_w, new_s = two_hop_allreduce(
+                        g, self.intra_axes, self.inter_axes,
+                        wire_bits=self.grad_bits,
+                        group_size=self.group_size,
+                        error=e["worker"][0] if loco else None,
+                        server_error=e["server"][0] if loco else None)
+                else:
+                    out, new_w, new_s = quantized_allreduce(
+                        g, data_axes, bits=self.grad_bits,
+                        error=e["worker"][0] if loco else None,
+                        server_error=e["server"][0] if loco else None)
                 outs[idx] = out
                 errs.append({"worker": new_w[None], "server": new_s[None]}
                             if loco else e)
@@ -326,9 +377,24 @@ class _WireContext:
         return treedef.unflatten(outs), new_error
 
     def _plain_psum_mean(self, leaves, n):
-        """Mean-allreduce the plain-wire leaves — one fused psum per size
-        bucket when ``overlap.bucket_bytes`` is set (bit-identical to the
-        per-leaf exchange; psum is elementwise), per-leaf otherwise."""
+        """Mean-allreduce the plain-wire leaves with the selected
+        algorithm/wire — one exchange per size bucket when
+        ``overlap.bucket_bytes`` is set.  With algo=flat and wire_bits=0
+        this is the classic bucketed psum (bit-identical to per-leaf);
+        2-hop and quantized wires route through
+        ``comm/hierarchical.exchange_leaves`` (the seam the comm_sweep
+        bench measures)."""
+        if self.algo_2hop or self.wire_bits:
+            from .comm.hierarchical import exchange_leaves
+
+            exchanged, stats = exchange_leaves(
+                leaves, self.data_axes, self.intra_axes, self.inter_axes,
+                "2hop" if self.algo_2hop else "flat", self.wire_bits,
+                group_size=self.group_size,
+                bucket_bytes=self.bucket_bytes, n=n)
+            if self.overlap_mgr is not None and self.bucket_bytes > 0:
+                self.overlap_mgr.note_bucket_plan(stats)
+            return exchanged
         if self.bucket_bytes > 0:
             exchanged, stats = bucketed_allreduce_coalesced(
                 leaves, self.data_axes, self.bucket_bytes, n=n)
@@ -422,7 +488,11 @@ def build_explicit_comm_step(engine, _force_eager_micro: bool = False):
     # and stateless enough to fire per micro-batch without changing values
     micro_wire = bool((ctx.overlap_deferred or _force_eager_micro)
                       and gas > 1 and data_axes
-                      and not (ctx.qgz or ctx.loco or ctx.sparse))
+                      and not (ctx.qgz or ctx.loco or ctx.sparse)
+                      # an auto-selected quantized plain wire exchanges
+                      # once at the boundary too: a per-micro quantize
+                      # would change the wire numerics, not the schedule
+                      and ctx.wire_bits == 0)
     engine._deferred_active = bool(micro_wire and not _force_eager_micro)
     if ctx.overlap_deferred and gas > 1 and not micro_wire:
         from ..utils.logging import logger

@@ -159,6 +159,25 @@ class OverlapConfig(DeepSpeedConfigModel):
     #: auto mode: size buckets so the exchange runs in about this many
     #: collective launches
     auto_target_buckets: int = 8
+    #: explicit-wire gradient format override: 0 follows zero_optimization
+    #: (``zero_quantized_gradients`` → int4, else full precision); 8 or 4
+    #: force a quantized explicit-wire gradient exchange without the zero
+    #: config surface (the comm_sweep bench and the auto selector use this)
+    wire_bits: int = 0
+    #: 2-hop slice-aware gradient exchange (``runtime/comm/hierarchical.py``
+    #: — fp reduce-scatter intra-slice, quantized exchange inter-slice,
+    #: allgather back): "auto" lets the CollectiveAlgoSelector decide from
+    #: the topology slice model + ICI/DCN rooflines, "on"/"off" force it
+    hierarchical: str = "auto"
+    #: auto mode: may the selector pick a QUANTIZED (int8) wire from the
+    #: measured exposed-comm fraction?  Only affects the explicit wire
+    auto_wire: bool = True
+    #: minimum measured exposed-comm fraction that justifies a lossy wire
+    auto_quant_threshold: float = 0.15
+    #: override which mesh axes cross a slice (DCN) boundary, comma list
+    #: (e.g. "data_outer") — the CPU-sim/test seam; real multislice jobs
+    #: derive it from device slice_index (DSTPU_CROSS_SLICE_AXES also works)
+    cross_slice_axes: Optional[str] = None
 
     @model_validator(mode="after")
     def _check_mode(self):
@@ -167,6 +186,12 @@ class OverlapConfig(DeepSpeedConfigModel):
                              f"got {self.mode!r}")
         if self.bucket_bytes < 0:
             raise ValueError("overlap.bucket_bytes must be >= 0")
+        if self.wire_bits not in (0, 4, 8):
+            raise ValueError(f"overlap.wire_bits must be 0, 4 or 8, "
+                             f"got {self.wire_bits!r}")
+        if self.hierarchical not in ("auto", "on", "off"):
+            raise ValueError(f"overlap.hierarchical must be 'auto', 'on' or "
+                             f"'off', got {self.hierarchical!r}")
         return self
 
 

@@ -115,6 +115,12 @@ class DeepSpeedEngine:
                                                   telemetry=self.telemetry)
         self._gather_cache = GatherWindowCache()
         self._deferred_active = False
+        # slice model override (CPU sim / tests): which mesh axes cross a
+        # DCN boundary — feeds the 2-hop hierarchical collectives
+        csa = getattr(config.overlap, "cross_slice_axes", None)
+        if csa:
+            self.topology.set_cross_slice_axes(
+                [a.strip() for a in str(csa).split(",") if a.strip()])
 
         self._timers = SynchronizedWallClockTimer(telemetry=self.telemetry)
         self.tput_timer = ThroughputTimer(
@@ -217,16 +223,35 @@ class DeepSpeedEngine:
             logger.warning("zero_quantized_weights ignored below ZeRO stage 3")
         comm_error = None
         if zc.zero_quantized_gradients and getattr(zc, "zeropp_loco", False):
+            from .comm.hierarchical import hop_axes, two_hop_loco_sizes
             from .comm_path import dp_axes_info, loco_partition_size
 
-            _, n_dp, dp_entry = dp_axes_info(self.topology)
+            axes, n_dp, dp_entry = dp_axes_info(self.topology)
             err_spec = PartitionSpec(dp_entry)
+
+            # 2-hop LoCo (explicit overlap.hierarchical: "on" only — auto
+            # never moves residual state between algorithms): the quantized
+            # exchange runs on the intra-slice-reduced partition, so both
+            # residuals live there (comm/hierarchical.two_hop_loco_sizes).
+            intra, inter = hop_axes(self.topology, axes)
+            loco_2hop = bool(self.overlap.enabled
+                             and self.overlap.hierarchical == "on"
+                             and intra and inter)
+            n_i = int(np.prod([self.topology.dims[a] for a in intra])) \
+                if intra else 1
+            n_x = int(np.prod([self.topology.dims[a] for a in inter])) \
+                if inter else 1
 
             # Two-level LoCo state (reference loco variant): stage-1 worker
             # residual per local contribution, stage-2 server residual per
             # reduced partition; leading axis = one row per DP rank.
             def _mk_error(x):
-                per = loco_partition_size(int(np.prod(x.shape)), n_dp)
+                numel = int(np.prod(x.shape))
+                if loco_2hop:
+                    worker, server = two_hop_loco_sizes(numel, n_i, n_x)
+                    return {"worker": jnp.zeros((n_dp, worker), jnp.float32),
+                            "server": jnp.zeros((n_dp, server), jnp.float32)}
+                per = loco_partition_size(numel, n_dp)
                 return {"worker": jnp.zeros((n_dp,) + x.shape, jnp.float32),
                         "server": jnp.zeros((n_dp, per), jnp.float32)}
 

@@ -30,14 +30,21 @@ class AutoTuneDecision:
     bucket_bytes: int
     exposed_comm_fraction: Optional[float]   # None = no trace yet
     reason: str
+    #: per-bucket collective algorithm/wire pick
+    #: (runtime/comm/hierarchical.py CommAlgoChoice), present when the
+    #: caller supplied a CollectiveAlgoSelector
+    comm: Optional[Any] = None
 
     def as_event(self) -> Dict[str, Any]:
-        return {
+        out = {
             "deferred": self.deferred,
             "bucket_bytes": self.bucket_bytes,
             "exposed_comm_fraction": self.exposed_comm_fraction,
             "reason": self.reason,
         }
+        if self.comm is not None:
+            out["comm"] = self.comm.as_event()
+        return out
 
 
 def exposed_comm_fraction(xprof_report: Dict[str, Any]) -> Optional[float]:
@@ -65,8 +72,11 @@ def size_targeted_bucket(grad_bytes: float, target_buckets: int) -> int:
 def autotune(xprof_report: Optional[Dict[str, Any]],
              grad_bytes: float,
              comm_threshold: float = 0.05,
-             target_buckets: int = 8) -> AutoTuneDecision:
-    """Pick deferred-reduction and bucket-size settings.
+             target_buckets: int = 8,
+             comm_selector: Optional[Any] = None) -> AutoTuneDecision:
+    """Pick deferred-reduction and bucket-size settings (and, when a
+    :class:`~..comm.hierarchical.CollectiveAlgoSelector` is supplied, the
+    per-bucket collective algorithm + wire format).
 
     ``xprof_report``: device-time attribution of one captured step (or
     None before any capture).  ``grad_bytes``: fp32 gradient wire volume
@@ -75,16 +85,21 @@ def autotune(xprof_report: Optional[Dict[str, Any]],
     """
     bucket = size_targeted_bucket(grad_bytes, target_buckets)
     frac = exposed_comm_fraction(xprof_report) if xprof_report else None
+    comm = comm_selector.select(bucket, exposed_comm_fraction=frac) \
+        if comm_selector is not None else None
     if frac is None:
         return AutoTuneDecision(
             deferred=True, bucket_bytes=bucket, exposed_comm_fraction=None,
-            reason="no xprof capture yet: size heuristic only, deferred on")
+            reason="no xprof capture yet: size heuristic only, deferred on",
+            comm=comm)
     if frac < comm_threshold:
         return AutoTuneDecision(
             deferred=False, bucket_bytes=bucket, exposed_comm_fraction=frac,
             reason=f"comm fraction {frac:.3f} < threshold {comm_threshold}: "
-                   f"not worth the deferred gradient buffer")
+                   f"not worth the deferred gradient buffer",
+            comm=comm)
     return AutoTuneDecision(
         deferred=True, bucket_bytes=bucket, exposed_comm_fraction=frac,
         reason=f"comm fraction {frac:.3f} >= threshold {comm_threshold}: "
-               f"deferring reduction, {target_buckets}-launch buckets")
+               f"deferring reduction, {target_buckets}-launch buckets",
+        comm=comm)

@@ -44,6 +44,17 @@ class OverlapManager:
         self.last_decision: Optional[AutoTuneDecision] = None
         self._tuned_without_trace = False
         self._tuned_with_trace = False
+        # ---- collective algorithm/wire selection (comm/hierarchical) --- #
+        #: effective wire bits for the explicit plain-grad wire: config
+        #: overlap.wire_bits wins; in auto mode the selector may raise it
+        #: to int8 once the exposed-comm fraction justifies it
+        self.comm_wire_bits = int(getattr(cfg, "wire_bits", 0) or 0) \
+            if self.enabled else 0
+        self.hierarchical = getattr(cfg, "hierarchical", "auto") \
+            if self.enabled else "off"
+        #: effective algorithm ("flat"/"2hop"); None = not yet resolved
+        self.comm_algo: Optional[str] = None
+        self.comm_choice = None           # last CommAlgoChoice (evidence)
 
     @classmethod
     def from_config(cls, config, telemetry=None) -> "OverlapManager":
@@ -60,6 +71,66 @@ class OverlapManager:
         self.prefetch_misses = cache.misses
 
     # ------------------------------------------------------------------ #
+    # Collective algorithm/wire selection
+    # ------------------------------------------------------------------ #
+    def comm_selector(self, engine):
+        """Build the topology-driven selector for this engine's exchange
+        group.  ``allow_loco`` requires the config to carry LoCo residual
+        state; the selector never turns LoCo on dynamically (the error
+        buffers are allocated at engine init)."""
+        from ..comm.hierarchical import CollectiveAlgoSelector
+        from ..comm_path import dp_axes_info
+
+        axes, _, _ = dp_axes_info(engine.topology)
+        zc = engine.config.zero_config
+        loco = bool(zc.zero_quantized_gradients
+                    and getattr(zc, "zeropp_loco", False))
+        allow_quant = bool(getattr(self.cfg, "auto_wire", True)) \
+            and not zc.zero_quantized_gradients
+        return CollectiveAlgoSelector.from_topology(
+            engine.topology, axes,
+            allow_quantized=allow_quant, allow_loco=loco,
+            quant_threshold=float(
+                getattr(self.cfg, "auto_quant_threshold", 0.15)))
+
+    def resolve_comm(self, engine) -> None:
+        """Resolve the effective (algorithm, wire) once, before the first
+        step build.  ``hierarchical: "on"/"off"`` forces the algorithm;
+        "auto" asks the selector (roofline-only at this point — no
+        exposed-comm measurement yet, so the wire stays full precision
+        until a re-tune).  Config LoCo freezes both afterwards: the
+        residual buffers were shaped for this choice at engine init."""
+        if not self.enabled or self.comm_algo is not None:
+            return
+        if self.hierarchical in ("on", "off"):
+            self.comm_algo = "2hop" if self.hierarchical == "on" else "flat"
+            return
+        if self._comm_frozen(engine):
+            # auto may not move LoCo residual state between algorithms —
+            # the buffers were shaped for the flat wire at engine init
+            # (2-hop LoCo needs the explicit hierarchical: "on")
+            self.comm_algo = "flat"
+            return
+        try:
+            choice = self.comm_selector(engine).select(
+                max(self.bucket_bytes, 1 << 20))
+        except Exception as e:  # noqa: BLE001 — selection is best-effort
+            logger.debug(f"comm algo selection unavailable: {e}")
+            self.comm_algo = "flat"
+            return
+        self.comm_choice = choice
+        self.comm_algo = choice.algo
+        log_dist(f"comm algo: {choice.algo}/{choice.wire} — {choice.reason}",
+                 ranks=[0])
+
+    def _comm_frozen(self, engine) -> bool:
+        """LoCo residual state is allocated at init for one (algo, wire) —
+        never re-tune across it."""
+        zc = engine.config.zero_config
+        return bool(zc.zero_quantized_gradients
+                    and getattr(zc, "zeropp_loco", False))
+
+    # ------------------------------------------------------------------ #
     # Auto mode
     # ------------------------------------------------------------------ #
     def _apply(self, decision: AutoTuneDecision, engine) -> bool:
@@ -68,6 +139,28 @@ class OverlapManager:
                    or decision.bucket_bytes != self.bucket_bytes)
         self.deferred = decision.deferred
         self.bucket_bytes = decision.bucket_bytes
+        comm = decision.comm
+        if comm is not None and not self._comm_frozen(engine) \
+                and self.hierarchical == "auto" \
+                and getattr(engine, "_explicit_comm", False):
+            # only the explicit wire consumes the choice: a fused-path
+            # engine neither recompiles for it nor publishes it (gauges
+            # claiming a quantized/2-hop wire nothing uses would mislead)
+            new_bits = comm.wire_bits if not comm.loco else 0
+            overridden = bool(int(getattr(self.cfg, "wire_bits", 0) or 0))
+            if overridden:
+                new_bits = self.comm_wire_bits   # explicit config wins
+            if (comm.algo != self.comm_algo
+                    or new_bits != self.comm_wire_bits):
+                changed = True
+            # when the config forces a different wire than the selector
+            # picked, the choice's predicted_* numbers describe a config
+            # that is not in effect — don't publish them as gauges
+            self.comm_choice = None if (overridden
+                                        and new_bits != comm.wire_bits) \
+                else comm
+            self.comm_algo = comm.algo
+            self.comm_wire_bits = new_bits
         if self.telemetry is not None:
             self.telemetry.event("overlap_autotune", **decision.as_event())
         log_dist(f"overlap auto: {decision.reason} "
@@ -110,17 +203,25 @@ class OverlapManager:
             self._trace_failures = getattr(self, "_trace_failures", 0) + 1
             if self._trace_failures >= 3:
                 self._tuned_with_trace = True
+        selector = None
+        if self.hierarchical == "auto" and not self._comm_frozen(engine):
+            try:
+                selector = self.comm_selector(engine)
+            except Exception as e:  # noqa: BLE001 — selection is best-effort
+                logger.debug(f"comm selector unavailable: {e}")
         if report is not None:
             self._tuned_with_trace = True
             decision = autotune(report, grad_bytes,
                                 self.cfg.auto_comm_threshold,
-                                self.cfg.auto_target_buckets)
+                                self.cfg.auto_target_buckets,
+                                comm_selector=selector)
             return self._apply(decision, engine)
         if not self._tuned_without_trace:
             self._tuned_without_trace = True
             decision = autotune(None, grad_bytes,
                                 self.cfg.auto_comm_threshold,
-                                self.cfg.auto_target_buckets)
+                                self.cfg.auto_target_buckets,
+                                comm_selector=selector)
             return self._apply(decision, engine)
         return False
 
@@ -147,6 +248,16 @@ class OverlapManager:
                 float(self.last_decision.exposed_comm_fraction))
         if self.prefetch_hits or self.prefetch_misses:
             m.gauge("overlap/prefetch_reuse").set(float(self.prefetch_hits))
+        # collective algorithm/wire selection (comm/hierarchical.py)
+        if self.comm_algo is not None:
+            m.gauge("comm/algo_2hop").set(
+                1.0 if self.comm_algo == "2hop" else 0.0)
+            m.gauge("comm/wire_bits").set(float(self.comm_wire_bits))
+        if self.comm_choice is not None:
+            m.gauge("comm/predicted_exchange_ms").set(
+                float(self.comm_choice.predicted_ms))
+            m.gauge("comm/predicted_wire_bytes").set(
+                float(self.comm_choice.predicted_wire_bytes))
 
     def on_step(self, engine, deferred_active: bool) -> None:
         """Per-step hook (engine ``_post_step_logging``): counters, auto

@@ -260,6 +260,75 @@ class MeshTopology:
         """Axes over which ZeRO partitions params/grads/optimizer state."""
         return tuple(a for a in self.axes_for("zero_partition") if self.dims[a] > 1)
 
+    # -------------------------------------------------------------- #
+    # Slice model (ICI vs DCN) — hierarchical collectives
+    # -------------------------------------------------------------- #
+    def set_cross_slice_axes(self, axes: Optional[Sequence[str]]) -> None:
+        """Explicit override of which mesh axes cross a slice (DCN)
+        boundary — for the CPU sim and tests, or when the config says so
+        (``overlap.cross_slice_axes``).  ``None`` restores derivation."""
+        if axes is not None:
+            bad = sorted(set(axes) - set(AXIS_ORDER))
+            if bad:
+                raise ValueError(f"unknown mesh axes {bad}; "
+                                 f"known: {list(AXIS_ORDER)}")
+            axes = tuple(a for a in AXIS_ORDER if a in set(axes))
+        self._cross_slice_override = axes
+
+    def cross_slice_axes(self) -> Tuple[str, ...]:
+        """Mesh axes whose neighbors live in a DIFFERENT TPU slice — hops
+        along these cross DCN, not ICI (the slow domain of the 2-hop
+        hierarchical collectives in ``runtime/comm/hierarchical.py``).
+
+        Resolution order: :meth:`set_cross_slice_axes` override →
+        ``DSTPU_CROSS_SLICE_AXES`` env (comma list; how the CPU sim and the
+        comm_sweep bench model a multislice job) → derived from each
+        device's ``slice_index`` (multislice TPU runtimes expose it; absent
+        or uniform → single slice, no cross axes).  Only nontrivial axes
+        are ever returned."""
+        import os
+
+        override = getattr(self, "_cross_slice_override", None)
+        if override is None:
+            env = os.environ.get("DSTPU_CROSS_SLICE_AXES", "").strip()
+            if env:
+                override = tuple(a.strip() for a in env.split(",")
+                                 if a.strip())
+                bad = sorted(set(override) - set(AXIS_ORDER))
+                if bad:
+                    raise ValueError(
+                        f"DSTPU_CROSS_SLICE_AXES names unknown axes {bad}; "
+                        f"known: {list(AXIS_ORDER)}")
+        if override is not None:
+            return tuple(a for a in AXIS_ORDER
+                         if a in set(override) and self.dims[a] > 1)
+        return self._derived_cross_slice_axes()
+
+    def _derived_cross_slice_axes(self) -> Tuple[str, ...]:
+        grid = np.asarray(self.mesh.devices)
+        slice_ids = np.asarray(
+            [getattr(d, "slice_index", None) for d in grid.ravel()],
+            dtype=object).reshape(grid.shape)
+        if all(s is None for s in slice_ids.ravel()) or \
+                len({s for s in slice_ids.ravel()}) <= 1:
+            return ()
+        out = []
+        for k, axis in enumerate(AXIS_ORDER):
+            if self.dims[axis] <= 1:
+                continue
+            first = np.take(slice_ids, 0, axis=k)
+            if any((np.take(slice_ids, i, axis=k) != first).any()
+                   for i in range(1, grid.shape[k])):
+                out.append(axis)
+        return tuple(out)
+
+    def slice_axes(self) -> Tuple[str, ...]:
+        """Nontrivial mesh axes fully inside one slice (all hops ride
+        ICI)."""
+        cross = set(self.cross_slice_axes())
+        return tuple(a for a in AXIS_ORDER
+                     if self.dims[a] > 1 and a not in cross)
+
     def __repr__(self) -> str:  # pragma: no cover
         return f"MeshTopology({self.dims})"
 

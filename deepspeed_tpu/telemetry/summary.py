@@ -202,15 +202,30 @@ def comm_table(metrics: Sequence[Dict[str, Any]],
     return rows
 
 
+#: collective algorithm/wire selection gauges (overlap manager,
+#: runtime/comm/hierarchical.py) — exact names, distinct from the
+#: labelled per-op comm facade series (comm/calls, comm/bytes, …)
+COMM_SELECTION_GAUGES = ("comm/algo_2hop", "comm/wire_bits",
+                         "comm/predicted_exchange_ms",
+                         "comm/predicted_wire_bytes")
+
+
 def overlap_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     """The ``overlap/*`` gauges (comm/compute overlap subsystem): exposed
-    comm fraction, deferred-reduction activity, bucket shape."""
+    comm fraction, deferred-reduction activity, bucket shape — plus the
+    collective algorithm/wire selection (``comm/*`` gauges) under
+    ``comm_selection``."""
     out: Dict[str, Any] = {}
+    comm: Dict[str, Any] = {}
     for m in metrics:
         name = str(m.get("name", ""))
         if name.startswith("overlap/"):
             key = name.split("/", 1)[1]
             out[key] = m.get("value", m.get("count"))
+        elif name in COMM_SELECTION_GAUGES:
+            comm[name.split("/", 1)[1]] = m.get("value")
+    if comm:
+        out["comm_selection"] = comm
     return out
 
 
@@ -439,6 +454,13 @@ def format_summary(s: Dict[str, Any]) -> str:
                         f" @ {_fmt_bytes(ov.get('bucket_bytes') or 0)} target")
         if ov.get("prefetch_reuse"):
             bits.append(f"prefetch reuse {int(ov['prefetch_reuse'])}")
+        cs = ov.get("comm_selection") or {}
+        if cs:
+            wb = int(cs.get("wire_bits") or 0)
+            bits.append(
+                f"collectives "
+                f"{'2-hop' if cs.get('algo_2hop') else 'flat'}/"
+                f"{f'int{wb}' if wb else 'fp'}")
         add("overlap: " + " · ".join(bits))
     add("")
 

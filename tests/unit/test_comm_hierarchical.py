@@ -1,0 +1,427 @@
+"""Hierarchical (2-hop) slice-aware collectives + topology-driven
+algorithm/wire selection (``runtime/comm/hierarchical.py``): 2-hop-vs-flat
+accuracy bounds against the fp32 oracle on the 8-device CPU sim, LoCo
+residual carry across both hops, the mesh slice model, selector
+determinism under a fixed roofline table, and the jaxpr fusion property
+(no full-precision materialization between quantize and exchange).
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deepspeed_tpu.runtime.comm import fused_wire as fw
+from deepspeed_tpu.runtime.comm import hierarchical as h
+from deepspeed_tpu.runtime.topology import (DATA, DATA_OUTER, TopologyConfig,
+                                            compat_shard_map,
+                                            initialize_mesh)
+
+pytestmark = pytest.mark.comm
+
+N_DEV = 8
+N_INTRA, N_INTER = 4, 2
+
+
+@pytest.fixture
+def mesh2slice():
+    """data_outer(2) × data(4) mesh with data_outer marked cross-slice —
+    the CPU-sim model of a 2-slice job."""
+    topo = initialize_mesh(TopologyConfig(zero_shard_size=N_INTRA),
+                           force=True)
+    topo.set_cross_slice_axes((DATA_OUTER,))
+    return topo
+
+
+def _sharded(fn, topo, in_specs, out_specs):
+    return compat_shard_map(fn, topo.mesh, in_specs, out_specs,
+                            manual_axes={DATA_OUTER, DATA})
+
+
+def _per_rank(shape=(N_DEV, 40, 8), seed=0):
+    rng = np.random.default_rng(seed)
+    return jnp.asarray(rng.normal(size=shape), jnp.float32)
+
+
+class TestTwoHopAllreduce:
+    def test_fp_two_hop_matches_exact_mean(self, mesh2slice):
+        """wire_bits=0: RS + psum + AG is the same mean, just reordered —
+        error at fp32 reassociation level, every rank identical."""
+        stacked = _per_rank(seed=1)
+        exact = np.asarray(stacked, np.float64).mean(axis=0)
+
+        def ex(x):
+            out, _, _ = h.two_hop_allreduce(x[0], (DATA,), (DATA_OUTER,),
+                                            wire_bits=0)
+            return out[None]
+
+        spec = P((DATA_OUTER, DATA))
+        out = np.asarray(jax.jit(_sharded(ex, mesh2slice, (spec,), spec))(
+            stacked))
+        assert np.abs(out[0] - exact).max() < 1e-5
+        for r in range(1, N_DEV):
+            np.testing.assert_array_equal(out[0], out[r])
+
+    @pytest.mark.parametrize("bits,tol", [(8, 5e-2), (4, 4e-1)])
+    def test_quantized_two_hop_error_bound_vs_fp32_oracle(self, mesh2slice,
+                                                          bits, tol):
+        """Only the inter-slice hop is lossy: 2-hop error must be bounded
+        by the wire precision, like the flat quantized exchange."""
+        stacked = _per_rank(seed=2)
+        exact = np.asarray(stacked).mean(axis=0)
+
+        def ex(x):
+            out, _, _ = h.two_hop_allreduce(x[0], (DATA,), (DATA_OUTER,),
+                                            wire_bits=bits)
+            return out[None]
+
+        spec = P((DATA_OUTER, DATA))
+        out = np.asarray(jax.jit(_sharded(ex, mesh2slice, (spec,), spec))(
+            stacked))
+        scale = np.abs(np.asarray(stacked)).max()
+        assert np.abs(out[0] - exact).max() <= tol * scale
+        for r in range(1, N_DEV):
+            np.testing.assert_array_equal(out[0], out[r])
+
+    def test_two_hop_not_worse_than_flat_quantized(self, mesh2slice):
+        """2-hop quantizes the intra-slice SUM once across slices; flat
+        quantizes every rank's contribution.  Both bounded; 2-hop should
+        not be meaningfully worse (it quantizes fewer values)."""
+        stacked = _per_rank(seed=3)
+        exact = np.asarray(stacked).mean(axis=0)
+        spec = P((DATA_OUTER, DATA))
+
+        def two_hop(x):
+            out, _, _ = h.two_hop_allreduce(x[0], (DATA,), (DATA_OUTER,),
+                                            wire_bits=8)
+            return out[None]
+
+        def flat(x):
+            out, _, _ = fw.fused_quantized_allreduce(
+                x[0], (DATA_OUTER, DATA), bits=8)
+            return out[None]
+
+        e2 = np.abs(np.asarray(jax.jit(_sharded(
+            two_hop, mesh2slice, (spec,), spec))(stacked))[0] - exact).max()
+        ef = np.abs(np.asarray(jax.jit(_sharded(
+            flat, mesh2slice, (spec,), spec))(stacked))[0] - exact).max()
+        scale = np.abs(np.asarray(stacked)).max()
+        assert e2 <= 5e-2 * scale and ef <= 5e-2 * scale
+        assert e2 <= ef * 2.0, (e2, ef)
+
+    def test_loco_residuals_carry_across_both_hops(self, mesh2slice):
+        """LoCo on the 2-hop wire: worker residual lives on the intra-
+        reduced partition, server residual on its inter-partition
+        (two_hop_loco_sizes); both are nonzero (the int4 wire is lossy),
+        bounded by the intra-sum magnitude, and a second step carrying
+        them in keeps shapes stable and changes the residuals."""
+        stacked = _per_rank(shape=(N_DEV, 16, 16), seed=4)
+        numel = 16 * 16
+        wlen, slen = h.two_hop_loco_sizes(numel, N_INTRA, N_INTER)
+        assert wlen % slen == 0 and wlen // slen == N_INTER
+
+        err0 = jnp.zeros((N_DEV, wlen), jnp.float32)
+        serr0 = jnp.zeros((N_DEV, slen), jnp.float32)
+
+        def ex(x, e, se):
+            out, ne, nse = h.two_hop_allreduce(
+                x[0], (DATA,), (DATA_OUTER,), wire_bits=4,
+                error=e[0], server_error=se[0])
+            return out[None], ne[None], nse[None]
+
+        spec = P((DATA_OUTER, DATA))
+        fn = jax.jit(_sharded(ex, mesh2slice, (spec,) * 3, (spec,) * 3))
+        out1, e1, se1 = fn(stacked, err0, serr0)
+        assert e1.shape == err0.shape and se1.shape == serr0.shape
+        intra_sum_scale = N_INTRA * float(np.abs(np.asarray(stacked)).max())
+        for r in (e1, se1):
+            m = float(np.abs(np.asarray(r)).max())
+            assert 0 < m < intra_sum_scale, m
+        out2, e2, se2 = fn(stacked, e1, se1)
+        assert e2.shape == err0.shape and se2.shape == serr0.shape
+        assert not np.array_equal(np.asarray(e1), np.asarray(e2))
+        # error feedback: the corrected second step must not drift away
+        exact = np.asarray(stacked).mean(axis=0)
+        scale = np.abs(np.asarray(stacked)).max()
+        assert np.abs(np.asarray(out2)[0] - exact).max() <= 4e-1 * scale
+
+    def test_degenerate_no_inter_axis_is_plain_mean(self, mesh8):
+        """Empty inter group: hop 2 vanishes, result is the exact mean."""
+        stacked = _per_rank(seed=5)
+
+        def ex(x):
+            out, _, _ = h.two_hop_allreduce(x[0], (DATA,), (), wire_bits=0)
+            return out[None]
+
+        out = np.asarray(jax.jit(compat_shard_map(
+            ex, mesh8.mesh, (P(DATA),), P(DATA),
+            manual_axes={DATA}))(stacked))
+        np.testing.assert_allclose(out[0], np.asarray(stacked).mean(axis=0),
+                                   atol=1e-5)
+
+
+class TestSliceModel:
+    def test_default_cpu_sim_has_no_cross_slice_axes(self, mesh8):
+        assert mesh8.cross_slice_axes() == ()
+        assert DATA in mesh8.slice_axes()
+
+    def test_override_and_complement(self):
+        topo = initialize_mesh(TopologyConfig(zero_shard_size=4), force=True)
+        topo.set_cross_slice_axes((DATA_OUTER,))
+        assert topo.cross_slice_axes() == (DATA_OUTER,)
+        assert topo.slice_axes() == (DATA,)
+        topo.set_cross_slice_axes(None)
+        assert topo.cross_slice_axes() == ()
+
+    def test_override_rejects_unknown_axis(self, mesh8):
+        with pytest.raises(ValueError, match="unknown mesh axes"):
+            mesh8.set_cross_slice_axes(("dcn",))
+
+    def test_env_override(self, monkeypatch):
+        topo = initialize_mesh(TopologyConfig(zero_shard_size=4), force=True)
+        monkeypatch.setenv("DSTPU_CROSS_SLICE_AXES", "data_outer")
+        assert topo.cross_slice_axes() == (DATA_OUTER,)
+        monkeypatch.setenv("DSTPU_CROSS_SLICE_AXES", "bogus")
+        with pytest.raises(ValueError, match="unknown axes"):
+            topo.cross_slice_axes()
+
+    def test_trivial_axes_never_cross(self, mesh8):
+        """An override naming a size-1 axis is elided (nothing to hop)."""
+        mesh8.set_cross_slice_axes((DATA_OUTER,))   # data_outer == 1 here
+        assert mesh8.cross_slice_axes() == ()
+
+    def test_hop_axes_partition(self):
+        topo = initialize_mesh(TopologyConfig(zero_shard_size=4), force=True)
+        topo.set_cross_slice_axes((DATA_OUTER,))
+        intra, inter = h.hop_axes(topo, (DATA_OUTER, DATA))
+        assert intra == (DATA,) and inter == (DATA_OUTER,)
+
+
+#: a fixed roofline table (v5p-like ICI, slow DCN) — selector inputs must
+#: be fully static so the choice is deterministic
+FIXED = dict(n_intra=4, n_inter=2, ici_bw=600e9, dcn_bw=25e9,
+             hbm_bw=2765e9)
+
+
+class TestCollectiveAlgoSelector:
+    def test_deterministic_under_fixed_roofline(self):
+        picks = [h.CollectiveAlgoSelector(**FIXED, allow_loco=True).select(
+            64 << 20, exposed_comm_fraction=0.3) for _ in range(5)]
+        assert len({(c.algo, c.wire) for c in picks}) == 1
+        assert picks[0].predicted_ms == picks[1].predicted_ms
+
+    def test_no_measurement_stays_full_precision(self):
+        c = h.CollectiveAlgoSelector(**FIXED).select(64 << 20)
+        assert c.wire == "fp"
+        assert "no exposed-comm measurement" in c.reason
+
+    def test_low_exposed_comm_rejects_quantization(self):
+        c = h.CollectiveAlgoSelector(**FIXED).select(
+            64 << 20, exposed_comm_fraction=0.01)
+        assert c.wire == "fp"
+
+    def test_high_exposed_comm_quantizes_the_dcn_hop(self):
+        """Cross-slice group + exposed comm: 2-hop with a quantized wire
+        is the roofline-cheapest (the ZeRO++ schedule)."""
+        c = h.CollectiveAlgoSelector(**FIXED, allow_loco=True).select(
+            64 << 20, exposed_comm_fraction=0.5)
+        assert c.algo == "2hop"
+        assert c.wire in ("int8", "int4_loco")
+        assert c.predicted_ms == min(c.predicted_ms_all.values())
+
+    def test_single_slice_never_offers_2hop(self):
+        sel = h.CollectiveAlgoSelector(n_intra=8, n_inter=1, ici_bw=600e9,
+                                       dcn_bw=25e9, hbm_bw=2765e9)
+        assert all(a == "flat" for a, _ in sel.candidates())
+        c = sel.select(64 << 20, exposed_comm_fraction=0.5)
+        assert c.algo == "flat"
+
+    def test_loco_only_when_allowed(self):
+        c = h.CollectiveAlgoSelector(**FIXED, allow_loco=False).select(
+            64 << 20, exposed_comm_fraction=0.5)
+        assert c.wire != "int4_loco"
+
+    def test_measured_table_overrides_the_model(self):
+        sel = h.CollectiveAlgoSelector(**FIXED, allow_loco=True)
+        c = sel.select(64 << 20, measured_ms={
+            "flat/fp": 3.0, "2hop/int8": 9.0, "flat/int8": 1.5})
+        assert (c.algo, c.wire) == ("flat", "int8")
+        assert c.measured
+
+    def test_2hop_quantized_shrinks_predicted_dcn_bytes(self):
+        sel = h.CollectiveAlgoSelector(**FIXED)
+        b = 64 << 20
+        flat_fp = sel.predict_wire_bytes(b, "flat", "fp")
+        hop_int8 = sel.predict_wire_bytes(b, "2hop", "int8")
+        # 1/n_intra partition × ~1/4 wire: > 10x less DCN traffic
+        assert hop_int8 < flat_fp / 10
+
+
+class TestFusionJaxpr:
+    """The acceptance property: no intermediate full-precision
+    materialization between quantize and exchange, asserted via jaxpr
+    inspection of the traced shard_map program."""
+
+    def _trace(self, mesh8, fn):
+        stacked = _per_rank()
+        return jax.make_jaxpr(compat_shard_map(
+            fn, mesh8.mesh, (P(DATA),), P(DATA),
+            manual_axes={DATA}))(stacked)
+
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_fused_allreduce_wire_is_int8_from_the_pack_kernel(self, mesh8,
+                                                               bits):
+        from deepspeed_tpu.runtime.comm_path import quantized_allreduce
+
+        def ex(x):
+            out, _, _ = quantized_allreduce(x[0], (DATA,), bits=bits)
+            return out[None]
+
+        traced = self._trace(mesh8, ex)
+        fw.assert_quantized_wire(traced, expect_exchanges=2)
+        fw.assert_fused_pack(traced)
+
+    def test_legacy_unfused_int4_fails_the_fusion_assert(self, mesh8):
+        """Negative control: the jnp-composed int4 wire packs nibbles
+        BETWEEN the quantize and the collective — the assertion must see
+        it (proves the check has teeth)."""
+        from deepspeed_tpu.runtime.comm_path import quantized_allreduce
+
+        def ex(x):
+            out, _, _ = quantized_allreduce(x[0], (DATA,), bits=4,
+                                            fused=False)
+            return out[None]
+
+        with pytest.raises(AssertionError, match="non-layout op"):
+            fw.assert_fused_pack(self._trace(mesh8, ex))
+
+    def test_two_hop_quantized_wire_is_fused(self):
+        topo = initialize_mesh(TopologyConfig(zero_shard_size=4), force=True)
+        topo.set_cross_slice_axes((DATA_OUTER,))
+        stacked = _per_rank()
+        spec = P((DATA_OUTER, DATA))
+
+        def ex(x):
+            out, _, _ = h.two_hop_allreduce(x[0], (DATA,), (DATA_OUTER,),
+                                            wire_bits=4)
+            return out[None]
+
+        traced = jax.make_jaxpr(compat_shard_map(
+            ex, topo.mesh, (spec,), spec,
+            manual_axes={DATA_OUTER, DATA}))(stacked)
+        fw.assert_fused_pack(traced)
+        # the fp intra hops (psum_scatter/all_gather) carry the partition,
+        # the int8 wire crosses slices
+        prims = {o["prim"] for o in fw.wire_ops(traced)}
+        assert "reduce_scatter" in prims and "all_to_all" in prims
+
+
+class TestSelectionWiring:
+    def test_manager_publishes_comm_gauges(self):
+        from deepspeed_tpu.runtime.config import OverlapConfig
+        from deepspeed_tpu.runtime.overlap.manager import OverlapManager
+        from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+
+        class _T:
+            metrics = MetricsRegistry()
+
+            def event(self, *a, **k):
+                pass
+
+        t = _T()
+        mgr = OverlapManager(OverlapConfig(enabled=True), telemetry=t)
+        mgr.comm_algo = "2hop"
+        mgr.comm_wire_bits = 8
+        mgr.comm_choice = h.CollectiveAlgoSelector(**FIXED).select(1 << 20)
+        mgr.publish()
+        vals = t.metrics.gauge_values()
+        assert vals["comm/algo_2hop"] == 1.0
+        assert vals["comm/wire_bits"] == 8.0
+        assert "comm/predicted_exchange_ms" in vals
+        assert "comm/predicted_wire_bytes" in vals
+
+    def test_engine_explicit_wire_resolves_2hop_on_sliced_mesh(self):
+        """hierarchical:"auto" + a cross-slice mesh: the selector resolves
+        2-hop before the first step build and the wire context consumes
+        it (the CPU-fallback roofline's slow "DCN" makes 2-hop the clear
+        analytic winner)."""
+        import deepspeed_tpu
+        from deepspeed_tpu.models.transformer import (CausalLM,
+                                                      TransformerConfig)
+
+        topo = initialize_mesh(TopologyConfig(zero_shard_size=N_INTRA),
+                               force=True)
+        cfg = TransformerConfig.tiny(use_flash=False)
+        model = CausalLM(cfg)
+        params = model.init_params(jax.random.PRNGKey(0))
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "gradient_accumulation_steps": 1,
+                    "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2},
+                    "overlap": {"enabled": True, "explicit_wire": True,
+                                "cross_slice_axes": "data_outer"}},
+            topology=topo)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": jnp.asarray(
+            rng.integers(0, cfg.vocab_size, size=(16, cfg.max_seq_len)),
+            jnp.int32)}
+        loss = eng.train_batch(batch)
+        assert np.isfinite(float(loss))
+        assert eng.overlap.comm_algo == "2hop"
+        assert eng._wire_ctx_cache.algo_2hop
+        # no exposed-comm measurement yet → the wire stays full precision
+        assert eng._wire_ctx_cache.wire_bits == 0
+
+
+class TestTooling:
+    def test_comm_package_lint_clean(self):
+        """tools/check_no_bare_print.py covers runtime/comm/ — the new
+        collectives must not print outside CLI seams."""
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        lint = os.path.join(repo, "tools", "check_no_bare_print.py")
+        pkg = os.path.join(repo, "deepspeed_tpu", "runtime", "comm")
+        quant = os.path.join(repo, "deepspeed_tpu", "ops", "quantizer")
+        proc = subprocess.run([sys.executable, lint, pkg, quant],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout
+
+    def test_comm_marker_registered(self):
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        with open(os.path.join(repo, "tests", "pytest.ini")) as f:
+            assert "comm:" in f.read()
+
+
+class TestWireBytePrediction:
+    def test_predicted_matches_jaxpr_measured(self, mesh2slice):
+        """The selector's operand-byte model must mirror what actually
+        lands in the traced program (the comm_sweep's predicted-vs-
+        measured column) — exact for group-aligned payloads."""
+        numel = 4 * N_DEV * 256 * 8          # group/rank aligned
+        leaves = [jnp.ones((numel,), jnp.float32)]
+        payload = numel * 4
+        spec = P()
+        for algo, wire in (("flat", "fp"), ("flat", "int8"),
+                           ("2hop", "fp"), ("2hop", "int8")):
+            def ex(ls):
+                outs, _ = h.exchange_leaves(
+                    ls, (DATA_OUTER, DATA), (DATA,), (DATA_OUTER,),
+                    algo, h.WIRE_BITS[wire], n=N_DEV)
+                return outs
+
+            traced = jax.make_jaxpr(compat_shard_map(
+                ex, mesh2slice.mesh, (spec,), spec,
+                manual_axes={DATA_OUTER, DATA}))(leaves)
+            measured = sum(o["bytes"] for o in fw.wire_ops(traced))
+            predicted = h.predict_operand_bytes(
+                payload, algo, wire, N_INTRA, N_INTER)["total"]
+            assert measured == int(predicted), \
+                (algo, wire, measured, predicted)

@@ -163,3 +163,113 @@ class TestQuantizedAllreduce:
         g = jnp.arange(12.0).reshape(3, 4)
         out, e, se = quantized_allreduce(g, (), bits=4)
         np.testing.assert_array_equal(np.asarray(out), np.asarray(g))
+
+
+@pytest.mark.comm
+class TestFusedWireParity:
+    """The EQuARX-style fused wire (one Pallas scale+quantize+pack kernel
+    feeding the collective, fused unpack+dequant+mean on the receive side)
+    must be BITWISE equal to the legacy jnp-composed wire under jit — the
+    fusion moves HBM traffic, never values."""
+
+    def _stacked(self, seed=0, shape=(N_DEV, 48, 8)):
+        rng = np.random.default_rng(seed)
+        return jnp.asarray(rng.normal(size=shape), jnp.float32)
+
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_fused_allreduce_bitwise_vs_unfused(self, mesh8, bits):
+        stacked = self._stacked()
+
+        def ex(fused):
+            def body(x):
+                out, _, _ = quantized_allreduce(x[0], (DATA,), bits=bits,
+                                                fused=fused)
+                return out[None]
+
+            return np.asarray(jax.jit(_sharded(
+                body, mesh8, (P(DATA),), P(DATA)))(stacked))
+
+        np.testing.assert_array_equal(ex(True), ex(False))
+
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_fused_gather_bitwise_vs_unfused(self, mesh8, bits):
+        rng = np.random.default_rng(1)
+        full = jnp.asarray(rng.normal(size=(N_DEV * 64, 16)), jnp.float32)
+
+        def ex(fused):
+            def body(x):
+                return quantized_all_gather_shard(
+                    x, (DATA,), dim=0, bits=bits, out_dtype=jnp.float32,
+                    fused=fused)
+
+            return np.asarray(jax.jit(_sharded(
+                body, mesh8, (P(DATA),), P()))(full))
+
+        np.testing.assert_array_equal(ex(True), ex(False))
+
+    def test_fused_loco_bitwise_vs_unfused(self, mesh8):
+        """LoCo residuals must also match: the fused path reconstructs
+        "what hit the wire" from the SAME quant+pack output the exchange
+        used, the legacy path re-quantizes — same math, same values."""
+        stacked = self._stacked(seed=2, shape=(N_DEV, 16, 16))
+        err0 = jnp.zeros((N_DEV, 16, 16), jnp.float32)
+        from deepspeed_tpu.runtime.comm_path import loco_partition_size
+
+        per = loco_partition_size(16 * 16, N_DEV)
+        serr0 = jnp.zeros((N_DEV, per), jnp.float32)
+        specs = (P(DATA),) * 3
+
+        def ex(fused):
+            def body(x, e, se):
+                out, ne, nse = quantized_allreduce(
+                    x[0], (DATA,), bits=4, error=e[0], server_error=se[0],
+                    fused=fused)
+                return out[None], ne[None], nse[None]
+
+            return jax.jit(_sharded(body, mesh8, specs, specs))(
+                stacked, err0, serr0)
+
+        a, b = ex(True), ex(False)
+        for got, ref in zip(a, b):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    def test_coalesced_loco_fused_parity_unaligned(self, mesh8):
+        """The single-quantization fused LoCo path (return_sent seam) must
+        match the legacy double-quantization composition bitwise — also on
+        a length that does NOT divide the quantization group, where the
+        two passes' padded shapes differ."""
+        from deepspeed_tpu.runtime.comm.coalesced_collectives import \
+            loco_quantized_reduce_scatter
+
+        rng = np.random.default_rng(5)
+        stacked = jnp.asarray(rng.normal(size=(N_DEV, 300)), jnp.float32)
+        err = jnp.asarray(rng.normal(size=(N_DEV, 300)) * 0.01, jnp.float32)
+
+        def run(fused):
+            def body(x, e):
+                r, ne = loco_quantized_reduce_scatter(
+                    x[0], e[0], (DATA,), bits=4, fused=fused)
+                return r[None], ne[None]
+
+            return jax.jit(_sharded(body, mesh8, (P(DATA), P(DATA)),
+                                    (P(DATA), P(DATA))))(stacked, err)
+
+        for got, ref in zip(run(True), run(False)):
+            np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+    @pytest.mark.parametrize("bits", [8, 4])
+    def test_coalesced_reduce_scatter_fused_parity(self, mesh8, bits):
+        from deepspeed_tpu.runtime.comm.coalesced_collectives import \
+            quantized_reduce_scatter
+
+        stacked = self._stacked(seed=3)
+
+        def ex(fused):
+            def body(x):
+                return quantized_reduce_scatter(x[0], (DATA,), bits=bits,
+                                                fused=fused)[None]
+
+            return np.asarray(jax.jit(_sharded(
+                body, mesh8, (P(DATA),), P(DATA)))(stacked))
+
+        np.testing.assert_array_equal(ex(True), ex(False))

@@ -103,8 +103,14 @@ class TestSparseLayerParity:
                 from deepspeed_tpu.moe.sharded_moe import dispatch_to_experts
                 return dispatch_to_experts(g.dispatch, tokens, jnp.float32)
 
-            cost = jax.jit(f).lower(tokens, logits).compile().cost_analysis()
-            return (cost or {}).get("flops", 0.0)
+            # compiled_cost_stats tolerates every jax-version shape of
+            # cost_analysis() (dict, [dict], None) — raw .get() broke when
+            # this jax started returning a list
+            from deepspeed_tpu.profiling.flops_profiler.profiler import \
+                compiled_cost_stats
+
+            return compiled_cost_stats(
+                jax.jit(f).lower(tokens, logits).compile())["flops"]
 
         f_dense = flops("dense", 4096)
         f_sparse = flops("sparse", 4096)
