@@ -10,8 +10,12 @@ Submodules (imported directly to keep this package import-light):
     cancellation, KV-pressure preemption with prefill-recompute resume,
     decode watchdog (NaN isolation + hang incidents).
   * ``server``      — the ``dstpu-serve`` HTTP front end (POST
-    /v1/generate with optional SSE streaming, /metrics, /healthz serving
-    states, graceful drain on SIGTERM).
+    /v1/generate with optional SSE streaming + per-request
+    ``speculative: {mode, k}``, /metrics, /healthz serving states,
+    graceful drain on SIGTERM).
+  * ``speculative`` — speculative decoding: n-gram and draft-model
+    drafters plus the verify-window driver (greedy streams bit-exact vs
+    vanilla decode; rejection rolls the paged KV length back for free).
   * ``model_runner``/``kernels``/``ragged`` — compiled forward, paged
     attention kernels, and the paged KV-cache substrate.
 """

@@ -122,6 +122,7 @@ class InferenceEngineV2:
         self._wrappers: Dict[Tuple[int, int], RaggedBatchWrapper] = {}
         self._steps: Dict[Tuple[int, int], object] = {}
         self._decode_loops: Dict = {}
+        self._verify_steps: Dict[Tuple[int, int], object] = {}
         self.trace_counts: Dict[Tuple, int] = {}
         #: device-resident continuous-decode state: the advanced packed
         #: metadata returned by the last fused window, reusable by the next
@@ -129,8 +130,18 @@ class InferenceEngineV2:
         self._decode_state: Optional[Dict] = None
         self.decode_resume_hits = 0
         #: monotonically increasing fused-window index — the ``step``
-        #: passed to the ``decode_window`` fault-injection site
+        #: passed to the ``decode_window`` fault-injection site (verify
+        #: windows share the counter and the site, so the chaos harness
+        #: covers spec-dec with no new injection grammar)
         self.decode_windows_dispatched = 0
+        #: cumulative speculative-decoding accounting (drafted = candidate
+        #: tokens scored, draft_accepted = candidates matching the target's
+        #: greedy chain, emitted = tokens produced by verify windows —
+        #: always >= windows, each window emits at least the seed's argmax)
+        self.spec_windows = 0
+        self.spec_drafted = 0
+        self.spec_draft_accepted = 0
+        self.spec_emitted = 0
         self._rng = jax.random.PRNGKey(0)
         self._param_bytes = sum(
             x.size * jnp.dtype(x.dtype).itemsize
@@ -199,6 +210,26 @@ class InferenceEngineV2:
             self._steps[key] = jax.jit(self._counted(key, fn),
                                        donate_argnums=(1,))
         return self._steps[key]
+
+    def _verify_step_for(self, key: Tuple[int, int]):
+        """Per-bucket compiled spec-dec verify pass (model_runner.
+        build_verify_step); first use of a bucket compiles — returns
+        (step, first_compile) so callers can flag compile-polluted wall
+        times off the telemetry plane like decode windows do."""
+        first = key not in self._verify_steps
+        if first:
+            from .model_runner import build_verify_step
+
+            c = self.config
+            fn = build_verify_step(
+                self.cfg, max_q=key[0], num_blocks=self._num_blocks,
+                attn_impl=c.attn_impl, max_seqs=key[1],
+                max_blocks=self._wrapper_for(key).max_blocks,
+                block_q=c.block_q, pages_per_chunk=c.pages_per_chunk,
+                jit=False, kv_replicate=self._kv_replicate)
+            self._verify_steps[key] = jax.jit(
+                self._counted(("verify",) + key, fn), donate_argnums=(1,))
+        return self._verify_steps[key], first
 
     # ------------------------------------------------------------------ #
     # Admission control (reference :158-242)
@@ -278,6 +309,175 @@ class InferenceEngineV2:
         so their admission behavior cannot desynchronize."""
         need = min(prompt_len + max_new, self.config.max_ctx)
         return need, -(-need // self.config.block_size)
+
+    # ------------------------------------------------------------------ #
+    # Speculative decoding: verify-window mode over the paged decode path
+    # ------------------------------------------------------------------ #
+    def rollback_kv(self, uid: int, new_seen: int) -> None:
+        """Truncate ``uid``'s KV length to ``new_seen`` tokens — the
+        spec-dec rejection path (and the draft engine's resync path).
+
+        Cheap by construction: pages are NEVER copied or freed — the block
+        allocator's truncation-keeps-mid-block-state property means the
+        rows past the new length are simply dead, and the next append for
+        this sequence overwrites them (positions re-derive from
+        ``seen_tokens``).  Blocks stay allocated so a whole-lifetime
+        reservation (LifecycleScheduler admission invariant: live requests
+        never allocate mid-flight) survives any number of rollbacks; the
+        over-hold is bounded by one speculative window.  Device-resident
+        decode-resume metadata is invalidated — it was advanced past the
+        rollback point."""
+        seq = self.state_manager.get_sequence(uid)
+        assert seq is not None, f"rollback of unknown uid {uid}"
+        assert 0 <= new_seen <= seq.seen_tokens, \
+            f"rollback can only truncate: {new_seen} > {seq.seen_tokens}"
+        seq.seen_tokens = int(new_seen)
+        seq.in_flight_tokens = 0
+        self._decode_state = None
+
+    def verify_decode(self, uids: Sequence[int],
+                      seed_tokens: Sequence[int],
+                      drafts: Sequence[Sequence[int]],
+                      draft_wall_s: float = 0.0) -> "VerifyResult":
+        """One speculative verify window: score every sequence's
+        ``[seed] + draft`` candidate row in ONE ragged multi-token pass,
+        accept the longest prefix matching the target's greedy argmax, and
+        roll the KV length back past the first rejection.
+
+        Greedy bit-exactness by construction: position 0's argmax is
+        computed over exactly the context vanilla decode would see for the
+        seed token, and draft position j only stays in the chain when every
+        earlier candidate matched — so the emitted tokens are the vanilla
+        greedy stream, just discovered up to ``K+1`` at a time.  Every
+        window emits at least one token (the seed position's argmax), so
+        rejection can never stall a stream; acceptance only changes speed.
+
+        KV accounting: the full speculative extent (``1 + len(draft)``
+        tokens per row) is appended — and allocated — up front, so
+        KV-pressure signals (``kv_used_fraction``) count speculative pages
+        while the window is in flight; rejection truncates the length
+        (``rollback_kv``) without touching pages.
+
+        ``draft_wall_s`` (host time the caller spent drafting) folds into
+        the published ``serving/draft_overhead_frac`` / effective-tok/s
+        gauges.  Shares the ``decode_window`` fault-injection site and the
+        per-sequence non-finite isolation contract with fused decode
+        windows."""
+        n = len(uids)
+        assert n == len(seed_tokens) == len(drafts)
+        lens = [1 + len(d) for d in drafts]
+        if sum(lens) > self.config.max_tokens:
+            # fail BEFORE touching allocator/descriptor state: the ragged
+            # pack would raise mid-insert otherwise.  Callers must deal
+            # draft lengths out of the flat token budget (the lifecycle
+            # scheduler does; see _run_verify_window).
+            raise RuntimeError(
+                f"verify window needs {sum(lens)} flat tokens "
+                f"({n} seqs + drafts) > max_tokens "
+                f"{self.config.max_tokens} — cap the draft lengths")
+        verdict = self.can_schedule(uids, lens)
+        if verdict != SchedulingResult.Success:
+            raise RuntimeError(f"cannot schedule verify window: {verdict}")
+        self._decode_state = None      # host forward invalidates device meta
+        bucket = self.bucket_for(sum(lens), n)
+        wrapper = self._wrapper_for(bucket)
+        wrapper.clear()
+        ctx_before = []
+        for uid, seed, draft in zip(uids, seed_tokens, drafts):
+            seq = self.state_manager.get_or_create_sequence(uid)
+            ctx_before.append(seq.seen_tokens)
+            ok = self.state_manager.maybe_allocate_kv(seq, 1 + len(draft))
+            assert ok, "allocator raced"  # can_schedule checked
+            wrapper.insert_sequence(seq, [int(seed)] + [int(t) for t in draft])
+        batch = wrapper.finalize()
+        dev = jnp.asarray(batch.pack())
+        step, first_compile = self._verify_step_for(bucket)
+
+        t0 = time.perf_counter()
+        self.decode_windows_dispatched += 1
+        poisoned = False
+        try:
+            inject("decode_window", step=self.decode_windows_dispatched)
+        except InjectedNaN:
+            poisoned = True
+            self._poison_kv(uids[0])
+        greedy_dev, bad_dev, new_pages = step(self.params, self.kv.pages, dev)
+        self.kv.update(new_pages)
+        greedy = np.asarray(greedy_dev)
+        bad = np.asarray(bad_dev)
+        duration_s = time.perf_counter() - t0
+
+        accepted: List[List[int]] = []
+        nonfinite_uids: List[int] = []
+        drafted = accepted_draft = 0
+        for row, (uid, draft) in enumerate(zip(uids, drafts)):
+            seq = self.state_manager.get_sequence(uid)
+            seq.post_forward()         # seen += 1 + len(draft)
+            if bool(bad[row]):
+                # poisoned row: emit nothing and leave NO speculative KV —
+                # the caller flushes the request (NaN isolation, as in
+                # fused decode windows); batchmates stay clean
+                self.rollback_kv(uid, ctx_before[row])
+                nonfinite_uids.append(uid)
+                accepted.append([])
+                continue
+            off = int(batch.q_offset[row])
+            g = [int(t) for t in greedy[off:off + 1 + len(draft)]]
+            a = 0
+            while a < len(draft) and int(draft[a]) == g[a]:
+                a += 1
+            drafted += len(draft)
+            accepted_draft += a
+            accepted.append(g[:a + 1])
+            # truncate to the accepted length: seed + a matched drafts are
+            # real context; rows past them are dead until overwritten
+            self.rollback_kv(uid, ctx_before[row] + 1 + a)
+        emitted = sum(len(t) for t in accepted)
+        self.spec_windows += 1
+        self.spec_drafted += drafted
+        self.spec_draft_accepted += accepted_draft
+        self.spec_emitted += emitted
+        result = VerifyResult(
+            uids=list(uids), accepted=accepted,
+            nonfinite_uids=nonfinite_uids, drafted=drafted,
+            accepted_draft=accepted_draft, emitted=emitted,
+            duration_s=duration_s, draft_s=float(draft_wall_s),
+            compiled=first_compile, poisoned=poisoned)
+        self._record_verify_window(result)
+        return result
+
+    def _record_verify_window(self, result: "VerifyResult") -> None:
+        """Publish the spec-dec gauges (``serving/acceptance_rate``,
+        ``serving/effective_tok_per_s``, ``serving/draft_overhead_frac``)
+        and a ``verify_window`` event.  Compile-polluted windows (first use
+        of a verify bucket) stay off the telemetry plane — their wall time
+        measures XLA compilation, exactly like decode-window rooflines."""
+        if result.compiled:
+            return
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is None:
+            return
+        m = tel.metrics
+        if self.spec_drafted:
+            m.gauge("serving/acceptance_rate").set(
+                round(self.spec_draft_accepted / self.spec_drafted, 4))
+        wall = result.duration_s + result.draft_s
+        if wall > 0:
+            m.gauge("serving/effective_tok_per_s").set(
+                round(result.emitted / wall, 2))
+            m.gauge("serving/draft_overhead_frac").set(
+                round(result.draft_s / wall, 4))
+        tel.event("verify_window", n_seqs=len(result.uids),
+                  drafted=result.drafted,
+                  accepted_draft=result.accepted_draft,
+                  emitted=result.emitted,
+                  acceptance=round(result.accepted_draft /
+                                   result.drafted, 4)
+                  if result.drafted else None,
+                  duration_s=round(result.duration_s, 6),
+                  draft_s=round(result.draft_s, 6))
 
     # ------------------------------------------------------------------ #
     # Fused multi-step decode (device-resident loop; the CUDA-graph-decode
@@ -579,6 +779,34 @@ class InferenceEngineV2:
         )
 
         OrbaxCheckpointEngine(path).save(self.params, "model")
+
+
+@dataclasses.dataclass
+class VerifyResult:
+    """Outcome of one speculative verify window
+    (:meth:`InferenceEngineV2.verify_decode`).
+
+    ``accepted[i]`` is the greedy token chain emitted for ``uids[i]`` —
+    ``1 + a_i`` tokens where ``a_i`` is the matched-draft prefix length;
+    its LAST element is the next decode seed (not yet in the KV cache,
+    matching put()/decode semantics).  A uid listed in ``nonfinite_uids``
+    emitted nothing and its KV was rolled back to the pre-window length.
+    """
+
+    uids: List[int]
+    accepted: List[List[int]]
+    nonfinite_uids: List[int]
+    drafted: int                 # draft candidate tokens scored
+    accepted_draft: int          # of those, matched the greedy chain
+    emitted: int                 # tokens produced (>= len(uids) - poisoned)
+    duration_s: float            # verify forward wall time
+    draft_s: float               # caller-reported drafting wall time
+    compiled: bool               # first use of this verify bucket
+    poisoned: bool               # decode_window nan injection fired
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.accepted_draft / self.drafted if self.drafted else 0.0
 
 
 class DecodeWindow:

@@ -178,6 +178,17 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
             jax.lax.broadcasted_iota(jnp.int32, (rows, CH), 1)
         q_pos = kvl - (q1 - q0) + (t - q0)           # absolute position
         mask = (t >= q0) & (t < q1) & (k_pos <= q_pos) & (k_pos < kvl)
+        # rows OUTSIDE sequence s must treat s's chunks as exact no-ops.
+        # Masked scores alone don't achieve that: a fully-masked row has
+        # m = -NEG_INF so p = exp(-1e30 - -1e30) = 1, and its acc picks up
+        # a 1-weighted sum of s's V values.  Finite garbage washes out
+        # later (the row's own chunk rescales by alpha ≈ 0) — but
+        # alpha·NaN STICKS, so one NaN-poisoned sequence would
+        # contaminate every batchmate sharing its query block.  Gate the
+        # accumulator updates on row ownership instead (the per-sequence
+        # NaN-isolation contract the dense/decode lowerings already
+        # enforce by construction).
+        row_ok = (t[:, :1] >= q0) & (t[:, :1] < q1)  # [rows, 1]
         kv = kv_bufs[slot]                           # [P, ps, 2KV, hd]
         # pages past this block's CAUSAL bound (eff_kvl <= kv_len) are never
         # DMA'd — their buffer rows hold stale / uninitialized data.  Scores
@@ -210,15 +221,23 @@ def _ragged_paged_kernel(kvl_ref, pt_ref, cu_ref,        # scalar prefetch
             s_mat = jnp.where(mask, s_mat, _NEG_INF)
 
             m_prev = m_scr[h][:, :1]
-            m_new = jnp.maximum(m_prev, jnp.max(s_mat, axis=1, keepdims=True))
+            m_cand = jnp.maximum(m_prev,
+                                 jnp.max(s_mat, axis=1, keepdims=True))
+            # foreign rows keep their softmax state: m frozen ⇒ alpha = 1
+            # ⇒ acc/l untouched, and their (possibly NaN) chunk
+            # contribution is dropped below
+            m_new = jnp.where(row_ok, m_cand, m_prev)
             alpha = jnp.exp(m_prev - m_new)
             p_mat = jnp.exp(s_mat - m_new)
             l_scr[h] = jnp.broadcast_to(
                 alpha * l_scr[h][:, :1] +
-                jnp.sum(p_mat, axis=1, keepdims=True), l_scr[h].shape)
+                jnp.where(row_ok,
+                          jnp.sum(p_mat, axis=1, keepdims=True), 0.0),
+                l_scr[h].shape)
             acc[h] = acc[h] * alpha + \
-                jnp.dot(p_mat.astype(vh.dtype), vh,
-                        preferred_element_type=jnp.float32)
+                jnp.where(row_ok,
+                          jnp.dot(p_mat.astype(vh.dtype), vh,
+                                  preferred_element_type=jnp.float32), 0.0)
             m_scr[h] = jnp.broadcast_to(m_new, m_scr[h].shape)
 
     # ---- main walk: (sequence, chunk) pairs, double-buffered ------------ #
@@ -553,6 +572,44 @@ def decode_paged_attention(q: jnp.ndarray, kv_pages: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct((S, H, hd), q.dtype),
         interpret=_interpret() if interpret is None else interpret,
     )(kv_lens.astype(jnp.int32), page_table.astype(jnp.int32), q, kv_pages)
+
+
+def verify_window_attention(q: jnp.ndarray, kv_pages: jnp.ndarray,
+                            kv_lens: jnp.ndarray, page_table: jnp.ndarray,
+                            cu_q_lens: jnp.ndarray, *,
+                            num_kv_heads: int,
+                            scale: Optional[float] = None,
+                            alibi=None, alibi_scaled: bool = False,
+                            block_q: int = 128, pages_per_chunk: int = 8,
+                            interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Speculative-decoding verify windows: score a short multi-token row
+    per sequence (the seed token plus K draft candidates) in ONE pass.
+
+    This is the ragged prefill kernel's multi-row scoring reused — a verify
+    window IS a ragged batch whose rows are all K+1 tokens or shorter — but
+    dispatched through its own seam so the query tile is sized to the
+    window: a verify window is ``S·(K+1)`` flat tokens (tens, not
+    hundreds), and the prefill default ``block_q=128`` would burn a
+    mostly-padding MXU tile per grid step.  Clamping the tile to the flat
+    token budget keeps the whole window in one grid step, which is also
+    what makes verify cheaper than K+1 sequential decode steps: one page
+    walk per sequence scores every candidate position.
+
+    Layout contract (what the engine's verify bucket builds): sequence s's
+    ``q_len[s] = 1 + len(draft_s)`` query tokens sit contiguously at flat
+    indices ``[cu_q_lens[s], cu_q_lens[s+1])``; ``kv_lens`` counts seen +
+    in-flight (so the KV append for the window has already happened);
+    causal masking inside the kernel gives draft position j visibility of
+    the real context plus drafts ``< j`` — exactly the state vanilla decode
+    would have when it reached that position, which is why the greedy
+    argmax chain is stream-identical to vanilla decode.
+    """
+    T = q.shape[0]
+    return ragged_paged_attention(
+        q, kv_pages, kv_lens, page_table, cu_q_lens,
+        num_kv_heads=num_kv_heads, scale=scale, alibi=alibi,
+        alibi_scaled=alibi_scaled, block_q=min(block_q, T),
+        pages_per_chunk=pages_per_chunk, interpret=interpret)
 
 
 def decode_attend_dense(q: jnp.ndarray, kv_pages: jnp.ndarray,

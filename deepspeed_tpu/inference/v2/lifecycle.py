@@ -96,6 +96,13 @@ class ServeRequest:
     deadline_s: Optional[float] = None
     ttft_timeout_s: Optional[float] = None
     on_event: Optional[Callable[[str, "ServeRequest"], None]] = None
+    #: per-request speculative-decoding override (`/v1/generate` grows
+    #: ``speculative: {mode, k}``): ``spec_mode`` None inherits the
+    #: scheduler default; "off" disables; any other mode enables the
+    #: scheduler's configured drafter.  ``spec_k`` overrides the draft
+    #: length for this request only.
+    spec_mode: Optional[str] = None
+    spec_k: Optional[int] = None
 
     # -- runtime state (scheduler-owned) --
     state: RequestState = RequestState.QUEUED
@@ -169,6 +176,7 @@ class LifecycleScheduler:
                  eos_token_id: Optional[int] = None,
                  fallback_tok_per_s: float = 32.0,
                  degraded_window_s: float = 60.0,
+                 speculative=None, drafter=None,
                  clock: Callable[[], float] = time.monotonic):
         self.eng = engine
         self.max_queue = int(max_queue)
@@ -180,6 +188,19 @@ class LifecycleScheduler:
         self.fallback_tok_per_s = float(fallback_tok_per_s)
         self.degraded_window_s = float(degraded_window_s)
         self.clock = clock
+        #: speculative decoding (SpeculativeConfig + drafter): when armed,
+        #: decode windows become VERIFY windows — the drafter proposes K
+        #: candidates per stream, the engine scores seed+K in one ragged
+        #: pass, and the longest greedy-matching prefix is accepted.
+        #: Greedy streams stay bit-exact; only tok/s changes.  A drafter
+        #: instance may be handed in (draft_model mode needs its engine);
+        #: otherwise it is built from the config.
+        self.spec = speculative
+        self.drafter = drafter
+        if self.spec is not None and self.drafter is None:
+            from .speculative import make_drafter
+
+            self.drafter = make_drafter(self.spec)
 
         self._lock = threading.RLock()
         self._reqs: Dict[int, ServeRequest] = {}
@@ -311,6 +332,8 @@ class LifecycleScheduler:
         self._decodes.pop(uid, None)
         if holds_blocks:
             self.eng.flush([uid])
+        if self.drafter is not None:
+            self.drafter.flush(uid)
         req.state = state
         req.finish_reason = reason
         req.finished_t = self.clock()
@@ -502,6 +525,8 @@ class LifecycleScheduler:
     def _finish(self, req: ServeRequest) -> None:
         self._decodes.pop(req.uid, None)
         self.eng.flush([req.uid])
+        if self.drafter is not None:
+            self.drafter.flush(req.uid)
         req.state = RequestState.FINISHED
         req.finish_reason = "eos" if (
             self.eos_token_id is not None and req.produced
@@ -539,6 +564,9 @@ class LifecycleScheduler:
                              "serving/rejected")
         if not uids:
             return []
+        if self.drafter is not None and \
+                any(self._spec_k_for(self._reqs[u]) > 0 for u in uids):
+            return self._run_verify_window(uids, room)
         steps = min(self.window_steps,
                     min(self._reqs[u].remaining for u in uids),
                     min(room[u] for u in uids))
@@ -547,24 +575,36 @@ class LifecycleScheduler:
         seeds = [self._decodes[u] for u in uids]
         window = self.eng.decode_batch_async(uids, seeds, steps)
         toks = window.tokens()
-        finished: List[int] = []
+        streams = [[int(t) for t in toks[:, col]]
+                   for col in range(len(uids))]
+        return self._apply_window_results(
+            uids, streams, set(window.nonfinite_uids()),
+            wall_s=window.duration_s, compiled=window.compiled)
 
-        if not window.compiled and window.duration_s is not None \
-                and window.duration_s > self.hang_deadline_s:
+    def _apply_window_results(self, uids: List[int],
+                              streams: List[List[int]], poisoned: set,
+                              wall_s: Optional[float],
+                              compiled: bool) -> List[int]:
+        """Shared tail of fused-decode and verify windows: post-hoc hang
+        detection, per-request NaN isolation, eos truncation, finish /
+        rotate bookkeeping.  ``streams[i]`` is uid i's newly produced
+        tokens (ignored for poisoned uids)."""
+        finished: List[int] = []
+        if not compiled and wall_s is not None \
+                and wall_s > self.hang_deadline_s:
             # post-hoc hang detection: the window drained, but took longer
             # than the deadline — a stuck DMA / pathological host stall.
             self.last_incident_t = self.clock()
             self.last_incident_kind = "window_hang"
             self._count("serving/window_hang")
             self._event("serving_window_hang", uids=list(uids),
-                        duration_s=round(window.duration_s, 3),
+                        duration_s=round(wall_s, 3),
                         deadline_s=self.hang_deadline_s)
 
-        poisoned = set(window.nonfinite_uids())
         if poisoned:
             self.last_incident_t = self.clock()
             self.last_incident_kind = "nan"
-        for col, uid in enumerate(uids):
+        for uid, stream in zip(uids, streams):
             req = self._reqs[uid]
             if uid in poisoned:
                 # flush ONLY the poisoned request; batchmates are clean by
@@ -574,7 +614,7 @@ class LifecycleScheduler:
                              "serving_nan_isolated")
                 finished.append(uid)
                 continue
-            stream = [int(t) for t in toks[:, col]]
+            stream = list(stream)
             if self.eos_token_id is not None and \
                     self.eos_token_id in stream:
                 stream = stream[:stream.index(self.eos_token_id) + 1]
@@ -587,6 +627,72 @@ class LifecycleScheduler:
                 self._decodes[uid] = req.produced[-1]
         self._publish_gauges()
         return finished
+
+    # ------------------------------------------------------------------ #
+    # Speculative decoding (verify windows)
+    # ------------------------------------------------------------------ #
+    def _spec_k_for(self, req: ServeRequest) -> int:
+        """Effective draft length for a request: the per-request override
+        (``speculative: {mode, k}`` on ``/v1/generate``) on top of the
+        scheduler default.  A request's ``spec_mode`` acts as a toggle for
+        the SERVER-configured drafter — a single scheduler runs one
+        drafter, so requesting a different mode than the server's enables
+        that drafter rather than building another."""
+        if self.drafter is None:
+            return 0
+        mode = req.spec_mode if req.spec_mode is not None else \
+            (self.spec.mode if self.spec else "off")
+        if mode == "off":
+            return 0
+        k = req.spec_k if req.spec_k is not None else \
+            (self.spec.k if self.spec else 0)
+        return max(int(k), 0)
+
+    def _run_verify_window(self, uids: List[int],
+                           room: Dict[int, int]) -> List[int]:
+        """One speculative verify window over the rotated decode set.
+
+        Per stream the drafter proposes up to ``spec_k`` candidates —
+        capped at ``remaining - 1`` and ``room - 1`` so the speculative
+        append can never outgrow the whole-lifetime block reservation or
+        the context cap (the admission invariant that live requests never
+        allocate KV mid-flight survives speculation: verify-window allocs
+        are always no-ops under a reservation), and at the engine's flat
+        token budget: the window packs ``sum(1 + k_i)`` tokens into one
+        ragged batch, so with every stream drafting the wide batch could
+        exceed ``max_tokens`` and fail the pack — the leftover budget
+        after the mandatory one-token-per-stream rows is dealt out in
+        rotation order instead (late streams draft less this window, and
+        the rotation moves the full allowance around).  Streams whose
+        drafter has nothing to say ride along with an empty draft (a
+        1-token verify is exactly one vanilla decode step).  Greedy
+        bit-exactness, watchdog/NaN isolation, eos handling and
+        preemption bookkeeping all mirror the fused-decode path."""
+        t_d0 = time.perf_counter()
+        budget = self.eng.config.max_tokens - len(uids)   # draft allowance
+        seeds, drafts = [], []
+        for u in uids:
+            req = self._reqs[u]
+            cap = max(0, min(self._spec_k_for(req), req.remaining - 1,
+                             room[u] - 1, budget))
+            d = []
+            if cap > 0:
+                d = [int(t) for t in self.drafter.draft(
+                    u, req.prompt + req.produced, cap)][:cap]
+            budget -= len(d)
+            drafts.append(d)
+            seeds.append(self._decodes[u])
+        draft_s = time.perf_counter() - t_d0
+        result = self.eng.verify_decode(uids, seeds, drafts,
+                                        draft_wall_s=draft_s)
+        self._count("serving/spec_windows")
+        if result.drafted:
+            self._count("serving/spec_drafted", result.drafted)
+        if result.accepted_draft:
+            self._count("serving/spec_accepted", result.accepted_draft)
+        return self._apply_window_results(
+            uids, result.accepted, set(result.nonfinite_uids),
+            wall_s=result.duration_s + draft_s, compiled=result.compiled)
 
     def step(self) -> List[int]:
         """One scheduler iteration; returns uids that reached a terminal

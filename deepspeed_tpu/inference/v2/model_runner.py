@@ -38,6 +38,7 @@ from .kernels.ragged_ops import (
     decode_attention,
     paged_kv_append,
     ragged_paged_attention,
+    verify_window_attention,
 )
 from .ragged.ragged_wrapper import pack_layout
 
@@ -145,10 +146,11 @@ def _unpack_batch(batch, max_q, max_seqs, max_blocks):
 
 def _ragged_attend(q, kv_pages, batch, *, attn_impl, layer, num_blocks,
                    max_q, scale, alibi=None, alibi_scaled=False,
-                   block_q=128, pages_per_chunk=8, decode_mode=False):
+                   block_q=128, pages_per_chunk=8, decode_mode=False,
+                   verify_mode=False):
     """Shared ragged attention dispatch: the flat-token Pallas paged kernel,
-    the decode-specialized fast path, or the dense page-gather oracle.
-    q: [T, H, hd] → [T, H*hd].
+    the decode-specialized fast path, the spec-dec verify-window path, or
+    the dense page-gather oracle.  q: [T, H, hd] → [T, H*hd].
 
     ``kv_pages`` is the FULL multi-layer page pool; ``layer`` (traced) picks
     this layer's pages via table arithmetic — no per-layer slice
@@ -159,11 +161,26 @@ def _ragged_attend(q, kv_pages, batch, *, attn_impl, layer, num_blocks,
     what the fused decode loop's batches look like by construction) and
     dispatches the one-token-per-sequence kernel instead of burning a full
     ``block_q`` query tile per decoding sequence.
+
+    ``verify_mode`` (mutually exclusive with ``decode_mode``) is the
+    speculative-decoding seam: rows are short multi-token windows
+    (seed + K draft candidates) and dispatch goes through
+    :func:`verify_window_attention`, the ragged prefill kernel's multi-row
+    scoring with the query tile clamped to the window's flat token budget.
     """
+    assert not (decode_mode and verify_mode), \
+        "decode_mode and verify_mode are mutually exclusive dispatches"
     T, H, hd = q.shape
     KV = kv_pages.shape[2] // 2
     q_len, ctx_len = batch["q_len"], batch["ctx_len"]
     pt_l = batch["block_table"] + layer * num_blocks          # [S, NB]
+    if attn_impl == "paged" and verify_mode:
+        out = verify_window_attention(
+            q, kv_pages, ctx_len, pt_l, batch["cu_q_lens"],
+            num_kv_heads=KV, scale=scale, alibi=alibi,
+            alibi_scaled=alibi_scaled, block_q=block_q,
+            pages_per_chunk=pages_per_chunk)
+        return out.reshape(T, H * hd)
     if attn_impl == "paged" and decode_mode:
         S = q_len.shape[0]
         SW = min(S, T)
@@ -206,8 +223,12 @@ def ragged_forward(params: Dict, kv_pages: jnp.ndarray, batch,
                    attn_impl: str = "paged", max_seqs: int = 0,
                    max_blocks: int = 0, block_q: int = 128,
                    pages_per_chunk: int = 8, decode_mode: bool = False,
+                   verify_mode: bool = False,
                    kv_replicate=None) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """→ (last-token logits [max_seqs, V], new kv_pages)."""
+    """→ (last-token logits [max_seqs, V], new kv_pages); with
+    ``verify_mode`` → (ALL-position logits [max_q, V], new kv_pages) — the
+    spec-dec verify pass needs the target's greedy argmax at every window
+    position, not just each sequence's last token."""
     batch = _unpack_batch(batch, max_q, max_seqs, max_blocks)
     tokens = batch["tokens"]              # [T]
     page_of = batch["page_of_token"]      # [T] layer-relative
@@ -257,7 +278,8 @@ def ragged_forward(params: Dict, kv_pages: jnp.ndarray, batch,
                                 layer=l_idx, num_blocks=num_blocks,
                                 max_q=max_q, scale=scale, block_q=block_q,
                                 pages_per_chunk=pages_per_chunk,
-                                decode_mode=decode_mode).astype(dtype)
+                                decode_mode=decode_mode,
+                                verify_mode=verify_mode).astype(dtype)
         x = x + o_flat @ lp["o_proj"]["kernel"]
         h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
         if cfg.num_experts > 1:
@@ -282,7 +304,10 @@ def ragged_forward(params: Dict, kv_pages: jnp.ndarray, batch,
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
 
     x = rms_norm(x, params["norm_f"]["scale"], cfg.norm_eps)
-    last = jnp.take(x, logit_idx, axis=0)                          # [S, D]
+    # verify_mode: every window position needs its argmax (the spec-dec
+    # accept test compares the target's greedy chain against the draft
+    # candidates position by position), so skip the last-token gather
+    last = x if verify_mode else jnp.take(x, logit_idx, axis=0)    # [S, D]
     if cfg.tie_embeddings:
         logits = last @ params["embed"]["embedding"].T
     else:
@@ -295,7 +320,8 @@ def ragged_forward_universal(params: Dict, kv_pages: jnp.ndarray, batch, cfg,
                              attn_impl: str = "paged", max_seqs: int = 0,
                              max_blocks: int = 0, block_q: int = 128,
                              pages_per_chunk: int = 8,
-                             decode_mode: bool = False, kv_replicate=None
+                             decode_mode: bool = False,
+                             verify_mode: bool = False, kv_replicate=None
                              ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Paged ragged serving for the universal (ArchConfig) families —
     gpt2/gptj/opt/bloom/falcon/phi serve through the SAME put/query/flush
@@ -366,7 +392,8 @@ def ragged_forward_universal(params: Dict, kv_pages: jnp.ndarray, batch, cfg,
                                 alibi_scaled=cfg.alibi_scaled,
                                 block_q=block_q,
                                 pages_per_chunk=pages_per_chunk,
-                                decode_mode=decode_mode).astype(dtype)
+                                decode_mode=decode_mode,
+                                verify_mode=verify_mode).astype(dtype)
         attn_out = o_flat @ lp["o_proj"]["kernel"]
         if "bias" in lp["o_proj"]:
             attn_out = attn_out + lp["o_proj"]["bias"]
@@ -399,7 +426,8 @@ def ragged_forward_universal(params: Dict, kv_pages: jnp.ndarray, batch, cfg,
         (params["layers"], jnp.arange(cfg.num_layers, dtype=jnp.int32)))
 
     x = norm(x, params["norm_f"])
-    last = jnp.take(x, logit_idx, axis=0)
+    # verify_mode: all-position logits (see ragged_forward)
+    last = x if verify_mode else jnp.take(x, logit_idx, axis=0)
     if cfg.tie_embeddings:
         logits = last @ params["embed"]["embedding"].T
     else:
@@ -413,7 +441,8 @@ def build_ragged_step(cfg, max_q: int, num_blocks: int,
                       attn_impl: str = "paged", max_seqs: int = 0,
                       max_blocks: int = 0, block_q: int = 128,
                       pages_per_chunk: int = 8, jit: bool = True,
-                      decode_mode: bool = False, kv_replicate=None):
+                      decode_mode: bool = False, verify_mode: bool = False,
+                      kv_replicate=None):
     """Jitted step with a donated page pool (the CUDA-graph analogue: one
     compiled program reused for every batch; reference engine.py:494
     _create_cuda_graph).  Dispatches on the config type: TransformerConfig →
@@ -421,8 +450,11 @@ def build_ragged_step(cfg, max_q: int, num_blocks: int,
     ``jit=False`` returns the raw traceable fn (for embedding in the fused
     decode loop); ``decode_mode=True`` dispatches the one-token-per-sequence
     decode attention path (requires row-major decode batches);
-    ``kv_replicate`` (replicated NamedSharding) must be passed when params
-    are TP-sharded — see :func:`paged_kv_append`."""
+    ``verify_mode=True`` dispatches the spec-dec verify-window path (short
+    multi-token rows, ALL-position logits — see :func:`build_verify_step`
+    for the argmax/accept wrapper); ``kv_replicate`` (replicated
+    NamedSharding) must be passed when params are TP-sharded — see
+    :func:`paged_kv_append`."""
     from ...models.families import ArchConfig
 
     assert attn_impl in ("paged", "gather"), \
@@ -433,8 +465,58 @@ def build_ragged_step(cfg, max_q: int, num_blocks: int,
                  attn_impl=attn_impl, max_seqs=max_seqs,
                  max_blocks=max_blocks, block_q=block_q,
                  pages_per_chunk=pages_per_chunk, decode_mode=decode_mode,
-                 kv_replicate=kv_replicate)
+                 verify_mode=verify_mode, kv_replicate=kv_replicate)
     return jax.jit(fn, donate_argnums=(1,)) if jit else fn
+
+
+def build_verify_step(cfg, *, max_q: int, num_blocks: int,
+                      attn_impl: str = "paged", max_seqs: int = 0,
+                      max_blocks: int = 0, block_q: int = 128,
+                      pages_per_chunk: int = 8, jit: bool = True,
+                      kv_replicate=None):
+    """Spec-dec verify pass: score a ragged window of (seed + K draft)
+    tokens per sequence and return the target model's greedy argmax at
+    EVERY flat position, plus per-sequence non-finite flags.
+
+    The device→host transfer is two small int/bool vectors, not a
+    ``[T, vocab]`` logits tensor: the host-side accept test only needs the
+    argmax chain (greedy spec-dec is exact by construction — the argmax at
+    the seed position IS the token vanilla decode would have produced, and
+    each accepted draft position extends the chain under the identical
+    causal context), and the non-finite flags feed the serving decode
+    watchdog so a NaN-poisoned sequence is isolated in verify windows
+    exactly as in fused decode windows.
+
+    Returns jitted ``(params, kv_pages, packed_meta) →
+    (greedy [max_q] int32, nonfinite [max_seqs] bool, kv_pages)``.
+    """
+    step_fn = build_ragged_step(cfg, max_q=max_q, num_blocks=num_blocks,
+                                attn_impl=attn_impl, max_seqs=max_seqs,
+                                max_blocks=max_blocks, block_q=block_q,
+                                pages_per_chunk=pages_per_chunk, jit=False,
+                                verify_mode=True, kv_replicate=kv_replicate)
+    layout = pack_layout(max_q, max_seqs, max_blocks)
+
+    def field(meta, name):
+        off, shape = layout[name]
+        n = 1
+        for d in shape:
+            n *= d
+        return meta[off:off + n]
+
+    def step(params, kv_pages, meta):
+        logits, new_pages = step_fn(params, kv_pages, meta)   # [T, V]
+        greedy = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        # per-sequence poison flag over REAL tokens only: padded rows carry
+        # the pad-page sentinel and alias seq_of_token to the last row, so
+        # an unmasked scatter would blame row max_seqs-1 for pad garbage
+        valid = field(meta, "page_of_token") < num_blocks
+        bad_tok = ~jnp.all(jnp.isfinite(logits), axis=-1) & valid
+        bad_seq = jnp.zeros(max_seqs, jnp.bool_).at[
+            field(meta, "seq_of_token")].max(bad_tok)
+        return greedy, bad_seq, new_pages
+
+    return jax.jit(step, donate_argnums=(1,)) if jit else step
 
 
 def sample_tokens(logits, rng, temperature: float = 0.0, top_k: int = 0):

@@ -176,6 +176,25 @@ class _ServingHandler(BaseHTTPRequestHandler):
         try:
             payload = json.loads(self.rfile.read(length))
             prompt = [int(t) for t in payload["prompt"]]
+            # per-request speculative decoding: {"mode": off|ngram|
+            # draft_model, "k": int} — mode toggles the server-configured
+            # drafter, k overrides the draft length (see lifecycle.
+            # LifecycleScheduler._spec_k_for)
+            spec = payload.get("speculative") or {}
+            if not isinstance(spec, dict):
+                raise TypeError("speculative must be an object")
+            spec_mode = spec.get("mode")
+            if spec_mode is not None:
+                from .speculative import SPEC_MODES
+
+                if spec_mode not in SPEC_MODES:
+                    raise ValueError(f"speculative.mode must be one of "
+                                     f"{SPEC_MODES}")
+            spec_k = spec.get("k")
+            if spec_k is not None:
+                spec_k = int(spec_k)
+                if spec_k < 1:
+                    raise ValueError("speculative.k must be >= 1")
         except (ValueError, TypeError, KeyError) as e:
             self._send_json(400, {"error": f"bad request body: {e!r}"})
             return
@@ -188,6 +207,7 @@ class _ServingHandler(BaseHTTPRequestHandler):
             priority=int(payload.get("priority", 0)),
             deadline_s=payload.get("deadline_s"),
             ttft_timeout_s=payload.get("ttft_timeout_s"),
+            spec_mode=spec_mode, spec_k=spec_k,
             sink=events)
         if not verdict.admitted:
             code = 503 if verdict.reason == "draining" else 429
@@ -317,7 +337,8 @@ class ServingServer:
     # ---------------------------------------------------------------- #
     def submit_request(self, prompt: List[int], max_new_tokens: int = 32,
                        priority: int = 0, deadline_s=None,
-                       ttft_timeout_s=None, sink: "queue.Queue" = None
+                       ttft_timeout_s=None, spec_mode=None, spec_k=None,
+                       sink: "queue.Queue" = None
                        ) -> "tuple[ServeRequest, AdmissionVerdict]":
         """Build + submit one request; lifecycle events are copied into
         ``sink`` as ``(event, tokens_copy, finish_reason, state)`` tuples
@@ -337,6 +358,7 @@ class ServingServer:
             deadline_s=float(deadline_s) if deadline_s is not None else None,
             ttft_timeout_s=(float(ttft_timeout_s)
                             if ttft_timeout_s is not None else None),
+            spec_mode=spec_mode, spec_k=spec_k,
             on_event=on_event)
         verdict = self.scheduler.submit(req)
         self.kick()
@@ -420,22 +442,32 @@ class ServingServer:
 # ------------------------------------------------------------------- #
 # CLI (bin/dstpu-serve)
 # ------------------------------------------------------------------- #
+def tiny_engine_config(args):
+    """CLI budget flags → the CPU-sim engine config (shared by the main
+    tiny engine and a tiny draft engine so their settings cannot
+    diverge)."""
+    import jax.numpy as jnp
+
+    from .engine_v2 import RaggedInferenceEngineConfig
+
+    return RaggedInferenceEngineConfig(
+        max_tokens=args.max_tokens, max_seqs=args.max_seqs,
+        max_ctx=args.max_ctx, block_size=args.block_size,
+        num_blocks=args.num_blocks, dtype=jnp.float32,
+        attn_impl=args.attn_impl)
+
+
 def build_tiny_engine(args):
     """CPU-sim engine for smoke tests and local bring-up."""
     import jax
-    import jax.numpy as jnp
 
     from ...models.transformer import CausalLM, TransformerConfig
-    from .engine_v2 import InferenceEngineV2, RaggedInferenceEngineConfig
+    from .engine_v2 import InferenceEngineV2
 
     cfg = TransformerConfig.tiny(use_flash=False)
     model = CausalLM(cfg)
     params = model.init_params(jax.random.PRNGKey(0))
-    return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
-        max_tokens=args.max_tokens, max_seqs=args.max_seqs,
-        max_ctx=args.max_ctx, block_size=args.block_size,
-        num_blocks=args.num_blocks, dtype=jnp.float32,
-        attn_impl=args.attn_impl))
+    return InferenceEngineV2(model, params, tiny_engine_config(args))
 
 
 def main(argv: Optional[List[str]] = None) -> int:
@@ -477,6 +509,22 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="SIGTERM → exit budget: in-flight requests get "
                         "this long to finish before being expired")
     p.add_argument("--eos", type=int, default=None)
+    p.add_argument("--spec-mode", default="off",
+                   choices=["off", "ngram", "draft_model"],
+                   help="speculative decoding drafter: 'ngram' = free "
+                        "host-side prompt-lookup, 'draft_model' = small "
+                        "draft model (--draft-model/--draft-ckpt); greedy "
+                        "streams stay bit-exact, per-request override via "
+                        "the 'speculative' body field")
+    p.add_argument("--spec-k", type=int, default=4,
+                   help="draft candidates per verify window (speedup "
+                        "ceiling is k+1 tokens per model step)")
+    p.add_argument("--draft-model", default=None,
+                   help="draft model for --spec-mode draft_model: 'tiny' "
+                        "or an HF model dir/name")
+    p.add_argument("--draft-ckpt", default=None,
+                   help="load draft-model params from a framework training"
+                        " checkpoint (params-only resharded handoff)")
     p.add_argument("--telemetry-dir", default="telemetry_serve")
     args = p.parse_args(argv)
 
@@ -512,10 +560,44 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             engine = build_hf_engine(args.model, engine_config=ecfg)
 
+    spec = drafter = None
+    if args.spec_mode != "off":
+        from .speculative import SpeculativeConfig, make_drafter
+
+        spec = SpeculativeConfig(mode=args.spec_mode, k=args.spec_k)
+        draft_engine = None
+        if args.spec_mode == "draft_model":
+            if args.draft_ckpt:
+                # params-only handoff path; --draft-model names the arch
+                # ('tiny' = the CPU-sim bring-up shape)
+                from .speculative import draft_engine_from_checkpoint
+
+                if args.draft_model in (None, "tiny"):
+                    from ...models.transformer import (CausalLM,
+                                                       TransformerConfig)
+
+                    arch = CausalLM(TransformerConfig.tiny(use_flash=False))
+                    dcfg = tiny_engine_config(args)
+                else:
+                    from ...models.hf import from_pretrained_config
+
+                    arch = from_pretrained_config(args.draft_model)
+                    dcfg = None
+                draft_engine = draft_engine_from_checkpoint(
+                    args.draft_ckpt, arch, engine_config=dcfg)
+            elif args.draft_model in (None, "tiny"):
+                draft_engine = build_tiny_engine(args)
+            else:
+                from .engine_factory import build_hf_engine
+
+                draft_engine = build_hf_engine(args.draft_model)
+        drafter = make_drafter(spec, draft_engine=draft_engine)
+
     scheduler = LifecycleScheduler(
         engine, max_queue=args.queue_cap, window_steps=args.window_steps,
         kv_high_watermark=args.kv_watermark, preempt=not args.no_preempt,
-        hang_deadline_s=args.hang_deadline, eos_token_id=args.eos)
+        hang_deadline_s=args.hang_deadline, eos_token_id=args.eos,
+        speculative=spec, drafter=drafter)
     server = ServingServer(scheduler, telemetry=tel, port=args.port,
                            bind=args.bind,
                            drain_deadline_s=args.drain_deadline)

@@ -235,7 +235,8 @@ SERVING_LIFECYCLE_COUNTERS = (
     "serving/requests", "serving/completed", "serving/shed",
     "serving/preempted", "serving/cancelled", "serving/deadline_expired",
     "serving/ttft_timeout", "serving/nan_isolated", "serving/window_hang",
-    "serving/rejected", "serving/drain_expired")
+    "serving/rejected", "serving/drain_expired",
+    "serving/spec_windows", "serving/spec_drafted", "serving/spec_accepted")
 
 #: serving latency histograms: TTFT (arrival → first generated token) and
 #: TPOT (decode-phase seconds per output token)
@@ -539,6 +540,21 @@ def format_summary(s: Dict[str, Any]) -> str:
                 pct = f"{row['hbm_pct_peak']:.1f}%" \
                     if row.get("hbm_pct_peak") is not None else "-"
                 add(f"{kname:<22}{gbps:>12}{pct:>8}")
+        if srv.get("acceptance_rate") is not None or \
+                srv.get("effective_tok_per_s") is not None:
+            # speculative decoding gauges (engine._record_verify_window)
+            line = "spec-dec: "
+            parts = []
+            if srv.get("acceptance_rate") is not None:
+                parts.append(f"acceptance {srv['acceptance_rate']:.2f}")
+            if srv.get("effective_tok_per_s") is not None:
+                parts.append(
+                    f"effective {srv['effective_tok_per_s']:.1f} tok/s")
+            if srv.get("draft_overhead_frac") is not None:
+                parts.append(
+                    f"draft overhead "
+                    f"{100 * srv['draft_overhead_frac']:.1f}%")
+            add(line + ", ".join(parts))
         lat = srv.get("latency") or {}
         for hname, label in (("ttft_s", "TTFT"), ("tpot_s", "TPOT")):
             row = lat.get(hname)

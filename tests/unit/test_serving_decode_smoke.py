@@ -1,11 +1,14 @@
 """CI gate for the serving smoke check (tools/check_serving_smoke.py):
 `InferenceEngineV2` prefill → fused 4-token decode under both attention
 impls, the request-lifecycle scenario (deadline expiry mid-window with
-block reclaim + unperturbed survivor stream), and the real `dstpu-serve`
-graceful-drain scenario (SIGTERM during active decode → draining healthz
-→ 503 for new work → completed in-flight response → exit 0) — all on the
-CPU sim, same enforcement pattern as the no-bare-print lint, so the
-serving stack cannot rot silently while the TPU relay is down."""
+block reclaim + unperturbed survivor stream), the speculative-decoding
+scenario (planted-repetition prompt → n-gram drafter accepts >=1
+multi-token verify window → stream bit-identical to vanilla → blocks
+reclaimed, both impls), and the real `dstpu-serve` graceful-drain
+scenario (SIGTERM during active decode → draining healthz → 503 for new
+work → completed in-flight response → exit 0) — all on the CPU sim, same
+enforcement pattern as the no-bare-print lint, so the serving stack
+cannot rot silently while the TPU relay is down."""
 import os
 import subprocess
 import sys
@@ -22,9 +25,10 @@ CHECK = os.path.join(REPO_ROOT, "tools", "check_serving_smoke.py")
 class TestServingSmoke:
     def test_smoke_check_passes(self):
         """This IS the CI gate: every scenario (decode parity + roofline,
-        lifecycle expiry/reclaim, dstpu-serve drain) must hold."""
+        lifecycle expiry/reclaim, spec-dec bit-exactness + acceptance,
+        dstpu-serve drain) must hold."""
         proc = subprocess.run([sys.executable, CHECK],
-                              capture_output=True, text=True, timeout=420)
+                              capture_output=True, text=True, timeout=900)
         assert proc.returncode == 0, \
             f"serving smoke checks failed:\n{proc.stdout}" \
             f"{proc.stderr[-1000:]}"

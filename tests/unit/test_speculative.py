@@ -1,0 +1,648 @@
+"""Speculative decoding (marker: specdec): verify-window mode over the
+paged decode path.
+
+The acceptance property under test everywhere: greedy spec-dec streams
+are BIT-IDENTICAL to vanilla decode under both attention impls — the
+verify pass scores the same logits vanilla decode would have computed at
+every accepted position, so speculation changes tok/s, never content.
+Covers the n-gram and draft-model drafters, rejected-draft KV rollback,
+KV accounting for speculative pages, lifecycle composition (preemption /
+resume mid-stream, deadline expiry, NaN isolation in verify windows,
+per-request toggle), the PR-7 params-only draft-model handoff, and the
+``serving/acceptance_rate`` / ``effective_tok_per_s`` /
+``draft_overhead_frac`` gauges.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (
+    InferenceEngineV2,
+    RaggedInferenceEngineConfig,
+)
+from deepspeed_tpu.inference.v2.lifecycle import (
+    LifecycleScheduler,
+    RequestState,
+    ServeRequest,
+)
+from deepspeed_tpu.inference.v2.speculative import (
+    DraftModelDrafter,
+    NGramDrafter,
+    SpeculativeConfig,
+    make_drafter,
+    speculative_decode,
+)
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+from deepspeed_tpu.runtime.fault import injection
+
+pytestmark = pytest.mark.specdec
+
+#: planted repetition: this prompt's greedy continuation under the
+#: PRNGKey(0) tiny model is a constant stream (deterministic on the CPU
+#: sim), so the n-gram drafter must reach full acceptance
+REPEAT_PROMPT = [142] * 6
+MIXED_PROMPT = [3, 5, 7, 11]
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+@pytest.fixture(autouse=True)
+def _clean_injector():
+    injection.clear()
+    yield
+    injection.clear()
+
+
+def _engine(tiny_lm, **kw):
+    model, params = tiny_lm
+    defaults = dict(max_tokens=16, max_seqs=4, max_ctx=96, block_size=8,
+                    dtype=jnp.float32, attn_impl="gather")
+    defaults.update(kw)
+    return InferenceEngineV2(model, params,
+                             RaggedInferenceEngineConfig(**defaults))
+
+
+def _vanilla_stream(eng, prompt, steps):
+    """Prefill + fused vanilla decode; returns (seed, stream)."""
+    logits = eng.put([0], [prompt])
+    seed = int(jnp.argmax(logits[0]))
+    toks = [int(t) for t in eng.decode_batch([0], [seed], steps)[:, 0]]
+    return seed, toks
+
+
+class TestNGramDrafter:
+    def test_matches_longest_suffix_and_copies_continuation(self):
+        d = NGramDrafter(ngram_max=3)
+        toks = [1, 2, 3, 9, 9, 1, 2, 3]
+        # suffix [1,2,3] occurred at 0, followed by [9,9,1,2]
+        assert d.draft(0, toks, 4) == [9, 9, 1, 2]
+
+    def test_prefers_occurrence_with_full_continuation(self):
+        d = NGramDrafter(ngram_max=1)
+        # constant stream: the LATEST earlier occurrence has no room; an
+        # older one must supply the full k tokens
+        assert d.draft(0, [5] * 8, 4) == [5, 5, 5, 5]
+
+    def test_no_match_returns_empty(self):
+        d = NGramDrafter()
+        assert d.draft(0, [1, 2, 3, 4], 4) == []
+
+    def test_k_cap_and_flush(self):
+        d = NGramDrafter(ngram_max=1)
+        assert len(d.draft(0, [7] * 10, 3)) == 3
+        d.flush(0)
+        assert d._toks == {}
+
+    def test_incremental_extension_matches_fresh_index(self):
+        d1, d2 = NGramDrafter(), NGramDrafter()
+        toks = [4, 4, 5, 4, 4, 5, 4, 4]
+        for i in range(4, len(toks) + 1):
+            a = d1.draft(0, toks[:i], 3)       # incremental
+        b = d2.draft(0, toks, 3)               # fresh
+        assert a == b
+
+    def test_divergent_history_rebuilds(self):
+        d = NGramDrafter(ngram_max=1)
+        d.draft(0, [1, 2, 3, 1], 2)
+        # a non-extension stream (different request reusing the uid)
+        assert d.draft(0, [9, 8, 9], 2) == [8, 9]
+
+
+class _WrongDrafter:
+    """Adversarial drafter: every candidate is off by one, so every draft
+    is rejected and every window exercises the KV rollback path."""
+
+    def __init__(self, vocab):
+        self.vocab = vocab
+
+    def draft(self, uid, tokens, k):
+        nxt = (int(tokens[-1]) + 1) % self.vocab
+        return [nxt] * k
+
+    def flush(self, uid):
+        pass
+
+
+class TestEngineVerifyDecode:
+    @pytest.mark.parametrize("impl", ["gather", "paged"])
+    def test_ngram_spec_stream_bit_exact(self, tiny_lm, impl):
+        """THE tentpole property: spec-dec greedy == vanilla greedy,
+        token for token, under both attention impls."""
+        steps = 10
+        eng = _engine(tiny_lm, attn_impl=impl)
+        seed, vanilla = _vanilla_stream(eng, REPEAT_PROMPT, steps)
+        eng.flush([0])
+
+        eng = _engine(tiny_lm, attn_impl=impl)
+        pool0 = eng.state_manager.free_blocks
+        logits = eng.put([0], [REPEAT_PROMPT])
+        seed2 = int(jnp.argmax(logits[0]))
+        assert seed2 == seed
+        out, stats = speculative_decode(
+            eng, NGramDrafter(), [0], [seed2], [REPEAT_PROMPT + [seed2]],
+            steps=steps, k=4)
+        assert out[0][:steps] == vanilla
+        # planted repetition: multi-token windows were genuinely accepted
+        assert stats["accepted_draft"] >= 1
+        assert stats["windows"] < steps
+        eng.flush([0])
+        assert eng.state_manager.free_blocks == pool0
+
+    @pytest.mark.parametrize("impl", ["gather", "paged"])
+    def test_rejected_drafts_roll_back_bit_exact(self, tiny_lm, impl):
+        """All-rejected drafts: every window rolls the KV length back,
+        yet the stream stays identical to vanilla — the rollback leaves
+        exactly the state vanilla decode would have."""
+        steps = 6
+        eng = _engine(tiny_lm, attn_impl=impl)
+        seed, vanilla = _vanilla_stream(eng, MIXED_PROMPT, steps)
+        eng.flush([0])
+
+        eng = _engine(tiny_lm, attn_impl=impl)
+        logits = eng.put([0], [MIXED_PROMPT])
+        seed2 = int(jnp.argmax(logits[0]))
+        wrong = _WrongDrafter(tiny_lm[0].config.vocab_size)
+        out, stats = speculative_decode(
+            eng, wrong, [0], [seed2], [MIXED_PROMPT + [seed2]],
+            steps=steps, k=3)
+        assert out[0][:steps] == vanilla
+        assert stats["accepted_draft"] == 0          # every draft rejected
+        assert stats["windows"] == steps             # one token per window
+        # KV length rolled back to the vanilla invariant: seen counts
+        # prompt + produced tokens except the pending seed
+        seq = eng.state_manager.get_sequence(0)
+        assert seq.seen_tokens == len(MIXED_PROMPT) + len(out[0])
+        eng.flush([0])
+
+    def test_rollback_truncates_without_freeing_blocks(self, tiny_lm):
+        """Speculative pages are allocated up front (KV-pressure sees
+        them) and rollback truncates length only — blocks stay for the
+        next window to overwrite."""
+        eng = _engine(tiny_lm, block_size=8)
+        eng.put([0], [[3, 5, 7, 11, 13]])            # seen=5, 1 block
+        seq = eng.state_manager.get_sequence(0)
+        assert seq.cur_allocated_blocks == 1
+        free_before = eng.state_manager.free_blocks
+        # verify with a 7-draft window appends 8 rows → needs 2 blocks
+        res = eng.verify_decode([0], [1], [[2, 3, 4, 5, 6, 7, 8]])
+        assert seq.cur_allocated_blocks == 2          # speculative page kept
+        assert eng.state_manager.free_blocks == free_before - 1
+        assert seq.seen_tokens == 5 + 1 + res.accepted_draft
+        assert len(res.accepted[0]) == 1 + res.accepted_draft
+        eng.flush([0])
+
+    def test_rollback_kv_validates(self, tiny_lm):
+        eng = _engine(tiny_lm)
+        eng.put([0], [[3, 5, 7]])
+        with pytest.raises(AssertionError):
+            eng.rollback_kv(0, 7)                     # cannot extend
+        eng.rollback_kv(0, 2)
+        assert eng.state_manager.get_sequence(0).seen_tokens == 2
+        eng.flush([0])
+
+    def test_verify_invalidates_decode_resume(self, tiny_lm):
+        """A verify window is a host forward: the device-resident decode
+        metadata must not survive it (it was advanced past the rollback
+        point)."""
+        eng = _engine(tiny_lm)
+        logits = eng.put([0], [MIXED_PROMPT])
+        seed = int(jnp.argmax(logits[0]))
+        toks = eng.decode_batch([0], [seed], 2)
+        assert eng._decode_state is not None
+        eng.verify_decode([0], [int(toks[-1, 0])], [[1, 2]])
+        assert eng._decode_state is None
+        eng.flush([0])
+
+    def test_mixed_draft_lengths_one_window(self, tiny_lm):
+        """Rows with different draft lengths (including empty) share one
+        ragged verify window."""
+        eng = _engine(tiny_lm)
+        for uid, prompt in ((0, REPEAT_PROMPT), (1, MIXED_PROMPT)):
+            eng.put([uid], [prompt])
+        res = eng.verify_decode([0, 1], [142, 1], [[142, 142], []])
+        assert len(res.accepted[0]) >= 1
+        assert len(res.accepted[1]) == 1              # empty draft = 1 tok
+        eng.flush([0, 1])
+
+
+class TestDraftModelDrafter:
+    def test_same_model_draft_accepts_everything(self, tiny_lm):
+        """Draft model == target model ⇒ the draft chain IS the greedy
+        chain: acceptance 1.0 and the stream matches vanilla."""
+        steps = 8
+        eng = _engine(tiny_lm)
+        seed, vanilla = _vanilla_stream(eng, MIXED_PROMPT, steps)
+        eng.flush([0])
+
+        eng = _engine(tiny_lm)
+        draft_eng = _engine(tiny_lm)
+        logits = eng.put([0], [MIXED_PROMPT])
+        seed2 = int(jnp.argmax(logits[0]))
+        out, stats = speculative_decode(
+            eng, DraftModelDrafter(draft_eng), [0], [seed2],
+            [MIXED_PROMPT + [seed2]], steps=steps, k=4)
+        assert out[0][:steps] == vanilla
+        assert stats["acceptance_rate"] == 1.0
+        assert stats["windows"] < steps
+        eng.flush([0])
+
+    def test_different_draft_model_still_bit_exact(self, tiny_lm):
+        """An imperfect draft model (different init) only lowers
+        acceptance; the emitted stream must still be the target's."""
+        model, _ = tiny_lm
+        steps = 6
+        eng = _engine(tiny_lm)
+        seed, vanilla = _vanilla_stream(eng, MIXED_PROMPT, steps)
+        eng.flush([0])
+
+        eng = _engine(tiny_lm)
+        draft_eng = InferenceEngineV2(
+            model, model.init_params(jax.random.PRNGKey(7)),
+            RaggedInferenceEngineConfig(
+                max_tokens=16, max_seqs=4, max_ctx=96, block_size=8,
+                dtype=jnp.float32, attn_impl="gather"))
+        logits = eng.put([0], [MIXED_PROMPT])
+        seed2 = int(jnp.argmax(logits[0]))
+        drafter = DraftModelDrafter(draft_eng)
+        out, stats = speculative_decode(
+            eng, drafter, [0], [seed2], [MIXED_PROMPT + [seed2]],
+            steps=steps, k=3)
+        assert out[0][:steps] == vanilla
+        drafter.flush(0)
+        eng.flush([0])
+        # the drafter's own engine reclaimed its blocks too
+        assert draft_eng.state_manager.free_blocks == \
+            draft_eng.state_manager.allocator.total_blocks
+
+    def test_draft_engine_from_checkpoint_params_only(self, tiny_lm,
+                                                      tmp_path):
+        """Draft model loaded through the PR-7 params-only handoff
+        (build_engine_from_ds_checkpoint) drafts with acceptance 1.0
+        against the same-weights target."""
+        from deepspeed_tpu.inference.v2.speculative import \
+            draft_engine_from_checkpoint
+        from deepspeed_tpu.runtime.checkpoint_engine.\
+            orbax_checkpoint_engine import OrbaxCheckpointEngine
+        from deepspeed_tpu.runtime.config import FaultConfig
+        from deepspeed_tpu.runtime.topology import (TopologyConfig,
+                                                    initialize_mesh)
+
+        initialize_mesh(TopologyConfig(), force=True)
+        model, params = tiny_lm
+        store = OrbaxCheckpointEngine(
+            str(tmp_path), fault_config=FaultConfig(
+                max_retries=2, retry_base_s=0.001, retry_cap_s=0.002))
+        store.save({"state": {"params": params,
+                              "global_step": jnp.zeros((), jnp.int32)},
+                    "client_state": {}}, "global_step3")
+        store.commit("global_step3")
+
+        draft_eng = draft_engine_from_checkpoint(
+            str(tmp_path), model,
+            engine_config=RaggedInferenceEngineConfig(
+                max_tokens=16, max_seqs=2, max_ctx=96, block_size=8,
+                dtype=jnp.float32, attn_impl="gather"))
+        eng = _engine(tiny_lm)
+        logits = eng.put([0], [MIXED_PROMPT])
+        seed = int(jnp.argmax(logits[0]))
+        out, stats = speculative_decode(
+            eng, DraftModelDrafter(draft_eng), [0], [seed],
+            [MIXED_PROMPT + [seed]], steps=4, k=3)
+        assert stats["acceptance_rate"] == 1.0
+        eng.flush([0])
+
+
+class TestLifecycleSpeculative:
+    def _run(self, tiny_lm, impl, spec=None, drafter=None, prompts=None,
+             max_new=10, **sched_kw):
+        eng = _engine(tiny_lm, attn_impl=impl)
+        s = LifecycleScheduler(eng, window_steps=4, speculative=spec,
+                               drafter=drafter, **sched_kw)
+        for uid, p in enumerate(prompts or [REPEAT_PROMPT, MIXED_PROMPT]):
+            s.submit(ServeRequest(uid=uid, prompt=list(p),
+                                  max_new_tokens=max_new))
+        s.run_until_idle()
+        return s, eng
+
+    @pytest.mark.parametrize("impl", ["gather", "paged"])
+    def test_spec_streams_bit_exact_vs_vanilla(self, tiny_lm, impl):
+        """Mixed batch (one repetition-heavy stream, one not — the second
+        exercises rejected-draft rollback every few windows) through the
+        scheduler: spec streams == vanilla streams, both impls."""
+        s_ref, _ = self._run(tiny_lm, impl)
+        refs = {u: list(s_ref.request(u).produced) for u in (0, 1)}
+        s, eng = self._run(tiny_lm, impl,
+                           spec=SpeculativeConfig(mode="ngram", k=4))
+        assert {u: list(s.request(u).produced) for u in (0, 1)} == refs
+        assert s.counters["serving/spec_windows"] >= 1
+        assert s.counters["serving/spec_accepted"] >= 1
+        assert eng.state_manager.free_blocks == \
+            eng.state_manager.allocator.total_blocks
+
+    @pytest.mark.parametrize("impl", ["gather", "paged"])
+    def test_preempt_resume_mid_stream_bit_exact(self, tiny_lm, impl):
+        """KV-pressure preemption between verify windows: the victim
+        resumes via prefill recompute and its spec-dec stream still
+        matches the uninterrupted spec-dec run."""
+        spec = SpeculativeConfig(mode="ngram", k=4)
+
+        def mk():
+            eng = _engine(tiny_lm, num_blocks=10, attn_impl=impl)
+            return eng
+
+        eng = mk()
+        s = LifecycleScheduler(eng, window_steps=4, speculative=spec)
+        s.submit(ServeRequest(uid=0, prompt=[142, 142, 142, 142, 142],
+                              max_new_tokens=16))
+        s.run_until_idle()
+        ref = list(s.request(0).produced)
+
+        eng = mk()
+        s = LifecycleScheduler(eng, window_steps=4, speculative=spec,
+                               kv_high_watermark=0.2)
+        s.submit(ServeRequest(uid=0, prompt=[142, 142, 142, 142, 142],
+                              max_new_tokens=16))
+        s.step()
+        s.step()                    # uid 0 decoding via verify windows
+        assert len(s.request(0).produced) > 1
+        s.submit(ServeRequest(uid=1, prompt=[2] * 40, max_new_tokens=24))
+        s.run_until_idle()
+        assert s.counters["serving/preempted"] == 1
+        assert s.request(0).preempt_count == 1
+        assert list(s.request(0).produced) == ref     # bit-exact resume
+        assert s.request(1).state == RequestState.FINISHED
+        assert eng.state_manager.free_blocks == 10
+
+    def test_deadline_expiry_mid_spec_stream(self, tiny_lm):
+        """A deadline lands between verify windows: the victim is flushed
+        mid-stream, the survivor's spec stream is unperturbed, blocks
+        drain back."""
+        clock = {"t": 1000.0}
+        spec = SpeculativeConfig(mode="ngram", k=4)
+        eng = _engine(tiny_lm)
+        s = LifecycleScheduler(eng, window_steps=2, speculative=spec,
+                               clock=lambda: clock["t"])
+        s.submit(ServeRequest(uid=1, prompt=list(REPEAT_PROMPT),
+                              max_new_tokens=8))
+        s.run_until_idle()
+        ref = list(s.request(1).produced)
+
+        eng = _engine(tiny_lm)
+        pool = eng.state_manager.free_blocks
+        s = LifecycleScheduler(eng, window_steps=2, speculative=spec,
+                               clock=lambda: clock["t"])
+        s.submit(ServeRequest(uid=0, prompt=[3, 5, 7, 11],
+                              max_new_tokens=32, deadline_s=5.0))
+        s.submit(ServeRequest(uid=1, prompt=list(REPEAT_PROMPT),
+                              max_new_tokens=8))
+        s.step()
+        s.step()
+        clock["t"] += 10.0
+        s.run_until_idle()
+        assert s.request(0).state == RequestState.EXPIRED
+        assert len(s.request(0).produced) < 32
+        assert s.request(1).state == RequestState.FINISHED
+        assert list(s.request(1).produced) == ref
+        assert eng.state_manager.free_blocks == pool
+
+    def test_per_request_toggle_and_k_override(self, tiny_lm):
+        """spec_mode='off' on a request bypasses verify windows entirely;
+        spec_k overrides the draft length."""
+        spec = SpeculativeConfig(mode="ngram", k=4)
+        eng = _engine(tiny_lm)
+        s = LifecycleScheduler(eng, window_steps=4, speculative=spec)
+        s.submit(ServeRequest(uid=0, prompt=list(REPEAT_PROMPT),
+                              max_new_tokens=8, spec_mode="off"))
+        s.run_until_idle()
+        assert s.counters["serving/spec_windows"] == 0
+
+        eng2 = _engine(tiny_lm)
+        s2 = LifecycleScheduler(eng2, window_steps=4, speculative=spec)
+        s2.submit(ServeRequest(uid=0, prompt=list(REPEAT_PROMPT),
+                               max_new_tokens=8, spec_k=2))
+        s2.run_until_idle()
+        assert s2.counters["serving/spec_windows"] >= 1
+        # k=2 caps accepted drafts at 2 per window
+        assert s2.counters["serving/spec_accepted"] <= \
+            2 * s2.counters["serving/spec_windows"]
+        # streams agree regardless of the toggle/k
+        assert list(s.request(0).produced) == list(s2.request(0).produced)
+
+    def test_full_width_window_respects_token_budget(self, tiny_lm):
+        """max_seqs streams all drafting at once: sum(1+k) would exceed
+        max_tokens (4·5 > 16) — the scheduler must deal draft lengths out
+        of the flat budget instead of wedging the pack (previously a
+        mid-insert ValueError the server driver would respin forever)."""
+        spec = SpeculativeConfig(mode="ngram", k=4)
+        eng = _engine(tiny_lm, max_tokens=16, max_seqs=4, max_ctx=96)
+        s = LifecycleScheduler(eng, window_steps=4, speculative=spec)
+        for uid in range(4):
+            s.submit(ServeRequest(uid=uid, prompt=list(REPEAT_PROMPT),
+                                  max_new_tokens=10))
+        s.run_until_idle()
+        for uid in range(4):
+            assert s.request(uid).state == RequestState.FINISHED
+            assert len(s.request(uid).produced) == 10
+        # streams must still be the vanilla ones
+        ref_s = LifecycleScheduler(_engine(tiny_lm, max_tokens=16,
+                                           max_seqs=4, max_ctx=96),
+                                   window_steps=4)
+        ref_s.submit(ServeRequest(uid=0, prompt=list(REPEAT_PROMPT),
+                                  max_new_tokens=10))
+        ref_s.run_until_idle()
+        for uid in range(4):
+            assert list(s.request(uid).produced) == \
+                list(ref_s.request(0).produced)
+        assert eng.state_manager.free_blocks == \
+            eng.state_manager.allocator.total_blocks
+
+    def test_engine_rejects_over_budget_window_cleanly(self, tiny_lm):
+        eng = _engine(tiny_lm, max_tokens=8)
+        eng.put([0], [MIXED_PROMPT])
+        with pytest.raises(RuntimeError, match="max_tokens"):
+            eng.verify_decode([0], [1], [[2] * 8])
+        # no state was mutated: a plain window still runs
+        assert len(eng.verify_decode([0], [1], [[2]]).accepted[0]) >= 1
+        eng.flush([0])
+
+    def test_default_off_without_config(self, tiny_lm):
+        eng = _engine(tiny_lm)
+        s = LifecycleScheduler(eng, window_steps=4)
+        assert s.drafter is None
+        s.submit(ServeRequest(uid=0, prompt=[3, 5], max_new_tokens=4,
+                              spec_mode="ngram"))
+        s.run_until_idle()                  # no drafter → vanilla windows
+        assert s.counters["serving/spec_windows"] == 0
+
+    @pytest.mark.parametrize("impl", ["gather", "paged"])
+    def test_nan_in_verify_window_isolated(self, tiny_lm, impl):
+        """decode_window/nan injection fires on a VERIFY window: only the
+        poisoned request is flushed, survivors are bit-identical, pool
+        drains back (the PR-8 isolation contract extended to spec-dec)."""
+        spec = SpeculativeConfig(mode="ngram", k=4)
+
+        def run(fault=None):
+            injection.clear()
+            eng = _engine(tiny_lm, attn_impl=impl)
+            s = LifecycleScheduler(eng, window_steps=4, speculative=spec)
+            for uid in range(3):
+                s.submit(ServeRequest(uid=uid, prompt=[3 + uid, 5, 7, 11],
+                                      max_new_tokens=8))
+            if fault:
+                injection.configure(fault)
+            s.run_until_idle()
+            injection.clear()
+            return s, eng
+
+        s_ref, _ = run()
+        refs = {u: list(s_ref.request(u).produced) for u in range(3)}
+        s, eng = run("site=decode_window,kind=nan,times=1")
+        failed = [u for u in range(3)
+                  if s.request(u).state == RequestState.FAILED]
+        assert len(failed) == 1
+        assert s.request(failed[0]).finish_reason == "nan"
+        assert s.counters["serving/nan_isolated"] == 1
+        assert s.health_state()[0] == "degraded"
+        for u in range(3):
+            if u not in failed:
+                assert s.request(u).state == RequestState.FINISHED
+                assert list(s.request(u).produced) == refs[u]
+        assert eng.state_manager.free_blocks == \
+            eng.state_manager.allocator.total_blocks
+
+
+class TestSpecTelemetry:
+    def test_gauges_published_and_summarized(self, tiny_lm, tmp_path):
+        """serving/acceptance_rate, effective_tok_per_s and
+        draft_overhead_frac land in the registry and surface through
+        serving_summary (the dstpu-telemetry section)."""
+        from deepspeed_tpu.telemetry import (Telemetry, get_telemetry,
+                                             set_telemetry)
+        from deepspeed_tpu.telemetry.summary import serving_summary
+
+        tel = Telemetry(output_dir=str(tmp_path))
+        set_telemetry(tel)
+        try:
+            eng = _engine(tiny_lm)
+            logits = eng.put([0], [REPEAT_PROMPT])
+            seed = int(jnp.argmax(logits[0]))
+            # enough windows that some land AFTER the verify bucket's
+            # compile — compile-polluted windows stay off the plane
+            speculative_decode(eng, NGramDrafter(), [0], [seed],
+                               [REPEAT_PROMPT + [seed]], steps=16, k=4)
+            eng.flush([0])
+            m = get_telemetry().metrics
+            assert m.gauge("serving/acceptance_rate").value() > 0
+            assert m.gauge("serving/effective_tok_per_s").value() > 0
+            assert m.gauge("serving/draft_overhead_frac").value() >= 0
+            rows = [{"name": "serving/acceptance_rate",
+                     "value": m.gauge("serving/acceptance_rate").value()},
+                    {"name": "serving/effective_tok_per_s",
+                     "value":
+                     m.gauge("serving/effective_tok_per_s").value()},
+                    {"name": "serving/draft_overhead_frac",
+                     "value":
+                     m.gauge("serving/draft_overhead_frac").value()}]
+            summ = serving_summary(rows)
+            assert summ["acceptance_rate"] > 0
+            assert summ["effective_tok_per_s"] > 0
+        finally:
+            set_telemetry(None)
+            tel.close()
+
+    def test_verify_trace_counts_one_compile_per_bucket(self, tiny_lm):
+        """Verify windows ride the compile cache: repeated same-bucket
+        windows trace once."""
+        eng = _engine(tiny_lm)
+        eng.put([0], [REPEAT_PROMPT])
+        for _ in range(3):
+            eng.verify_decode([0], [142], [[142, 142, 142]])
+        verify_keys = [k for k in eng.trace_counts if k[0] == "verify"]
+        assert verify_keys
+        assert all(eng.trace_counts[k] == 1 for k in verify_keys)
+        eng.flush([0])
+
+
+class TestServerSpeculative:
+    def test_generate_accepts_speculative_field(self, tiny_lm):
+        """HTTP path: /v1/generate with speculative {mode, k} rides the
+        verify-window path and still answers the vanilla stream."""
+        import json
+        import urllib.error
+        import urllib.request
+
+        from deepspeed_tpu.inference.v2.server import ServingServer
+
+        eng = _engine(tiny_lm)
+        ref = eng.generate([list(REPEAT_PROMPT)], max_new_tokens=6)[0]
+        eng.flush([0])
+
+        eng = _engine(tiny_lm)
+        sched = LifecycleScheduler(
+            eng, window_steps=4, max_queue=8,
+            speculative=SpeculativeConfig(mode="ngram", k=4))
+        srv = ServingServer(sched, port=0, bind="127.0.0.1").start()
+        try:
+            def post(body):
+                req = urllib.request.Request(
+                    f"http://127.0.0.1:{srv.port}/v1/generate",
+                    data=json.dumps(body).encode())
+                try:
+                    with urllib.request.urlopen(req, timeout=120) as r:
+                        return r.status, json.loads(r.read())
+                except urllib.error.HTTPError as e:
+                    return e.code, json.loads(e.read())
+
+            code, out = post({"prompt": REPEAT_PROMPT, "max_new_tokens": 6,
+                              "speculative": {"mode": "ngram", "k": 4}})
+            assert code == 200
+            assert out["tokens"] == ref
+            assert sched.counters["serving/spec_windows"] >= 1
+            # malformed speculative payloads are a 400, not a 500
+            code, out = post({"prompt": [1, 2], "speculative":
+                              {"mode": "warp"}})
+            assert code == 400
+            code, out = post({"prompt": [1, 2], "speculative": {"k": 0}})
+            assert code == 400
+        finally:
+            srv.stop()
+
+
+class TestConfigAndMarker:
+    def test_speculative_config_validation(self):
+        with pytest.raises(ValueError):
+            SpeculativeConfig(mode="wat")
+        with pytest.raises(ValueError):
+            SpeculativeConfig(mode="ngram", k=0)
+        with pytest.raises(ValueError):
+            SpeculativeConfig(mode="ngram", ngram_min=3, ngram_max=2)
+        assert make_drafter(SpeculativeConfig(mode="off")) is None
+        with pytest.raises(ValueError):
+            make_drafter(SpeculativeConfig(mode="draft_model"))
+
+    def test_specdec_marker_registered(self, pytestconfig):
+        markers = [m.split(":")[0].strip()
+                   for m in pytestconfig.getini("markers")]
+        assert any(m.startswith("specdec") for m in markers)
+
+    def test_spec_modules_lint_clean(self):
+        """tools/check_no_bare_print.py covers inference/v2/ — the
+        speculative module and the verify-window engine/runner/kernel
+        seams must not print outside CLI seams."""
+        import os
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        lint = os.path.join(repo, "tools", "check_no_bare_print.py")
+        pkg = os.path.join(repo, "deepspeed_tpu", "inference", "v2")
+        proc = subprocess.run([sys.executable, lint, pkg],
+                              capture_output=True, text=True)
+        assert proc.returncode == 0, proc.stdout

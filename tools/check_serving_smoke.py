@@ -20,8 +20,14 @@ the same way the no-bare-print lint is:
     an active decode returns the in-flight request's completed response,
     rejects new requests with 503 (Retry-After), reports ``draining`` on
     ``/healthz``, and exits 0 within the drain deadline.
+  * ``specdec``   — speculative decoding: prefill a planted-repetition
+    prompt, run an 8-token spec-dec decode with the n-gram drafter under
+    BOTH attention impls; the drafter must accept at least one
+    multi-token window, the greedy stream must be bit-identical to
+    vanilla decode, and every KV block must be reclaimed.
 
-Usage: ``python tools/check_serving_smoke.py [--scenario all|decode|lifecycle|drain]``
+Usage: ``python tools/check_serving_smoke.py
+[--scenario all|decode|lifecycle|drain|specdec]``
 Exit status 1 lists what broke.
 """
 from __future__ import annotations
@@ -161,6 +167,74 @@ def scenario_lifecycle(check):
         check("lifecycle scenario", False, repr(exc)[-300:])
 
 
+def scenario_specdec(check):
+    """Planted-repetition prompt → 8-token spec-dec decode (n-gram
+    drafter) → >=1 multi-token acceptance, stream bit-identical to
+    vanilla, blocks reclaimed — both attention impls.
+
+    The prompt [142]*6 is the planted repetition: this seed/params
+    combination greedily continues with a constant stream (verified
+    deterministic on the CPU sim), so the suffix-match drafter MUST land
+    full-length accepted windows — an acceptance regression here is a
+    spec-dec bug, not workload noise."""
+    import jax
+    import jax.numpy as jnp
+
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2,
+        RaggedInferenceEngineConfig,
+    )
+    from deepspeed_tpu.inference.v2.speculative import (
+        NGramDrafter,
+        speculative_decode,
+    )
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    prompt = [142] * 6
+    steps = 8
+
+    def mk(impl):
+        return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            max_tokens=16, max_seqs=4, max_ctx=64, block_size=8,
+            dtype=jnp.float32, attn_impl=impl, block_q=16,
+            pages_per_chunk=2))
+
+    for impl in ("paged", "gather"):
+        try:
+            eng = mk(impl)
+            logits = eng.put([0], [prompt])
+            seed = int(jnp.argmax(logits[0]))
+            vanilla = [int(t) for t in
+                       eng.decode_batch([0], [seed], steps)[:, 0]]
+            eng.flush([0])
+
+            eng = mk(impl)
+            pool0 = eng.state_manager.free_blocks
+            logits = eng.put([0], [prompt])
+            seed2 = int(jnp.argmax(logits[0]))
+            check(f"{impl}: specdec prefill argmax matches vanilla",
+                  seed2 == seed, f"{seed2} != {seed}")
+            out, stats = speculative_decode(
+                eng, NGramDrafter(), [0], [seed2], [prompt + [seed2]],
+                steps=steps, k=4)
+            check(f"{impl}: specdec stream bit-identical to vanilla",
+                  out[0][:steps] == vanilla,
+                  f"spec={out[0][:steps]} vanilla={vanilla}")
+            check(f"{impl}: n-gram drafter accepted a multi-token window",
+                  stats["accepted_draft"] >= 1 and
+                  stats["windows"] < steps,
+                  f"stats={stats}")
+            eng.flush([0])
+            check(f"{impl}: specdec blocks reclaimed",
+                  eng.state_manager.free_blocks == pool0,
+                  f"free={eng.state_manager.free_blocks} want={pool0}")
+        except Exception as exc:  # noqa: BLE001
+            check(f"{impl}: specdec scenario", False, repr(exc)[-300:])
+
+
 def _http(method, url, body=None, timeout=30):
     req = urllib.request.Request(url, method=method,
                                  data=json.dumps(body).encode()
@@ -173,13 +247,23 @@ def _http(method, url, body=None, timeout=30):
 
 
 def scenario_drain(check):
-    """SIGTERM the real dstpu-serve during an active decode."""
+    """SIGTERM the real dstpu-serve during an active decode.
+
+    Deflaked (flagged in PR 9: passed standalone, failed in-suite): the
+    drain deadline was 60s, but in-suite this machine can spend most of
+    that compiling decode buckets for the 64-token in-flight request —
+    blowing the deadline expires the request instead of completing it.
+    The deadline is sized for a loaded CI box now (the drain still exits
+    the moment the request finishes; the budget is a ceiling, not a
+    sleep), and every wait below synchronizes on an observable state
+    transition (healthz pending / draining, process exit) rather than a
+    fixed wall-time margin."""
     env = dict(os.environ, JAX_PLATFORMS="cpu")
     proc = subprocess.Popen(
         [sys.executable, os.path.join(REPO_ROOT, "bin", "dstpu-serve"),
          "--port", "0", "--bind", "127.0.0.1", "--max-tokens", "16",
          "--max-seqs", "4", "--max-ctx", "96", "--block-size", "8",
-         "--window-steps", "4", "--drain-deadline", "60",
+         "--window-steps", "4", "--drain-deadline", "300",
          "--telemetry-dir", "/tmp/dstpu_serve_smoke_tel"],
         stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         env=env)
@@ -217,12 +301,12 @@ def scenario_drain(check):
         def long_request():
             result["resp"] = _http(
                 "POST", f"{base}/v1/generate",
-                {"prompt": [5, 6, 7], "max_new_tokens": 64}, timeout=150)
+                {"prompt": [5, 6, 7], "max_new_tokens": 64}, timeout=400)
 
         t = threading.Thread(target=long_request, daemon=True)
         t.start()
         # wait until the request is genuinely in flight (admitted counter)
-        deadline = time.monotonic() + 60
+        deadline = time.monotonic() + 120
         inflight = False
         while time.monotonic() < deadline and not inflight:
             code, body = _http("GET", f"{base}/healthz")
@@ -231,16 +315,21 @@ def scenario_drain(check):
         check("drain: request in flight before SIGTERM", inflight)
 
         proc.send_signal(signal.SIGTERM)
-        # /healthz flips to draining (503) while the decode finishes
+        # /healthz flips to draining (503) while the decode finishes —
+        # poll the STATE TRANSITION, bounded only by the widened drain
+        # budget (the 64-token decode keeps the server alive far longer
+        # than the flip takes; exit-before-observation means drain broke)
         saw_draining = False
-        deadline = time.monotonic() + 30
-        while time.monotonic() < deadline and not saw_draining:
+        deadline = time.monotonic() + 300
+        while time.monotonic() < deadline and not saw_draining \
+                and proc.poll() is None:
             try:
                 code, body = _http("GET", f"{base}/healthz", timeout=5)
             except Exception:  # noqa: BLE001 — server may already be gone
                 break
             saw_draining = code == 503 and body.get("status") == "draining"
-            time.sleep(0.05)
+            if not saw_draining:
+                time.sleep(0.05)   # throttle: don't hammer the draining box
         check("drain: healthz reported draining", saw_draining)
         # new requests are shed with 503 + Retry-After while draining
         try:
@@ -254,10 +343,10 @@ def scenario_drain(check):
             check("drain: new request shed with 503", False,
                   f"server unreachable during drain: {exc!r}")
 
-        rc = proc.wait(timeout=90)
+        rc = proc.wait(timeout=330)
         check("drain: exit 0 within the drain deadline", rc == 0,
               f"rc={rc}")
-        t.join(timeout=30)
+        t.join(timeout=60)
         code, resp = result.get("resp", (None, None))
         check("drain: in-flight request completed",
               code == 200 and resp and resp.get("state") == "finished"
@@ -273,7 +362,8 @@ def scenario_drain(check):
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--scenario", default="all",
-                   choices=["all", "decode", "lifecycle", "drain"])
+                   choices=["all", "decode", "lifecycle", "drain",
+                            "specdec"])
     args = p.parse_args(argv)
 
     failures = []
@@ -294,6 +384,8 @@ def main(argv=None) -> int:
         scenario_decode(check)
     if args.scenario in ("all", "lifecycle"):
         scenario_lifecycle(check)
+    if args.scenario in ("all", "specdec"):
+        scenario_specdec(check)
     if args.scenario in ("all", "drain"):
         scenario_drain(check)
 
