@@ -1,0 +1,29 @@
+"""``dstpu-check``: static analysis over traced jaxprs and source ASTs.
+
+The correctness-tooling layer: recurring miscompile / NaN-poisoning /
+trace-hygiene bug classes encoded as registered detectors.  Entry points:
+
+  * ``bin/dstpu-check`` — CLI sweep over the actual built artifacts
+    (train step, decode/verify buckets, fused wire) + source tree;
+  * ``config.debug.graph_lint`` — engine knob: run the graph passes at
+    first trace, emit ``analysis/*`` telemetry;
+  * ``tools/check_graph_lint.py`` — the CI gate (HEAD clean, historical
+    fixtures fire), enforced from tier-1.
+
+Importing this package registers every built-in pass.
+"""
+from .core import (ADVICE, ERROR, WARN, Finding, GraphLintError, GraphPass,
+                   PassContext, SourcePass, all_passes, filter_pragmas,
+                   get_pass, max_severity, pragma_disables, register_pass,
+                   run_graph_passes, sort_findings, summarize)
+from . import graph_passes  # noqa: F401  — registers the jaxpr passes
+from . import source_passes  # noqa: F401  — registers the AST passes
+from .source_passes import SourceFile, run_source_passes  # noqa: F401
+
+__all__ = [
+    "ADVICE", "ERROR", "WARN", "Finding", "GraphLintError", "GraphPass",
+    "PassContext", "SourcePass", "SourceFile", "all_passes",
+    "filter_pragmas", "get_pass", "max_severity", "pragma_disables",
+    "register_pass", "run_graph_passes", "run_source_passes",
+    "sort_findings", "summarize",
+]

@@ -1,0 +1,109 @@
+"""``bin/dstpu-check`` — run the static-analysis passes from the shell.
+
+Two sweeps, both on by default:
+
+  * ``--graphs`` — build the actual artifacts on the CPU sim (train step,
+    prefetched micro program, serving prefill/decode/verify buckets under
+    both attention impls, fused quantized wire) and run every registered
+    jaxpr pass over each (``analysis/artifacts.py``).
+  * ``--source`` — run every registered AST pass over the library tree
+    (default root: ``deepspeed_tpu/``).
+
+Findings print one per line with ``file:line`` provenance, followed by a
+prometheus-style summary (``dstpu_check_findings{pass=...,severity=...}``).
+Exit status: 0 when no error-severity findings, 1 otherwise (warn/advice
+never gate), 2 on usage errors.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+from typing import List, Optional
+
+from .core import (ERROR, GraphPass, all_passes, sort_findings, summarize)
+
+
+def _parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dstpu-check",
+        description="Static analysis over traced jaxprs (miscompile / "
+                    "NaN-poisoning detectors) and source ASTs (trace "
+                    "hygiene).  No flags = both sweeps.")
+    p.add_argument("--graphs", nargs="*", metavar="GROUP", default=None,
+                   help="jaxpr sweep only; optional artifact groups "
+                        "(default: all — see --list)")
+    p.add_argument("--source", nargs="*", metavar="ROOT", default=None,
+                   help="AST sweep only; optional roots "
+                        "(default: the deepspeed_tpu/ package)")
+    p.add_argument("--list", action="store_true",
+                   help="list registered passes + artifact groups and exit")
+    p.add_argument("--json", action="store_true",
+                   help="emit findings as JSON instead of text")
+    return p
+
+
+def _list() -> str:
+    from . import artifacts
+
+    lines = ["registered passes (severity · kind · bug class):"]
+    for p in all_passes():
+        kind = "jaxpr" if isinstance(p, GraphPass) else "source"
+        lines.append(f"  {p.name:<24} {p.severity:<7} {kind:<7} "
+                     f"{p.bug_class}")
+    lines.append("artifact groups (--graphs): " +
+                 ", ".join(artifacts.builder_names()))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = _parser().parse_args(argv)
+    if args.list:
+        print(_list())
+        return 0
+
+    run_graphs = args.graphs is not None or args.source is None
+    run_source = args.source is not None or args.graphs is None
+
+    findings = []
+    artifact_names: List[str] = []
+    if run_graphs:
+        from . import artifacts
+
+        try:
+            fs, artifact_names = artifacts.sweep(
+                only=args.graphs or None,
+                log=None if args.json else
+                lambda m: print(f"dstpu-check: {m}", file=sys.stderr))
+        except KeyError as e:
+            print(f"dstpu-check: {e.args[0]}", file=sys.stderr)
+            return 2
+        findings.extend(fs)
+    if run_source:
+        from .source_passes import run_source_passes
+
+        roots = args.source or [os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__)))]
+        findings.extend(run_source_passes(roots))
+
+    findings = sort_findings(findings)
+    errors = [f for f in findings if f.severity == ERROR]
+    if args.json:
+        print(json.dumps({
+            "findings": [vars(f) for f in findings],
+            "artifacts": artifact_names,
+            "errors": len(errors),
+        }, indent=2))
+    else:
+        for f in findings:
+            print(f.render())
+        print(summarize(findings,
+                        artifacts=artifact_names if run_graphs else None))
+        verdict = "CLEAN" if not errors else f"{len(errors)} error(s)"
+        print(f"dstpu-check: {len(findings)} finding(s), {verdict}")
+    return 1 if errors else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,292 @@
+"""Static-analysis pass framework (``dstpu-check``) core.
+
+Three PRs in a row root-caused the *same* bug classes after the fact —
+GSPMD rewriting a gather/scatter over a sharded operand into per-replica-
+group scatter-adds (PR 8 ``paged_kv_append``, PR 9 ``combine_sparse``),
+0×NaN padding poisoning through unused block-table slots (fixed three
+times: ``_attend_gather``, ``decode_attend_dense``, the ragged kernel),
+and retrace explosions guarded only by per-test ``trace_counts`` probes.
+This module is the correctness-tooling layer the paper's runtime-only
+debugging story lacks: each recurring class becomes a *detector* that runs
+over traced jaxprs (``graph_passes``) or source ASTs (``source_passes``)
+at trace time / in CI, instead of being re-bisected on silicon.
+
+Vocabulary:
+
+  * :class:`Finding` — one detector hit, carrying ``file:line`` / eqn
+    provenance and a severity (``error`` fails the CI gate, ``warn`` and
+    ``advice`` are reported only).
+  * :class:`GraphPass` / :class:`SourcePass` — a detector.  Graph passes
+    walk closed jaxprs (recursively, through scan/cond/while/pjit/
+    custom_vjp sub-jaxprs, multiplying scan trip counts); source passes
+    walk Python ASTs.
+  * the registry (:func:`register_pass` / :func:`all_passes`) — the one
+    list ``bin/dstpu-check``, the engine ``debug.graph_lint`` knob, and
+    the fixture suite all consume.
+  * allowlist pragmas — ``# dstpu-check: disable=<pass>[,<pass>|all]`` on
+    the offending source line suppresses a finding (jaxpr findings
+    resolve to the traced Python line via eqn provenance, so the pragma
+    works for both kinds).
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+ERROR = "error"
+WARN = "warn"
+ADVICE = "advice"
+
+#: severity rank: higher sorts first in reports; only ERROR gates CI
+_SEVERITY_RANK = {ERROR: 0, WARN: 1, ADVICE: 2}
+
+_PRAGMA_RE = re.compile(r"#\s*dstpu-check:\s*disable=([\w\-,\s]+)")
+
+
+class GraphLintError(RuntimeError):
+    """Raised by the engine's ``debug.graph_lint: "error"`` mode when an
+    error-severity finding survives pragma filtering."""
+
+
+@dataclasses.dataclass
+class Finding:
+    """One detector hit with provenance.
+
+    ``file``/``line`` point at the Python source that produced the flagged
+    construct (the traced line for jaxpr passes, the AST node for source
+    passes); ``eqn`` carries the jaxpr-level description (primitive name +
+    operand summary) when the finding came from a graph pass; ``artifact``
+    names which built program was being linted (train step, decode bucket,
+    fused wire, ...).
+    """
+
+    pass_name: str
+    severity: str
+    message: str
+    file: Optional[str] = None
+    line: Optional[int] = None
+    eqn: Optional[str] = None
+    artifact: Optional[str] = None
+
+    def where(self) -> str:
+        loc = f"{self.file}:{self.line}" if self.file else "<no provenance>"
+        art = f" [{self.artifact}]" if self.artifact else ""
+        return loc + art
+
+    def render(self) -> str:
+        eqn = f" ({self.eqn})" if self.eqn else ""
+        return (f"{self.where()}: {self.severity}: {self.pass_name}: "
+                f"{self.message}{eqn}")
+
+
+@dataclasses.dataclass
+class PassContext:
+    """Everything a graph pass may need beyond the jaxpr itself.
+
+    ``artifact``       — name of the built program under analysis.
+    ``mesh``           — the live mesh, when the caller has one (passes
+                         must not require it: sharding objects embedded in
+                         the jaxpr carry their own mesh).
+    ``arg_shardings``  — optional per-invar shardings for the top-level
+                         jaxpr (the engine knows its param shardings; a
+                         bare ``make_jaxpr`` trace does not).
+    ``gather_budget``  — max ``all-gather`` eqns allowed (scan-multiplied);
+                         ``None`` disables the gather-budget pass.  The
+                         PR-4 prefetch invariant is budget 0 on the
+                         pregathered per-micro program.
+    """
+
+    artifact: str = "<unnamed>"
+    mesh: Any = None
+    arg_shardings: Optional[Sequence[Any]] = None
+    gather_budget: Optional[int] = None
+    extra: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+
+class GraphPass:
+    """Base for jaxpr detectors.  Subclasses set ``name``, ``severity``,
+    ``bug_class`` (one line: the historical bug this encodes) and implement
+    ``run(jaxpr, ctx) -> List[Finding]`` over a *closed* jaxpr."""
+
+    name: str = "<abstract>"
+    severity: str = ERROR
+    bug_class: str = ""
+
+    def run(self, closed, ctx: PassContext) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, message: str, *, file=None, line=None, eqn=None,
+                ctx: Optional[PassContext] = None,
+                severity: Optional[str] = None) -> Finding:
+        return Finding(self.name, severity or self.severity, message,
+                       file=file, line=line, eqn=eqn,
+                       artifact=ctx.artifact if ctx else None)
+
+
+class SourcePass:
+    """Base for AST detectors.  ``run(sf) -> List[Finding]`` over a parsed
+    :class:`~.source_passes.SourceFile`."""
+
+    name: str = "<abstract>"
+    severity: str = ERROR
+    bug_class: str = ""
+
+    def run(self, sf) -> List[Finding]:
+        raise NotImplementedError
+
+    def finding(self, message: str, *, file=None, line=None,
+                severity: Optional[str] = None) -> Finding:
+        return Finding(self.name, severity or self.severity, message,
+                       file=file, line=line)
+
+
+# --------------------------------------------------------------------- #
+# Registry
+# --------------------------------------------------------------------- #
+_REGISTRY: Dict[str, Any] = {}
+
+
+def register_pass(p):
+    """Register a pass instance (or class — instantiated immediately).
+    Usable as a decorator on the class.  Re-registration under the same
+    name replaces (reload-friendly)."""
+    inst = p() if isinstance(p, type) else p
+    _REGISTRY[inst.name] = inst
+    return p
+
+
+def get_pass(name: str):
+    if name not in _REGISTRY:
+        raise KeyError(f"unknown dstpu-check pass {name!r}; registered: "
+                       f"{sorted(_REGISTRY)}")
+    return _REGISTRY[name]
+
+
+def all_passes(kind: Optional[str] = None) -> List[Any]:
+    """Registered passes, optionally filtered: ``kind='jaxpr'`` → graph
+    passes, ``kind='source'`` → AST passes."""
+    out = []
+    for name in sorted(_REGISTRY):
+        p = _REGISTRY[name]
+        if kind == "jaxpr" and not isinstance(p, GraphPass):
+            continue
+        if kind == "source" and not isinstance(p, SourcePass):
+            continue
+        out.append(p)
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Pragma allowlist
+# --------------------------------------------------------------------- #
+_FILE_LINE_CACHE: Dict[str, List[str]] = {}
+
+
+def _source_lines(path: str) -> List[str]:
+    if path not in _FILE_LINE_CACHE:
+        try:
+            with open(path, "r", encoding="utf-8", errors="replace") as f:
+                _FILE_LINE_CACHE[path] = f.read().splitlines()
+        except OSError:
+            _FILE_LINE_CACHE[path] = []
+    return _FILE_LINE_CACHE[path]
+
+
+def pragma_disables(line_text: str, pass_name: str) -> bool:
+    """True when ``line_text`` carries ``# dstpu-check: disable=`` naming
+    ``pass_name`` (or ``all``)."""
+    m = _PRAGMA_RE.search(line_text)
+    if not m:
+        return False
+    names = {n.strip() for n in m.group(1).split(",")}
+    return "all" in names or pass_name in names
+
+
+def filter_pragmas(findings: Sequence[Finding]) -> List[Finding]:
+    """Drop findings whose provenance line carries a disabling pragma."""
+    kept = []
+    for f in findings:
+        if f.file and f.line:
+            lines = _source_lines(f.file)
+            if 0 < f.line <= len(lines) and \
+                    pragma_disables(lines[f.line - 1], f.pass_name):
+                continue
+        kept.append(f)
+    return kept
+
+
+# --------------------------------------------------------------------- #
+# Runners + report
+# --------------------------------------------------------------------- #
+def run_graph_passes(traced, ctx: PassContext,
+                     passes: Optional[Sequence[GraphPass]] = None,
+                     ) -> List[Finding]:
+    """All (or the given) graph passes over one traced program, pragma-
+    filtered.  ``traced`` is a ``jax.make_jaxpr`` result, a ClosedJaxpr,
+    or a raw jaxpr.  The producer/alias graph is built ONCE here and
+    shared via ``ctx.extra["value_graph"]`` — several passes chase
+    producer chains and a large scanned train step should not pay the
+    full-jaxpr walk per pass."""
+    from .jaxpr_walk import value_graph
+
+    cached = ctx.extra.get("value_graph")
+    if cached is None or cached[0] is not traced:   # ctx reuse = rebuild
+        ctx.extra["value_graph"] = (traced, value_graph(traced))
+    findings: List[Finding] = []
+    for p in (passes if passes is not None else all_passes("jaxpr")):
+        findings.extend(p.run(traced, ctx))
+    return filter_pragmas(findings)
+
+
+def max_severity(findings: Sequence[Finding]) -> Optional[str]:
+    if not findings:
+        return None
+    return min((f.severity for f in findings),
+               key=lambda s: _SEVERITY_RANK.get(s, 99))
+
+
+def sort_findings(findings: Sequence[Finding]) -> List[Finding]:
+    return sorted(findings, key=lambda f: (
+        _SEVERITY_RANK.get(f.severity, 99), f.pass_name,
+        f.file or "", f.line or 0))
+
+
+def summarize(findings: Sequence[Finding],
+              artifacts: Optional[Sequence[str]] = None) -> str:
+    """Prometheus-style summary block: one ``dstpu_check_findings`` series
+    per (pass, severity) — including zero series for every registered pass
+    so a clean run is visibly clean — plus the artifact sweep count."""
+    counts: Dict[Tuple[str, str], int] = {}
+    for f in findings:
+        counts[(f.pass_name, f.severity)] = \
+            counts.get((f.pass_name, f.severity), 0) + 1
+    lines = ["# TYPE dstpu_check_findings gauge"]
+    default_sev = {p.name: p.severity for p in all_passes()}
+    # every registered pass gets a (zero) series so a clean run is visibly
+    # clean — PLUS any finding name outside the registry (e.g. the
+    # runner's "syntax-error"), which must never vanish from the summary
+    names = sorted(set(default_sev) | {f.pass_name for f in findings})
+    for name in names:
+        sevs = {s for (n, s) in counts if n == name} or \
+            {default_sev.get(name, ERROR)}
+        for sev in sorted(sevs):
+            lines.append(
+                f'dstpu_check_findings{{pass="{name}",severity="{sev}"}} '
+                f'{counts.get((name, sev), 0)}')
+    if artifacts is not None:
+        lines.append("# TYPE dstpu_check_artifacts gauge")
+        lines.append(f"dstpu_check_artifacts {len(artifacts)}")
+    return "\n".join(lines)
+
+
+def relpath(path: Optional[str]) -> Optional[str]:
+    """Repo-relative path when possible (stable finding rendering)."""
+    if not path:
+        return path
+    try:
+        rel = os.path.relpath(path)
+        return rel if not rel.startswith("..") else path
+    except ValueError:
+        return path

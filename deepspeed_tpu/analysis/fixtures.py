@@ -1,0 +1,225 @@
+"""Historical-bug fixtures: every detector's bug class, deliberately
+re-introduced, so the gate can assert each pass still FIRES (a linter
+whose detectors rot is worse than none — it certifies broken code clean).
+
+Used by ``tools/check_graph_lint.py`` and the tier-1 fixture suite
+(``tests/unit/test_graph_lint.py``).  Each builder returns a traced
+program (or source text for AST passes) reproducing the original bug
+pattern as closely as the tiny CPU sim allows:
+
+  * ``unpinned_sharded_gather``  — PR 8/9: ``jnp.take`` over a
+    tensor-sharded operand on a dp4×tp2 mesh, no replicated pin.
+  * ``nan_mask_multiply``        — PR 6/8/10: mask-multiply over values
+    gathered from a page pool, select-AFTER-multiply.
+  * ``legacy_unfused_int4_wire`` — PR 9: the jnp-composed strided int4
+    nibble pack between quantize and collective.
+  * ``all_gather_in_micro``      — PR 4: an all-gather inside the
+    (supposedly prefetched) per-micro program.
+  * source snippets for import-time-jnp / retrace-hazard / host-sync /
+    bare-print / bare-except.
+"""
+from __future__ import annotations
+
+from .core import PassContext
+
+
+def unpinned_sharded_gather():
+    """(traced, ctx): the PR-8/9 replica-group miscompile pattern — a
+    gather whose operand is pinned TENSOR-sharded (not replicated) on a
+    dp4×tp2 mesh, outside any shard_map."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..runtime.topology import TENSOR, TopologyConfig, initialize_mesh
+
+    topo = initialize_mesh(TopologyConfig(tensor=2), force=True)
+    sharded = NamedSharding(topo.mesh, P(TENSOR, None))
+
+    def bad(table, idx):
+        t = jax.lax.with_sharding_constraint(table, sharded)
+        return jnp.take(t, idx, axis=0)
+
+    traced = jax.make_jaxpr(bad)(
+        jax.ShapeDtypeStruct((8, 4), jnp.float32),
+        jax.ShapeDtypeStruct((3,), jnp.int32))
+    return traced, PassContext(artifact="fixture:unpinned_sharded_gather",
+                               mesh=topo.mesh)
+
+
+def pinned_replicated_gather():
+    """The FIXED idiom for the same pattern (``_pin_replicated`` /
+    ``paged_kv_append(replicate=)``): identical gather, operand pinned
+    fully replicated — must stay clean."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    from ..runtime.topology import TopologyConfig, initialize_mesh
+
+    topo = initialize_mesh(TopologyConfig(tensor=2), force=True)
+    replicated = NamedSharding(topo.mesh, P())
+
+    def good(table, idx):
+        t = jax.lax.with_sharding_constraint(table, replicated)
+        return jnp.take(t, idx, axis=0)
+
+    traced = jax.make_jaxpr(good)(
+        jax.ShapeDtypeStruct((8, 4), jnp.float32),
+        jax.ShapeDtypeStruct((3,), jnp.int32))
+    return traced, PassContext(artifact="fixture:pinned_replicated_gather",
+                               mesh=topo.mesh)
+
+
+def nan_mask_multiply():
+    """(traced, ctx): the thrice-fixed 0×NaN class — rows gathered from a
+    page pool multiplied by a padding mask AFTER the read, so a garbage/
+    NaN slot rides ``0×NaN=NaN`` into the output."""
+    import jax
+    import jax.numpy as jnp
+
+    def bad(pages, idx, ctx_len):
+        v = jnp.take(pages, idx, axis=0)          # page-pool read
+        mask = (jnp.arange(v.shape[0]) < ctx_len).astype(v.dtype)
+        return v * mask[:, None]                  # select-AFTER-multiply
+
+    traced = jax.make_jaxpr(bad)(
+        jax.ShapeDtypeStruct((16, 4), jnp.float32),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32))
+    return traced, PassContext(artifact="fixture:nan_mask_multiply")
+
+
+def select_before_multiply():
+    """The FIXED idiom: ``jnp.where(mask, v, 0)`` before any multiply —
+    must stay clean."""
+    import jax
+    import jax.numpy as jnp
+
+    def good(pages, idx, ctx_len):
+        v = jnp.take(pages, idx, axis=0)
+        mask = jnp.arange(v.shape[0]) < ctx_len
+        v = jnp.where(mask[:, None], v, 0.0)
+        return v * 2.0
+
+    traced = jax.make_jaxpr(good)(
+        jax.ShapeDtypeStruct((16, 4), jnp.float32),
+        jax.ShapeDtypeStruct((8,), jnp.int32),
+        jax.ShapeDtypeStruct((), jnp.int32))
+    return traced, PassContext(artifact="fixture:select_before_multiply")
+
+
+def legacy_unfused_int4_wire():
+    """(traced, ctx): PR 9's negative control — the legacy jnp-composed
+    int4 wire whose strided nibble pack (an ``or`` of shifted slices) sits
+    between the quantize and the collective."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..runtime.comm_path import quantized_allreduce
+    from ..runtime.topology import (DATA, TopologyConfig, compat_shard_map,
+                                    initialize_mesh)
+
+    topo = initialize_mesh(TopologyConfig(), force=True)
+
+    def ex(x):
+        out, _, _ = quantized_allreduce(x[0], (DATA,), bits=4, fused=False)
+        return out[None]
+
+    n = topo.mesh.shape[DATA]
+    traced = jax.make_jaxpr(compat_shard_map(
+        ex, topo.mesh, (P(DATA),), P(DATA), manual_axes={DATA}))(
+            jax.ShapeDtypeStruct((n, 40, 8), jnp.float32))
+    return traced, PassContext(artifact="fixture:legacy_unfused_int4_wire",
+                               mesh=topo.mesh)
+
+
+def all_gather_in_micro():
+    """(traced, ctx): the PR-4 prefetch-invariant violation — a param
+    all-gather inside a per-micro program linted with gather_budget=0."""
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from ..runtime.topology import (DATA, TopologyConfig, compat_shard_map,
+                                    initialize_mesh)
+
+    topo = initialize_mesh(TopologyConfig(), force=True)
+
+    def micro(w_shard):
+        full = jax.lax.all_gather(w_shard, DATA)   # leaked into the micro
+        return (full * full).sum()
+
+    n = topo.mesh.shape[DATA]
+    traced = jax.make_jaxpr(compat_shard_map(
+        micro, topo.mesh, (P(DATA),), P(), manual_axes={DATA}))(
+            jax.ShapeDtypeStruct((n, 16), "float32"))
+    return traced, PassContext(artifact="fixture:all_gather_in_micro",
+                               gather_budget=0)
+
+
+# --------------------------------------------------------------------- #
+# Source-pass fixtures (text → write to a tmp file, run the AST passes)
+# --------------------------------------------------------------------- #
+SOURCE_FIXTURES = {
+    "import-time-jnp": (
+        "import jax.numpy as jnp\n"
+        "PAD_ROW = jnp.zeros((4,))        # initializes the backend\n"
+        "def f(x, scale=jnp.float32(2.0) * jnp.ones(())):\n"
+        "    return x\n"
+    ),
+    "retrace-hazard": (
+        "import jax\n"
+        "import jax.numpy as jnp\n"
+        "@jax.jit\n"
+        "def pad_to(x, n):\n"
+        "    return jnp.concatenate([x, jnp.zeros((n,))])\n"
+    ),
+    "host-sync": (
+        "import numpy as np\n"
+        "def decode_window(engine, steps):\n"
+        "    out = []\n"
+        "    for _ in range(steps):\n"
+        "        tok = engine.step_once()\n"
+        "        out.append(tok.item())\n"
+        "    return out\n"
+    ),
+    "bare-print": (
+        "def helper(x):\n"
+        "    print('value', x)\n"
+        "    return x\n"
+    ),
+    "bare-except": (
+        "def helper(x):\n"
+        "    try:\n"
+        "        return x()\n"
+        "    except:\n"
+        "        return None\n"
+    ),
+}
+
+
+def run_source_fixture(pass_name: str, tmp_dir: str):
+    """Write the named source fixture into ``tmp_dir`` and run ONLY that
+    pass over it; returns the findings."""
+    import os
+
+    from .core import get_pass
+    from .source_passes import run_source_passes
+
+    path = os.path.join(tmp_dir, f"fixture_{pass_name.replace('-', '_')}.py")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(SOURCE_FIXTURES[pass_name])
+    return run_source_passes([path], passes=[get_pass(pass_name)])
+
+
+#: graph-pass fixture table: pass name → (firing builder, clean builder)
+GRAPH_FIXTURES = {
+    "replica-group-gather": (unpinned_sharded_gather,
+                             pinned_replicated_gather),
+    "masked-nan-propagation": (nan_mask_multiply, select_before_multiply),
+    "fused-wire-layout": (legacy_unfused_int4_wire, None),
+    "gather-budget": (all_gather_in_micro, None),
+}

@@ -1,0 +1,470 @@
+"""Source-level (AST) detectors + the walker that feeds them.
+
+Consolidates the two standalone lints (``tools/check_no_bare_print.py``,
+``tools/check_no_bare_except.py``) into the pass registry — their CLI entry
+points now delegate here — and adds the trace-hygiene classes that can only
+be caught at the source level: import-time ``jnp`` computation (initializes
+the XLA backend before ``apply_xla_flags`` can set ``LIBTPU_INIT_ARGS`` —
+the PR-4 flag-wiring hazard), jitted entry points taking Python scalars in
+shape-relevant positions (retrace explosions, historically guarded only by
+per-test ``trace_counts`` probes), and host-sync calls inside step-loop /
+decode-window code paths (a per-iteration D2H round trip was the measured
+3 tok/s decode regression PR 6 removed).
+
+All passes honor ``# dstpu-check: disable=<pass>`` on the offending line;
+the bare-print pass additionally keeps its historical ``# lint:
+allow-print`` marker so existing allowlists stay valid.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import os
+import re
+from typing import List, Optional, Sequence, Set, Tuple
+
+from .core import (ERROR, WARN, Finding, SourcePass, pragma_disables,
+                   register_pass, relpath)
+
+# --------------------------------------------------------------------- #
+# Parsed-file carrier
+# --------------------------------------------------------------------- #
+
+
+@dataclasses.dataclass
+class SourceFile:
+    path: str
+    text: str
+    lines: List[str]
+    tree: Optional[ast.Module]
+    syntax_error: Optional[Tuple[int, str]] = None
+
+    @classmethod
+    def parse(cls, path: str) -> "SourceFile":
+        with open(path, "rb") as f:
+            raw = f.read()
+        text = raw.decode("utf-8", "replace")
+        try:
+            tree = ast.parse(raw, filename=path)
+            return cls(path, text, text.splitlines(), tree)
+        except SyntaxError as e:
+            return cls(path, text, text.splitlines(), None,
+                       syntax_error=(e.lineno or 0, e.msg or "syntax error"))
+
+    def jnp_aliases(self) -> Set[str]:
+        """Local names bound to ``jax.numpy`` (``jnp`` by idiom)."""
+        aliases = {"jnp"}
+        if self.tree is None:
+            return aliases
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.name == "jax.numpy" and a.asname:
+                        aliases.add(a.asname)
+            elif isinstance(node, ast.ImportFrom) and node.module == "jax":
+                for a in node.names:
+                    if a.name == "numpy":
+                        aliases.add(a.asname or "numpy")
+        return aliases
+
+
+def _attr_chain(expr) -> List[str]:
+    """``jax.numpy.zeros`` → ['jax', 'numpy', 'zeros']; [] when the base is
+    not a plain name."""
+    parts: List[str] = []
+    while isinstance(expr, ast.Attribute):
+        parts.append(expr.attr)
+        expr = expr.value
+    if isinstance(expr, ast.Name):
+        parts.append(expr.id)
+        return parts[::-1]
+    return []
+
+
+def _names_in(expr) -> Set[str]:
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+# --------------------------------------------------------------------- #
+# bare-print (tools/check_no_bare_print.py logic, registry-hosted)
+# --------------------------------------------------------------------- #
+ALLOW_PRINT_MARKER = "lint: allow-print"
+
+#: functions whose body (incl. nested defs) may print: CLI entry points and
+#: the flops profiler's single audited report-output seam
+PRINTING_FUNC_NAMES = frozenset({"main", "emit_report"})
+
+
+def _main_guard_lines(tree: ast.Module) -> Set[int]:
+    lines: Set[int] = set()
+    for node in tree.body:
+        if not isinstance(node, ast.If):
+            continue
+        test = node.test
+        if (isinstance(test, ast.Compare) and isinstance(test.left, ast.Name)
+                and test.left.id == "__name__"):
+            end = getattr(node, "end_lineno", node.lineno)
+            lines.update(range(node.lineno, end + 1))
+    return lines
+
+
+def bare_print_offenders(sf: SourceFile) -> List[Tuple[int, str]]:
+    """(line, why) offenders — the exact semantics the standalone lint has
+    enforced since PR 2 (main()/__main__-guard/emit_report exempt,
+    ``# lint: allow-print`` per-line allowlist)."""
+    if sf.tree is None:
+        return []
+    allowed = {i + 1 for i, line in enumerate(sf.lines)
+               if ALLOW_PRINT_MARKER in line}
+    allowed |= _main_guard_lines(sf.tree)
+    offenders: List[Tuple[int, str]] = []
+
+    def walk(node, in_main: bool):
+        for child in ast.iter_child_nodes(node):
+            child_in_main = in_main
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                child_in_main = in_main or child.name in PRINTING_FUNC_NAMES
+            if (isinstance(child, ast.Call)
+                    and isinstance(child.func, ast.Name)
+                    and child.func.id == "print"
+                    and not in_main
+                    and child.lineno not in allowed):
+                offenders.append((child.lineno, "bare print"))
+            walk(child, child_in_main)
+
+    walk(sf.tree, in_main=False)
+    return offenders
+
+
+@register_pass
+class BarePrintPass(SourcePass):
+    """Library output must go through utils.logging or telemetry; a stray
+    ``print`` spams every rank and is invisible to the run summary (see
+    tools/check_no_bare_print.py for the full contract)."""
+
+    name = "bare-print"
+    severity = ERROR
+    bug_class = "un-capturable per-rank stdout spam (PR 2 logging contract)"
+
+    def run(self, sf: SourceFile) -> List[Finding]:
+        return [self.finding(
+            "bare print in library code — use utils.logging / telemetry, "
+            "or move CLI output into main()",
+            file=relpath(sf.path), line=line)
+            for line, _why in bare_print_offenders(sf)]
+
+
+def bare_except_offenders(sf: SourceFile) -> List[Tuple[int, str]]:
+    if sf.tree is None:
+        return []
+    return [(node.lineno, "bare except")
+            for node in ast.walk(sf.tree)
+            if isinstance(node, ast.ExceptHandler) and node.type is None]
+
+
+@register_pass
+class BareExceptPass(SourcePass):
+    """A bare except swallows KeyboardInterrupt/SystemExit and hides the
+    storage/transport errors the fault subsystem exists to surface."""
+
+    name = "bare-except"
+    severity = ERROR
+    bug_class = "fault paths swallowed by bare except (PR 1 fault contract)"
+
+    def run(self, sf: SourceFile) -> List[Finding]:
+        return [self.finding(
+            "bare except — use 'except Exception:' or narrower so fault "
+            "paths stay visible",
+            file=relpath(sf.path), line=line)
+            for line, _why in bare_except_offenders(sf)]
+
+
+# --------------------------------------------------------------------- #
+# import-time jnp computation
+# --------------------------------------------------------------------- #
+@register_pass
+class ImportTimeJnpPass(SourcePass):
+    """No ``jnp.``/``jax.numpy`` computation at module import time.
+
+    Bug class: an import-time op initializes the XLA backend BEFORE
+    ``deepspeed_tpu.initialize()`` runs ``apply_xla_flags`` — so
+    ``LIBTPU_INIT_ARGS`` (the PR-4 latency-hiding-scheduler flags) is read
+    too late and silently ignored for the whole process.  Flags module- and
+    class-level calls plus default-argument expressions of module/class-
+    level functions (defaults evaluate at import).  Constants belong inside
+    the traced function or behind a lazy/cached accessor.
+    """
+
+    name = "import-time-jnp"
+    severity = ERROR
+    bug_class = ("backend initialized before apply_xla_flags could set "
+                 "LIBTPU_INIT_ARGS (PR 4 flag wiring)")
+
+    def run(self, sf: SourceFile) -> List[Finding]:
+        if sf.tree is None:
+            return []
+        aliases = sf.jnp_aliases()
+        findings: List[Finding] = []
+
+        def is_jnp_call(call: ast.Call) -> bool:
+            chain = _attr_chain(call.func)
+            if not chain:
+                return False
+            return chain[0] in aliases or \
+                (len(chain) >= 2 and chain[0] == "jax"
+                 and chain[1] == "numpy")
+
+        def flag(call: ast.Call, where: str) -> None:
+            findings.append(self.finding(
+                f"jnp computation at import time ({where}) — initializes "
+                f"the XLA backend before apply_xla_flags can set "
+                f"LIBTPU_INIT_ARGS; build it lazily inside the function",
+                file=relpath(sf.path), line=call.lineno))
+
+        def scan(node, where: str) -> None:
+            """Import-time-executed statements of one module/class body."""
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    for d in list(child.args.defaults) + \
+                            [kd for kd in child.args.kw_defaults if kd]:
+                        for sub in ast.walk(d):
+                            if isinstance(sub, ast.Call) and \
+                                    is_jnp_call(sub):
+                                flag(sub, f"default arg of {child.name}()")
+                    continue   # body runs at call time, not import
+                if isinstance(child, ast.Lambda):
+                    continue
+                if isinstance(child, ast.ClassDef):
+                    scan(child, f"class {child.name} body")
+                    continue
+                if isinstance(child, ast.Call) and is_jnp_call(child):
+                    flag(child, where)
+                scan(child, where)
+
+        scan(sf.tree, "module level")
+        return findings
+
+
+# --------------------------------------------------------------------- #
+# retrace-hazard
+# --------------------------------------------------------------------- #
+_SHAPE_FUNCS = frozenset({
+    "zeros", "ones", "full", "empty", "arange", "eye", "tile",
+    "broadcast_to", "linspace", "reshape",
+})
+
+
+def _jit_decorator_info(dec) -> Optional[Tuple[Set[str], Set[int]]]:
+    """(static_argnames, static_argnums) when ``dec`` is a jax.jit form
+    (``@jax.jit``, ``@jit``, ``@partial(jax.jit, ...)``), else None."""
+    def is_jit_ref(expr) -> bool:
+        chain = _attr_chain(expr)
+        return chain in (["jit"], ["jax", "jit"])
+
+    call = None
+    if is_jit_ref(dec):
+        return set(), set()
+    if isinstance(dec, ast.Call):
+        chain = _attr_chain(dec.func)
+        if is_jit_ref(dec.func):
+            call = dec
+        elif chain and chain[-1] == "partial" and dec.args and \
+                is_jit_ref(dec.args[0]):
+            call = dec
+    if call is None:
+        return None
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    for kw in call.keywords:
+        vals = kw.value.elts if isinstance(
+            kw.value, (ast.Tuple, ast.List)) else [kw.value]
+        if kw.arg == "static_argnames":
+            names |= {v.value for v in vals
+                      if isinstance(v, ast.Constant)
+                      and isinstance(v.value, str)}
+        elif kw.arg == "static_argnums":
+            nums |= {v.value for v in vals
+                     if isinstance(v, ast.Constant)
+                     and isinstance(v.value, int)}
+    return names, nums
+
+
+@register_pass
+class RetraceHazardPass(SourcePass):
+    """Jitted entry points taking Python scalars in shape-relevant
+    positions: every distinct value is a fresh trace + XLA compile.
+
+    Bug class: the retrace explosions only the per-test ``trace_counts``
+    probes have guarded so far — the sanctioned idioms are the compile-
+    cache bucket tables (``bucket_tokens``/``bucket_for``) or
+    ``static_argnums``/``static_argnames``.  Flags a non-static parameter
+    of a ``@jax.jit`` function used inside a shape-constructing call
+    (``jnp.zeros((n,))``, ``x.reshape(n, -1)``) or as a Python loop bound
+    (``range(n)`` additionally unrolls the loop into the trace).
+    """
+
+    name = "retrace-hazard"
+    severity = WARN
+    bug_class = ("per-value retrace of jitted fns taking Python scalars "
+                 "in shape positions (trace_counts probe class)")
+
+    def run(self, sf: SourceFile) -> List[Finding]:
+        if sf.tree is None:
+            return []
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            info = None
+            for dec in node.decorator_list:
+                info = _jit_decorator_info(dec)
+                if info is not None:
+                    break
+            if info is None:
+                continue
+            static_names, static_nums = info
+            params = [a.arg for a in node.args.posonlyargs + node.args.args]
+            dynamic = {p for i, p in enumerate(params)
+                       if p not in static_names and i not in static_nums
+                       and p != "self"}
+            if not dynamic:
+                continue
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                used = self._shape_use(sub, dynamic)
+                if used:
+                    findings.append(self.finding(
+                        f"jitted function {node.name}() uses Python "
+                        f"argument(s) {sorted(used)} in a shape position — "
+                        f"every distinct value retraces; mark static_"
+                        f"argnums/static_argnames or route through a "
+                        f"bucket table",
+                        file=relpath(sf.path), line=sub.lineno))
+        return findings
+
+    def _shape_use(self, call: ast.Call, dynamic: Set[str]) -> Set[str]:
+        chain = _attr_chain(call.func)
+        shapeish = bool(chain) and chain[-1] in _SHAPE_FUNCS
+        loopish = isinstance(call.func, ast.Name) and \
+            call.func.id == "range"
+        if not (shapeish or loopish):
+            return set()
+        used: Set[str] = set()
+        for arg in list(call.args) + [kw.value for kw in call.keywords]:
+            used |= _names_in(arg) & dynamic
+        return used
+
+
+# --------------------------------------------------------------------- #
+# host-sync
+# --------------------------------------------------------------------- #
+_HOT_FUNC_RE = re.compile(r"(decode|verify|train_batch|window|micro|step)")
+
+
+@register_pass
+class HostSyncPass(SourcePass):
+    """Per-iteration device→host syncs inside step-loop / decode-window
+    code paths.
+
+    Bug class: the measured 3 tok/s decode (PR 6) — a host round trip per
+    decode step dominated wall time until sampling moved on-device and the
+    loop became a fused scan.  Flags, inside ``for``/``while`` bodies of
+    functions whose name matches step/decode/verify/window/micro,
+    ``.item()``, ``jax.device_get(...)``, and ``float``/``int`` applied
+    directly to a ``jnp`` computation — each is a blocking transfer per
+    iteration.  Window-boundary drains (one sync per window, not per step)
+    belong OUTSIDE the loop or behind a ``# dstpu-check:
+    disable=host-sync`` pragma naming why the sync is sanctioned.
+    """
+
+    name = "host-sync"
+    severity = WARN
+    bug_class = ("per-step D2H sync in the decode loop (PR 6's measured "
+                 "3 tok/s host-driven decode)")
+
+    def run(self, sf: SourceFile) -> List[Finding]:
+        if sf.tree is None:
+            return []
+        aliases = sf.jnp_aliases()
+        findings: List[Finding] = []
+        for node in ast.walk(sf.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            if not _HOT_FUNC_RE.search(node.name):
+                continue
+            for loop in ast.walk(node):
+                if not isinstance(loop, (ast.For, ast.While,
+                                         ast.AsyncFor)):
+                    continue
+                for sub in ast.walk(loop):
+                    if isinstance(sub, ast.Call):
+                        why = self._sync_call(sub, aliases)
+                        if why:
+                            findings.append(self.finding(
+                                f"{why} inside a loop of {node.name}() — "
+                                f"one blocking device→host transfer per "
+                                f"iteration; batch the sync at the window "
+                                f"boundary or keep the value on device",
+                                file=relpath(sf.path), line=sub.lineno))
+        return findings
+
+    def _sync_call(self, call: ast.Call, aliases: Set[str]) -> Optional[str]:
+        chain = _attr_chain(call.func)
+        if isinstance(call.func, ast.Attribute) and \
+                call.func.attr == "item" and not call.args:
+            return ".item()"
+        if chain and chain[-1] == "device_get":
+            return "jax.device_get"
+        if isinstance(call.func, ast.Name) and \
+                call.func.id in ("float", "int") and len(call.args) == 1:
+            for sub in ast.walk(call.args[0]):
+                if isinstance(sub, ast.Call):
+                    inner = _attr_chain(sub.func)
+                    if inner and (inner[0] in aliases or
+                                  inner[0] == "jax"):
+                        return f"{call.func.id}() on a jnp value"
+        return None
+
+
+# --------------------------------------------------------------------- #
+# Runner
+# --------------------------------------------------------------------- #
+def iter_py_files(roots: Sequence[str]):
+    for root in roots:
+        if os.path.isfile(root):
+            yield root
+            continue
+        for d, _dirs, fns in os.walk(root):
+            if "__pycache__" in d:
+                continue
+            for fn in sorted(fns):
+                if fn.endswith(".py"):
+                    yield os.path.join(d, fn)
+
+
+def run_source_passes(roots: Sequence[str],
+                      passes: Optional[Sequence[SourcePass]] = None,
+                      ) -> List[Finding]:
+    """All (or the given) source passes over every ``.py`` under ``roots``;
+    unparseable files produce one error-severity ``syntax-error`` finding.
+    Pragma filtering happens against the freshly-read file content."""
+    from .core import all_passes
+    ps = list(passes) if passes is not None else all_passes("source")
+    findings: List[Finding] = []
+    for path in sorted(set(iter_py_files(roots))):
+        sf = SourceFile.parse(path)
+        if sf.syntax_error is not None:
+            line, msg = sf.syntax_error
+            findings.append(Finding("syntax-error", ERROR,
+                                    f"syntax error: {msg}",
+                                    file=relpath(path), line=line))
+            continue
+        for p in ps:
+            for f in p.run(sf):
+                if f.line and 0 < f.line <= len(sf.lines) and \
+                        pragma_disables(sf.lines[f.line - 1], f.pass_name):
+                    continue
+                findings.append(f)
+    return findings

@@ -69,6 +69,12 @@ class RaggedInferenceEngineConfig:
     #: on-device sampling default for fused decode: 0 = full-vocab
     #: categorical (or argmax at temperature 0), k>0 = top-k sampling
     top_k: int = 0
+    #: dstpu-check graph lint: run the registered jaxpr passes over every
+    #: freshly-built bucket program (prefill/decode/verify) — findings
+    #: accumulate in ``engine.graph_lint_findings`` and emit ``analysis/*``
+    #: telemetry events.  Advisory (never raises): serving keeps serving;
+    #: the CI gate (tools/check_graph_lint.py) is where errors block.
+    graph_lint: bool = False
 
 
 class InferenceEngineV2:
@@ -147,6 +153,9 @@ class InferenceEngineV2:
             x.size * jnp.dtype(x.dtype).itemsize
             for x in jax.tree_util.tree_leaves(self.params))
         self.last_decode_roofline: Optional[Dict] = None
+        #: dstpu-check findings accumulated by ``config.graph_lint`` (one
+        #: lint per freshly-built bucket program; see _graph_lint_bucket)
+        self.graph_lint_findings: List = []
         log_dist(f"InferenceEngineV2: blocks={num_blocks}×{c.block_size} "
                  f"budget={c.max_tokens}tok/{c.max_seqs}seq "
                  f"kv={self.kv.mem_bytes()/1e6:.0f}MB "
@@ -198,6 +207,59 @@ class InferenceEngineV2:
 
         return wrapped
 
+    def _graph_lint_bucket(self, kind: str, key: Tuple[int, int], raw_fn,
+                           with_rng: bool = False) -> None:
+        """``config.graph_lint``: run the registered jaxpr passes over a
+        freshly-built bucket program (the RAW traceable fn, so the
+        ``trace_counts`` retrace probes never see the extra trace).
+        Findings accumulate in ``graph_lint_findings`` and emit
+        ``analysis/*`` telemetry — advisory only; the blocking enforcement
+        lives in the CI gate."""
+        if not self.config.graph_lint:
+            return
+        try:
+            from ...analysis import PassContext, run_graph_passes
+            from ...telemetry.hub import emit_event
+            from .ragged.ragged_wrapper import pack_layout
+
+            structs = [
+                jax.tree.map(
+                    lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype),
+                    self.params),
+                jax.ShapeDtypeStruct(self.kv.pages.shape,
+                                     self.kv.pages.dtype),
+                jax.ShapeDtypeStruct((pack_layout(
+                    key[0], key[1],
+                    self._wrapper_for(key).max_blocks)["_total"][0],),
+                    jnp.int32),
+            ]
+            if with_rng:
+                structs.append(jax.ShapeDtypeStruct(self._rng.shape,
+                                                    self._rng.dtype))
+            # seed the replica-group pass with the REAL leaf shardings
+            # (TP-sharded params are exactly the paged_kv_append class)
+            shardings = [getattr(leaf, "sharding", None)
+                         for leaf in jax.tree_util.tree_leaves(self.params)]
+            shardings += [getattr(self.kv.pages, "sharding", None), None]
+            if with_rng:
+                shardings.append(None)
+            artifact = f"{kind}[{self.config.attn_impl},bucket={key}]"
+            findings = run_graph_passes(
+                jax.make_jaxpr(raw_fn)(*structs),
+                PassContext(artifact=artifact, arg_shardings=shardings))
+            self.graph_lint_findings.extend(findings)
+            for f in findings:
+                emit_event("analysis/finding", pass_name=f.pass_name,
+                           severity=f.severity, message=f.message,
+                           file=f.file, line=f.line, artifact=f.artifact)
+                log_dist(f"graph_lint: {f.render()}", ranks=[0])
+            emit_event("analysis/graph_lint", artifact=artifact,
+                       findings=len(findings))
+        except Exception as e:  # noqa: BLE001 — advisory by contract:
+            # a lint-machinery failure must never fail the serving path
+            log_dist(f"graph_lint: lint of {kind}{key} failed ({e}); "
+                     f"serving continues", ranks=[0])
+
     def _step_for(self, key: Tuple[int, int]):
         if key not in self._steps:
             c = self.config
@@ -207,6 +269,7 @@ class InferenceEngineV2:
                 max_blocks=self._wrapper_for(key).max_blocks,
                 block_q=c.block_q, pages_per_chunk=c.pages_per_chunk,
                 jit=False, kv_replicate=self._kv_replicate)
+            self._graph_lint_bucket("prefill", key, fn)
             self._steps[key] = jax.jit(self._counted(key, fn),
                                        donate_argnums=(1,))
         return self._steps[key]
@@ -227,6 +290,7 @@ class InferenceEngineV2:
                 max_blocks=self._wrapper_for(key).max_blocks,
                 block_q=c.block_q, pages_per_chunk=c.pages_per_chunk,
                 jit=False, kv_replicate=self._kv_replicate)
+            self._graph_lint_bucket("verify", key, fn)
             self._verify_steps[key] = jax.jit(
                 self._counted(("verify",) + key, fn), donate_argnums=(1,))
         return self._verify_steps[key], first
@@ -595,6 +659,8 @@ class InferenceEngineV2:
                 attn_impl=c.attn_impl, steps=steps, temperature=temperature,
                 block_q=c.block_q, pages_per_chunk=c.pages_per_chunk,
                 top_k=top_k, jit=False, kv_replicate=self._kv_replicate)
+            self._graph_lint_bucket("decode_loop", bucket, loop,
+                                    with_rng=True)
             self._decode_loops[key] = jax.jit(
                 self._counted(("decode",) + key, loop), donate_argnums=(1,))
         if rng is None:

@@ -438,7 +438,8 @@ def _decode_paged_kernel(kvl_ref, pt_ref,                # scalar prefetch
                 kh = kv[:, :, h, :].reshape(CH, -1).astype(jnp.float32)
                 # never-DMA'd columns hold stale data: scores there are
                 # masked, but V rows must be zeroed so 0·garbage(NaN)
-                # cannot poison the accumulate
+                # cannot poison the accumulate (select-before-multiply —
+                # the masked-nan-propagation pass contract)
                 vh = jnp.where(col_ok, kv[:, :, KV + h, :].reshape(CH, -1),
                                0.0).astype(jnp.float32)
                 s_mat = jnp.dot(qh, kh.T,
@@ -640,7 +641,8 @@ def decode_attend_dense(q: jnp.ndarray, kv_pages: jnp.ndarray,
     k_ctx, v_ctx = ctx[..., :KV, :], ctx[..., KV:, :]
     # out-of-context columns may hold never-written garbage: scores there
     # are masked to -inf, but V must be zeroed too so 0·garbage(NaN)
-    # cannot poison the weighted sum (mirrors the Pallas kernel's col_ok)
+    # cannot poison the weighted sum (mirrors the Pallas kernel's col_ok;
+    # select-before-multiply — the masked-nan-propagation pass contract)
     valid = (ctx_pos[None, :] < kv_lens[:, None])[:, :, None, None]
     v_ctx = jnp.where(valid, v_ctx, 0.0)
     if KV != H:

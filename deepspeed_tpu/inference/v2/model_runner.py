@@ -100,7 +100,8 @@ def _attend_gather(q_seq, kv_pages, page_table, q_len, ctx_len,
     # and 0*NaN = NaN.  A sequence's UNUSED block-table slots are 0 and
     # alias page 0, so a NaN-poisoned page 0 would contaminate every
     # sequence through its padding columns without this (same hardening
-    # the dense decode lowering already has).
+    # the dense decode lowering already has).  Select-BEFORE-multiply is
+    # the contract dstpu-check's masked-nan-propagation pass enforces.
     valid_col = ctx_pos[None, :] < ctx_len[:, None]   # [S, C]
     v_ctx = jnp.where(valid_col[:, :, None, None], v_ctx, 0)
     if KV != H:

@@ -29,6 +29,10 @@ from typing import Optional, Tuple
 import jax
 import jax.numpy as jnp
 
+from ...analysis.jaxpr_walk import (
+    COLLECTIVE_PRIMS as _COLLECTIVE_PRIMS,
+    WIRE_LAYOUT_PRIMS as _LAYOUT_PRIMS,
+)
 from ...ops.quantizer.quantizer import (
     quant_pack_wire,
     unpack_dequant_mean,
@@ -147,9 +151,10 @@ def fused_quantized_allreduce(grad: jnp.ndarray, axes, bits: int = 8,
 # --------------------------------------------------------------------- #
 # jaxpr inspection (the fusion property the tests assert)
 # --------------------------------------------------------------------- #
-_COLLECTIVE_PRIMS = ("all_to_all", "all_gather", "psum", "reduce_scatter")
-_LAYOUT_PRIMS = {"reshape", "transpose", "squeeze", "expand_dims",
-                 "broadcast_in_dim", "convert_element_type"}
+# _COLLECTIVE_PRIMS/_LAYOUT_PRIMS are the shared analysis/jaxpr_walk.py
+# definitions (imported above): the fused-wire pass, wire_ops, and
+# assert_quantized_wire must agree on what counts as a collective / a
+# layout-only hop
 
 
 def _all_eqns(jaxpr):
@@ -200,39 +205,23 @@ def assert_fused_pack(traced) -> None:
     between quantize and exchange.  The legacy jnp-composed int4 wire fails
     this (its nibble pack is an ``or`` of shifted slices between the
     quantize and the collective), which the tests use as the negative
-    control."""
-    eqns = _all_eqns(traced)
-    producer = {}
-    for eqn in eqns:
-        for v in eqn.outvars:
-            producer[v] = eqn
-    wire_eqns = [e for e in eqns
-                 if any(e.primitive.name.startswith(p)
-                        for p in _COLLECTIVE_PRIMS)
-                 and any(getattr(v.aval, "dtype", None) == jnp.int8
-                         for v in e.invars)]
-    if not wire_eqns:
+    control.
+
+    The walk itself is the ``fused-wire-layout`` pass of the
+    ``dstpu-check`` framework (``analysis/graph_passes.py``) — this
+    assertion keeps its historical raise-on-first-violation contract (plus
+    the wires-must-exist check, which the general pass deliberately lacks:
+    a program with no quantized collectives is not a wire regression)."""
+    from ...analysis.core import ERROR, PassContext
+    from ...analysis.graph_passes import FusedWireLayoutPass
+
+    if not any("int8" in o["dtypes"] for o in wire_ops(traced)):
         raise AssertionError("no int8-wire collectives found")
-    for eqn in wire_eqns:
-        v = next(iv for iv in eqn.invars
-                 if getattr(iv.aval, "dtype", None) == jnp.int8)
-        hops = 0
-        while v in producer and hops < 32:
-            p = producer[v]
-            name = p.primitive.name
-            if name == "pallas_call":
-                break
-            if name not in _LAYOUT_PRIMS:
-                raise AssertionError(
-                    f"int8 wire operand of {eqn.primitive.name} produced "
-                    f"through non-layout op {name!r} — pack is not fused "
-                    f"into the quant kernel")
-            v = p.invars[0]
-            hops += 1
-        else:
-            raise AssertionError(
-                f"int8 wire operand of {eqn.primitive.name} does not "
-                f"originate from a Pallas quant+pack kernel")
+    findings = FusedWireLayoutPass().run(
+        traced, PassContext(artifact="assert_fused_pack"))
+    errors = [f for f in findings if f.severity == ERROR]
+    if errors:
+        raise AssertionError(errors[0].message)
 
 
 def assert_quantized_wire(traced, expect_exchanges: int) -> None:

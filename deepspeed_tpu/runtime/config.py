@@ -570,9 +570,19 @@ class DeepSpeedConfig:
         dbg = dict(config.get("debug", {}))
         self.debug_deterministic: bool = bool(dbg.pop("deterministic", False))
         self.debug_nan_check: bool = bool(dbg.pop("nan_check", False))
+        # graph lint (dstpu-check): run the registered jaxpr passes over
+        # the train step at first trace and emit analysis/* telemetry.
+        # false | true/"warn" (report only) | "error" (raise GraphLintError
+        # on an error-severity finding BEFORE dispatching the step).
+        gl = dbg.pop("graph_lint", False)
+        if gl not in (False, True, "warn", "error"):
+            raise ValueError(f"debug.graph_lint must be false, true, "
+                             f"'warn', or 'error'; got {gl!r}")
+        self.debug_graph_lint = "warn" if gl is True else gl
         if dbg:
             raise ValueError(f"unknown debug config keys: {sorted(dbg)}; "
-                             f"known: ['deterministic', 'nan_check']")
+                             f"known: ['deterministic', 'graph_lint', "
+                             f"'nan_check']")
         self.compression_config = CompressionConfig(**config.get("compression_training", {}))
         self.elasticity = ElasticityConfig(**config.get("elasticity", {}))
         self.fault = FaultConfig(**config.get("fault", {}))

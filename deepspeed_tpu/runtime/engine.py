@@ -145,6 +145,12 @@ class DeepSpeedEngine:
             jax.config.update("jax_debug_nans", True)
             log_dist("debug.nan_check: jax_debug_nans enabled "
                      "(process-global)", ranks=[0])
+        # graph lint (dstpu-check): run the registered jaxpr passes over
+        # the train step jaxpr at first trace; "error" aborts BEFORE the
+        # first dispatch — catch the GSPMD replica-group / 0×NaN classes
+        # mechanically instead of bisecting a 4x-wrong tensor at runtime
+        self._graph_lint_mode = getattr(config, "debug_graph_lint", False)
+        self._graph_lint_done = False
 
         self.loss_fn = self._resolve_loss_fn(model)
         self.compute_dtype = config.dtype
@@ -828,6 +834,71 @@ class DeepSpeedEngine:
         donate = jax.jit(step_fn, donate_argnums=(0,))
         return donate
 
+    def _run_graph_lint(self) -> None:
+        """``config.debug.graph_lint``: trace the train step once and run
+        every registered jaxpr pass over it (replica-group gather, masked
+        NaN, fused wire, gather budget — analysis/graph_passes.py).
+
+        Findings are logged, counted in ``analysis/findings`` and emitted
+        as ``analysis/finding`` telemetry events (plus one
+        ``analysis/graph_lint`` summary event); in ``"error"`` mode an
+        error-severity finding raises :class:`~..analysis.GraphLintError`
+        before the step is ever dispatched.  The trace is cached into
+        ``self._step_jaxpr`` so ``train_step_cost``'s module-tree walk
+        reuses it instead of re-tracing.
+        """
+        from ..analysis import (ERROR, GraphLintError, PassContext,
+                                run_graph_passes, sort_findings)
+
+        fn = self._compiled["train_batch"]
+        state_struct = jax.tree.map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), self.state)
+        struct = self._last_batch_struct
+        try:
+            with self._span("analysis/graph_lint"):
+                traced = jax.make_jaxpr(fn)(state_struct, struct)
+                key = tuple((tuple(l.shape), str(l.dtype))
+                            for l in jax.tree.leaves(struct))
+                self._step_jaxpr = (key, traced.jaxpr)
+                shardings = [getattr(leaf, "sharding", None)
+                             for leaf in jax.tree.leaves(self.state)]
+                shardings += [None] * len(jax.tree.leaves(struct))
+                findings = sort_findings(run_graph_passes(
+                    traced, PassContext(artifact="train_step",
+                                        mesh=self.mesh,
+                                        arg_shardings=shardings)))
+        except Exception as e:  # noqa: BLE001 — a lint-machinery failure
+            # is not a finding: report-only modes promise not to break
+            # training, and error mode only raises on actual findings
+            log_dist(f"graph_lint: train-step lint failed ({e}); "
+                     f"training continues", ranks=[0])
+            self._graph_lint_done = True
+            return
+        errors = [f for f in findings if f.severity == ERROR]
+        tel = self.telemetry
+        if tel is not None:
+            for f in findings:
+                tel.metrics.counter("analysis/findings").inc(
+                    **{"pass": f.pass_name, "severity": f.severity})
+                tel.event("analysis/finding", pass_name=f.pass_name,
+                          severity=f.severity, message=f.message,
+                          file=f.file, line=f.line, artifact=f.artifact)
+            tel.event("analysis/graph_lint", artifact="train_step",
+                      findings=len(findings), errors=len(errors),
+                      mode=self._graph_lint_mode)
+        for f in findings:
+            log_dist(f"graph_lint: {f.render()}", ranks=[0])
+        if errors and self._graph_lint_mode == "error":
+            # deliberately NOT marking the lint done: a caller that
+            # catches and retries train_batch must hit the abort again,
+            # never dispatch the flagged program unlinted
+            raise GraphLintError(
+                f"debug.graph_lint: {len(errors)} error-severity finding(s) "
+                f"in the train step jaxpr; first: {errors[0].render()}")
+        self._graph_lint_done = True
+        if not findings:
+            log_dist("graph_lint: train step jaxpr clean", ranks=[0])
+
     def train_batch(self, batch) -> jnp.ndarray:
         """One full optimizer step over a global batch.
 
@@ -843,6 +914,8 @@ class DeepSpeedEngine:
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch)
         if "train_batch" not in self._compiled:
             self._compiled["train_batch"] = self._build_train_batch_fn()
+        if self._graph_lint_mode and not self._graph_lint_done:
+            self._run_graph_lint()
         self._heartbeat("train_batch")
         injector = fault_injection.get_injector()
         if injector is not None:   # don't pay the global_steps sync otherwise

@@ -22,5 +22,6 @@ setup(
         "hf": ["transformers", "torch"],
         "dev": ["pytest", "chex"],
     },
-    scripts=["bin/dstpu", "bin/ds_report", "bin/dstpu-telemetry"],
+    scripts=["bin/dstpu", "bin/ds_report", "bin/dstpu-telemetry",
+             "bin/dstpu-check"],
 )

@@ -22,17 +22,60 @@ jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", False)
 
 
+_BUILTIN_MARKERS = frozenset({
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast", "anyio",
+})
+
+
+def _registered_marker_names(config):
+    """Marker names REGISTERED in tests/pytest.ini (``name:`` /
+    ``name(args):``) that ROUTE a suite.  ``config.getini("markers")``
+    also reports pytest's builtin markers (parametrize/xfail/skipif/...),
+    which must NOT satisfy the coverage lint — a parametrized-but-unrouted
+    test file is exactly what it exists to catch — so builtins are
+    excluded, as is ``world_size`` (a capability marker: it gates device
+    count, it does not select a subsystem)."""
+    names = set()
+    for entry in config.getini("markers"):
+        head = entry.split(":", 1)[0].strip()
+        names.add(head.split("(", 1)[0])
+    return names - _BUILTIN_MARKERS - {"world_size"}
+
+
 def pytest_collection_modifyitems(config, items):
-    """Marker lint: every test in a chaos-suite file must carry the
-    ``serving_chaos`` marker — with ``--strict-markers`` (pytest.ini) a
-    misspelled marker already fails collection; this closes the remaining
-    hole of a chaos file with NO marker silently joining every run."""
+    """Marker lints, both failing collection loudly:
+
+    * every test in a chaos-suite file must carry the ``serving_chaos``
+      marker — with ``--strict-markers`` (pytest.ini) a misspelled marker
+      already fails collection; this closes the remaining hole of a chaos
+      file with NO marker silently joining every run;
+    * generalized (PR 12): every ``tests/unit/test_*.py`` file must carry
+      at least one marker REGISTERED in pytest.ini on every test, so
+      ``-m <subsystem>`` selections stay exhaustive and a new suite can't
+      land unroutable.
+    """
     bad = [item.nodeid for item in items
            if "chaos" in os.path.basename(str(item.fspath))
            and item.get_closest_marker("serving_chaos") is None]
     if bad:
         raise pytest.UsageError(
             "chaos tests must be marked serving_chaos: " + ", ".join(bad))
+
+    registered = _registered_marker_names(config)
+    unmarked = {}
+    for item in items:
+        path = str(item.fspath)
+        if os.sep + "unit" + os.sep not in path:
+            continue
+        if not any(m.name in registered for m in item.iter_markers()):
+            unmarked.setdefault(os.path.basename(path), 0)
+            unmarked[os.path.basename(path)] += 1
+    if unmarked:
+        raise pytest.UsageError(
+            "test files without a registered pytest marker (add a "
+            "subsystem pytestmark; see tests/pytest.ini markers): " +
+            ", ".join(sorted(unmarked)))
 
 
 @pytest.fixture(autouse=True)

@@ -15,6 +15,8 @@ from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 from deepspeed_tpu.runtime.activation_checkpointing import checkpointing as ac
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.core
+
 
 def _engine(act_ckpt=None):
     topo = initialize_mesh(TopologyConfig(), force=True)

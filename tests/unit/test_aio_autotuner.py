@@ -8,6 +8,8 @@ import pytest
 
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.core
+
 
 def _aio_ok():
     from deepspeed_tpu.ops.aio import aio_available

@@ -19,6 +19,8 @@ from deepspeed_tpu.runtime.topology import (TopologyConfig, initialize_mesh,
 from deepspeed_tpu.sequence.layer import UlyssesAttention
 from deepspeed_tpu.sequence.ring_attention import ring_attention
 
+pytestmark = pytest.mark.kernels
+
 
 @pytest.fixture
 def sp_mesh():

@@ -9,6 +9,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.core
+
 
 class TestDataAnalyzer:
     def _dataset(self, n=40):

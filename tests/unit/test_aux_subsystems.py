@@ -10,6 +10,8 @@ import pytest
 
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.core
+
 
 class TestFusedAdam:
     def test_matches_optax_adamw(self):

@@ -11,6 +11,9 @@ from contextlib import redirect_stdout
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__)))))
 import bench  # noqa: E402
+import pytest
+
+pytestmark = pytest.mark.core
 
 
 def _emit(*args, **kw):

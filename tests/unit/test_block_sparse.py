@@ -17,6 +17,8 @@ from deepspeed_tpu.ops.sparse_attention.sparsity_config import (
     FixedSparsityConfig,
 )
 
+pytestmark = pytest.mark.kernels
+
 
 def _qkv(B=2, H=2, S=128, hd=32, seed=0):
     rng = np.random.default_rng(seed)

@@ -8,6 +8,8 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu import comm as dist
 from deepspeed_tpu.runtime.topology import DATA, TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.comm
+
 
 def shard_map_over(mesh, in_specs, out_specs):
     from deepspeed_tpu.runtime.topology import compat_shard_map

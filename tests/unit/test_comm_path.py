@@ -14,6 +14,8 @@ import deepspeed_tpu
 from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.comm
+
 
 def _engine(stage, zero_extra=None, top_extra=None, seed=0):
     topo = initialize_mesh(TopologyConfig(), force=True)

@@ -10,6 +10,8 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.runtime.topology import DATA, TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.comm
+
 
 class TestCompressedAllreduce:
     @pytest.mark.xfail(strict=False, reason="jax 0.4.x has no jax.shard_map (exercises the newer partial-manual API)")

@@ -19,6 +19,8 @@ from deepspeed_tpu.inference.v2.engine_v2 import (
 from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.inference
+
 
 @pytest.fixture(scope="module")
 def tiny():

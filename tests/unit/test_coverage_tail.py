@@ -10,6 +10,8 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.runtime.topology import TENSOR, TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.core
+
 
 class TestAutoTP:
     def test_classifies_llama_layout(self):

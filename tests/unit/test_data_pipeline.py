@@ -14,6 +14,8 @@ from deepspeed_tpu.runtime.data_pipeline.indexed_dataset import (
     MMapIndexedDatasetBuilder,
 )
 
+pytestmark = pytest.mark.core
+
 
 class TestDataSampler:
     def test_dp_shards_are_disjoint_and_cover(self):

@@ -9,6 +9,8 @@ import deepspeed_tpu
 from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.core
+
 
 def _engine(debug, seed=0):
     topo = initialize_mesh(TopologyConfig(), force=True)

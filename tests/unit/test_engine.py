@@ -10,6 +10,8 @@ from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
 from .simple_model import RandomClsDataset, init_mlp_params, mlp_loss_fn, random_batch
 
+pytestmark = pytest.mark.core
+
 HIDDEN = 16
 
 

@@ -4,6 +4,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.kernels
+
 
 class TestEvoformer:
     def _inputs(self, B=1, N=2, S=32, H=2, D=8, seed=0):

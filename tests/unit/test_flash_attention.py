@@ -24,8 +24,11 @@ def _pallas_supported():
         return False
 
 
-pytestmark = pytest.mark.skipif(not _pallas_supported(),
-                                reason="pallas not supported on this backend")
+pytestmark = [
+    pytest.mark.kernels,
+    pytest.mark.skipif(not _pallas_supported(),
+                       reason="pallas not supported on this backend"),
+]
 
 
 @pytest.mark.parametrize("causal", [True, False])

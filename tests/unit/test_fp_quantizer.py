@@ -7,6 +7,8 @@ import pytest
 
 from deepspeed_tpu.ops.fp_quantizer import FP_Quantize, fp_dequantize, fp_quantize
 
+pytestmark = pytest.mark.kernels
+
 
 class TestFPQuantize:
     @pytest.mark.parametrize("fmt,rel_tol", [("e4m3", 0.07), ("e5m2", 0.3),

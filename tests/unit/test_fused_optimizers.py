@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.kernels
+
 
 def _tree():
     rng = np.random.default_rng(0)

@@ -1,0 +1,442 @@
+"""dstpu-check pass framework (deepspeed_tpu/analysis/): registry +
+severity + pragma mechanics, every graph pass's historical-bug fixture
+firing (and the paired fixed idiom staying clean), the source passes'
+class-by-class behavior, and the engine/serving ``graph_lint`` knobs —
+including that the extra lint trace never perturbs the ``trace_counts``
+retrace probes the serving tests rely on.
+"""
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import deepspeed_tpu.analysis as A
+from deepspeed_tpu.analysis import fixtures as FX
+from deepspeed_tpu.analysis.source_passes import SourceFile, run_source_passes
+
+pytestmark = pytest.mark.analysis
+
+EXPECTED_GRAPH_PASSES = {"replica-group-gather", "masked-nan-propagation",
+                         "fused-wire-layout", "gather-budget"}
+EXPECTED_SOURCE_PASSES = {"bare-print", "bare-except", "import-time-jnp",
+                          "retrace-hazard", "host-sync"}
+
+
+class TestRegistry:
+    def test_all_builtin_passes_registered(self):
+        names = {p.name for p in A.all_passes()}
+        assert EXPECTED_GRAPH_PASSES | EXPECTED_SOURCE_PASSES <= names
+
+    def test_kind_filter(self):
+        assert {p.name for p in A.all_passes("jaxpr")} >= \
+            EXPECTED_GRAPH_PASSES
+        assert {p.name for p in A.all_passes("source")} >= \
+            EXPECTED_SOURCE_PASSES
+        assert not ({p.name for p in A.all_passes("jaxpr")} &
+                    EXPECTED_SOURCE_PASSES)
+
+    def test_unknown_pass_raises(self):
+        with pytest.raises(KeyError, match="unknown dstpu-check pass"):
+            A.get_pass("no-such-pass")
+
+    def test_every_pass_documents_its_bug_class(self):
+        for p in A.all_passes():
+            assert p.bug_class, f"{p.name} has no bug_class line"
+
+    def test_severity_ordering(self):
+        fs = [A.Finding("x", A.ADVICE, "a"), A.Finding("x", A.ERROR, "e"),
+              A.Finding("x", A.WARN, "w")]
+        assert [f.severity for f in A.sort_findings(fs)] == \
+            [A.ERROR, A.WARN, A.ADVICE]
+        assert A.max_severity(fs) == A.ERROR
+        assert A.max_severity([]) is None
+
+
+class TestGraphFixtures:
+    """Each jaxpr detector fires on its re-introduced historical bug and
+    stays silent on the fixed idiom — the core acceptance property."""
+
+    @pytest.mark.parametrize("pass_name", sorted(FX.GRAPH_FIXTURES))
+    def test_fixture_fires_at_error(self, pass_name):
+        fire, _clean = FX.GRAPH_FIXTURES[pass_name]
+        traced, ctx = fire()
+        findings = A.run_graph_passes(traced, ctx,
+                                      passes=[A.get_pass(pass_name)])
+        assert findings, f"{pass_name} missed its own bug class"
+        assert any(f.severity == A.ERROR for f in findings)
+        assert all(f.pass_name == pass_name for f in findings)
+
+    @pytest.mark.parametrize("pass_name", sorted(
+        n for n, (_f, c) in FX.GRAPH_FIXTURES.items() if c is not None))
+    def test_fixed_idiom_stays_clean(self, pass_name):
+        _fire, clean = FX.GRAPH_FIXTURES[pass_name]
+        traced, ctx = clean()
+        assert A.run_graph_passes(traced, ctx,
+                                  passes=[A.get_pass(pass_name)]) == []
+
+    def test_replica_group_seeds_from_arg_shardings(self, mesh8):
+        """The engine path: operand sharding arrives via ctx.arg_shardings
+        (param shardings), not a traced constraint."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        from deepspeed_tpu.runtime.topology import (DATA, TopologyConfig,
+                                                    initialize_mesh)
+
+        topo = initialize_mesh(TopologyConfig(), force=True)
+
+        def f(table, idx):
+            return jnp.take(table, idx, axis=0)
+
+        traced = jax.make_jaxpr(f)(
+            jax.ShapeDtypeStruct((8, 4), jnp.float32),
+            jax.ShapeDtypeStruct((3,), jnp.int32))
+        sharded = NamedSharding(topo.mesh, P(DATA))
+        fs = A.run_graph_passes(
+            traced, A.PassContext(arg_shardings=[sharded, None]),
+            passes=[A.get_pass("replica-group-gather")])
+        assert len(fs) == 1
+        # replicated arg sharding → clean
+        rep = NamedSharding(topo.mesh, P())
+        assert A.run_graph_passes(
+            traced, A.PassContext(arg_shardings=[rep, None]),
+            passes=[A.get_pass("replica-group-gather")]) == []
+
+    def test_gather_inside_shard_map_is_exempt(self):
+        """Manual regions are GSPMD-proof: the same sharded-operand gather
+        inside shard_map must not fire."""
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.runtime.topology import (DATA, TopologyConfig,
+                                                    compat_shard_map,
+                                                    initialize_mesh)
+
+        topo = initialize_mesh(TopologyConfig(), force=True)
+
+        def body(table, idx):
+            return jnp.take(table, idx[0], axis=0)[None]
+
+        traced = jax.make_jaxpr(compat_shard_map(
+            body, topo.mesh, (P(DATA), P(DATA)), P(DATA),
+            manual_axes={DATA}))(
+                jax.ShapeDtypeStruct((8, 4), jnp.float32),
+                jax.ShapeDtypeStruct((8, 3), jnp.int32))
+        fs = A.run_graph_passes(
+            traced, A.PassContext(
+                arg_shardings=[None, None]),
+            passes=[A.get_pass("replica-group-gather")])
+        assert fs == []
+
+    def test_gather_budget_respects_scan_multiplier(self):
+        """An all-gather inside a scan body counts once per trip."""
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.runtime.topology import (DATA, TopologyConfig,
+                                                    compat_shard_map,
+                                                    initialize_mesh)
+
+        topo = initialize_mesh(TopologyConfig(), force=True)
+
+        def body(x):
+            def step(c, _):
+                return c + jax.lax.all_gather(x, DATA).sum(), None
+            out, _ = jax.lax.scan(step, 0.0, None, length=3)
+            return out[None]
+
+        traced = jax.make_jaxpr(compat_shard_map(
+            body, topo.mesh, (P(DATA),), P(DATA), manual_axes={DATA}))(
+                jax.ShapeDtypeStruct((8, 4), jnp.float32))
+        fire = A.run_graph_passes(
+            traced, A.PassContext(gather_budget=2),
+            passes=[A.get_pass("gather-budget")])
+        assert len(fire) == 1 and "3 all-gather" in fire[0].message
+        assert A.run_graph_passes(
+            traced, A.PassContext(gather_budget=3),
+            passes=[A.get_pass("gather-budget")]) == []
+
+    def test_duplicate_collective_warns(self):
+        from jax.sharding import PartitionSpec as P
+
+        from deepspeed_tpu.runtime.topology import (DATA, TopologyConfig,
+                                                    compat_shard_map,
+                                                    initialize_mesh)
+
+        topo = initialize_mesh(TopologyConfig(), force=True)
+
+        def body(x):
+            a = jax.lax.psum(x, DATA)
+            b = jax.lax.psum(x, DATA)     # same operand exchanged twice
+            return a + b
+
+        traced = jax.make_jaxpr(compat_shard_map(
+            body, topo.mesh, (P(DATA),), P(DATA), manual_axes={DATA}))(
+                jax.ShapeDtypeStruct((8, 4), jnp.float32))
+        fs = A.run_graph_passes(traced, A.PassContext(),
+                                passes=[A.get_pass("fused-wire-layout")])
+        assert len(fs) == 1
+        assert fs[0].severity == A.WARN and "duplicate" in fs[0].message
+
+
+class TestPragmas:
+    def test_pragma_parsing(self):
+        assert A.pragma_disables(
+            "x = f()  # dstpu-check: disable=masked-nan-propagation",
+            "masked-nan-propagation")
+        assert A.pragma_disables("y  # dstpu-check: disable=all", "anything")
+        assert not A.pragma_disables(
+            "x = f()  # dstpu-check: disable=other-pass", "masked-nan")
+        assert not A.pragma_disables("x = f()", "masked-nan")
+
+    def test_graph_finding_suppressed_by_source_pragma(self, tmp_path):
+        """A jaxpr finding resolves to its traced source line; a pragma on
+        that line suppresses it through filter_pragmas."""
+        f = tmp_path / "site.py"
+        f.write_text("v = mul()  # dstpu-check: disable=my-pass\n")
+        finding = A.Finding("my-pass", A.ERROR, "boom",
+                            file=str(f), line=1)
+        other = A.Finding("other-pass", A.ERROR, "stays",
+                          file=str(f), line=1)
+        kept = A.filter_pragmas([finding, other])
+        assert [k.pass_name for k in kept] == ["other-pass"]
+
+    def test_source_pragma_suppresses(self, tmp_path):
+        f = tmp_path / "m.py"
+        f.write_text("import jax.numpy as jnp\n"
+                     "X = jnp.zeros((4,))  "
+                     "# dstpu-check: disable=import-time-jnp\n")
+        assert run_source_passes(
+            [str(f)], passes=[A.get_pass("import-time-jnp")]) == []
+
+
+class TestSourcePasses:
+    def _run(self, tmp_path, code, pass_name):
+        f = tmp_path / "m.py"
+        f.write_text(code)
+        return run_source_passes([str(f)],
+                                 passes=[A.get_pass(pass_name)])
+
+    @pytest.mark.parametrize("pass_name", sorted(FX.SOURCE_FIXTURES))
+    def test_source_fixture_fires(self, pass_name, tmp_path):
+        assert FX.run_source_fixture(pass_name, str(tmp_path))
+
+    def test_import_time_jnp_class_body_and_defaults(self, tmp_path):
+        fs = self._run(tmp_path,
+                       "import jax.numpy as jnp\n"
+                       "class K:\n"
+                       "    PAD = jnp.zeros((2,))\n"
+                       "def f(x, d=jnp.ones(())):\n"
+                       "    return x\n",
+                       "import-time-jnp")
+        assert sorted(f.line for f in fs) == [3, 4]
+
+    def test_import_time_jnp_function_body_is_fine(self, tmp_path):
+        assert self._run(tmp_path,
+                         "import jax.numpy as jnp\n"
+                         "def f():\n"
+                         "    return jnp.zeros((4,))\n"
+                         "NAMES = ['a', 'b']\n",
+                         "import-time-jnp") == []
+
+    def test_import_time_jnp_sees_jax_numpy_spelling(self, tmp_path):
+        fs = self._run(tmp_path,
+                       "import jax\n"
+                       "X = jax.numpy.ones((2,))\n",
+                       "import-time-jnp")
+        assert len(fs) == 1 and fs[0].severity == A.ERROR
+
+    def test_retrace_hazard_static_args_exempt(self, tmp_path):
+        code = ("import jax\n"
+                "import jax.numpy as jnp\n"
+                "from functools import partial\n"
+                "@partial(jax.jit, static_argnames=('n',))\n"
+                "def ok(x, n):\n"
+                "    return x + jnp.zeros((n,))\n"
+                "@jax.jit\n"
+                "def bad(x, n):\n"
+                "    return x + jnp.zeros((n,))\n")
+        fs = self._run(tmp_path, code, "retrace-hazard")
+        assert len(fs) == 1 and fs[0].line == 9
+        assert fs[0].severity == A.WARN
+
+    def test_retrace_hazard_range_loop(self, tmp_path):
+        fs = self._run(tmp_path,
+                       "import jax\n"
+                       "@jax.jit\n"
+                       "def f(x, steps):\n"
+                       "    for _ in range(steps):\n"
+                       "        x = x * 2\n"
+                       "    return x\n",
+                       "retrace-hazard")
+        assert len(fs) == 1
+
+    def test_retrace_hazard_value_use_is_fine(self, tmp_path):
+        assert self._run(tmp_path,
+                         "import jax\n"
+                         "@jax.jit\n"
+                         "def f(x, y):\n"
+                         "    return x + y\n",
+                         "retrace-hazard") == []
+
+    def test_host_sync_only_in_hot_loops(self, tmp_path):
+        code = ("import jax\n"
+                "def decode_window(xs):\n"
+                "    out = []\n"
+                "    for x in xs:\n"
+                "        out.append(x.item())\n"
+                "        y = jax.device_get(x)\n"
+                "    total = xs[0].item()\n"          # outside the loop
+                "    return out, total\n"
+                "def summarize(xs):\n"                 # not a hot name
+                "    return [x.item() for x in xs]\n")
+        fs = self._run(tmp_path, code, "host-sync")
+        assert sorted(f.line for f in fs) == [5, 6]
+
+    def test_host_sync_float_on_jnp_value(self, tmp_path):
+        fs = self._run(tmp_path,
+                       "import jax.numpy as jnp\n"
+                       "def train_batch_loop(batches):\n"
+                       "    for b in batches:\n"
+                       "        v = float(jnp.mean(b))\n"
+                       "    return v\n",
+                       "host-sync")
+        assert len(fs) == 1 and "float()" in fs[0].message
+
+    def test_syntax_error_reported_as_finding(self, tmp_path):
+        f = tmp_path / "broken.py"
+        f.write_text("def f(:\n")
+        fs = run_source_passes([str(f)])
+        assert len(fs) == 1 and fs[0].pass_name == "syntax-error"
+        assert fs[0].severity == A.ERROR
+
+    def test_summarize_renders_prometheus_series(self):
+        txt = A.summarize([A.Finding("bare-print", A.ERROR, "x")],
+                          artifacts=["a", "b"])
+        assert 'dstpu_check_findings{pass="bare-print",severity="error"} 1' \
+            in txt
+        assert "dstpu_check_artifacts 2" in txt
+
+    def test_summarize_keeps_unregistered_pass_names(self):
+        """The runner emits findings outside the registry (syntax-error);
+        a failing run must never render as all-zero gauges."""
+        txt = A.summarize([A.Finding("syntax-error", A.ERROR, "boom")])
+        assert 'dstpu_check_findings{pass="syntax-error",' \
+            'severity="error"} 1' in txt
+
+    def test_legacy_wrappers_honor_the_framework_pragma(self, tmp_path):
+        """tools/check_no_bare_print|except and `dstpu-check --source` must
+        agree on a pragma'd line — one green and one red CI is the exact
+        confusion the consolidation satellite removes."""
+        import subprocess
+        import sys
+
+        repo = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        lib = tmp_path / "lib.py"
+        lib.write_text(
+            "def helper(x):\n"
+            "    print(x)  # dstpu-check: disable=bare-print\n"
+            "    try:\n"
+            "        return x\n"
+            "    except:  # dstpu-check: disable=bare-except\n"
+            "        pass\n")
+        for tool in ("check_no_bare_print.py", "check_no_bare_except.py"):
+            proc = subprocess.run(
+                [sys.executable, os.path.join(repo, "tools", tool),
+                 str(tmp_path)], capture_output=True, text=True)
+            assert proc.returncode == 0, f"{tool}: {proc.stdout}"
+
+
+class _AlwaysFirePass(A.GraphPass):
+    name = "test-always-fire"
+    severity = A.ERROR
+    bug_class = "test fixture"
+
+    def run(self, closed, ctx):
+        return [self.finding("synthetic error finding", ctx=ctx)]
+
+
+@pytest.fixture
+def always_fire_pass():
+    """Temporarily register an error-severity pass (engine-knob raise
+    path); unregistered afterwards so other tests stay unaffected."""
+    from deepspeed_tpu.analysis import core as C
+
+    A.register_pass(_AlwaysFirePass)
+    yield
+    C._REGISTRY.pop("test-always-fire", None)
+
+
+def _tiny_train_engine(graph_lint):
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+    from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
+
+    topo = initialize_mesh(TopologyConfig(), force=True)
+    model = CausalLM(TransformerConfig.tiny(use_flash=False))
+    params = model.init_params(jax.random.PRNGKey(0))
+    eng, _, _, _ = deepspeed_tpu.initialize(
+        model=model, model_parameters=params,
+        config={"train_micro_batch_size_per_gpu": 4,
+                "optimizer": {"type": "AdamW", "params": {"lr": 1e-3}},
+                "debug": {"graph_lint": graph_lint}},
+        topology=topo)
+    return eng
+
+
+def _batch():
+    rng = np.random.default_rng(0)
+    return {"input_ids": jnp.asarray(
+        rng.integers(0, 64, size=(32, 16)), jnp.int32)}
+
+
+class TestEngineKnob:
+    def test_clean_step_trains_under_error_mode(self):
+        """HEAD's train step is lint-clean, so even "error" mode trains."""
+        eng = _tiny_train_engine("error")
+        loss = eng.train_batch(_batch())
+        assert np.isfinite(float(loss))
+        assert eng._graph_lint_done
+
+    def test_error_mode_raises_before_dispatch(self, always_fire_pass):
+        eng = _tiny_train_engine("error")
+        with pytest.raises(A.GraphLintError, match="synthetic error"):
+            eng.train_batch(_batch())
+        # a caller that catches and RETRIES must hit the abort again —
+        # never dispatch the flagged program unlinted
+        with pytest.raises(A.GraphLintError, match="synthetic error"):
+            eng.train_batch(_batch())
+        # warn mode reports but trains through the same finding
+        eng2 = _tiny_train_engine("warn")
+        loss = eng2.train_batch(_batch())
+        assert np.isfinite(float(loss))
+
+    def test_config_rejects_unknown_mode(self):
+        from deepspeed_tpu.runtime.config import DeepSpeedConfig
+
+        with pytest.raises(ValueError, match="graph_lint"):
+            DeepSpeedConfig({"train_micro_batch_size_per_gpu": 1,
+                             "debug": {"graph_lint": "loud"}})
+
+
+class TestServingKnob:
+    def test_lint_runs_clean_and_probes_unperturbed(self):
+        from deepspeed_tpu.inference.v2.engine_v2 import (
+            InferenceEngineV2, RaggedInferenceEngineConfig)
+        from deepspeed_tpu.models.transformer import (CausalLM,
+                                                      TransformerConfig)
+
+        model = CausalLM(TransformerConfig.tiny(use_flash=False))
+        params = model.init_params(jax.random.PRNGKey(0))
+        eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            max_tokens=16, max_seqs=4, max_ctx=64, block_size=8,
+            dtype=jnp.float32, attn_impl="gather", block_q=16,
+            pages_per_chunk=2, graph_lint=True))
+        logits = eng.put([0], [[3, 5, 7, 11, 13]])
+        seed = int(jnp.argmax(logits[0]))
+        eng.decode_batch([0], [seed], steps=2)
+        assert eng.graph_lint_findings == []
+        # the lint traces the RAW fn — the retrace probes must still show
+        # exactly one trace per bucket (the contract the serving tests pin)
+        assert all(v == 1 for v in eng.trace_counts.values()), \
+            eng.trace_counts

@@ -1,0 +1,89 @@
+"""CI gate for the static-analysis framework (tools/check_graph_lint.py):
+``bin/dstpu-check`` sweeps the REAL built artifacts (train step,
+prefetched micro program, serving prefill/decode/verify buckets, fused
+quantized wire) clean at HEAD within the 120 s budget, and every detector
+still fires on its historical-bug fixture (unpinned sharded gather on a
+dp4×tp2 mesh, 0×NaN mask multiply, legacy strided int4 pack, per-micro
+all-gather leak, import-time jnp, ...) — same enforcement pattern as the
+serving/comm-sweep gates, so neither the tree nor the detectors can rot
+silently."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.analysis
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+CHECK = os.path.join(REPO_ROOT, "tools", "check_graph_lint.py")
+
+
+class TestGraphLintGate:
+    def test_gate_passes(self):
+        """This IS the CI gate: HEAD clean through the real CLI + every
+        fixture fires + pragma suppression + nonzero exit on injection."""
+        proc = subprocess.run([sys.executable, CHECK],
+                              capture_output=True, text=True, timeout=600)
+        assert proc.returncode == 0, \
+            f"graph-lint gate failed:\n{proc.stdout}\n{proc.stderr[-1500:]}"
+
+    def test_analysis_marker_registered(self):
+        """`-m analysis` selects the suite; strict-marker runs stay
+        green."""
+        ini = os.path.join(REPO_ROOT, "tests", "pytest.ini")
+        with open(ini, encoding="utf-8") as f:
+            assert "analysis:" in f.read()
+
+
+class TestMarkerCoverageLint:
+    """The generalized conftest marker lint (PR-8's chaos rule widened):
+    every tests/unit file must carry a registered marker on every test."""
+
+    def test_registered_names_parsed_from_ini(self, pytestconfig):
+        from tests.conftest import _registered_marker_names
+
+        names = _registered_marker_names(pytestconfig)
+        assert {"analysis", "core", "kernels", "inference", "serving",
+                "fault", "comm", "moe"} <= names
+        # capability + builtin markers must not satisfy the routing lint
+        assert "world_size" not in names
+        assert "parametrize" not in names and "xfail" not in names
+
+    def test_unmarked_file_fails_collection(self, pytestconfig):
+        from tests import conftest as C
+
+        class _Parametrize:
+            name = "parametrize"              # builtin ≠ registered
+
+        class _Item:
+            fspath = os.path.join("x", "tests", "unit", "test_fake.py")
+            nodeid = "tests/unit/test_fake.py::test_x"
+
+            def iter_markers(self):
+                return [_Parametrize()]
+
+            def get_closest_marker(self, name):
+                return None
+
+        with pytest.raises(pytest.UsageError, match="test_fake.py"):
+            C.pytest_collection_modifyitems(pytestconfig, [_Item()])
+
+    def test_registered_marker_passes_lint(self, pytestconfig):
+        from tests import conftest as C
+
+        class _Core:
+            name = "core"
+
+        class _Item:
+            fspath = os.path.join("x", "tests", "unit", "test_fake.py")
+            nodeid = "tests/unit/test_fake.py::test_x"
+
+            def iter_markers(self):
+                return [_Core()]
+
+            def get_closest_marker(self, name):
+                return None
+
+        C.pytest_collection_modifyitems(pytestconfig, [_Item()])

@@ -22,6 +22,8 @@ from deepspeed_tpu.inference.v2.ragged import (
 from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.inference
+
 
 class TestBlockedAllocator:
     def test_allocate_free_cycle(self):

@@ -8,6 +8,8 @@ import pytest
 from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.inference
+
 
 class TestWeightOnlyQuant:
     def test_int4_halves_int8_weight_bytes(self):

@@ -9,6 +9,8 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.models.transformer import _xla_attention
 from deepspeed_tpu.runtime.topology import TENSOR, TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.core
+
 
 class TestChunkedAttention:
     @pytest.mark.parametrize("causal", [True, False])

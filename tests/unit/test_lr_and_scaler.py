@@ -11,6 +11,8 @@ from deepspeed_tpu.runtime.lr_schedules import (
     get_schedule_fn,
 )
 
+pytestmark = pytest.mark.core
+
 
 class TestSchedules:
     def test_warmup_lr_endpoints(self):

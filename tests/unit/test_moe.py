@@ -16,6 +16,8 @@ from deepspeed_tpu.moe import (
 )
 from deepspeed_tpu.runtime.topology import EXPERT, TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.moe
+
 
 class TestGating:
     def test_top1_shapes_and_capacity(self):

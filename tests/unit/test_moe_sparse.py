@@ -17,6 +17,8 @@ from deepspeed_tpu.moe.sharded_moe import (
     topkgating_sparse,
 )
 
+pytestmark = pytest.mark.moe
+
 
 class TestSparseGatingParity:
     @pytest.mark.parametrize("k", [1, 2, 4])

@@ -10,6 +10,8 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.runtime.topology import DATA, TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.comm
+
 
 def _converge(tx, steps=150, lr_note=""):
     """Optimize a quadratic on an 8-rank mesh with per-rank grad noise;

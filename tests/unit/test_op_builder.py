@@ -7,6 +7,8 @@ import pytest
 
 from deepspeed_tpu.ops.op_builder import ALL_OPS, AsyncIOBuilder, get_builder
 
+pytestmark = pytest.mark.core
+
 
 class TestOpBuilder:
     def test_registry(self):

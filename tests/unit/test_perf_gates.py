@@ -17,6 +17,8 @@ import deepspeed_tpu
 from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.profiling
+
 
 def _engine(remat=True, stage=2):
     topo = initialize_mesh(TopologyConfig(), force=True)

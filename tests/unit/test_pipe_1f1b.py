@@ -18,6 +18,8 @@ from deepspeed_tpu.runtime.pipe.engine import (
 )
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.core
+
 
 def _setup(pp, tp=1, seq=16, num_layers=4, remat=False):
     topo = initialize_mesh(TopologyConfig(pipe=pp, tensor=tp), force=True)

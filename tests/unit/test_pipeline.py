@@ -21,6 +21,8 @@ from deepspeed_tpu.runtime.pipe.schedule import (
 )
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.core
+
 
 class TestSchedules:
     def test_inference_schedule_covers_all(self):

@@ -13,6 +13,8 @@ import subprocess
 import sys
 import textwrap
 
+pytestmark = pytest.mark.core
+
 REPO = os.path.dirname(os.path.dirname(os.path.dirname(
     os.path.abspath(__file__))))
 

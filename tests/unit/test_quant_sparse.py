@@ -9,6 +9,8 @@ from jax.sharding import PartitionSpec as P
 
 from deepspeed_tpu.runtime.topology import DATA, TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.kernels
+
 
 class TestQuantizerKernels:
     @pytest.mark.parametrize("bits", [4, 8])

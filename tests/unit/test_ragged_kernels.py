@@ -18,6 +18,8 @@ from deepspeed_tpu.inference.v2.kernels.ragged_ops import (
 )
 from deepspeed_tpu.inference.v2.model_runner import _attend_gather
 
+pytestmark = pytest.mark.kernels
+
 
 def _case(rng, q_lens, ctx_lens, KV, G, hd, ps, NB):
     """Random flat-token batch in the page-pool layout."""

@@ -14,6 +14,8 @@ from deepspeed_tpu.sequence import (
     vocab_sequence_parallel_cross_entropy,
 )
 
+pytestmark = pytest.mark.core
+
 
 def qkv(B=2, S=64, H=4, hd=16, kv=None, seed=0):
     ks = jax.random.split(jax.random.PRNGKey(seed), 3)

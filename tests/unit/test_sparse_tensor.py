@@ -8,6 +8,8 @@ from jax.sharding import PartitionSpec as P
 from deepspeed_tpu.runtime.sparse_tensor import SparseTensor, sparse_allreduce
 from deepspeed_tpu.runtime.topology import DATA, TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.core
+
 
 class TestSparseTensor:
     def test_roundtrip(self):

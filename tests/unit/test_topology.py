@@ -15,6 +15,8 @@ from deepspeed_tpu.runtime.topology import (
     initialize_mesh,
 )
 
+pytestmark = pytest.mark.core
+
 
 class TestProcessTopology:
     def test_world_size(self):

@@ -8,6 +8,8 @@ import deepspeed_tpu
 from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 
+pytestmark = pytest.mark.core
+
 
 def tiny_batch(batch=8, seq=32, vocab=256, seed=0):
     rng = np.random.default_rng(seed)

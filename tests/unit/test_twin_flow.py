@@ -14,6 +14,8 @@ from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
 from deepspeed_tpu.runtime.topology import TopologyConfig, initialize_mesh
 from deepspeed_tpu.runtime.zero.twin_flow import TwinFlowState
 
+pytestmark = pytest.mark.core
+
 
 def _engine(offload=None, stage=2):
     topo = initialize_mesh(TopologyConfig(), force=True)

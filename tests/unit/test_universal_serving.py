@@ -19,6 +19,8 @@ from deepspeed_tpu.inference.v2.engine_v2 import (
 )
 from deepspeed_tpu.models.families import ArchConfig, UniversalCausalLM
 
+pytestmark = pytest.mark.inference
+
 BASE = dict(vocab_size=97, hidden_size=32, intermediate_size=64,
             num_layers=2, num_heads=4, num_kv_heads=4, max_seq_len=128)
 

@@ -6,6 +6,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.inference
+
 
 class TestModuleRegistry:
     def test_builtin_modules_registered(self):

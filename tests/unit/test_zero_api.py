@@ -16,6 +16,8 @@ from deepspeed_tpu.runtime.topology import (
 
 from .simple_model import init_mlp_params, mlp_loss_fn, random_batch
 
+pytestmark = pytest.mark.core
+
 
 class TestZeroInit:
     def test_materialize_sharded(self):

@@ -1,0 +1,40 @@
+"""Load ``deepspeed_tpu/analysis`` source passes WITHOUT the package
+import chain.
+
+``import deepspeed_tpu.analysis.source_passes`` executes
+``deepspeed_tpu/__init__.py`` (comm, runtime, jax — seconds of import and
+a hard jax dependency), but the AST detectors themselves are pure stdlib.
+The standalone lint wrappers (``check_no_bare_print.py``,
+``check_no_bare_except.py``) must keep running on a bare-stdlib
+bootstrap/pre-commit environment as they always have, so this loader
+builds a synthetic package from ``core.py`` + ``source_passes.py`` file
+paths only — no parent packages executed, no jax imported.
+"""
+from __future__ import annotations
+
+import importlib.util
+import os
+import sys
+import types
+
+_PKG_NAME = "_dstpu_analysis_standalone"
+
+
+def load_source_passes():
+    """The ``analysis.source_passes`` module, loaded standalone (cached)."""
+    mod = sys.modules.get(f"{_PKG_NAME}.source_passes")
+    if mod is not None:
+        return mod
+    pkg_dir = os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        "deepspeed_tpu", "analysis")
+    pkg = types.ModuleType(_PKG_NAME)
+    pkg.__path__ = [pkg_dir]
+    sys.modules[_PKG_NAME] = pkg
+    for stem in ("core", "source_passes"):
+        spec = importlib.util.spec_from_file_location(
+            f"{_PKG_NAME}.{stem}", os.path.join(pkg_dir, f"{stem}.py"))
+        mod = importlib.util.module_from_spec(spec)
+        sys.modules[spec.name] = mod
+        spec.loader.exec_module(mod)
+    return sys.modules[f"{_PKG_NAME}.source_passes"]

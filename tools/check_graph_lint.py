@@ -1,0 +1,162 @@
+#!/usr/bin/env python
+"""CI gate for the dstpu-check static-analysis framework.
+
+Two properties, both enforced from ``tests/unit/test_graph_lint_smoke.py``
+the same way the serving/comm-sweep gates are:
+
+  * ``head_clean`` — ``bin/dstpu-check`` (the REAL CLI, as a subprocess)
+    builds every artifact on the CPU sim — train step, prefetched micro
+    program, serving prefill/decode/verify buckets under both attention
+    impls, fused quantized wire — runs every jaxpr pass plus the source
+    sweep, and must exit 0 within the 120 s budget: HEAD is clean.
+  * ``fixtures`` — every detector still FIRES on its historical bug
+    pattern (``analysis/fixtures.py``: the PR-8/9 unpinned sharded
+    gather on a dp4×tp2 mesh, the thrice-fixed 0×NaN mask multiply, the
+    PR-9 legacy strided int4 pack, a PR-4 per-micro all-gather leak, and
+    the five source classes), each with its severity intact, and the
+    paired fixed-idiom fixtures stay clean; injecting an error-severity
+    source fixture into a tree makes the CLI exit nonzero.
+
+A linter is only worth shipping while both hold: clean-at-HEAD without
+firing fixtures means the detectors rotted; firing fixtures without
+clean-at-HEAD means the tree regressed.
+
+Usage: ``python tools/check_graph_lint.py [--scenario all|head_clean|fixtures]``
+Exit status 1 lists what broke.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("DS_ACCELERATOR", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+CLI = os.path.join(REPO_ROOT, "bin", "dstpu-check")
+SWEEP_BUDGET_S = 120.0
+
+
+def scenario_head_clean(check):
+    t0 = time.time()
+    proc = subprocess.run([sys.executable, CLI], capture_output=True,
+                          text=True, timeout=600)
+    wall = time.time() - t0
+    check("dstpu-check exits 0 at HEAD",
+          proc.returncode == 0,
+          f"rc={proc.returncode}\n{proc.stdout}\n{proc.stderr[-2000:]}")
+    check(f"full sweep within {SWEEP_BUDGET_S:.0f}s budget",
+          wall < SWEEP_BUDGET_S, f"took {wall:.1f}s")
+    check("verdict line reports CLEAN", "CLEAN" in proc.stdout,
+          proc.stdout[-500:])
+    m = re.search(r"^dstpu_check_artifacts (\d+)$", proc.stdout, re.M)
+    count = int(m.group(1)) if m else 0
+    check("all artifact groups swept (>= 10 artifacts)",
+          count >= 10, f"artifact gauge: {m.group(0) if m else 'missing'}")
+
+
+def scenario_fixtures(check):
+    from deepspeed_tpu.analysis import (ERROR, PassContext, get_pass,
+                                        run_graph_passes)
+    from deepspeed_tpu.analysis.fixtures import (GRAPH_FIXTURES,
+                                                 SOURCE_FIXTURES,
+                                                 run_source_fixture)
+
+    for name, (fire, clean) in GRAPH_FIXTURES.items():
+        traced, ctx = fire()
+        findings = run_graph_passes(traced, ctx, passes=[get_pass(name)])
+        check(f"{name}: historical bug fixture fires",
+              len(findings) >= 1, f"no findings on {ctx.artifact}")
+        check(f"{name}: fires at error severity",
+              any(f.severity == ERROR for f in findings),
+              f"severities: {[f.severity for f in findings]}")
+        if clean is not None:
+            traced, ctx = clean()
+            stayed = run_graph_passes(traced, ctx,
+                                      passes=[get_pass(name)])
+            check(f"{name}: fixed idiom stays clean", not stayed,
+                  "; ".join(f.render() for f in stayed))
+
+    with tempfile.TemporaryDirectory() as tmp:
+        for name in SOURCE_FIXTURES:
+            findings = run_source_fixture(name, tmp)
+            check(f"{name}: source fixture fires", len(findings) >= 1,
+                  f"no findings for {name}")
+        # pragma allowlist: the same pattern + disable pragma is silent
+        pragma = os.path.join(tmp, "pragma_fixture.py")
+        with open(pragma, "w", encoding="utf-8") as f:
+            f.write("import jax.numpy as jnp\n"
+                    "X = jnp.zeros((4,))  # dstpu-check: "
+                    "disable=import-time-jnp\n")
+        from deepspeed_tpu.analysis.source_passes import run_source_passes
+        sup = run_source_passes([pragma],
+                                passes=[get_pass("import-time-jnp")])
+        check("pragma suppresses the finding", not sup,
+              "; ".join(f.render() for f in sup))
+
+        # the CLI exits nonzero when an error-severity pattern is injected
+        inj = os.path.join(tmp, "injected")
+        os.makedirs(inj, exist_ok=True)
+        with open(os.path.join(inj, "offender.py"), "w",
+                  encoding="utf-8") as f:
+            f.write(SOURCE_FIXTURES["import-time-jnp"])
+        proc = subprocess.run([sys.executable, CLI, "--source", inj],
+                              capture_output=True, text=True, timeout=120)
+        check("dstpu-check exits nonzero on injected error fixture",
+              proc.returncode == 1,
+              f"rc={proc.returncode}\n{proc.stdout}")
+
+
+SCENARIOS = {
+    "head_clean": scenario_head_clean,
+    "fixtures": scenario_fixtures,
+}
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--scenario", default="all",
+                   choices=["all"] + sorted(SCENARIOS))
+    args = p.parse_args(argv)
+
+    failures = []
+
+    def check(name, ok, detail=""):
+        status = "ok" if ok else "FAIL"
+        print(f"[{status}] {name}")
+        if not ok:
+            failures.append(f"{name}: {detail}")
+
+    names = sorted(SCENARIOS) if args.scenario == "all" \
+        else [args.scenario]
+    for name in names:
+        print(f"--- scenario: {name}")
+        try:
+            SCENARIOS[name](check)
+        except Exception as e:  # noqa: BLE001 — gate must report, not die
+            import traceback
+            failures.append(f"{name}: crashed: {e}")
+            traceback.print_exc()
+    if failures:
+        print(f"\n{len(failures)} graph-lint gate failure(s):")
+        for f in failures:
+            print(f"  - {f}")
+        return 1
+    print("\ngraph-lint gate: all checks passed")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,29 +6,41 @@ fault subsystem — hides the storage/transport errors the retry and
 verification machinery exists to surface.  ``except Exception:`` (or
 narrower) is always available and is what reviewers should see.
 
+This entry point is a thin wrapper: the detector itself lives in the
+``dstpu-check`` pass registry (``deepspeed_tpu/analysis/source_passes.py``,
+pass ``bare-except``) alongside the other source passes, and also runs via
+``bin/dstpu-check --source``.  The pass modules are loaded standalone
+(``_analysis_loader``) so this tool stays runnable on bare stdlib —
+no jax, no package import.
+
 Usage: ``python tools/check_no_bare_except.py [root ...]``
 Exit status 1 lists every offender as ``path:line``.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-DEFAULT_ROOT = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "deepspeed_tpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _analysis_loader import load_source_passes  # noqa: E402
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_ROOT = os.path.join(REPO_ROOT, "deepspeed_tpu")
+
+_sp = load_source_passes()
 
 
 def bare_excepts(path: str):
-    with open(path, "rb") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"syntax error: {e.msg}")]
-    return [(node.lineno, "bare except")
-            for node in ast.walk(tree)
-            if isinstance(node, ast.ExceptHandler) and node.type is None]
+    sf = _sp.SourceFile.parse(path)
+    if sf.syntax_error is not None:
+        lineno, msg = sf.syntax_error
+        return [(lineno, f"syntax error: {msg}")]
+    # honor the framework pragma too, so this wrapper and
+    # `bin/dstpu-check --source` can never disagree on the same line
+    return [(line, why) for line, why in _sp.bare_except_offenders(sf)
+            if not (0 < line <= len(sf.lines)
+                    and _sp.pragma_disables(sf.lines[line - 1],
+                                            "bare-except"))]
 
 
 def main(argv=None) -> int:

@@ -15,71 +15,44 @@ per-line exemptions scattered through the report builder.  A deliberate
 exception elsewhere takes a ``# lint: allow-print`` comment on the
 offending line.
 
+This entry point is a thin wrapper: the detector itself lives in the
+``dstpu-check`` pass registry (``deepspeed_tpu/analysis/source_passes.py``,
+pass ``bare-print``) alongside the other source passes, and also runs via
+``bin/dstpu-check --source``.  The pass modules are loaded standalone
+(``_analysis_loader``) so this tool stays runnable on bare stdlib —
+no jax, no package import.
+
 Usage: ``python tools/check_no_bare_print.py [root ...]``
 Exit status 1 lists every offender as ``path:line``.
 """
 from __future__ import annotations
 
-import ast
 import os
 import sys
 
-DEFAULT_ROOT = os.path.join(os.path.dirname(os.path.dirname(
-    os.path.abspath(__file__))), "deepspeed_tpu")
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+from _analysis_loader import load_source_passes  # noqa: E402
 
-ALLOW_MARKER = "lint: allow-print"
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_ROOT = os.path.join(REPO_ROOT, "deepspeed_tpu")
 
-#: functions whose body (incl. nested defs) may print: CLI entry points and
-#: the profiler's single audited report-output seam
-PRINTING_FUNC_NAMES = frozenset({"main", "emit_report"})
-
-
-def _main_guard_lines(tree: ast.Module) -> set:
-    """Line ranges of top-level ``if __name__ == "__main__":`` blocks."""
-    lines = set()
-    for node in tree.body:
-        if not isinstance(node, ast.If):
-            continue
-        test = node.test
-        is_guard = (isinstance(test, ast.Compare)
-                    and isinstance(test.left, ast.Name)
-                    and test.left.id == "__name__")
-        if is_guard:
-            end = getattr(node, "end_lineno", node.lineno)
-            lines.update(range(node.lineno, end + 1))
-    return lines
+_sp = load_source_passes()
+#: legacy re-exports (the contract this tool has carried since PR 2)
+ALLOW_MARKER = _sp.ALLOW_PRINT_MARKER
+PRINTING_FUNC_NAMES = _sp.PRINTING_FUNC_NAMES
 
 
 def bare_prints(path: str):
-    with open(path, "rb") as f:
-        source = f.read()
-    try:
-        tree = ast.parse(source, filename=path)
-    except SyntaxError as e:
-        return [(e.lineno or 0, f"syntax error: {e.msg}")]
-
-    allowed_lines = {i + 1 for i, line in
-                     enumerate(source.decode("utf-8", "replace").splitlines())
-                     if ALLOW_MARKER in line}
-    allowed_lines |= _main_guard_lines(tree)
-
-    offenders = []
-
-    def walk(node, in_main: bool):
-        for child in ast.iter_child_nodes(node):
-            child_in_main = in_main
-            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                child_in_main = in_main or child.name in PRINTING_FUNC_NAMES
-            if (isinstance(child, ast.Call)
-                    and isinstance(child.func, ast.Name)
-                    and child.func.id == "print"
-                    and not in_main
-                    and child.lineno not in allowed_lines):
-                offenders.append((child.lineno, "bare print"))
-            walk(child, child_in_main)
-
-    walk(tree, in_main=False)
-    return offenders
+    sf = _sp.SourceFile.parse(path)
+    if sf.syntax_error is not None:
+        lineno, msg = sf.syntax_error
+        return [(lineno, f"syntax error: {msg}")]
+    # honor the framework pragma too, so this wrapper and
+    # `bin/dstpu-check --source` can never disagree on the same line
+    return [(line, why) for line, why in _sp.bare_print_offenders(sf)
+            if not (0 < line <= len(sf.lines)
+                    and _sp.pragma_disables(sf.lines[line - 1],
+                                            "bare-print"))]
 
 
 def main(argv=None) -> int:
