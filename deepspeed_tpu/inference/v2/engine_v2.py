@@ -75,6 +75,13 @@ class RaggedInferenceEngineConfig:
     #: telemetry events.  Advisory (never raises): serving keeps serving;
     #: the CI gate (tools/check_graph_lint.py) is where errors block.
     graph_lint: bool = False
+    #: radix prefix KV reuse: committed prompt pages become a token trie
+    #: (ragged/prefix_cache.py) that admission grafts from instead of
+    #: recomputing shared prefixes — multi-tenant traffic with a common
+    #: system prompt skips its prefill entirely.  Pages are refcounted;
+    #: a grafted partial page is copied before the sequence's first append
+    #: (copy-on-write), and cold cache pages evict on allocation pressure.
+    prefix_cache: bool = False
 
 
 class InferenceEngineV2:
@@ -94,6 +101,11 @@ class InferenceEngineV2:
         num_blocks = c.num_blocks or (c.max_seqs * -(-c.max_ctx // c.block_size))
         self.state_manager = DSStateManager(num_blocks=num_blocks,
                                             block_size=c.block_size)
+        if c.prefix_cache:
+            from .ragged.prefix_cache import RadixPrefixCache
+
+            self.state_manager.prefix_cache = RadixPrefixCache(
+                self.state_manager.allocator, c.block_size)
         self.kv = BlockedKVCache(KVCacheConfig(
             num_layers=self.cfg.num_layers, num_blocks=num_blocks,
             block_size=c.block_size, num_kv_heads=self.cfg.num_kv_heads,
@@ -373,6 +385,82 @@ class InferenceEngineV2:
         so their admission behavior cannot desynchronize."""
         need = min(prompt_len + max_new, self.config.max_ctx)
         return need, -(-need // self.config.block_size)
+
+    # ------------------------------------------------------------------ #
+    # Radix prefix KV reuse (config.prefix_cache)
+    # ------------------------------------------------------------------ #
+    @property
+    def prefix_cache(self):
+        return self.state_manager.prefix_cache
+
+    def _copy_pages(self, src_block: int, dst_block: int) -> None:
+        """Copy one logical page across every layer's physical slot — the
+        copy-on-write materialization for a shared partial page."""
+        src = jnp.asarray([src_block + layer * self._num_blocks
+                           for layer in range(self.cfg.num_layers)])
+        dst = src + (dst_block - src_block)
+        self.kv.update(self.kv.pages.at[dst].set(self.kv.pages[src]))
+
+    def graft_prefix(self, uid: int, tokens: Sequence[int]) -> int:
+        """Admission-side prefix reuse: graft the longest cached prefix of
+        ``tokens`` into a fresh sequence and return how many tokens it
+        covers (0 = miss / cache disabled); the caller prefills only the
+        remainder.  Full matched pages are SHARED (one extra allocator ref
+        each); a trailing partial page is copied into a private block
+        before the graft returns — the sequence's very next forward
+        appends into that page mid-row, and writing a shared page would
+        corrupt every other holder (the copy-on-write invariant
+        test_prefix_cache.py pins by checksumming the original page).
+        When no block is free for the copy the partial page is simply
+        dropped from the match — correctness never depends on the copy."""
+        cache = self.prefix_cache
+        if cache is None or len(tokens) < 2:
+            return 0
+        seq = self.state_manager.get_sequence(uid)
+        assert seq is None or (not seq.blocks and seq.seen_tokens == 0), \
+            f"prefix graft into a non-fresh sequence uid={uid}"
+        matched, blocks, partial = cache.match(list(tokens))
+        if not matched:
+            return 0
+        # create the descriptor FIRST: get_or_create can raise on the
+        # tracked-sequence cap, and nothing may be allocated before it
+        seq = self.state_manager.get_or_create_sequence(uid)
+        if partial:
+            # CoW the tail page: private copy, or shrink the match
+            alloc = self.state_manager.allocator
+            if alloc.free_blocks < 1:
+                cache.evict(1)
+            if alloc.free_blocks < 1:
+                matched -= partial
+                blocks = blocks[:-1]
+                if not matched:
+                    return 0
+            else:
+                private = int(alloc.allocate(1)[0])
+                self._copy_pages(blocks[-1], private)
+                # the sequence owns `private`; share only the full pages
+                self.state_manager.share_blocks(seq, blocks[:-1],
+                                                matched - partial)
+                seq.blocks.append(private)
+                seq.seen_tokens = matched
+                return matched
+        self.state_manager.share_blocks(seq, blocks, matched)
+        return matched
+
+    def commit_prefix(self, uid: int, tokens: Sequence[int],
+                      allow_partial: bool = False) -> int:
+        """Commit ``uid``'s prompt pages to the radix cache (no-op when
+        disabled).  Called at prefill completion (full pages only — the
+        sequence keeps appending into its partial tail) and again at
+        retirement with ``allow_partial=True``, when the tail page goes
+        quiet forever."""
+        cache = self.prefix_cache
+        seq = self.state_manager.get_sequence(uid)
+        if cache is None or seq is None:
+            return 0
+        upto = min(len(tokens), seq.seen_tokens)
+        return cache.commit(list(tokens), seq.blocks, upto=upto,
+                            allow_partial=allow_partial)
 
     # ------------------------------------------------------------------ #
     # Speculative decoding: verify-window mode over the paged decode path
@@ -720,15 +808,28 @@ class InferenceEngineV2:
     def _poison_kv(self, uid: int) -> None:
         """Write NaN over every cached page of ``uid`` across all layers
         (the ``decode_window``/``nan`` injection payload).  Rows past the
-        sequence's context length are masked out by attention, and no other
-        sequence references these pages, so the poison is confined to
-        ``uid`` — the kernel-level NaN-isolation property the serving
-        watchdog's per-sequence flag builds on."""
+        sequence's context length are masked out by attention, and — with
+        prefix reuse — pages holding more than one reference (shared via
+        the radix cache) are SKIPPED: poisoning a shared system-prompt
+        page would leak NaN into every co-tenant, breaking exactly the
+        isolation property this injection exists to exercise.  The
+        sequence's privately-owned decode pages (there is always at least
+        one: decode windows allocate before the injection site fires) are
+        enough to drive its logits non-finite."""
         seq = self.state_manager.get_sequence(uid)
         if seq is None or not seq.blocks:
             return
+        alloc = self.state_manager.allocator
+        own = [b for b in seq.blocks if alloc.refcount(b) == 1]
+        if not own:
+            # cannot happen for a decoding sequence (its tail page is
+            # always private: fresh alloc or CoW copy) — but never poison
+            # a shared page, whatever state got us here
+            logger.warning(f"nan injection skipped: uid {uid} owns no "
+                           f"private page")
+            return
         phys = [b + layer * self._num_blocks
-                for layer in range(self.cfg.num_layers) for b in seq.blocks]
+                for layer in range(self.cfg.num_layers) for b in own]
         self.kv.update(self.kv.pages.at[jnp.asarray(phys)].set(jnp.nan))
 
     def _record_decode_roofline(self, window: "DecodeWindow") -> None:

@@ -103,9 +103,19 @@ class ServeRequest:
     #: length for this request only.
     spec_mode: Optional[str] = None
     spec_k: Optional[int] = None
+    #: disaggregated prefill (serving/fleet): ``prefill_only`` requests
+    #: stop at prefill completion and export their KV rows into
+    #: ``kv_shipment`` (a kv_ship.KVShipment) instead of decoding;
+    #: ``kv_import`` carries a shipment produced elsewhere — its rows are
+    #: grafted at admission so only the unshipped prompt tail (>= 1 token)
+    #: prefills locally and the stream continues bit-exactly.
+    prefill_only: bool = False
+    kv_import: Optional[object] = None
 
     # -- runtime state (scheduler-owned) --
     state: RequestState = RequestState.QUEUED
+    kv_shipment: Optional[object] = None     # prefill_only export result
+    prefix_hit_tokens: int = 0               # prompt tokens grafted, not run
     produced: List[int] = dataclasses.field(default_factory=list)
     finish_reason: Optional[str] = None
     arrival_t: float = 0.0
@@ -117,6 +127,7 @@ class ServeRequest:
     _admit_order: int = 0
     _prefill_pos: int = 0
     _resume_seed: Optional[int] = None       # set while resuming a preempt
+    _prefix_counted: bool = False            # hit/miss recorded once
 
     @property
     def remaining(self) -> int:
@@ -423,7 +434,22 @@ class LifecycleScheduler:
     def _reserve_for(self, req: ServeRequest) -> Optional[bool]:
         """Whole-lifetime KV reservation for admission.  Returns True on
         success, False on transient exhaustion (backpressure), None when
-        the request can never fit (rejected)."""
+        the request can never fit (rejected).
+
+        Before reserving, two graft paths may pre-seed the sequence's KV:
+
+          * a ``kv_import`` shipment (disaggregated prefill handoff) is
+            validated against the request's own prompt and scattered into
+            freshly-allocated pages — only the unshipped tail prefills;
+          * otherwise the radix prefix cache is consulted and the longest
+            committed prefix is grafted (shared full pages + a CoW'd
+            partial tail).
+
+        Either way ``_prefill_pos`` advances past the grafted rows and the
+        reservation covers only the remainder.  On a FAILED reservation
+        the graft is fully released (flush) so a waiting queue head holds
+        zero blocks — grafted pages stay evictable in the trie, and the
+        retry re-grafts for a few microseconds of host work."""
         c = self.eng.config
         need, need_blocks = self.eng.lifetime_reservation(
             len(req.resume_prompt), req.remaining)
@@ -435,14 +461,66 @@ class LifecycleScheduler:
             # short, so only the eos-less overrun is deterministic): reject
             # now instead of wedging the queue head
             return None
-        seq = self.eng.state_manager.get_or_create_sequence(req.uid)
-        if not self.eng.state_manager.maybe_allocate_kv(seq, need):
-            # roll back the empty descriptor so a shed/preempted retry
-            # starts clean (an allocated-blocks descriptor must NOT be
-            # flushed here — there are none)
-            if not seq.blocks and seq.seen_tokens == 0:
-                self.eng.state_manager._seqs.pop(req.uid, None)
+        sm = self.eng.state_manager
+        if sm.get_sequence(req.uid) is None:
+            req._prefill_pos = 0
+            if req.kv_import is not None:
+                ship = req.kv_import
+                attested = [int(t) for t in
+                            req.resume_prompt[:ship.n_tokens]]
+                if (ship.n_tokens > len(req.resume_prompt) - 1
+                        or list(ship.tokens) != attested):
+                    # wrong conversation's KV: no retry can fix this
+                    return None
+                # feasibility gate BEFORE the device write: a blocked
+                # queue head retries every pass, and importing (pages
+                # scatter + decode-state invalidation) only to flush on a
+                # failed reservation would repeat that work per window.
+                # Evict cache slack first, then bail cheaply.
+                if need_blocks > sm.allocator.free_blocks and \
+                        sm.prefix_cache is not None:
+                    sm.prefix_cache.evict(
+                        need_blocks - sm.allocator.free_blocks)
+                if need_blocks > sm.allocator.free_blocks:
+                    return False
+                from .kv_ship import import_kv
+
+                if not import_kv(self.eng, ship, req.uid):
+                    return False           # transient exhaustion
+                req._prefill_pos = ship.n_tokens
+            elif self.eng.prefix_cache is not None:
+                matched = self.eng.graft_prefix(req.uid, req.resume_prompt)
+                if matched:
+                    req._prefill_pos = matched
+        seq = sm.get_or_create_sequence(req.uid)
+        if not sm.maybe_allocate_kv(seq, need - seq.seen_tokens):
+            # roll back so a shed/preempted retry starts clean: grafted /
+            # imported blocks are released (shared pages survive in the
+            # trie), an empty descriptor is popped
+            if seq.blocks or seq.seen_tokens:
+                sm.flush_sequence(req.uid)
+            else:
+                sm._seqs.pop(req.uid, None)
+            req._prefill_pos = 0
             return False
+        # count the graft ONLY on a successful reservation: a blocked
+        # head releases and re-grafts every pass, and counting those
+        # retries would inflate the hit stats (cache.note_hit/note_miss
+        # exist for the same reason — match() itself is a pure lookup)
+        cache = self.eng.prefix_cache
+        if req.kv_import is not None and req._prefill_pos:
+            self._count("serving/kv_import")
+            self._count("serving/kv_import_tokens", req._prefill_pos)
+        elif cache is not None and req.prefix_hit_tokens == 0 \
+                and not req._prefix_counted:
+            req._prefix_counted = True
+            if req._prefill_pos:
+                req.prefix_hit_tokens = req._prefill_pos
+                cache.note_hit(req._prefill_pos)
+                self._count("serving/prefix_hits")
+                self._count("serving/prefix_hit_tokens", req._prefill_pos)
+            else:
+                cache.note_miss()
         return True
 
     def _build_prefill_batch(self) -> List[Tuple[int, List[int]]]:
@@ -482,7 +560,10 @@ class LifecycleScheduler:
             self._prefilling[head.uid] = None
             self._admit_seq += 1
             head._admit_order = self._admit_seq
-            chunk = head.resume_prompt[:budget]
+            # _prefill_pos may start past 0: grafted prefix / imported KV
+            # rows are already cached, so only the remainder runs
+            chunk = head.resume_prompt[head._prefill_pos:
+                                       head._prefill_pos + budget]
             picked.append((head.uid, chunk))
             budget -= len(chunk)
         return picked
@@ -496,6 +577,24 @@ class LifecycleScheduler:
             req._prefill_pos += len(chunk)
             if req._prefill_pos < len(req.resume_prompt):
                 continue                       # mid-prompt; logits unused
+            # prefill complete: commit the full prompt pages to the radix
+            # cache NOW (not at retirement) so concurrent staggered
+            # requests sharing the prefix hit while this one still decodes
+            self.eng.commit_prefix(uid, req.resume_prompt)
+            if req.prefill_only:
+                # disaggregated-prefill producer: export the rows, finish
+                # without decoding a single token (_retire pops the
+                # prefilling entry and reclaims the blocks — the export
+                # above it is a pure read)
+                from .kv_ship import export_kv
+
+                req.kv_shipment = export_kv(self.eng, uid,
+                                            req.resume_prompt)
+                self._count("serving/completed")
+                self._retire(req, RequestState.FINISHED, "prefill_done",
+                             "serving_finished", "serving/prefill_exported")
+                finished.append(uid)
+                continue
             del self._prefilling[uid]
             req.state = RequestState.DECODE
             if req._resume_seed is not None:
@@ -524,6 +623,10 @@ class LifecycleScheduler:
 
     def _finish(self, req: ServeRequest) -> None:
         self._decodes.pop(req.uid, None)
+        # the tail prompt page goes quiet forever now — commit it too
+        # (allow_partial), so sub-page prefixes become reusable; full pages
+        # were committed at prefill completion
+        self.eng.commit_prefix(req.uid, req.prompt, allow_partial=True)
         self.eng.flush([req.uid])
         if self.drafter is not None:
             self.drafter.flush(req.uid)
@@ -839,3 +942,9 @@ class LifecycleScheduler:
             len(self._prefilling) + len(self._decodes))
         m.gauge("serving/kv_pressure").set(
             round(self.eng.kv_used_fraction(), 4))
+        cache = self.eng.prefix_cache
+        if cache is not None:
+            total = cache.hits + cache.misses
+            m.gauge("serving/prefix_hit_rate").set(
+                round(cache.hits / total, 4) if total else 0.0)
+            m.gauge("serving/prefix_cached_pages").set(cache.nodes)

@@ -1,8 +1,9 @@
 from .blocked_allocator import BlockedAllocator
 from .kv_cache import BlockedKVCache, KVCacheConfig
+from .prefix_cache import RadixPrefixCache
 from .ragged_wrapper import RaggedBatch, RaggedBatchWrapper
 from .sequence_descriptor import DSSequenceDescriptor, DSStateManager
 
 __all__ = ["BlockedAllocator", "BlockedKVCache", "KVCacheConfig",
-           "RaggedBatch", "RaggedBatchWrapper", "DSSequenceDescriptor",
-           "DSStateManager"]
+           "RadixPrefixCache", "RaggedBatch", "RaggedBatchWrapper",
+           "DSSequenceDescriptor", "DSStateManager"]

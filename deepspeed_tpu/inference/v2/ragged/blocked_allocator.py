@@ -3,6 +3,14 @@
 Host-side free-list over a fixed pool of KV blocks.  The reference keeps the
 free list in a torch int32 tensor; plain numpy suffices on the host — the
 device only ever sees block *ids* inside block tables.
+
+Blocks are REFERENCE-COUNTED so the radix prefix cache can share committed
+KV pages across sequences (prefix_cache.py): ``allocate`` hands out blocks
+at refcount 1, ``ref`` adds a holder (a second sequence grafting the page,
+or the trie itself), and ``free`` drops one holder — the block only returns
+to the free list when the last holder lets go.  Callers that never share
+(training, plain continuous batching) see the original semantics unchanged:
+every allocate is refcount 1 and the matching free releases it.
 """
 from __future__ import annotations
 
@@ -20,6 +28,8 @@ class BlockedAllocator:
         self._next = np.arange(1, num_blocks + 1, dtype=np.int64)
         self._head = 0
         self._free = num_blocks
+        # holders per block: 0 = on the free list
+        self._refs = np.zeros(num_blocks, dtype=np.int64)
 
     @property
     def free_blocks(self) -> int:
@@ -28,6 +38,12 @@ class BlockedAllocator:
     @property
     def total_blocks(self) -> int:
         return self._num_blocks
+
+    def refcount(self, block: int) -> int:
+        """Current holder count of ``block`` (0 = free)."""
+        if not 0 <= block < self._num_blocks:
+            raise ValueError(f"block id {block} out of range")
+        return int(self._refs[block])
 
     def allocate(self, num_blocks: int) -> np.ndarray:
         if num_blocks > self._free:
@@ -38,11 +54,27 @@ class BlockedAllocator:
             out[i] = self._head
             self._head = self._next[self._head]
         self._free -= num_blocks
+        self._refs[out] = 1
         return out
 
+    def ref(self, blocks: Union[Iterable[int], np.ndarray]) -> None:
+        """Add one holder to each (already-allocated) block — the prefix
+        cache's share path.  Refusing free blocks catches the classic
+        use-after-free: sharing a page somebody already released."""
+        for b in np.atleast_1d(np.asarray(blocks, dtype=np.int64)):
+            b = int(b)
+            if not 0 <= b < self._num_blocks:
+                raise ValueError(f"block id {b} out of range")
+            if self._refs[b] <= 0:
+                raise ValueError(f"ref of free block {b}")
+            self._refs[b] += 1
+
     def free(self, blocks: Union[Iterable[int], np.ndarray]) -> None:
+        """Drop one holder per block; a block returns to the free list only
+        when its last holder releases it."""
         blocks = np.atleast_1d(np.asarray(blocks, dtype=np.int64))
         seen = set()
+        released = 0
         for b in blocks:
             b = int(b)
             if not 0 <= b < self._num_blocks:
@@ -50,6 +82,11 @@ class BlockedAllocator:
             if b in seen:
                 raise ValueError(f"double free of block {b} in one call")
             seen.add(b)
-            self._next[b] = self._head
-            self._head = b
-        self._free += len(seen)
+            if self._refs[b] <= 0:
+                raise ValueError(f"free of already-free block {b}")
+            self._refs[b] -= 1
+            if self._refs[b] == 0:
+                self._next[b] = self._head
+                self._head = b
+                released += 1
+        self._free += released

@@ -30,7 +30,13 @@ class DSSequenceDescriptor:
 
 
 class DSStateManager:
-    """uid → descriptor registry + KV block bookkeeping."""
+    """uid → descriptor registry + KV block bookkeeping.
+
+    When a :class:`~.prefix_cache.RadixPrefixCache` is attached
+    (``prefix_cache``), cached pages are treated as RECLAIMABLE capacity:
+    an allocation that would otherwise fail first evicts cold cache pages
+    (refcount-1, LRU) and retries — so the cache can grow into every idle
+    block without ever starving admission."""
 
     def __init__(self, num_blocks: int, block_size: int = 128,
                  max_tracked_sequences: int = 2048):
@@ -38,6 +44,7 @@ class DSStateManager:
         self.allocator = BlockedAllocator(num_blocks)
         self.max_tracked_sequences = max_tracked_sequences
         self._seqs: Dict[int, DSSequenceDescriptor] = {}
+        self.prefix_cache = None       # set by InferenceEngineV2 when enabled
 
     @property
     def free_blocks(self) -> int:
@@ -77,10 +84,29 @@ class DSStateManager:
             inject("kv_alloc")
         except InjectedExhausted:
             return False
+        if need > self.allocator.free_blocks and self.prefix_cache is not None:
+            # cached prefix pages are free capacity in disguise: evict cold
+            # ones (LRU, trie-only holders) before reporting exhaustion, so
+            # KV-pressure preemption only ever fires on a genuinely-dry pool
+            self.prefix_cache.evict(need - self.allocator.free_blocks)
         if need > self.allocator.free_blocks:
             return False
         seq.blocks.extend(int(b) for b in self.allocator.allocate(need))
         return True
+
+    def share_blocks(self, seq: DSSequenceDescriptor, blocks,
+                     n_tokens: int) -> None:
+        """Graft already-cached KV pages into a FRESH sequence: the blocks
+        are appended to its table with one extra allocator reference each,
+        and the first ``n_tokens`` rows they cover count as seen.  The
+        caller (engine ``graft_prefix``) guarantees the attested tokens
+        match — this layer only does the accounting."""
+        assert not seq.blocks and seq.seen_tokens == 0, \
+            f"prefix graft into a non-fresh sequence uid={seq.uid}"
+        blocks = [int(b) for b in blocks]
+        self.allocator.ref(blocks)
+        seq.blocks.extend(blocks)
+        seq.seen_tokens = int(n_tokens)
 
     def flush_sequence(self, uid: int) -> None:
         """Release a sequence's blocks (reference engine_v2.flush :242)."""

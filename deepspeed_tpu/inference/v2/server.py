@@ -121,6 +121,8 @@ class _ServingHandler(BaseHTTPRequestHandler):
         try:
             if url.path == "/v1/generate":
                 self._post_generate()
+            elif url.path == "/v1/prefill":
+                self._post_prefill()
             else:
                 self._send_json(404, {"error": f"unknown path {url.path}"})
         except (BrokenPipeError, ConnectionResetError):
@@ -151,26 +153,44 @@ class _ServingHandler(BaseHTTPRequestHandler):
         self._send(200, text.encode(), "text/plain; version=0.0.4")
 
     def _get_healthz(self) -> None:
+        """Machine-readable health: a structured JSON body (state, queue
+        depth, KV pressure, predicted drain rate) the fleet router
+        balances on — no prometheus-text scraping in the routing hot
+        path.  Content negotiation keeps old plain-text consumers
+        working: an ``Accept`` header preferring ``text/plain`` gets the
+        bare status word (dumb probers also never parse anything — the
+        status CODE alone says healthy/not)."""
         srv: "_ServingHTTPServer" = self.server
         sched = srv.owner.scheduler
         status, reasons = sched.health_state()
+        code = 200 if status == "healthy" else 503
+        accept = self.headers.get("Accept", "")
+        if "text/plain" in accept and "application/json" not in accept:
+            self._send(code, (status + "\n").encode(), "text/plain")
+            return
         body = {
             "status": status,
+            "state": status,            # alias: the router's field name
             "reasons": reasons,
             "pending": sched.pending,
             "queue_depth": len(sched._waiting),
             "kv_pressure": round(sched.eng.kv_used_fraction(), 4),
+            "predicted_tok_per_s": round(sched.predicted_tok_per_s(), 3),
+            "predicted_drain_s": round(sched.predicted_drain_s(), 3),
             "counters": dict(sched.counters),
             "ts": time.time(),
         }
-        self._send_json(200 if status == "healthy" else 503, body)
+        self._send_json(code, body)
 
     # ---------------------------------------------------------------- #
     def _post_generate(self) -> None:
         srv: "_ServingHTTPServer" = self.server
         owner = srv.owner
         length = int(self.headers.get("Content-Length", 0))
-        if length <= 0 or length > 8 * 1024 * 1024:
+        # kv_import bodies carry base64 KV pages (L*n*2*KV*HD floats) and
+        # legitimately dwarf a plain prompt — give them the same 64 MB
+        # ceiling the router's ingest uses, keep 8 MB for everything else
+        if length <= 0 or length > 64 * 1024 * 1024:
             self._send_json(400, {"error": "missing/oversized body"})
             return
         try:
@@ -195,8 +215,25 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 spec_k = int(spec_k)
                 if spec_k < 1:
                     raise ValueError("speculative.k must be >= 1")
+            kv_import = None
+            if payload.get("kv_import"):
+                # disaggregated prefill handoff: a base64 DSKV1 frame from
+                # a prefill replica's /v1/prefill response
+                from .kv_ship import from_b64
+
+                kv_import = from_b64(payload["kv_import"])
         except (ValueError, TypeError, KeyError) as e:
             self._send_json(400, {"error": f"bad request body: {e!r}"})
+            return
+        if spec_mode not in (None, "off") and \
+                owner.scheduler.drafter is None:
+            # fail at ADMISSION, not mid-stream: a replica without a
+            # drafter cannot honor a speculative request, and silently
+            # decoding vanilla would misreport what the client asked for
+            self._send_json(400, {
+                "error": "speculative decoding requested but no drafter "
+                         "is configured on this replica",
+                "reason": "no_drafter"})
             return
         stream = bool(payload.get("stream", False))
 
@@ -208,6 +245,7 @@ class _ServingHandler(BaseHTTPRequestHandler):
             deadline_s=payload.get("deadline_s"),
             ttft_timeout_s=payload.get("ttft_timeout_s"),
             spec_mode=spec_mode, spec_k=spec_k,
+            kv_import=kv_import,
             sink=events)
         if not verdict.admitted:
             code = 503 if verdict.reason == "draining" else 429
@@ -221,6 +259,73 @@ class _ServingHandler(BaseHTTPRequestHandler):
             self._stream_response(owner, req, events)
         else:
             self._blocking_response(owner, req, events)
+
+    def _post_prefill(self) -> None:
+        """Disaggregated-prefill producer endpoint: prefill the posted
+        tokens through the normal lifecycle (admission, shedding, prefix
+        cache — everything /v1/generate gets) and answer with the KV rows
+        as a base64 DSKV1 frame.  The caller (dstpu-router) ships the
+        frame to a decode replica as ``kv_import``.  ``wire: "int8"``
+        quantizes the rows through the PR-9 fused-wire kernel."""
+        srv: "_ServingHTTPServer" = self.server
+        owner = srv.owner
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0 or length > 8 * 1024 * 1024:
+            self._send_json(400, {"error": "missing/oversized body"})
+            return
+        try:
+            payload = json.loads(self.rfile.read(length))
+            prompt = [int(t) for t in payload["prompt"]]
+            wire = payload.get("wire", "fp32")
+            from .kv_ship import WIRE_FORMATS
+
+            if wire not in WIRE_FORMATS:
+                raise ValueError(f"wire must be one of {WIRE_FORMATS}")
+            if not prompt:
+                raise ValueError("empty prompt")
+        except (ValueError, TypeError, KeyError) as e:
+            self._send_json(400, {"error": f"bad request body: {e!r}"})
+            return
+        t0 = time.perf_counter()
+        events: "queue.Queue" = queue.Queue()
+        req, verdict = owner.submit_request(
+            prompt=prompt, max_new_tokens=0,
+            priority=int(payload.get("priority", 0)),
+            deadline_s=payload.get("deadline_s"),
+            prefill_only=True, sink=events)
+        if not verdict.admitted:
+            code = 503 if verdict.reason == "draining" else 429
+            self._send_json(code, {
+                "error": "overloaded", "reason": verdict.reason,
+                "retry_after_s": verdict.retry_after_s,
+            }, headers={"Retry-After":
+                        str(int(round(verdict.retry_after_s or 1)))})
+            return
+        while True:
+            try:
+                event, tokens, reason, state = events.get(
+                    timeout=owner.request_poll_s)
+            except queue.Empty:
+                if owner.stopping.is_set():
+                    self._send_json(503, {"error": "server stopping"})
+                    return
+                continue
+            if state in TERMINAL_STATES:
+                break
+        if state != RequestState.FINISHED or req.kv_shipment is None:
+            self._send_json(_TERMINAL_HTTP.get(state, 500), {
+                "error": "prefill failed", "state": state.value,
+                "finish_reason": reason})
+            return
+        from .kv_ship import to_b64
+
+        frame = to_b64(req.kv_shipment, wire=wire)
+        self._send_json(200, {
+            "uid": req.uid, "n_tokens": req.kv_shipment.n_tokens,
+            "wire": wire, "prefix_hit_tokens": req.prefix_hit_tokens,
+            "ship_ms": round((time.perf_counter() - t0) * 1e3, 3),
+            "kv": frame,
+        })
 
     def _blocking_response(self, owner: "ServingServer", req: ServeRequest,
                            events: "queue.Queue") -> None:
@@ -338,6 +443,7 @@ class ServingServer:
     def submit_request(self, prompt: List[int], max_new_tokens: int = 32,
                        priority: int = 0, deadline_s=None,
                        ttft_timeout_s=None, spec_mode=None, spec_k=None,
+                       prefill_only: bool = False, kv_import=None,
                        sink: "queue.Queue" = None
                        ) -> "tuple[ServeRequest, AdmissionVerdict]":
         """Build + submit one request; lifecycle events are copied into
@@ -359,6 +465,7 @@ class ServingServer:
             ttft_timeout_s=(float(ttft_timeout_s)
                             if ttft_timeout_s is not None else None),
             spec_mode=spec_mode, spec_k=spec_k,
+            prefill_only=prefill_only, kv_import=kv_import,
             on_event=on_event)
         verdict = self.scheduler.submit(req)
         self.kick()
@@ -438,6 +545,25 @@ class ServingServer:
                 t.join(timeout=5.0)
         self._http_thread = self._driver_thread = None
 
+    def hard_kill(self) -> None:
+        """SIGKILL analogue for in-process (threaded) chaos tests: stop
+        serving IMMEDIATELY — no drain, no flush, no terminal SSE events.
+        The listening socket closes, in-flight streams see EOF mid-body,
+        and whatever the scheduler held is abandoned exactly as a killed
+        process would abandon it.  The fleet chaos harness kills one
+        replica this way and asserts every stream NOT on it survives
+        bit-identically."""
+        self.stopping.set()            # handlers bail at their next poll
+        self._work.set()
+        srv, self._server = self._server, None
+        if srv is not None:
+            try:
+                srv.shutdown()
+                srv.server_close()
+            except OSError:            # half-dead socket: exactly the point
+                pass
+        # no thread joins, no scheduler drain: the "process" is gone
+
 
 # ------------------------------------------------------------------- #
 # CLI (bin/dstpu-serve)
@@ -454,7 +580,8 @@ def tiny_engine_config(args):
         max_tokens=args.max_tokens, max_seqs=args.max_seqs,
         max_ctx=args.max_ctx, block_size=args.block_size,
         num_blocks=args.num_blocks, dtype=jnp.float32,
-        attn_impl=args.attn_impl)
+        attn_impl=args.attn_impl,
+        prefix_cache=getattr(args, "prefix_cache", False))
 
 
 def build_tiny_engine(args):
@@ -492,6 +619,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--max-ctx", type=int, default=2048)
     p.add_argument("--block-size", type=int, default=64)
     p.add_argument("--num-blocks", type=int, default=None)
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="radix prefix KV reuse: committed prompt pages are "
+                        "shared across requests (refcounts + copy-on-write;"
+                        " multi-tenant traffic with a common system prompt "
+                        "skips its prefill)")
     p.add_argument("--queue-cap", type=int, default=64,
                    help="admission queue bound; beyond it requests are "
                         "shed with 429 + Retry-After")
@@ -550,7 +682,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             max_tokens=args.max_tokens, max_seqs=args.max_seqs,
             max_ctx=args.max_ctx, block_size=args.block_size,
             num_blocks=args.num_blocks, dtype=jnp.bfloat16,
-            attn_impl=args.attn_impl)
+            attn_impl=args.attn_impl, prefix_cache=args.prefix_cache)
         if args.ckpt:
             from ...models.hf import from_pretrained_config
 

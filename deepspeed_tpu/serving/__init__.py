@@ -1,0 +1,7 @@
+"""Serving fleet plane: the tier above one ``dstpu-serve`` process.
+
+``deepspeed_tpu.serving.fleet`` owns multi-replica serving — the
+``dstpu-router`` front tier (load balancing on replica health/drain-rate,
+transparent reroute of dead-replica work), disaggregated prefill (KV pages
+shipped prefill→decode replica), and fleet-wide observability.
+"""

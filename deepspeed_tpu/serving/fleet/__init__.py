@@ -1,0 +1,16 @@
+"""Multi-replica serving: router, replica registry, disaggregated prefill.
+
+Entry points:
+  * :class:`~.replica.ReplicaHandle` — one registered ``dstpu-serve``
+    process: scraped ``/healthz`` state + the routing score derived from
+    its lifecycle drain-rate prediction.
+  * :class:`~.router.FleetRouter` — balancing, reroute-on-death, and the
+    prefill→decode KV handoff.
+  * :class:`~.server.RouterServer` / ``bin/dstpu-router`` — the HTTP
+    front tier terminating ``POST /v1/generate`` for the whole fleet.
+"""
+from .replica import ReplicaHandle
+from .router import FleetRouter
+from .server import RouterServer
+
+__all__ = ["ReplicaHandle", "FleetRouter", "RouterServer"]

@@ -1,0 +1,151 @@
+"""One registered ``dstpu-serve`` replica, as the router sees it.
+
+The handle is pure host-side state: the last scraped ``/healthz`` JSON
+(the machine-readable body the serve tier grew for exactly this consumer —
+no prometheus-text parsing in the routing path) plus failure accounting.
+A replica that misses ``lost_after`` consecutive scrapes is declared LOST
+and rotated out; a later successful scrape resurrects it — processes come
+back, and the router should notice without an operator re-registering.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from ...utils.logging import logger
+
+#: /healthz states eligible for new work.  saturated/draining/degraded
+#: replicas are ROTATED OUT: they answer probes but should not take load.
+ROUTABLE_STATES = ("healthy",)
+
+ROLES = ("decode", "prefill", "both")
+
+
+class ReplicaHandle:
+    def __init__(self, url: str, role: str = "decode",
+                 name: Optional[str] = None, lost_after: int = 2,
+                 timeout_s: float = 5.0):
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
+        self.url = url.rstrip("/")
+        if "://" not in self.url:
+            self.url = "http://" + self.url
+        self.role = role
+        self.name = name or self.url.split("://", 1)[1]
+        self.lost_after = int(lost_after)
+        self.timeout_s = float(timeout_s)
+        self._lock = threading.Lock()
+        # -- scraped state --
+        self.status = "unknown"
+        self.queue_depth = 0
+        self.pending = 0
+        self.kv_pressure = 0.0
+        self.predicted_tok_per_s = 1.0
+        self.predicted_drain_s = 1.0
+        self.counters: Dict[str, float] = {}
+        self.last_scrape_t: Optional[float] = None
+        self.consecutive_failures = 0
+        self.lost = False
+
+    # ------------------------------------------------------------------ #
+    def scrape(self) -> bool:
+        """One ``/healthz`` poll; returns True when the replica answered
+        (any status — a 503 ``draining`` body is a healthy scrape of an
+        unroutable replica).  Connection-level failure counts toward
+        ``lost``."""
+        req = urllib.request.Request(
+            f"{self.url}/healthz",
+            headers={"Accept": "application/json"})
+        try:
+            try:
+                with urllib.request.urlopen(req,
+                                            timeout=self.timeout_s) as r:
+                    body = json.loads(r.read())
+            except urllib.error.HTTPError as e:
+                body = json.loads(e.read())       # 503 still carries JSON
+        except Exception as e:  # noqa: BLE001 — any transport failure counts
+            with self._lock:
+                self.consecutive_failures += 1
+                became_lost = (not self.lost
+                               and self.consecutive_failures
+                               >= self.lost_after)
+                if became_lost:
+                    self.lost = True
+                    self.status = "lost"
+            if became_lost:
+                logger.warning(f"replica {self.name} lost: {e!r}")
+            return False
+        with self._lock:
+            resurrected = self.lost
+            self.consecutive_failures = 0
+            self.lost = False
+            self.status = str(body.get("state", body.get("status",
+                                                         "unknown")))
+            self.queue_depth = int(body.get("queue_depth", 0))
+            self.pending = int(body.get("pending", 0))
+            self.kv_pressure = float(body.get("kv_pressure", 0.0))
+            self.predicted_tok_per_s = float(
+                body.get("predicted_tok_per_s", 1.0)) or 1.0
+            self.predicted_drain_s = float(body.get("predicted_drain_s",
+                                                    1.0))
+            self.counters = dict(body.get("counters", {}))
+            self.last_scrape_t = time.monotonic()
+        if resurrected:
+            logger.info(f"replica {self.name} back: {self.status}")
+        return True
+
+    def metrics_text(self) -> Optional[str]:
+        """Scrape the replica's prometheus ``/metrics`` (fleet aggregation
+        / debugging; NOT on the routing path)."""
+        try:
+            with urllib.request.urlopen(f"{self.url}/metrics",
+                                        timeout=self.timeout_s) as r:
+                return r.read().decode()
+        except Exception:  # noqa: BLE001 — best-effort
+            return None
+
+    # ------------------------------------------------------------------ #
+    def note_failure(self) -> bool:
+        """A request-path failure (connection refused/reset mid-proxy) is
+        stronger evidence than a missed probe: count it immediately.
+        Returns True when this pushed the replica into LOST."""
+        with self._lock:
+            self.consecutive_failures += 1
+            if not self.lost and \
+                    self.consecutive_failures >= self.lost_after:
+                self.lost = True
+                self.status = "lost"
+                return True
+        return False
+
+    @property
+    def routable(self) -> bool:
+        with self._lock:
+            return not self.lost and self.status in ROUTABLE_STATES
+
+    def serves(self, kind: str) -> bool:
+        """Can this replica take ``kind`` ("decode" | "prefill") work?"""
+        return self.role == "both" or self.role == kind
+
+    def score(self) -> float:
+        """Predicted wait to drain this replica's backlog — the balancing
+        signal: outstanding work over the lifecycle's own drain-rate
+        prediction.  Lower is better."""
+        with self._lock:
+            backlog = self.queue_depth + self.pending
+            return backlog / max(self.predicted_tok_per_s, 1e-6)
+
+    def snapshot(self) -> Dict:
+        with self._lock:
+            return {
+                "name": self.name, "url": self.url, "role": self.role,
+                "status": self.status, "lost": self.lost,
+                "queue_depth": self.queue_depth, "pending": self.pending,
+                "kv_pressure": self.kv_pressure,
+                "predicted_tok_per_s": self.predicted_tok_per_s,
+                "consecutive_failures": self.consecutive_failures,
+            }

@@ -1,0 +1,531 @@
+"""Fleet routing core: balancing, reroute-on-death, disaggregated prefill.
+
+The router terminates client traffic and forwards it to registered
+``dstpu-serve`` replicas:
+
+  * **Balancing** — among ROUTABLE replicas (scraped ``/healthz`` state
+    ``healthy``; saturated/draining/degraded/lost replicas are rotated
+    out) the one with the smallest predicted backlog-drain time wins:
+    ``(queue_depth + pending) / predicted_tok_per_s``, the lifecycle
+    scheduler's own drain-rate prediction doing fleet duty.
+  * **Retry semantics** — a request that has delivered ZERO tokens to the
+    client is idempotent-safe: replica death (connection refused, reset,
+    EOF before the first event) transparently re-routes it.  A stream
+    that already forwarded tokens cannot be silently replayed — the
+    client sees a TYPED error event (``error: replica_lost``) carrying a
+    ``retry_after_s``, mirrored as ``Retry-After`` on blocking paths.
+  * **Disaggregated prefill** — prompts at or past ``disagg_threshold``
+    prefill on a prefill-designated replica (``/v1/prefill``); the KV
+    rows ship (fp32 or PR-9-wire int8) and graft into the decode replica
+    via ``kv_import``, so long-prompt compute lands on prefill-shaped
+    capacity while decode replicas stay latency-bound.  Every failure
+    along that path falls back to plain routing (``fleet/prefill_
+    fallback``) — disaggregation is an optimization, never a liveness
+    dependency.
+
+Thread safety: registry mutations and counters take the router lock;
+proxied HTTP runs outside it, so slow replicas never serialize the fleet.
+"""
+from __future__ import annotations
+
+import collections
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional, Set, Tuple
+
+from ...utils.logging import logger
+from .replica import ReplicaHandle
+
+
+class FleetUnavailable(Exception):
+    """No routable replica: the fleet-level shed."""
+
+    def __init__(self, retry_after_s: float, reason: str = "no_replica"):
+        super().__init__(reason)
+        self.retry_after_s = float(retry_after_s)
+        self.reason = reason
+
+
+class ReplicaBadRequest(Exception):
+    """A replica answered 4xx before any bytes streamed: forward it."""
+
+    def __init__(self, code: int, body: Dict):
+        super().__init__(f"replica 4xx: {code}")
+        self.code = int(code)
+        self.body = body
+
+
+def _http_json(method: str, url: str, body=None,
+               timeout: float = 300.0) -> Tuple[int, Dict]:
+    req = urllib.request.Request(
+        url, method=method,
+        data=json.dumps(body).encode() if body is not None else None,
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        try:
+            return e.code, json.loads(e.read())
+        except (ValueError, OSError):
+            return e.code, {"error": f"http {e.code}"}
+
+
+class FleetRouter:
+    def __init__(self, poll_s: float = 0.5, disagg_threshold: int = 0,
+                 wire: str = "fp32", request_timeout_s: float = 600.0,
+                 lost_after: int = 2, scrape_timeout_s: float = 5.0):
+        self.poll_s = float(poll_s)
+        #: prompt length at/past which disaggregated prefill kicks in
+        #: (0 = disabled; also needs a prefill-capable replica)
+        self.disagg_threshold = int(disagg_threshold)
+        self.wire = wire
+        self.request_timeout_s = float(request_timeout_s)
+        self.lost_after = int(lost_after)
+        self.scrape_timeout_s = float(scrape_timeout_s)
+        self._lock = threading.Lock()
+        self._replicas: "collections.OrderedDict[str, ReplicaHandle]" = \
+            collections.OrderedDict()
+        self.counters: "collections.Counter[str]" = collections.Counter()
+        self._rr = 0                      # round-robin tie-break cursor
+        self._stop = threading.Event()
+        self._scrape_thread: Optional[threading.Thread] = None
+        self.draining = False
+
+    # ------------------------------------------------------------------ #
+    # Registry
+    # ------------------------------------------------------------------ #
+    def add_replica(self, url: str, role: str = "decode",
+                    name: Optional[str] = None,
+                    scrape: bool = True) -> ReplicaHandle:
+        h = ReplicaHandle(url, role=role, name=name,
+                          lost_after=self.lost_after,
+                          timeout_s=self.scrape_timeout_s)
+        with self._lock:
+            if h.name in self._replicas:
+                raise ValueError(f"replica {h.name} already registered")
+            self._replicas[h.name] = h
+        if scrape:
+            h.scrape()
+        self._event("fleet_replica_registered", name=h.name, url=h.url,
+                    role=h.role)
+        logger.info(f"fleet: registered {h.role} replica {h.name}")
+        return h
+
+    def remove_replica(self, name: str) -> bool:
+        with self._lock:
+            return self._replicas.pop(name, None) is not None
+
+    def replicas(self) -> List[ReplicaHandle]:
+        with self._lock:
+            return list(self._replicas.values())
+
+    def snapshot(self) -> List[Dict]:
+        return [h.snapshot() for h in self.replicas()]
+
+    # ------------------------------------------------------------------ #
+    # Scrape loop
+    # ------------------------------------------------------------------ #
+    def start(self) -> "FleetRouter":
+        if self._scrape_thread is None:
+            self._scrape_thread = threading.Thread(
+                target=self._scrape_loop, name="dstpu-router-scrape",
+                daemon=True)
+            self._scrape_thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._scrape_thread = self._scrape_thread, None
+        if t is not None:
+            t.join(timeout=5.0)
+
+    def _scrape_loop(self) -> None:
+        while not self._stop.wait(self.poll_s):
+            try:
+                self.scrape_all()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                logger.warning(f"fleet scrape pass failed: {e!r}")
+
+    def scrape_all(self) -> None:
+        """One health pass over every replica + fleet gauge publication."""
+        for h in self.replicas():
+            was_lost = h.lost
+            h.scrape()
+            if h.lost and not was_lost:
+                self._on_lost(h)
+        self._publish_gauges()
+
+    def _on_lost(self, h: ReplicaHandle) -> None:
+        self._count("fleet/replica_lost")
+        self._event("fleet_replica_lost", name=h.name, url=h.url,
+                    failures=h.consecutive_failures)
+
+    # ------------------------------------------------------------------ #
+    # Selection
+    # ------------------------------------------------------------------ #
+    def _pick(self, kind: str,
+              exclude: Set[str]) -> Optional[ReplicaHandle]:
+        with self._lock:
+            cands = [h for h in self._replicas.values()
+                     if h.name not in exclude and h.serves(kind)
+                     and h.routable]
+            self._rr += 1
+            rr = self._rr
+        if not cands:
+            return None
+        # smallest predicted drain wait; equal scores rotate round-robin
+        # so an idle fleet doesn't funnel everything at replica 0
+        scored = sorted(
+            (h.score(), (i + rr) % len(cands), h)
+            for i, h in enumerate(cands))
+        return scored[0][2]
+
+    def retry_after_s(self) -> float:
+        preds = [h.predicted_drain_s for h in self.replicas()
+                 if not h.lost]
+        return float(min(max(min(preds), 1.0), 120.0)) if preds else 5.0
+
+    # ------------------------------------------------------------------ #
+    # Disaggregated prefill
+    # ------------------------------------------------------------------ #
+    def _maybe_disagg(self, payload: Dict) -> None:
+        """Prefill long prompts on a prefill-designated replica and attach
+        the shipped KV as ``kv_import``.  Mutates ``payload``; every
+        failure leaves it untouched (plain routing)."""
+        prompt = payload.get("prompt") or []
+        if (not self.disagg_threshold
+                or len(prompt) < self.disagg_threshold
+                or payload.get("kv_import")
+                or len(prompt) < 2):
+            return
+        h = self._pick("prefill", set())
+        if h is None:
+            return
+        t0 = time.perf_counter()
+        pre_body = {"prompt": [int(t) for t in prompt[:-1]],
+                    "wire": self.wire}
+        # the prefill leg inherits the request's deadline/priority — a
+        # deadline the client set must bound the REMOTE prefill too, not
+        # just the decode half
+        for key in ("deadline_s", "priority"):
+            if payload.get(key) is not None:
+                pre_body[key] = payload[key]
+        try:
+            code, body = _http_json(
+                "POST", f"{h.url}/v1/prefill", pre_body,
+                timeout=self.request_timeout_s)
+        except Exception as e:  # noqa: BLE001 — prefill death => fallback
+            if h.note_failure():
+                self._on_lost(h)
+            self._count("fleet/prefill_fallback")
+            self._event("fleet_prefill_fallback", name=h.name,
+                        error=repr(e))
+            return
+        if code != 200 or "kv" not in body:
+            self._count("fleet/prefill_fallback")
+            self._event("fleet_prefill_fallback", name=h.name, code=code)
+            return
+        payload["kv_import"] = body["kv"]
+        ship_ms = (time.perf_counter() - t0) * 1e3
+        self._count("fleet/prefill_disagg")
+        self._count("fleet/kv_ship_bytes", len(body["kv"]))
+        self._gauge("fleet/kv_ship_ms", round(ship_ms, 3))
+        self._gauge("fleet/kv_ship_tokens", body.get("n_tokens", 0))
+
+    # ------------------------------------------------------------------ #
+    # Blocking path
+    # ------------------------------------------------------------------ #
+    def generate_blocking(self, payload: Dict
+                          ) -> Tuple[int, Dict, Dict[str, str]]:
+        """Route one blocking ``/v1/generate``; returns (status, body,
+        extra headers).  Nothing has been sent to the client yet, so
+        EVERY replica failure is idempotent-safe to retry."""
+        payload = dict(payload)
+        if self.draining:
+            ra = self.retry_after_s()
+            return 503, {"error": "router draining",
+                         "reason": "draining", "retry_after_s": ra}, \
+                {"Retry-After": str(int(max(ra, 1)))}
+        self._maybe_disagg(payload)
+        tried: Set[str] = set()
+        last_shed: Optional[Dict] = None
+        while True:
+            h = self._pick("decode", tried)
+            if h is None:
+                self._count("fleet/shed")
+                ra = (last_shed or {}).get("retry_after_s") \
+                    or self.retry_after_s()
+                body = {"error": "no routable replica",
+                        "reason": (last_shed or {}).get(
+                            "reason", "fleet_unavailable"),
+                        "retry_after_s": ra}
+                return 503, body, {"Retry-After": str(int(max(ra, 1)))}
+            tried.add(h.name)
+            try:
+                code, body = _http_json(
+                    "POST", f"{h.url}/v1/generate", payload,
+                    timeout=self.request_timeout_s)
+            except Exception as e:  # noqa: BLE001 — transport death: reroute
+                if h.note_failure():
+                    self._on_lost(h)
+                self._count("fleet/rerouted")
+                self._event("fleet_rerouted", name=h.name, error=repr(e))
+                continue
+            if code in (429, 503):
+                # replica-level shed (queue full / draining): rotate on
+                last_shed = body
+                self._count("fleet/replica_shed")
+                continue
+            if payload.get("kv_import") and (
+                    code == 400
+                    or (code >= 500
+                        and body.get("finish_reason") == "impossible")):
+                # the handoff itself was refused (oversized frame, token/
+                # geometry mismatch): drop the shipment and give the same
+                # replica a direct shot — disaggregation must never be a
+                # liveness dependency
+                payload.pop("kv_import", None)
+                tried.discard(h.name)
+                self._count("fleet/prefill_fallback")
+                self._event("fleet_prefill_fallback", name=h.name,
+                            code=code)
+                continue
+            if code >= 500:
+                self._count("fleet/rerouted")
+                self._event("fleet_rerouted", name=h.name, code=code)
+                continue
+            self._count("fleet/routed")
+            return code, body, {}
+
+    # ------------------------------------------------------------------ #
+    # Streaming path
+    # ------------------------------------------------------------------ #
+    def generate_stream(self, payload: Dict, start, send) -> None:
+        """Route one SSE ``/v1/generate``.
+
+        ``start()`` runs once, right before the first forwarded bytes
+        (the handler writes its SSE headers there); ``send(bytes)``
+        forwards one complete event block.  Raises
+        :class:`FleetUnavailable` / :class:`ReplicaBadRequest` ONLY
+        before ``start()`` — once bytes flow, failures surface in-band as
+        a typed ``error`` event."""
+        import http.client
+        from urllib.parse import urlparse
+
+        payload = dict(payload)
+        payload["stream"] = True
+        if self.draining:
+            raise FleetUnavailable(self.retry_after_s(), "draining")
+        self._maybe_disagg(payload)
+        tried: Set[str] = set()
+        last_shed: Optional[Dict] = None
+        started = False
+        while True:
+            h = self._pick("decode", tried)
+            if h is None:
+                ra = (last_shed or {}).get("retry_after_s") \
+                    or self.retry_after_s()
+                self._count("fleet/shed")
+                if not started:
+                    raise FleetUnavailable(
+                        ra, (last_shed or {}).get("reason",
+                                                  "fleet_unavailable"))
+                send(self._error_event("fleet_unavailable", 0, ra))
+                return
+            tried.add(h.name)
+            u = urlparse(h.url)
+            conn = None
+            forwarded = 0
+            saw_terminal = False
+            try:
+                conn = http.client.HTTPConnection(
+                    u.hostname, u.port, timeout=self.request_timeout_s)
+                conn.request("POST", "/v1/generate",
+                             body=json.dumps(payload),
+                             headers={"Content-Type": "application/json"})
+                resp = conn.getresponse()
+                if resp.status != 200:
+                    body_raw = resp.read()
+                    try:
+                        body = json.loads(body_raw)
+                    except ValueError:
+                        body = {"error": body_raw.decode(errors="replace")}
+                    if resp.status in (429, 503):
+                        last_shed = body
+                        self._count("fleet/replica_shed")
+                        continue
+                    if payload.get("kv_import") and (
+                            resp.status == 400
+                            or (resp.status >= 500 and
+                                body.get("finish_reason")
+                                == "impossible")):
+                        # refused handoff: retry the same replica direct
+                        payload.pop("kv_import", None)
+                        tried.discard(h.name)
+                        self._count("fleet/prefill_fallback")
+                        self._event("fleet_prefill_fallback",
+                                    name=h.name, code=resp.status)
+                        continue
+                    if resp.status < 500 and not started:
+                        raise ReplicaBadRequest(resp.status, body)
+                    self._count("fleet/rerouted")
+                    continue
+                # -- 200: pump SSE event blocks ------------------------- #
+                block: List[bytes] = []
+                while True:
+                    line = resp.readline()
+                    if not line:
+                        break              # EOF: replica died or finished
+                    block.append(line)
+                    if line not in (b"\n", b"\r\n"):
+                        continue
+                    raw = b"".join(block)
+                    block = []
+                    n_tok, terminal = self._inspect_event(raw)
+                    if not started:
+                        start()
+                        started = True
+                    send(raw)
+                    forwarded += n_tok
+                    if terminal:
+                        saw_terminal = True
+                        break
+                if saw_terminal:
+                    self._count("fleet/routed")
+                    return
+                raise ConnectionError("stream ended without terminal event")
+            except (ReplicaBadRequest, FleetUnavailable):
+                raise
+            except Exception as e:  # noqa: BLE001 — transport-level death
+                if h.note_failure():
+                    self._on_lost(h)
+                if forwarded == 0 and not saw_terminal:
+                    # zero tokens delivered: idempotent-safe, re-route
+                    self._count("fleet/rerouted")
+                    self._event("fleet_rerouted", name=h.name,
+                                error=repr(e))
+                    continue
+                # tokens already reached the client: typed in-band error
+                ra = self.retry_after_s()
+                self._count("fleet/mid_stream_error")
+                self._event("fleet_mid_stream_error", name=h.name,
+                            forwarded=forwarded, error=repr(e))
+                try:
+                    send(self._error_event("replica_lost", forwarded, ra))
+                except OSError:
+                    pass                   # client is gone too
+                return
+            finally:
+                if conn is not None:
+                    try:
+                        conn.close()
+                    except OSError:
+                        pass
+
+    @staticmethod
+    def _inspect_event(raw: bytes) -> Tuple[int, bool]:
+        """(tokens carried, is_terminal) for one SSE event block."""
+        n_tok, terminal = 0, False
+        for line in raw.splitlines():
+            if line.startswith(b"data: "):
+                try:
+                    d = json.loads(line[len(b"data: "):])
+                except ValueError:
+                    continue
+                n_tok += len(d.get("tokens") or [])
+                if d.get("finish_reason") is not None or \
+                        d.get("state") in ("finished", "cancelled",
+                                           "expired", "failed", "shed"):
+                    terminal = True
+        return n_tok, terminal
+
+    @staticmethod
+    def _error_event(reason: str, forwarded: int,
+                     retry_after_s: float) -> bytes:
+        return (b"event: error\ndata: " + json.dumps({
+            "error": reason, "tokens_forwarded": forwarded,
+            "retry_after_s": round(retry_after_s, 3),
+        }).encode() + b"\n\n")
+
+    # ------------------------------------------------------------------ #
+    # Health / telemetry
+    # ------------------------------------------------------------------ #
+    def health(self) -> Tuple[str, Dict]:
+        reps = self.snapshot()
+        routable = [r for r in reps
+                    if not r["lost"] and r["status"] == "healthy"]
+        if self.draining:
+            status = "draining"
+        elif not reps:
+            status = "empty"
+        elif not routable:
+            status = "unavailable"
+        elif len(routable) < len(reps):
+            status = "degraded"
+        else:
+            status = "healthy"
+        return status, {
+            "status": status, "state": status,
+            "replicas": reps,
+            "routable": len(routable), "registered": len(reps),
+            "counters": dict(self.counters),
+            "retry_after_s": self.retry_after_s(),
+            "ts": time.time(),
+        }
+
+    def _publish_gauges(self) -> None:
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        reps = self.replicas()
+        routable = sum(1 for h in reps if h.routable)
+        if tel is None:
+            return
+        m = tel.metrics
+        m.gauge("fleet/replicas_registered").set(len(reps))
+        m.gauge("fleet/replicas_routable").set(routable)
+        hits = sat = tok = req = 0.0
+        for h in reps:
+            m.gauge("fleet/replica_queue_depth").set(
+                h.queue_depth, replica=h.name)
+            m.gauge("fleet/replica_pending").set(h.pending, replica=h.name)
+            m.gauge("fleet/replica_kv_pressure").set(
+                h.kv_pressure, replica=h.name)
+            m.gauge("fleet/replica_predicted_tok_per_s").set(
+                h.predicted_tok_per_s, replica=h.name)
+            hits += h.counters.get("serving/prefix_hits", 0)
+            tok += h.counters.get("serving/prefix_hit_tokens", 0)
+            req += h.counters.get("serving/requests", 0)
+            sat += 1 if h.status == "saturated" else 0
+        m.gauge("fleet/prefix_hits").set(hits)
+        m.gauge("fleet/prefix_hit_tokens").set(tok)
+        m.gauge("fleet/prefix_hit_rate").set(
+            round(hits / req, 4) if req else 0.0)
+        m.gauge("fleet/replicas_saturated").set(sat)
+
+    def _count(self, name: str, n: float = 1) -> None:
+        with self._lock:
+            self.counters[name] += n
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is not None:
+            tel.metrics.counter(name).inc(n)
+
+    def _gauge(self, name: str, value: float, **labels) -> None:
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is not None:
+            tel.metrics.gauge(name).set(value, **labels)
+
+    def _event(self, kind: str, **fields) -> None:
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is not None:
+            tel.event(kind, **fields)

@@ -1,0 +1,382 @@
+"""``dstpu-router``: the fleet's HTTP front tier.
+
+Same stdlib ``ThreadingHTTPServer`` machinery as ``dstpu-serve`` (PR 5/8),
+one tier up:
+
+  * ``POST /v1/generate`` — blocking or SSE; forwarded to the
+    least-loaded routable replica with the retry/reroute semantics of
+    :class:`~.router.FleetRouter` (zero-token streams re-route
+    transparently; mid-stream replica death surfaces a typed ``error``
+    event + ``Retry-After``).
+  * ``GET /healthz`` — fleet aggregate (``healthy`` | ``degraded`` |
+    ``unavailable`` | ``draining`` | ``empty``) with per-replica
+    snapshots; anything but healthy/degraded answers 503.  Content
+    negotiation mirrors the replica endpoint (``Accept: text/plain`` →
+    bare status word).
+  * ``GET /metrics`` — the router's ``fleet/*`` counters and gauges
+    (telemetry registry prometheus text; direct counter rendering
+    without a hub).
+  * ``GET /replicas`` / ``POST /replicas`` — registry introspection and
+    live registration (``{"url": ..., "role": "decode|prefill|both"}``).
+
+Graceful drain: SIGTERM flips ``/healthz`` to draining, sheds NEW
+requests with 503 + Retry-After, lets in-flight proxied requests finish
+bounded by the drain deadline, then exits 0 — replicas drain themselves;
+the router never buffers generation state, so its drain is cheap.
+"""
+from __future__ import annotations
+
+import json
+import signal
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional
+from urllib.parse import urlparse
+
+from ...utils.logging import logger
+from .replica import ROLES
+from .router import FleetRouter, FleetUnavailable, ReplicaBadRequest
+
+
+class _RouterHandler(BaseHTTPRequestHandler):
+    server_version = "dstpu-router/1"
+    protocol_version = "HTTP/1.1"
+    _streaming = False
+
+    def log_message(self, format, *args):  # noqa: A002 — stdlib signature
+        logger.debug("dstpu-router: " + format % args)
+
+    # ---------------------------------------------------------------- #
+    def _send(self, code: int, body: bytes, content_type: str,
+              headers: Optional[Dict[str, str]] = None) -> None:
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in (headers or {}).items():
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_json(self, code: int, obj,
+                   headers: Optional[Dict[str, str]] = None) -> None:
+        self._send(code, json.dumps(obj, sort_keys=True,
+                                    default=str).encode() + b"\n",
+                   "application/json", headers)
+
+    def _read_json(self) -> Optional[Dict]:
+        length = int(self.headers.get("Content-Length", 0))
+        if length <= 0 or length > 64 * 1024 * 1024:
+            self._send_json(400, {"error": "missing/oversized body"})
+            return None
+        try:
+            obj = json.loads(self.rfile.read(length))
+            if not isinstance(obj, dict):
+                raise TypeError("body must be a JSON object")
+            return obj
+        except (ValueError, TypeError) as e:
+            self._send_json(400, {"error": f"bad request body: {e!r}"})
+            return None
+
+    # ---------------------------------------------------------------- #
+    def do_GET(self):  # noqa: N802 — stdlib hook name
+        url = urlparse(self.path)
+        try:
+            if url.path == "/healthz":
+                self._get_healthz()
+            elif url.path == "/metrics":
+                self._get_metrics()
+            elif url.path == "/replicas":
+                self._send_json(200,
+                                {"replicas": self.server.owner
+                                 .router.snapshot()})
+            elif url.path == "/":
+                self._send_json(200, {"endpoints": [
+                    "/v1/generate (POST)", "/metrics", "/healthz",
+                    "/replicas (GET/POST)"]})
+            else:
+                self._send_json(404, {"error": f"unknown path {url.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001 — handler bug must surface as 500
+            logger.warning(f"dstpu-router {url.path} failed: {e!r}")
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except (OSError, ValueError):
+                pass
+
+    def do_POST(self):  # noqa: N802 — stdlib hook name
+        url = urlparse(self.path)
+        try:
+            if url.path == "/v1/generate":
+                self._post_generate()
+            elif url.path == "/replicas":
+                self._post_replicas()
+            else:
+                self._send_json(404, {"error": f"unknown path {url.path}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"dstpu-router {url.path} failed: {e!r}")
+            if self._streaming:
+                self.close_connection = True
+                return
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except (OSError, ValueError):
+                pass
+
+    # ---------------------------------------------------------------- #
+    def _get_healthz(self) -> None:
+        status, body = self.server.owner.router.health()
+        code = 200 if status in ("healthy", "degraded") else 503
+        accept = self.headers.get("Accept", "")
+        if "text/plain" in accept and "application/json" not in accept:
+            self._send(code, (status + "\n").encode(), "text/plain")
+            return
+        self._send_json(code, body)
+
+    def _get_metrics(self) -> None:
+        owner = self.server.owner
+        tel = owner.telemetry
+        if tel is not None:
+            text = tel.metrics.prometheus_text()
+        else:
+            lines = []
+            for name, value in sorted(owner.router.counters.items()):
+                prom = name.replace("/", "_")
+                lines.append(f"# TYPE {prom} counter")
+                lines.append(f"{prom} {value}")
+            text = "\n".join(lines) + ("\n" if lines else "")
+        self._send(200, text.encode(), "text/plain; version=0.0.4")
+
+    def _post_replicas(self) -> None:
+        body = self._read_json()
+        if body is None:
+            return
+        url = body.get("url")
+        role = body.get("role", "decode")
+        if not url or role not in ROLES:
+            self._send_json(400, {"error": "need url and a valid role "
+                                           f"{ROLES}"})
+            return
+        try:
+            h = self.server.owner.router.add_replica(
+                url, role=role, name=body.get("name"))
+        except ValueError as e:
+            self._send_json(409, {"error": str(e)})
+            return
+        self._send_json(200, {"registered": h.snapshot()})
+
+    # ---------------------------------------------------------------- #
+    def _post_generate(self) -> None:
+        owner: "RouterServer" = self.server.owner
+        body = self._read_json()
+        if body is None:
+            return
+        owner.inflight_inc()
+        try:
+            if body.get("stream"):
+                self._proxy_stream(owner, body)
+            else:
+                code, out, headers = owner.router.generate_blocking(body)
+                self._send_json(code, out, headers)
+        finally:
+            owner.inflight_dec()
+
+    def _proxy_stream(self, owner: "RouterServer", body: Dict) -> None:
+        def start():
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Connection", "close")
+            self.end_headers()
+            self._streaming = True
+
+        def send(chunk: bytes):
+            self.wfile.write(chunk)
+            self.wfile.flush()
+
+        try:
+            owner.router.generate_stream(body, start, send)
+        except FleetUnavailable as e:
+            self._send_json(503, {
+                "error": "no routable replica", "reason": e.reason,
+                "retry_after_s": e.retry_after_s,
+            }, headers={"Retry-After":
+                        str(int(max(e.retry_after_s, 1)))})
+        except ReplicaBadRequest as e:
+            self._send_json(e.code, e.body)
+
+
+class _RouterHTTPServer(ThreadingHTTPServer):
+    daemon_threads = True
+    allow_reuse_address = True
+    owner: "RouterServer" = None
+
+
+class RouterServer:
+    """Owner object: HTTP thread + the router's scrape loop + drain."""
+
+    def __init__(self, router: FleetRouter, telemetry=None,
+                 port: int = 8790, bind: str = "0.0.0.0",
+                 drain_deadline_s: float = 30.0):
+        self.router = router
+        self.telemetry = telemetry
+        self.requested_port = int(port)
+        self.bind = bind
+        self.drain_deadline_s = float(drain_deadline_s)
+        self.port: Optional[int] = None
+        self.stopping = threading.Event()
+        self._server: Optional[_RouterHTTPServer] = None
+        self._http_thread: Optional[threading.Thread] = None
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+
+    def inflight_inc(self) -> None:
+        with self._inflight_lock:
+            self._inflight += 1
+
+    def inflight_dec(self) -> None:
+        with self._inflight_lock:
+            self._inflight -= 1
+
+    @property
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    # ---------------------------------------------------------------- #
+    def start(self) -> "RouterServer":
+        if self._server is not None:
+            return self
+        srv = _RouterHTTPServer((self.bind, self.requested_port),
+                                _RouterHandler)
+        srv.owner = self
+        self._server = srv
+        self.port = srv.server_address[1]
+        self._http_thread = threading.Thread(
+            target=srv.serve_forever, name="dstpu-router-http",
+            kwargs={"poll_interval": 0.2}, daemon=True)
+        self._http_thread.start()
+        self.router.start()
+        logger.info(f"dstpu-router on http://{self.bind}:{self.port} "
+                    f"({len(self.router.replicas())} replica(s))")
+        if self.telemetry is not None:
+            self.telemetry.event("fleet_router_start", port=self.port,
+                                 bind=self.bind,
+                                 replicas=len(self.router.replicas()))
+        return self
+
+    def drain_and_stop(self, deadline_s: Optional[float] = None) -> Dict:
+        """SIGTERM path: shed new work, let in-flight proxies finish
+        bounded by the deadline, stop.  Idempotent."""
+        deadline_s = self.drain_deadline_s if deadline_s is None \
+            else float(deadline_s)
+        self.router.draining = True
+        t_end = time.monotonic() + deadline_s
+        while self.inflight and time.monotonic() < t_end:
+            time.sleep(0.05)
+        stranded = self.inflight
+        self.stop()
+        return {"stranded": stranded}
+
+    def stop(self) -> None:
+        self.stopping.set()
+        self.router.stop()
+        srv, self._server = self._server, None
+        if srv is not None:
+            srv.shutdown()
+            srv.server_close()
+        if self._http_thread is not None:
+            self._http_thread.join(timeout=5.0)
+            self._http_thread = None
+
+
+# ------------------------------------------------------------------- #
+# CLI (bin/dstpu-router)
+# ------------------------------------------------------------------- #
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="dstpu-router",
+        description="Fleet front tier: load-balance /v1/generate across "
+                    "dstpu-serve replicas, reroute around dead replicas, "
+                    "disaggregate long-prompt prefill.")
+    p.add_argument("--port", type=int, default=8790)
+    p.add_argument("--bind", default="0.0.0.0")
+    p.add_argument("--replica", action="append", default=[],
+                   metavar="URL",
+                   help="decode replica base URL (repeatable); more can "
+                        "be registered live via POST /replicas")
+    p.add_argument("--prefill-replica", action="append", default=[],
+                   metavar="URL",
+                   help="prefill-designated replica (disaggregated "
+                        "prefill producer; never takes decode traffic)")
+    p.add_argument("--both-replica", action="append", default=[],
+                   metavar="URL",
+                   help="replica serving BOTH roles")
+    p.add_argument("--disagg-threshold", type=int, default=0,
+                   help="prompt length at/past which prefill runs on a "
+                        "prefill replica and the KV ships to a decode "
+                        "replica (0 = disabled)")
+    p.add_argument("--wire", default="fp32", choices=["fp32", "int8"],
+                   help="KV page wire for disaggregated prefill: fp32 is "
+                        "bit-exact; int8 rides the PR-9 fused-wire "
+                        "quantizer at a quarter the bytes")
+    p.add_argument("--poll", type=float, default=0.5,
+                   help="replica /healthz scrape interval (s)")
+    p.add_argument("--lost-after", type=int, default=2,
+                   help="consecutive failed scrapes before a replica is "
+                        "declared lost and rotated out")
+    p.add_argument("--drain-deadline", type=float, default=30.0)
+    p.add_argument("--request-timeout", type=float, default=600.0)
+    p.add_argument("--telemetry-dir", default="telemetry_router")
+    args = p.parse_args(argv)
+
+    from ...telemetry import Telemetry, set_telemetry
+
+    tel = Telemetry(output_dir=args.telemetry_dir)
+    set_telemetry(tel)
+
+    router = FleetRouter(poll_s=args.poll,
+                         disagg_threshold=args.disagg_threshold,
+                         wire=args.wire, lost_after=args.lost_after,
+                         request_timeout_s=args.request_timeout)
+    for url in args.replica:
+        router.add_replica(url, role="decode")
+    for url in args.prefill_replica:
+        router.add_replica(url, role="prefill")
+    for url in args.both_replica:
+        router.add_replica(url, role="both")
+
+    server = RouterServer(router, telemetry=tel, port=args.port,
+                          bind=args.bind,
+                          drain_deadline_s=args.drain_deadline)
+    server.start()
+
+    done = threading.Event()
+    rc = {"code": 0}
+
+    def _drain_then_exit():
+        try:
+            server.drain_and_stop()
+        except Exception as e:  # noqa: BLE001 — a failed drain must still exit
+            logger.error(f"router drain failed: {e!r}")
+            rc["code"] = 1
+        finally:
+            done.set()
+
+    def _term(signum, frame):
+        logger.info(f"signal {signum}: draining router "
+                    f"(deadline {args.drain_deadline}s)")
+        threading.Thread(target=_drain_then_exit, daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    print(f"dstpu-router listening on http://{args.bind}:{server.port}",
+          flush=True)
+    done.wait()
+    tel.close()
+    return rc["code"]

@@ -31,7 +31,9 @@ EVENT_KINDS_INCIDENT = ("fault", "watchdog_timeout", "elastic_worker_failure",
                         "elastic_restart", "elastic_reshape", "straggler",
                         "anomaly", "anomaly_checkpoint_failed",
                         "checkpoint_reshard_fallback",
-                        "serving_nan_isolated", "serving_window_hang")
+                        "serving_nan_isolated", "serving_window_hang",
+                        "fleet_replica_lost", "fleet_mid_stream_error",
+                        "fleet_prefill_fallback")
 
 #: roofline table columns, shared between the section renderer and --help
 ROOFLINE_COLUMNS = (
@@ -236,7 +238,10 @@ SERVING_LIFECYCLE_COUNTERS = (
     "serving/preempted", "serving/cancelled", "serving/deadline_expired",
     "serving/ttft_timeout", "serving/nan_isolated", "serving/window_hang",
     "serving/rejected", "serving/drain_expired",
-    "serving/spec_windows", "serving/spec_drafted", "serving/spec_accepted")
+    "serving/spec_windows", "serving/spec_drafted", "serving/spec_accepted",
+    "serving/prefix_hits", "serving/prefix_hit_tokens",
+    "serving/kv_import", "serving/kv_import_tokens",
+    "serving/prefill_exported")
 
 #: serving latency histograms: TTFT (arrival → first generated token) and
 #: TPOT (decode-phase seconds per output token)
@@ -280,6 +285,43 @@ def serving_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         out["lifecycle"] = lifecycle
     if latency:
         out["latency"] = latency
+    return out
+
+
+#: fleet-tier counters (dstpu-router) surfaced in the fleet section
+FLEET_COUNTERS = (
+    "fleet/routed", "fleet/rerouted", "fleet/shed", "fleet/replica_shed",
+    "fleet/replica_lost", "fleet/mid_stream_error",
+    "fleet/prefill_disagg", "fleet/prefill_fallback",
+    "fleet/kv_ship_bytes")
+
+
+def fleet_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """The ``fleet/*`` series published by ``dstpu-router``: fleet size /
+    routability, routed/rerouted/shed/replica-lost counters, the
+    aggregated prefix-cache hit rate, per-replica queue depth + KV
+    pressure (labelled gauges), and disaggregated-prefill KV-ship
+    volume/latency."""
+    out: Dict[str, Any] = {}
+    counters: Dict[str, float] = {}
+    replicas: Dict[str, Dict[str, Any]] = {}
+    for m in metrics:
+        name = str(m.get("name", ""))
+        if not name.startswith("fleet/"):
+            continue
+        key = name.split("/", 1)[1]
+        labels = m.get("labels") or {}
+        if name in FLEET_COUNTERS:
+            counters[key] = m.get("value")
+        elif labels.get("replica"):
+            replicas.setdefault(labels["replica"], {})[
+                key.replace("replica_", "")] = m.get("value")
+        else:
+            out[key] = m.get("value")
+    if counters:
+        out["counters"] = counters
+    if replicas:
+        out["replicas"] = replicas
     return out
 
 
@@ -379,6 +421,7 @@ def summarize_run(events_path: Optional[str],
         "comm": comm_table(run["metrics"], device_kind=device_kind),
         "overlap": overlap_summary(run["metrics"]),
         "serving": serving_summary(run["metrics"]),
+        "fleet": fleet_summary(run["metrics"]),
         "profile": profile,
         "xprof": xprof_summary(run["events"], explicit_dir=xprof_dir),
         "memory": memory_summary(run["metrics"], run["events"]),
@@ -569,6 +612,45 @@ def format_summary(s: Dict[str, Any]) -> str:
                      if v]
             if parts:
                 add("lifecycle: " + ", ".join(parts))
+        add("")
+
+    fl = s.get("fleet") or {}
+    if fl:
+        add("--- serving fleet (dstpu-router) ---")
+        line = (f"replicas: {int(fl.get('replicas_routable') or 0)}"
+                f"/{int(fl.get('replicas_registered') or 0)} routable")
+        if fl.get("replicas_saturated"):
+            line += f" ({int(fl['replicas_saturated'])} saturated)"
+        if fl.get("prefix_hit_rate") is not None:
+            line += (f" · prefix-cache hit rate "
+                     f"{100 * fl['prefix_hit_rate']:.1f}%"
+                     f" ({int(fl.get('prefix_hit_tokens') or 0)} tokens"
+                     f" reused)")
+        add(line)
+        fc = fl.get("counters") or {}
+        if fc:
+            parts = [f"{k}={int(v)}" for k, v in sorted(fc.items())
+                     if v and k != "kv_ship_bytes"]
+            if parts:
+                add("routing: " + ", ".join(parts))
+        if fc.get("kv_ship_bytes") or fl.get("kv_ship_ms") is not None:
+            line = "kv ship: " + _fmt_bytes(int(fc.get("kv_ship_bytes")
+                                                or 0))
+            if fl.get("kv_ship_ms") is not None:
+                line += f", last {fl['kv_ship_ms']:.1f}ms"
+            if fl.get("kv_ship_tokens"):
+                line += f" ({int(fl['kv_ship_tokens'])} tokens)"
+            add(line)
+        reps = fl.get("replicas") or {}
+        if reps:
+            add(f"{'replica':<28}{'queue':>7}{'pending':>9}"
+                f"{'kv_pressure':>13}{'tok/s pred':>12}")
+            for rname in sorted(reps):
+                row = reps[rname]
+                add(f"{rname:<28}{int(row.get('queue_depth') or 0):>7}"
+                    f"{int(row.get('pending') or 0):>9}"
+                    f"{(row.get('kv_pressure') or 0):>13.3f}"
+                    f"{(row.get('predicted_tok_per_s') or 0):>12.1f}")
         add("")
 
     add("--- memory high-water marks ---")

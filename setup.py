@@ -23,5 +23,5 @@ setup(
         "dev": ["pytest", "chex"],
     },
     scripts=["bin/dstpu", "bin/ds_report", "bin/dstpu-telemetry",
-             "bin/dstpu-check"],
+             "bin/dstpu-check", "bin/dstpu-serve", "bin/dstpu-router"],
 )

@@ -1,0 +1,198 @@
+"""Fleet chaos harness (markers: serving, serving_chaos, fleet): 3
+threaded CPU-sim replicas behind a live dstpu-router, 64 staggered SSE
+requests sharing system-prompt prefixes, one replica hard-killed (the
+in-process SIGKILL analogue: listening socket closed, streams cut
+mid-body, scheduler abandoned) mid-run.  Acceptance properties:
+
+  * every stream NOT mid-flight on the dead replica completes
+    bit-identical to an unperturbed (single-engine greedy) run — in
+    particular EVERY request submitted after the kill;
+  * streams cut mid-flight surface the typed ``error`` event (replica
+    lost + retry_after) or re-route transparently when zero tokens had
+    been delivered;
+  * surviving replicas' prefix caches return to their refcount baseline
+    (every cached page held only by the trie; pool = total - cached);
+  * ``fleet/replica_lost`` and ``fleet/rerouted`` are scraped >= 1 from
+    the LIVE router ``/metrics`` over HTTP.
+"""
+import json
+import threading
+import time
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (
+    InferenceEngineV2,
+    RaggedInferenceEngineConfig,
+)
+from deepspeed_tpu.inference.v2.lifecycle import LifecycleScheduler
+from deepspeed_tpu.inference.v2.server import ServingServer
+from deepspeed_tpu.serving.fleet import FleetRouter, RouterServer
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+pytestmark = [pytest.mark.serving, pytest.mark.serving_chaos,
+              pytest.mark.fleet]
+
+N_REQ = 64
+N_REPLICAS = 3
+KILL_AFTER = 20               # requests launched before the hard kill
+SYS_PREFIX = [(7 * i + 3) % 250 + 1 for i in range(16)]    # 2 full pages
+
+
+def _prompt(uid):
+    return SYS_PREFIX + [(uid * 13 + j) % 250 + 1
+                         for j in range((uid % 4) + 1)]
+
+
+def _max_new(uid):
+    return 4 + (uid % 5)
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def _mk_replica(tiny_lm):
+    model, params = tiny_lm
+    eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        max_tokens=32, max_seqs=4, max_ctx=64, block_size=8,
+        dtype=jnp.float32, attn_impl="paged", prefix_cache=True))
+    sched = LifecycleScheduler(eng, window_steps=4, max_queue=64)
+    srv = ServingServer(sched, port=0, bind="127.0.0.1").start()
+    return eng, sched, srv
+
+
+def _stream(base, uid, out):
+    """One SSE client; records tokens, terminal state, typed errors."""
+    rec = {"uid": uid, "tokens": [], "terminal": None, "error": None}
+    out[uid] = rec
+    body = json.dumps({"prompt": _prompt(uid),
+                       "max_new_tokens": _max_new(uid),
+                       "stream": True}).encode()
+    req = urllib.request.Request(base + "/v1/generate", data=body)
+    try:
+        with urllib.request.urlopen(req, timeout=600) as r:
+            for line in r:
+                line = line.decode()
+                if not line.startswith("data: "):
+                    continue
+                d = json.loads(line[len("data: "):])
+                if "error" in d:
+                    rec["error"] = d
+                    return
+                rec["tokens"] += d.get("tokens") or []
+                if d.get("finish_reason") is not None:
+                    rec["terminal"] = d
+                    return
+        rec["error"] = {"error": "eof_without_terminal"}
+    except Exception as e:  # noqa: BLE001 — a cut stream is data, not a bug
+        rec["error"] = {"error": repr(e)}
+
+
+def test_fleet_chaos_replica_killed_mid_run(tiny_lm):
+    model, params = tiny_lm
+    # unperturbed references: greedy decode is replica-independent, so
+    # one local engine supplies the oracle for every request
+    ref_eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        max_tokens=32, max_seqs=4, max_ctx=64, block_size=8,
+        dtype=jnp.float32, attn_impl="paged"))
+    refs = {}
+    for uid in range(N_REQ):
+        key = (tuple(_prompt(uid)), _max_new(uid))
+        if key not in refs:
+            refs[key] = ref_eng.generate([_prompt(uid)],
+                                         max_new_tokens=_max_new(uid))[0]
+
+    replicas = [_mk_replica(tiny_lm) for _ in range(N_REPLICAS)]
+    router = FleetRouter(poll_s=0.3)
+    for i, (_, _, srv) in enumerate(replicas):
+        router.add_replica(f"127.0.0.1:{srv.port}", name=f"r{i}")
+    rs = RouterServer(router, port=0, bind="127.0.0.1").start()
+    base = f"http://127.0.0.1:{rs.port}"
+    out, threads = {}, []
+    try:
+        def launch(uid):
+            t = threading.Thread(target=_stream, args=(base, uid, out),
+                                 daemon=True)
+            t.start()
+            threads.append(t)
+
+        for uid in range(KILL_AFTER):
+            launch(uid)
+            time.sleep(0.05)            # staggered arrival waves
+        # -- the chaos: r0 dies without a goodbye -------------------- #
+        replicas[0][2].hard_kill()
+        killed_at = time.monotonic()
+        for uid in range(KILL_AFTER, N_REQ):
+            launch(uid)
+            time.sleep(0.03)
+        for t in threads:
+            t.join(timeout=600)
+        assert not any(t.is_alive() for t in threads), "stuck client"
+
+        # -- outcomes ------------------------------------------------ #
+        completed = [u for u in range(N_REQ)
+                     if out[u]["terminal"] is not None]
+        errored = [u for u in range(N_REQ) if out[u]["error"] is not None]
+        assert sorted(completed + errored) == list(range(N_REQ))
+        # every completed stream is bit-identical to the unperturbed run
+        for u in completed:
+            key = (tuple(_prompt(u)), _max_new(u))
+            assert out[u]["tokens"] == refs[key], \
+                f"uid {u} diverged: {out[u]['tokens']} != {refs[key]}"
+        # zero failed streams that weren't on the dead replica: every
+        # request submitted AFTER the kill completes (zero-token work
+        # re-routes transparently off the corpse)
+        post_kill_failures = [u for u in errored if u >= KILL_AFTER]
+        assert not post_kill_failures, \
+            f"post-kill streams failed: {post_kill_failures} " \
+            f"({[out[u]['error'] for u in post_kill_failures]})"
+        # only streams cut on the dead replica may have errored, and the
+        # kill can strand at most its in-flight + queued work
+        assert len(errored) <= KILL_AFTER
+        # typed mid-stream errors carry the retry hint
+        for u in errored:
+            err = out[u]["error"]
+            if err.get("error") == "replica_lost":
+                assert err["retry_after_s"] >= 0
+
+        # -- prefix reuse actually happened -------------------------- #
+        total_hits = sum(s.counters.get("serving/prefix_hits", 0)
+                        for _, s, _ in replicas[1:])
+        assert total_hits >= 1, "shared system prefix never reused"
+
+        # -- refcount baseline on the survivors ---------------------- #
+        for eng, sched, _ in replicas[1:]:
+            assert sched.pending == 0
+            al = eng.state_manager.allocator
+            cached = eng.prefix_cache.cached_blocks()
+            assert all(al.refcount(b) == 1 for b in cached), \
+                "live refs leaked on a surviving replica"
+            assert eng.state_manager.free_blocks == \
+                al.total_blocks - len(cached)
+
+        # -- live router /metrics scrape ----------------------------- #
+        with urllib.request.urlopen(base + "/metrics", timeout=10) as r:
+            text = r.read().decode()
+        scraped = {}
+        for ln in text.splitlines():
+            if ln.startswith("fleet_"):
+                name = ln.split("{")[0].split()[0]
+                try:
+                    scraped[name] = float(ln.split()[-1])
+                except ValueError:
+                    pass
+        assert scraped.get("fleet_replica_lost", 0) >= 1, scraped
+        assert scraped.get("fleet_rerouted", 0) >= 1, scraped
+        assert scraped.get("fleet_routed", 0) >= len(completed) - 1
+        assert time.monotonic() - killed_at < 600
+    finally:
+        rs.stop()
+        for _, _, srv in replicas[1:]:
+            srv.stop()

@@ -1,0 +1,447 @@
+"""dstpu-router fleet tier (markers: serving, fleet): balancing on
+scraped healthz drain-rate predictions, rotation of draining/saturated
+replicas, transparent retry of zero-token work off dead replicas, live
+replica registration, healthz content negotiation, the speculative-config
+forwarding regression (400 at admission on drafter-less replicas, not
+mid-stream), disaggregated prefill through the HTTP tier, and the
+telemetry fleet section."""
+import json
+import queue
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (
+    InferenceEngineV2,
+    RaggedInferenceEngineConfig,
+)
+from deepspeed_tpu.inference.v2.lifecycle import LifecycleScheduler
+from deepspeed_tpu.inference.v2.server import ServingServer
+from deepspeed_tpu.serving.fleet import (
+    FleetRouter,
+    ReplicaHandle,
+    RouterServer,
+)
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet]
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def mk_replica(tiny_lm, prefix_cache=True, drafter=False, block_size=8):
+    model, params = tiny_lm
+    eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        max_tokens=32, max_seqs=4, max_ctx=64, block_size=block_size,
+        dtype=jnp.float32, attn_impl="gather", prefix_cache=prefix_cache))
+    kwargs = {}
+    if drafter:
+        from deepspeed_tpu.inference.v2.speculative import (
+            NGramDrafter,
+            SpeculativeConfig,
+        )
+
+        kwargs = dict(speculative=SpeculativeConfig(mode="ngram", k=4),
+                      drafter=NGramDrafter())
+    sched = LifecycleScheduler(eng, window_steps=4, max_queue=16, **kwargs)
+    srv = ServingServer(sched, port=0, bind="127.0.0.1").start()
+    return eng, sched, srv
+
+
+@pytest.fixture(scope="module")
+def fleet(tiny_lm):
+    """Router over two decode replicas; torn down at module end."""
+    e0, s0, r0 = mk_replica(tiny_lm)
+    e1, s1, r1 = mk_replica(tiny_lm)
+    router = FleetRouter(poll_s=0.2)
+    router.add_replica(f"127.0.0.1:{r0.port}", name="r0")
+    router.add_replica(f"127.0.0.1:{r1.port}", name="r1")
+    rs = RouterServer(router, port=0, bind="127.0.0.1").start()
+    yield {"router": router, "server": rs,
+           "replicas": [(e0, s0, r0), (e1, s1, r1)]}
+    rs.stop()
+    for _, _, r in [(e0, s0, r0), (e1, s1, r1)]:
+        r.stop()
+
+
+def _post(rs, body, timeout=120, path="/v1/generate"):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{rs.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), json.loads(e.read())
+
+
+def _get(rs, path, timeout=10, accept=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{rs.port}{path}",
+        headers={"Accept": accept} if accept else {})
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return r.status, dict(r.headers), r.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, dict(e.headers), e.read().decode()
+
+
+# --------------------------------------------------------------------- #
+# Healthz negotiation (replica side) — the structured routing signal
+# --------------------------------------------------------------------- #
+class TestReplicaHealthz:
+    def test_json_body_has_routing_fields(self, fleet):
+        _, _, r0 = fleet["replicas"][0]
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{r0.port}/healthz", timeout=10) as r:
+            body = json.loads(r.read())
+        for field in ("state", "status", "queue_depth", "kv_pressure",
+                      "predicted_tok_per_s", "predicted_drain_s",
+                      "counters"):
+            assert field in body, field
+        assert body["state"] == body["status"]
+
+    def test_plain_text_negotiation(self, fleet):
+        _, _, r0 = fleet["replicas"][0]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{r0.port}/healthz",
+            headers={"Accept": "text/plain"})
+        with urllib.request.urlopen(req, timeout=10) as r:
+            assert r.headers["Content-Type"].startswith("text/plain")
+            assert r.read().decode().strip() == "healthy"
+
+
+# --------------------------------------------------------------------- #
+# Routing
+# --------------------------------------------------------------------- #
+class TestRouting:
+    def test_blocking_matches_engine(self, fleet):
+        rs = fleet["server"]
+        e0 = fleet["replicas"][0][0]
+        code, _, out = _post(rs, {"prompt": [3, 5, 7, 11],
+                                  "max_new_tokens": 6})
+        assert code == 200 and out["state"] == "finished"
+        assert out["tokens"] == e0.generate([[3, 5, 7, 11]],
+                                            max_new_tokens=6)[0]
+        assert fleet["router"].counters["fleet/routed"] >= 1
+
+    def test_streaming_matches_engine(self, fleet):
+        rs = fleet["server"]
+        e0 = fleet["replicas"][0][0]
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{rs.port}/v1/generate",
+            data=json.dumps({"prompt": [4, 5, 7, 11], "max_new_tokens": 6,
+                             "stream": True}).encode())
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.headers["Content-Type"].startswith(
+                "text/event-stream")
+            body = r.read().decode()
+        events = [json.loads(ln[len("data: "):])
+                  for ln in body.splitlines() if ln.startswith("data: ")]
+        streamed = [t for e in events for t in e["tokens"]]
+        assert streamed == e0.generate([[4, 5, 7, 11]],
+                                       max_new_tokens=6)[0]
+        assert events[-1]["state"] == "finished"
+
+    def test_draining_replica_rotated_out(self, fleet):
+        """Flip one replica to draining: its healthz goes 503, the router
+        rotates it out and every request lands on the survivor."""
+        router, rs = fleet["router"], fleet["server"]
+        _, s0, _ = fleet["replicas"][0]
+        _, s1, _ = fleet["replicas"][1]
+        s0.draining = True
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                router.scrape_all()
+                snap = {r["name"]: r["status"] for r in router.snapshot()}
+                if snap.get("r0") == "draining":
+                    break
+                time.sleep(0.1)
+            assert snap["r0"] == "draining"
+            done0 = s1.counters["serving/completed"]
+            code, _, out = _post(rs, {"prompt": [9, 9, 2],
+                                      "max_new_tokens": 4})
+            assert code == 200
+            assert s1.counters["serving/completed"] == done0 + 1
+        finally:
+            s0.draining = False
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                router.scrape_all()
+                if any(r["status"] == "healthy" and r["name"] == "r0"
+                       for r in router.snapshot()):
+                    break
+                time.sleep(0.1)
+
+    def test_balances_away_from_deep_queue(self, fleet):
+        """The drain-rate score routes around a backlogged replica."""
+        router = fleet["router"]
+        h0 = next(h for h in router.replicas() if h.name == "r0")
+        h1 = next(h for h in router.replicas() if h.name == "r1")
+        h0.queue_depth, h0.pending = 50, 4
+        h0.predicted_tok_per_s = 10.0
+        h1.queue_depth, h1.pending = 0, 0
+        h1.predicted_tok_per_s = 10.0
+        picked = {router._pick("decode", set()).name for _ in range(8)}
+        assert picked == {"r1"}
+        router.scrape_all()               # restore real scraped state
+
+    def test_fleet_healthz_aggregate_and_negotiation(self, fleet):
+        rs = fleet["server"]
+        code, _, body = _get(rs, "/healthz")
+        h = json.loads(body)
+        assert code == 200
+        assert h["status"] in ("healthy", "degraded")
+        assert h["registered"] == 2
+        assert {r["name"] for r in h["replicas"]} == {"r0", "r1"}
+        code, headers, body = _get(rs, "/healthz", accept="text/plain")
+        assert headers["Content-Type"].startswith("text/plain")
+        assert body.strip() in ("healthy", "degraded")
+
+    def test_metrics_scrape_has_fleet_counters(self, fleet):
+        code, _, text = _get(fleet["server"], "/metrics")
+        assert code == 200
+        assert "fleet_routed" in text
+
+    def test_live_registration_endpoint(self, fleet, tiny_lm):
+        rs = fleet["server"]
+        e2, s2, r2 = mk_replica(tiny_lm)
+        try:
+            code, _, out = _post(rs, {"url": f"127.0.0.1:{r2.port}",
+                                      "name": "r2"}, path="/replicas")
+            assert code == 200
+            assert out["registered"]["name"] == "r2"
+            code, _, body = _get(rs, "/replicas")
+            assert "r2" in {r["name"]
+                            for r in json.loads(body)["replicas"]}
+            # duplicate registration is a 409, not a silent overwrite
+            code, _, _ = _post(rs, {"url": f"127.0.0.1:{r2.port}",
+                                    "name": "r2"}, path="/replicas")
+            assert code == 409
+        finally:
+            fleet["router"].remove_replica("r2")
+            r2.stop()
+
+
+# --------------------------------------------------------------------- #
+# Reroute semantics
+# --------------------------------------------------------------------- #
+class TestReroute:
+    def test_zero_token_request_reroutes_off_dead_replica(self, tiny_lm):
+        """A replica that dies before producing anything: the router
+        notes the failure, reroutes transparently, the client sees a
+        normal 200."""
+        e0, s0, r0 = mk_replica(tiny_lm)
+        e1, s1, r1 = mk_replica(tiny_lm)
+        router = FleetRouter(poll_s=30.0)       # no scrape rescue: the
+        dead = router.add_replica(f"127.0.0.1:{r0.port}", name="dead")
+        alive = router.add_replica(f"127.0.0.1:{r1.port}", name="alive")
+        rs = RouterServer(router, port=0, bind="127.0.0.1").start()
+        try:
+            r0.hard_kill()                      # request path finds out
+            # bias the balancing score so the DEAD replica wins the pick:
+            # the reroute, not the pick, is under test
+            alive.queue_depth = 10
+            code, _, out = _post(rs, {"prompt": [5, 6, 7],
+                                      "max_new_tokens": 4})
+            assert code == 200 and out["state"] == "finished"
+            assert router.counters["fleet/rerouted"] >= 1
+        finally:
+            rs.stop()
+            r1.stop()
+
+    def test_all_dead_is_fleet_shed_with_retry_after(self, tiny_lm):
+        e0, s0, r0 = mk_replica(tiny_lm)
+        router = FleetRouter(poll_s=30.0)
+        router.add_replica(f"127.0.0.1:{r0.port}")
+        rs = RouterServer(router, port=0, bind="127.0.0.1").start()
+        try:
+            r0.hard_kill()
+            code, headers, out = _post(rs, {"prompt": [1, 2],
+                                            "max_new_tokens": 2})
+            assert code == 503
+            assert int(headers["Retry-After"]) >= 1
+            assert router.counters["fleet/shed"] >= 1
+        finally:
+            rs.stop()
+
+
+# --------------------------------------------------------------------- #
+# Speculative config threading (regression)
+# --------------------------------------------------------------------- #
+class TestSpeculativeThreading:
+    def test_no_drafter_replica_400s_at_admission(self, fleet):
+        """speculative:{mode,k} forwarded verbatim; the drafter-less
+        replica rejects at ADMISSION with reason no_drafter — the request
+        never reaches a decode window."""
+        rs = fleet["server"]
+        s0 = fleet["replicas"][0][1]
+        req0 = s0.counters["serving/requests"]
+        code, _, out = _post(rs, {"prompt": [1, 2, 3], "max_new_tokens": 4,
+                                  "speculative": {"mode": "ngram", "k": 4}})
+        assert code == 400
+        assert out["reason"] == "no_drafter"
+        # forwarded verbatim and rejected pre-admission on every replica
+        assert all(r[1].counters["serving/requests"] ==
+                   (req0 if i == 0
+                    else r[1].counters["serving/requests"])
+                   for i, r in enumerate(fleet["replicas"][:1]))
+
+    def test_drafter_replica_accepts_and_runs_spec(self, tiny_lm):
+        """A drafter-equipped replica honors the forwarded override and
+        actually runs verify windows."""
+        e, s, r = mk_replica(tiny_lm, drafter=True)
+        router = FleetRouter(poll_s=0.2)
+        router.add_replica(f"127.0.0.1:{r.port}")
+        rs = RouterServer(router, port=0, bind="127.0.0.1").start()
+        try:
+            code, _, out = _post(rs, {
+                "prompt": [142] * 6, "max_new_tokens": 8,
+                "speculative": {"mode": "ngram", "k": 4}})
+            assert code == 200 and out["state"] == "finished"
+            assert s.counters["serving/spec_windows"] >= 1
+            ref = e.generate([[142] * 6], max_new_tokens=8)[0]
+            assert out["tokens"] == ref      # greedy spec stays bit-exact
+        finally:
+            rs.stop()
+            r.stop()
+
+
+# --------------------------------------------------------------------- #
+# Disaggregated prefill over HTTP
+# --------------------------------------------------------------------- #
+class TestDisaggHTTP:
+    @pytest.mark.parametrize("wire", ["fp32", "int8"])
+    def test_long_prompt_disaggregates(self, tiny_lm, wire):
+        ed, sd, rd = mk_replica(tiny_lm, block_size=8)
+        ep, sp, rp = mk_replica(tiny_lm, block_size=16)
+        router = FleetRouter(poll_s=0.2, disagg_threshold=8, wire=wire)
+        router.add_replica(f"127.0.0.1:{rd.port}", role="decode")
+        router.add_replica(f"127.0.0.1:{rp.port}", role="prefill")
+        rs = RouterServer(router, port=0, bind="127.0.0.1").start()
+        try:
+            prompt = [3, 5, 7, 11, 13, 17, 19, 23, 29, 31]
+            code, _, out = _post(rs, {"prompt": prompt,
+                                      "max_new_tokens": 6})
+            assert code == 200 and out["state"] == "finished"
+            assert sd.counters["serving/kv_import"] == 1
+            assert sp.counters["serving/prefill_exported"] == 1
+            assert router.counters["fleet/prefill_disagg"] == 1
+            assert router.counters["fleet/kv_ship_bytes"] > 0
+            if wire == "fp32":
+                ref = ed.generate([prompt], max_new_tokens=6)[0]
+                assert out["tokens"] == ref
+            # short prompts stay local
+            code, _, out = _post(rs, {"prompt": [1, 2, 3],
+                                      "max_new_tokens": 4})
+            assert code == 200
+            assert router.counters["fleet/prefill_disagg"] == 1
+        finally:
+            rs.stop()
+            rd.stop()
+            rp.stop()
+
+    def test_prefill_replica_death_falls_back(self, tiny_lm):
+        """Prefill replica dies: the router falls back to direct routing
+        — disaggregation is an optimization, never a liveness
+        dependency."""
+        ed, sd, rd = mk_replica(tiny_lm)
+        ep, sp, rp = mk_replica(tiny_lm)
+        router = FleetRouter(poll_s=30.0, disagg_threshold=8)
+        router.add_replica(f"127.0.0.1:{rd.port}", role="decode")
+        router.add_replica(f"127.0.0.1:{rp.port}", role="prefill")
+        rs = RouterServer(router, port=0, bind="127.0.0.1").start()
+        try:
+            rp.hard_kill()
+            prompt = [3, 5, 7, 11, 13, 17, 19, 23, 29]
+            code, _, out = _post(rs, {"prompt": prompt,
+                                      "max_new_tokens": 6})
+            assert code == 200 and out["state"] == "finished"
+            assert router.counters["fleet/prefill_fallback"] >= 1
+            assert sd.counters.get("serving/kv_import", 0) == 0
+            ref = ed.generate([prompt], max_new_tokens=6)[0]
+            assert out["tokens"] == ref
+        finally:
+            rs.stop()
+            rd.stop()
+
+
+# --------------------------------------------------------------------- #
+# Telemetry: fleet section + incident digest
+# --------------------------------------------------------------------- #
+class TestFleetTelemetry:
+    def test_fleet_summary_section(self):
+        from deepspeed_tpu.telemetry.summary import (
+            fleet_summary,
+            format_summary,
+            summarize_run,
+        )
+
+        metrics = [
+            {"name": "fleet/routed", "type": "counter", "labels": {},
+             "value": 64},
+            {"name": "fleet/rerouted", "type": "counter", "labels": {},
+             "value": 3},
+            {"name": "fleet/replica_lost", "type": "counter",
+             "labels": {}, "value": 1},
+            {"name": "fleet/kv_ship_bytes", "type": "counter",
+             "labels": {}, "value": 4096},
+            {"name": "fleet/replicas_registered", "type": "gauge",
+             "labels": {}, "value": 3},
+            {"name": "fleet/replicas_routable", "type": "gauge",
+             "labels": {}, "value": 2},
+            {"name": "fleet/prefix_hit_rate", "type": "gauge",
+             "labels": {}, "value": 0.5},
+            {"name": "fleet/prefix_hit_tokens", "type": "gauge",
+             "labels": {}, "value": 320},
+            {"name": "fleet/replica_queue_depth", "type": "gauge",
+             "labels": {"replica": "r0"}, "value": 4},
+            {"name": "fleet/replica_kv_pressure", "type": "gauge",
+             "labels": {"replica": "r0"}, "value": 0.25},
+        ]
+        out = fleet_summary(metrics)
+        assert out["counters"]["routed"] == 64
+        assert out["counters"]["replica_lost"] == 1
+        assert out["replicas"]["r0"]["queue_depth"] == 4
+        assert out["prefix_hit_rate"] == 0.5
+        text = format_summary({
+            "sources": {"events": None, "trace": None, "xprof": None},
+            "runs_in_log": 1, "n_spans": 0, "step_breakdown": [],
+            "comm": [], "overlap": {}, "serving": {}, "fleet": out,
+            "profile": {}, "xprof": None, "memory": {},
+            "incidents": {"event_counts": {}, "incidents": [],
+                          "checkpoints": []},
+        })
+        assert "serving fleet" in text
+        assert "prefix-cache hit rate 50.0%" in text
+        assert "replica_lost=1" in text
+
+    def test_fleet_events_register_as_incidents(self):
+        from deepspeed_tpu.telemetry.summary import (
+            EVENT_KINDS_INCIDENT,
+            incident_summary,
+        )
+
+        for kind in ("fleet_replica_lost", "fleet_mid_stream_error",
+                     "fleet_prefill_fallback"):
+            assert kind in EVENT_KINDS_INCIDENT
+        inc = incident_summary([
+            {"kind": "fleet_replica_lost", "name": "r0"},
+            {"kind": "fleet_router_start"},
+        ])
+        assert any(e["kind"] == "fleet_replica_lost"
+                   for e in inc["incidents"])
+        assert not any(e.get("kind") == "fleet_router_start"
+                       for e in inc["incidents"])

@@ -1,0 +1,190 @@
+"""Live KV shipping for disaggregated prefill (markers: serving, fleet):
+export→import continuation bit-exact vs local prefill under both attention
+impls, page-geometry resharding (different block sizes per replica), wire
+framing roundtrips, the int8 fused-wire error bound, and the lifecycle's
+prefill_only / kv_import composition incl. the mismatch guard."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (
+    InferenceEngineV2,
+    RaggedInferenceEngineConfig,
+)
+from deepspeed_tpu.inference.v2.kv_ship import (
+    KVShipment,
+    export_kv,
+    from_b64,
+    from_wire,
+    import_kv,
+    int8_error_bound,
+    to_b64,
+    to_wire,
+)
+from deepspeed_tpu.inference.v2.lifecycle import (
+    LifecycleScheduler,
+    RequestState,
+    ServeRequest,
+)
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet]
+
+PROMPT = [3, 5, 7, 11, 13, 17, 19, 23, 29, 31, 6]
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def mk_engine(tiny_lm, impl="gather", block_size=8):
+    model, params = tiny_lm
+    return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        max_tokens=32, max_seqs=4, max_ctx=64, block_size=block_size,
+        dtype=jnp.float32, attn_impl=impl))
+
+
+def prefill_shipment(tiny_lm, tokens, impl="gather", block_size=8):
+    """Run a prefill_only request and return its exported shipment."""
+    eng = mk_engine(tiny_lm, impl, block_size)
+    sched = LifecycleScheduler(eng, window_steps=4)
+    sched.submit(ServeRequest(uid=0, prompt=tokens, max_new_tokens=0,
+                              prefill_only=True))
+    sched.run_until_idle()
+    req = sched.request(0)
+    assert req.state == RequestState.FINISHED
+    assert req.finish_reason == "prefill_done"
+    assert req.kv_shipment is not None and req.produced == []
+    # the producer released every block at retirement
+    assert eng.state_manager.free_blocks == \
+        eng.state_manager.allocator.total_blocks
+    return req.kv_shipment
+
+
+# --------------------------------------------------------------------- #
+# Continuation bit-exactness
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("impl", ["gather", "paged"])
+@pytest.mark.parametrize("dst_block_size", [8, 16])
+def test_disagg_continuation_bit_exact(tiny_lm, impl, dst_block_size):
+    """Prefill prompt[:-1] on one engine, ship, graft into another with a
+    (possibly different) page geometry, decode — bit-identical to a fully
+    local run."""
+    ref = mk_engine(tiny_lm, impl, dst_block_size).generate(
+        [PROMPT], max_new_tokens=6)[0]
+    ship = prefill_shipment(tiny_lm, PROMPT[:-1], impl, block_size=8)
+    assert ship.n_tokens == len(PROMPT) - 1
+
+    dec = mk_engine(tiny_lm, impl, dst_block_size)
+    sched = LifecycleScheduler(dec, window_steps=4)
+    sched.submit(ServeRequest(uid=9, prompt=PROMPT, max_new_tokens=6,
+                              kv_import=ship))
+    sched.run_until_idle()
+    assert sched.counters["serving/kv_import"] == 1
+    assert sched.counters["serving/kv_import_tokens"] == ship.n_tokens
+    assert list(sched.request(9).produced) == ref
+    assert dec.state_manager.free_blocks == \
+        dec.state_manager.allocator.total_blocks
+
+
+def test_import_mismatch_rejected_at_admission(tiny_lm):
+    """A shipment whose tokens don't prefix the request's prompt is a
+    poisoned handoff: the request retires as rejected BEFORE any forward
+    runs, and no blocks leak."""
+    ship = prefill_shipment(tiny_lm, PROMPT[:-1])
+    dec = mk_engine(tiny_lm)
+    sched = LifecycleScheduler(dec, window_steps=4)
+    wrong = [99] + PROMPT[1:]
+    sched.submit(ServeRequest(uid=1, prompt=wrong, max_new_tokens=6,
+                              kv_import=ship))
+    sched.run_until_idle()
+    assert sched.request(1).state == RequestState.FAILED
+    assert sched.request(1).finish_reason == "impossible"
+    assert dec.state_manager.free_blocks == \
+        dec.state_manager.allocator.total_blocks
+
+
+def test_import_geometry_mismatch_raises(tiny_lm):
+    ship = prefill_shipment(tiny_lm, PROMPT[:-1])
+    bad = KVShipment(tokens=ship.tokens, num_layers=ship.num_layers + 1,
+                     num_kv_heads=ship.num_kv_heads,
+                     head_dim=ship.head_dim,
+                     src_block_size=ship.src_block_size,
+                     wire="fp32", rows=ship.rows)
+    with pytest.raises(ValueError, match="geometry mismatch"):
+        import_kv(mk_engine(tiny_lm), bad, uid=2)
+
+
+def test_export_is_a_read_shared_pages_survive(tiny_lm):
+    """Exporting doesn't disturb the source: the sequence keeps decoding
+    bit-exactly after an export."""
+    eng = mk_engine(tiny_lm)
+    logits = eng.put([0], [PROMPT])
+    seed = int(jnp.argmax(logits[0]))
+    ship = export_kv(eng, 0, PROMPT)
+    assert ship.n_tokens == len(PROMPT)
+    toks = [int(t) for t in eng.decode_batch([0], [seed], 4)[:, 0]]
+    eng2 = mk_engine(tiny_lm)
+    logits2 = eng2.put([0], [PROMPT])
+    ref = [int(t) for t in eng2.decode_batch(
+        [0], [int(jnp.argmax(logits2[0]))], 4)[:, 0]]
+    assert toks == ref
+
+
+# --------------------------------------------------------------------- #
+# Wire formats
+# --------------------------------------------------------------------- #
+def test_fp32_wire_roundtrip_bit_exact(tiny_lm):
+    ship = prefill_shipment(tiny_lm, PROMPT[:-1])
+    back = from_wire(to_wire(ship, "fp32"))
+    assert back.tokens == ship.tokens
+    assert back.src_block_size == ship.src_block_size
+    assert np.array_equal(back.rows, ship.rows.astype(np.float32))
+    b64 = from_b64(to_b64(ship, "fp32"))
+    assert np.array_equal(b64.rows, ship.rows.astype(np.float32))
+
+
+def test_int8_wire_error_bounded(tiny_lm):
+    """The int8 page wire (PR-9 fused-wire quantizer) stays within half a
+    quantization step of the fp32 rows — elementwise, against the
+    per-group scales it shipped."""
+    from deepspeed_tpu.ops.quantizer.quantizer import quant_pack_wire
+
+    ship = prefill_shipment(tiny_lm, PROMPT[:-1])
+    back = from_wire(to_wire(ship, "int8"))
+    diff = np.abs(back.rows - ship.rows.astype(np.float32)).reshape(-1)
+    _, scales = quant_pack_wire(jnp.asarray(ship.rows), bits=8,
+                                group_size=256)
+    bound = int8_error_bound(np.asarray(scales), 256, diff.size)
+    assert (diff <= bound).all(), \
+        f"int8 wire error {diff.max()} above bound"
+    assert diff.max() > 0            # it IS lossy; the bound is doing work
+
+
+def test_int8_wire_continuation_stays_close(tiny_lm):
+    """int8-shipped KV still decodes: the graft succeeds and the stream
+    matches the fp32-shipped stream on this model (tiny logit margins
+    would flag a broken dequant immediately)."""
+    ship = prefill_shipment(tiny_lm, PROMPT[:-1])
+    streams = {}
+    for wire in ("fp32", "int8"):
+        dec = mk_engine(tiny_lm)
+        sched = LifecycleScheduler(dec, window_steps=4)
+        sched.submit(ServeRequest(
+            uid=3, prompt=PROMPT, max_new_tokens=6,
+            kv_import=from_wire(to_wire(ship, wire))))
+        sched.run_until_idle()
+        assert sched.request(3).state == RequestState.FINISHED
+        streams[wire] = list(sched.request(3).produced)
+    assert streams["fp32"] == streams["int8"]
+
+
+def test_bad_frame_rejected(tiny_lm):
+    with pytest.raises(ValueError, match="DSKV1"):
+        from_wire(b"not a frame at all")
+    with pytest.raises(ValueError, match="wire"):
+        to_wire(prefill_shipment(tiny_lm, PROMPT[:2]), "fp64")

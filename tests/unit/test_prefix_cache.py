@@ -1,0 +1,301 @@
+"""Radix prefix KV cache (markers: serving, fleet): allocator refcounts,
+trie match/commit/evict, the copy-on-write invariant for shared partial
+pages, prefix-hit prefill skipping pages bit-exactly under both attention
+impls, eviction under allocation pressure, and refcount baselines after
+every request retires."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (
+    InferenceEngineV2,
+    RaggedInferenceEngineConfig,
+)
+from deepspeed_tpu.inference.v2.lifecycle import (
+    LifecycleScheduler,
+    RequestState,
+    ServeRequest,
+)
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import (
+    BlockedAllocator,
+)
+from deepspeed_tpu.inference.v2.ragged.prefix_cache import RadixPrefixCache
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+
+pytestmark = [pytest.mark.serving, pytest.mark.fleet]
+
+BS = 8
+SYS_PROMPT = [7, 3, 9, 4, 11, 6, 2, 8, 13, 5, 1]       # 1 full page + 3
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def mk_engine(tiny_lm, impl="gather", prefix_cache=True, num_blocks=None):
+    model, params = tiny_lm
+    return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        max_tokens=32, max_seqs=4, max_ctx=64, block_size=BS,
+        num_blocks=num_blocks, dtype=jnp.float32, attn_impl=impl,
+        prefix_cache=prefix_cache))
+
+
+# --------------------------------------------------------------------- #
+# Allocator refcounts
+# --------------------------------------------------------------------- #
+class TestAllocatorRefcounts:
+    def test_allocate_ref_free_lifecycle(self):
+        al = BlockedAllocator(4)
+        blocks = al.allocate(2)
+        assert al.free_blocks == 2
+        assert all(al.refcount(int(b)) == 1 for b in blocks)
+        al.ref(blocks)                          # second holder
+        al.free(blocks)                         # first holder releases
+        assert al.free_blocks == 2              # still held
+        assert all(al.refcount(int(b)) == 1 for b in blocks)
+        al.free(blocks)                         # last holder releases
+        assert al.free_blocks == 4
+        assert all(al.refcount(int(b)) == 0 for b in blocks)
+
+    def test_ref_of_free_block_raises(self):
+        al = BlockedAllocator(2)
+        with pytest.raises(ValueError, match="free block"):
+            al.ref([0])
+
+    def test_free_of_free_block_raises(self):
+        al = BlockedAllocator(2)
+        b = al.allocate(1)
+        al.free(b)
+        with pytest.raises(ValueError, match="already-free"):
+            al.free(b)
+
+    def test_shared_block_not_reallocated_until_released(self):
+        al = BlockedAllocator(2)
+        blocks = al.allocate(2)
+        al.ref([int(blocks[0])])
+        al.free(blocks)
+        # block 0 still held by the second ref; only block 1 is free
+        got = al.allocate(1)
+        assert int(got[0]) == int(blocks[1])
+
+
+# --------------------------------------------------------------------- #
+# Trie mechanics (no engine)
+# --------------------------------------------------------------------- #
+class TestRadixTrie:
+    def mk(self, num_blocks=16):
+        al = BlockedAllocator(num_blocks)
+        return al, RadixPrefixCache(al, block_size=4)
+
+    def commit_seq(self, al, cache, tokens, allow_partial=True):
+        n_pages = -(-len(tokens) // 4)
+        blocks = [int(b) for b in al.allocate(n_pages)]
+        cache.commit(tokens, blocks, allow_partial=allow_partial)
+        al.free(blocks)                         # sequence retires
+        return blocks
+
+    def test_match_full_and_partial_pages(self):
+        al, cache = self.mk()
+        self.commit_seq(al, cache, [1, 2, 3, 4, 5, 6])   # page + 2-leaf
+        m, blocks, partial = cache.match([1, 2, 3, 4, 5, 6, 7])
+        assert m == 6 and len(blocks) == 2 and partial == 2
+        m, blocks, partial = cache.match([1, 2, 3, 4, 9, 9])
+        assert m == 4 and len(blocks) == 1 and partial == 0
+        m, blocks, partial = cache.match([9, 1, 2, 3])
+        assert m == 0 and not blocks
+
+    def test_match_leaves_one_token_to_prefill(self):
+        al, cache = self.mk()
+        self.commit_seq(al, cache, [1, 2, 3, 4])
+        # identical prompt: the match must NOT swallow the whole prompt
+        m, blocks, partial = cache.match([1, 2, 3, 4])
+        assert m == 0
+        m, blocks, partial = cache.match([1, 2, 3, 4, 5])
+        assert m == 4
+
+    def test_commit_dedup_first_committer_wins(self):
+        al, cache = self.mk()
+        b1 = self.commit_seq(al, cache, [1, 2, 3, 4])
+        free_before = al.free_blocks
+        n = cache.nodes
+        b2 = [int(b) for b in al.allocate(1)]
+        assert cache.commit([1, 2, 3, 4], b2) == 0    # already attested
+        al.free(b2)
+        assert cache.nodes == n
+        assert al.free_blocks == free_before
+        m, blocks, _ = cache.match([1, 2, 3, 4, 5])
+        assert blocks == [b1[0]]
+
+    def test_evict_lru_leaf_only_at_refcount_one(self):
+        al, cache = self.mk(num_blocks=8)
+        self.commit_seq(al, cache, [1, 2, 3, 4, 5, 6, 7, 8])   # chain of 2
+        self.commit_seq(al, cache, [9, 10, 11, 12])
+        assert cache.nodes == 3
+        # a live holder pins its page against eviction
+        m, blocks, _ = cache.match([9, 10, 11, 12, 13])
+        al.ref(blocks)
+        freed = cache.evict(8)
+        assert freed == 2                     # only the unpinned chain
+        assert cache.nodes == 1
+        al.free(blocks)
+        assert cache.evict(8) == 1            # now reclaimable
+        assert al.free_blocks == 8
+
+    def test_reclaimable_counts_cold_chains(self):
+        al, cache = self.mk()
+        self.commit_seq(al, cache, [1, 2, 3, 4, 5, 6, 7, 8])
+        assert cache.reclaimable_blocks() == 2
+        m, blocks, _ = cache.match([1, 2, 3, 4, 9])
+        al.ref(blocks)                        # pin the interior page
+        assert cache.reclaimable_blocks() == 1
+        al.free(blocks)
+
+
+# --------------------------------------------------------------------- #
+# Engine integration: bit-exactness, CoW, refcount baselines
+# --------------------------------------------------------------------- #
+@pytest.mark.parametrize("impl", ["gather", "paged"])
+def test_prefix_hit_bit_exact_and_pages_skipped(tiny_lm, impl):
+    """Two requests sharing a system prompt: the second grafts >=1 page
+    instead of recomputing, and BOTH streams are bit-identical to a
+    cache-disabled run."""
+    prompts = [SYS_PROMPT + [21, 22], SYS_PROMPT + [33, 34, 35]]
+    refs = {}
+    eng = mk_engine(tiny_lm, impl, prefix_cache=False)
+    for u, p in enumerate(prompts):
+        refs[u] = eng.generate([p], max_new_tokens=8)[0]
+
+    eng = mk_engine(tiny_lm, impl, prefix_cache=True)
+    sched = LifecycleScheduler(eng, window_steps=4)
+    free0 = eng.state_manager.free_blocks
+    for u, p in enumerate(prompts):
+        sched.submit(ServeRequest(uid=u, prompt=p, max_new_tokens=8))
+        sched.run_until_idle()            # sequential: second sees commits
+    for u in range(2):
+        assert list(sched.request(u).produced) == refs[u], f"uid {u}"
+    # >= 1 full page of prefill skipped, counted both places
+    assert sched.request(1).prefix_hit_tokens >= BS
+    assert sched.counters["serving/prefix_hits"] == 1
+    assert sched.counters["serving/prefix_hit_tokens"] >= BS
+    assert eng.prefix_cache.tokens_saved >= BS
+    # refcount baseline: only the trie holds the cached pages now
+    al = eng.state_manager.allocator
+    cached = eng.prefix_cache.cached_blocks()
+    assert all(al.refcount(b) == 1 for b in cached)
+    assert eng.state_manager.free_blocks == free0 - len(cached)
+    # dropping the cache returns the pool to its initial state
+    eng.prefix_cache.clear()
+    assert eng.state_manager.free_blocks == free0
+
+
+@pytest.mark.parametrize("impl", ["gather", "paged"])
+def test_concurrent_same_prefix_shares_pages(tiny_lm, impl):
+    """Staggered co-tenants: the prefix committed at the FIRST request's
+    prefill completion is grafted by the second while the first still
+    decodes — live sharing, not just after-the-fact reuse."""
+    eng = mk_engine(tiny_lm, impl)
+    sched = LifecycleScheduler(eng, window_steps=2)
+    p0, p1 = SYS_PROMPT + [21, 22], SYS_PROMPT + [33, 34, 35]
+    ref_eng = mk_engine(tiny_lm, impl, prefix_cache=False)
+    ref0 = ref_eng.generate([p0], max_new_tokens=8)[0]
+    ref1 = ref_eng.generate([p1], max_new_tokens=8)[0]
+
+    sched.submit(ServeRequest(uid=0, prompt=p0, max_new_tokens=8))
+    sched.step()                          # uid 0 prefills + commits
+    sched.submit(ServeRequest(uid=1, prompt=p1, max_new_tokens=8))
+    sched.run_until_idle()
+    assert sched.request(1).prefix_hit_tokens >= BS
+    assert list(sched.request(0).produced) == ref0
+    assert list(sched.request(1).produced) == ref1
+    # while both retired: shared page refcount is exactly the trie's 1
+    al = eng.state_manager.allocator
+    assert all(al.refcount(b) == 1
+               for b in eng.prefix_cache.cached_blocks())
+
+
+@pytest.mark.parametrize("impl", ["gather", "paged"])
+def test_partial_page_graft_is_copy_on_write(tiny_lm, impl):
+    """Grafting a PARTIAL page copies it before the first append: the
+    trie's original page bytes stay untouched while the grafting request
+    writes its own continuation into the copy."""
+    eng = mk_engine(tiny_lm, impl)
+    sched = LifecycleScheduler(eng, window_steps=4)
+    base = SYS_PROMPT                       # 8 full + 3 partial rows
+    sched.submit(ServeRequest(uid=0, prompt=base + [21], max_new_tokens=4))
+    sched.run_until_idle()
+    cache = eng.prefix_cache
+    # the retire-time commit attested the partial page [13, 5, 1, 21]
+    m, blocks, partial = cache.match(base + [21, 40, 41])
+    assert partial > 0 and m == len(base) + 1
+    shared_block = blocks[-1]
+    nb = eng.kv.config.num_blocks
+    phys = [shared_block + layer * nb
+            for layer in range(eng.cfg.num_layers)]
+    before = np.asarray(eng.kv.pages[jnp.asarray(phys)])
+
+    sched.submit(ServeRequest(uid=1, prompt=base + [21, 40, 41],
+                              max_new_tokens=4))
+    sched.run_until_idle()
+    assert sched.request(1).state == RequestState.FINISHED
+    assert sched.request(1).prefix_hit_tokens == m
+    after = np.asarray(eng.kv.pages[jnp.asarray(phys)])
+    assert np.array_equal(before, after), \
+        "shared partial page mutated by a grafting request (CoW broken)"
+    # and the grafted stream is still bit-exact vs a cold engine
+    ref = mk_engine(tiny_lm, impl, prefix_cache=False).generate(
+        [base + [21, 40, 41]], max_new_tokens=4)[0]
+    assert list(sched.request(1).produced) == ref
+
+
+def test_eviction_under_pressure_keeps_admission_alive(tiny_lm):
+    """A pool sized so cached pages MUST be evicted for the next request
+    to fit: admission succeeds (cache yields, LRU first), requests stay
+    bit-exact, and the pool never deadlocks on trie-held pages."""
+    eng = mk_engine(tiny_lm, num_blocks=6)     # 6 pages of 8 = 48 tokens
+    sched = LifecycleScheduler(eng, window_steps=4, kv_high_watermark=0.99)
+    ref_eng = mk_engine(tiny_lm, prefix_cache=False)
+    prompts = [[10 + i] * 9 for i in range(4)]   # 2 pages each, disjoint
+    refs = [ref_eng.generate([p], max_new_tokens=4)[0] for p in prompts]
+    for u, p in enumerate(prompts):
+        sched.submit(ServeRequest(uid=u, prompt=p, max_new_tokens=4))
+        sched.run_until_idle()
+        assert sched.request(u).state == RequestState.FINISHED
+        assert list(sched.request(u).produced) == refs[u]
+    assert eng.prefix_cache.evicted >= 1
+    # live-holder pages were never evicted: every request completed
+    assert sched.counters["serving/completed"] == 4
+
+
+def test_preemption_composes_with_prefix_cache(tiny_lm):
+    """KV-pressure preemption on a prefix-cache engine: the victim's
+    resume re-grafts its own committed prefix and the stream stays
+    bit-exact; all non-trie blocks return to the pool."""
+    model, params = tiny_lm
+    eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        max_tokens=32, max_seqs=4, max_ctx=64, block_size=BS,
+        num_blocks=10, dtype=jnp.float32, attn_impl="gather",
+        prefix_cache=True))
+    sched = LifecycleScheduler(eng, window_steps=2, kv_high_watermark=0.25)
+    ref_eng = mk_engine(tiny_lm, prefix_cache=False)
+    p_small, p_big = [5, 6, 7], [40 + i % 11 for i in range(30)]
+    ref_small = ref_eng.generate([p_small], max_new_tokens=20)[0]
+    ref_big = ref_eng.generate([p_big], max_new_tokens=32)[0]
+
+    # uid 0 reserves 3 of 10 blocks; uid 1 needs 8 (30 prompt + 32 budget,
+    # eos-less) — only preempting uid 0 can admit it
+    sched.submit(ServeRequest(uid=0, prompt=p_small, max_new_tokens=20))
+    sched.step()
+    sched.step()
+    sched.submit(ServeRequest(uid=1, prompt=p_big, max_new_tokens=32))
+    sched.run_until_idle()
+    assert sched.counters["serving/preempted"] >= 1
+    assert list(sched.request(0).produced) == ref_small
+    assert list(sched.request(1).produced) == ref_big
+    al = eng.state_manager.allocator
+    cached = eng.prefix_cache.cached_blocks()
+    assert all(al.refcount(b) == 1 for b in cached)
+    assert eng.state_manager.free_blocks == 10 - len(cached)

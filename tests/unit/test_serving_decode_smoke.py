@@ -4,11 +4,14 @@ impls, the request-lifecycle scenario (deadline expiry mid-window with
 block reclaim + unperturbed survivor stream), the speculative-decoding
 scenario (planted-repetition prompt → n-gram drafter accepts >=1
 multi-token verify window → stream bit-identical to vanilla → blocks
-reclaimed, both impls), and the real `dstpu-serve` graceful-drain
-scenario (SIGTERM during active decode → draining healthz → 503 for new
-work → completed in-flight response → exit 0) — all on the CPU sim, same
-enforcement pattern as the no-bare-print lint, so the serving stack
-cannot rot silently while the TPU relay is down."""
+reclaimed, both impls), the real `dstpu-serve` graceful-drain scenario
+(SIGTERM during active decode → draining healthz → 503 for new work →
+completed in-flight response → exit 0), and the FLEET scenario (real
+`dstpu-router` over two `--prefix-cache` replicas: prefix-cached request
+pair answers bit-identically to the cold replica with a counted cache
+hit; SIGTERM-draining one replica loses zero streams and exits 0) — all
+on the CPU sim, same enforcement pattern as the no-bare-print lint, so
+the serving stack cannot rot silently while the TPU relay is down."""
 import os
 import subprocess
 import sys
@@ -26,7 +29,8 @@ class TestServingSmoke:
     def test_smoke_check_passes(self):
         """This IS the CI gate: every scenario (decode parity + roofline,
         lifecycle expiry/reclaim, spec-dec bit-exactness + acceptance,
-        dstpu-serve drain) must hold."""
+        dstpu-serve drain, fleet router + prefix-cache + replica drain)
+        must hold."""
         proc = subprocess.run([sys.executable, CHECK],
                               capture_output=True, text=True, timeout=900)
         assert proc.returncode == 0, \

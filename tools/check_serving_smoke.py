@@ -25,9 +25,16 @@ the same way the no-bare-print lint is:
     BOTH attention impls; the drafter must accept at least one
     multi-token window, the greedy stream must be bit-identical to
     vanilla decode, and every KV block must be reclaimed.
+  * ``fleet``     — the fleet tier with REAL processes: ``bin/dstpu-router``
+    over two ``bin/dstpu-serve --prefix-cache`` replicas; a prefix-cached
+    request pair on one replica must land a cache hit AND answer
+    bit-identically to the cold replica; requests through the router
+    succeed; SIGTERM-draining one replica mid-stream loses ZERO streams
+    (in-flight finishes, new work routes to the survivor, drained
+    replica exits 0).
 
 Usage: ``python tools/check_serving_smoke.py
-[--scenario all|decode|lifecycle|drain|specdec]``
+[--scenario all|decode|lifecycle|drain|specdec|fleet]``
 Exit status 1 lists what broke.
 """
 from __future__ import annotations
@@ -359,11 +366,144 @@ def scenario_drain(check):
             proc.kill()
 
 
+def _spawn(argv_tail, marker, telemetry_dir, timeout=120):
+    """Start a bin/ server subprocess and read its bound port off the
+    '<marker> listening on' stdout line; returns (proc, port, tail).
+
+    The banner wait runs on a reader thread: a child that wedges before
+    printing (stdout open, nothing coming) must fail THIS deadline, not
+    sit in a blocked readline() until some outer test timeout."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable] + argv_tail +
+        ["--telemetry-dir", telemetry_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    found = threading.Event()
+    state = {"port": None}
+    tail = []
+
+    def _pump():
+        for line in proc.stdout:
+            if not found.is_set() and f"{marker} listening on" in line:
+                state["port"] = int(line.rsplit(":", 1)[1])
+                found.set()
+            tail.append(line)
+            del tail[:-50]
+        found.set()                     # EOF: child died before the banner
+
+    threading.Thread(target=_pump, daemon=True).start()
+    found.wait(timeout)
+    return proc, state["port"], tail
+
+
+def scenario_fleet(check):
+    """Real processes: dstpu-router over two --prefix-cache dstpu-serve
+    replicas.  Prefix pair lands a cache hit bit-identical to the cold
+    replica; SIGTERM-draining one replica loses zero streams."""
+    procs = []
+    try:
+        ports = []
+        for i in range(2):
+            proc, port, _tail = _spawn(
+                [os.path.join(REPO_ROOT, "bin", "dstpu-serve"),
+                 "--port", "0", "--bind", "127.0.0.1",
+                 "--max-tokens", "32", "--max-seqs", "4",
+                 "--max-ctx", "96", "--block-size", "8",
+                 "--window-steps", "4", "--prefix-cache",
+                 "--drain-deadline", "300"],
+                "dstpu-serve", f"/tmp/dstpu_fleet_smoke_tel{i}")
+            procs.append(proc)
+            ports.append(port)
+        check("fleet: both replicas came up", all(ports), f"{ports}")
+        if not all(ports):
+            return
+        rproc, rport, _rtail = _spawn(
+            [os.path.join(REPO_ROOT, "bin", "dstpu-router"),
+             "--port", "0", "--bind", "127.0.0.1",
+             "--replica", f"127.0.0.1:{ports[0]}",
+             "--replica", f"127.0.0.1:{ports[1]}",
+             "--poll", "0.3", "--drain-deadline", "60"],
+            "dstpu-router", "/tmp/dstpu_fleet_smoke_rtel")
+        procs.append(rproc)
+        check("fleet: router came up", rport is not None)
+        if rport is None:
+            return
+        base = f"http://127.0.0.1:{rport}"
+        rep = [f"http://127.0.0.1:{p}" for p in ports]
+
+        code, body = _http("GET", f"{base}/healthz", timeout=30)
+        check("fleet: router healthz healthy with 2 routable",
+              code == 200 and body.get("routable") == 2, f"{code} {body}")
+
+        # -- prefix-cached pair on replica 0, cold oracle on replica 1 --
+        sys_prefix = [7, 3, 9, 4, 11, 6, 2, 8, 13, 5]
+        pair = [sys_prefix + [21], sys_prefix + [33, 34]]
+        for prompt in pair:
+            code, warm = _http("POST", f"{rep[0]}/v1/generate",
+                               {"prompt": prompt, "max_new_tokens": 6},
+                               timeout=300)
+            check(f"fleet: warm replica answered ({prompt[-1]})",
+                  code == 200, f"{code} {warm}")
+        code, cold = _http("POST", f"{rep[1]}/v1/generate",
+                           {"prompt": pair[1], "max_new_tokens": 6},
+                           timeout=300)
+        check("fleet: prefix hit bit-exact vs cold replica",
+              code == 200 and warm.get("tokens") == cold.get("tokens"),
+              f"warm={warm.get('tokens')} cold={cold.get('tokens')}")
+        code, health = _http("GET", f"{rep[0]}/healthz", timeout=30)
+        hits = (health.get("counters") or {}).get("serving/prefix_hits", 0)
+        check("fleet: replica 0 counted a prefix-cache hit", hits >= 1,
+              f"counters={health.get('counters')}")
+
+        # -- SIGTERM drain of replica 0 with zero failed streams -------
+        results = {}
+
+        def via_router(key, n_new):
+            results[key] = _http(
+                "POST", f"{base}/v1/generate",
+                {"prompt": [5, 6, 7, key], "max_new_tokens": n_new},
+                timeout=400)
+
+        tin = threading.Thread(target=via_router, args=(1, 48),
+                               daemon=True)
+        tin.start()
+        time.sleep(1.0)                 # let it land somewhere
+        procs[0].send_signal(signal.SIGTERM)
+        # new work keeps flowing while replica 0 drains
+        t2 = threading.Thread(target=via_router, args=(2, 8), daemon=True)
+        t2.start()
+        rc = procs[0].wait(timeout=330)
+        check("fleet: drained replica exited 0", rc == 0, f"rc={rc}")
+        tin.join(timeout=120)
+        t2.join(timeout=120)
+        for key in (1, 2):
+            code, body = results.get(key, (None, None))
+            check(f"fleet: stream {key} survived the drain",
+                  code == 200 and body.get("state") == "finished",
+                  f"code={code} body={str(body)[:200]}")
+        code, body = _http("GET", f"{base}/healthz", timeout=30)
+        check("fleet: router still routable after drain",
+              code == 200 and body.get("routable", 0) >= 1,
+              f"{code} {body}")
+    except Exception as exc:  # noqa: BLE001
+        check("fleet scenario", False, repr(exc)[-300:])
+    finally:
+        for proc in procs:
+            if proc.poll() is None:
+                proc.terminate()
+        for proc in procs:
+            try:
+                proc.wait(timeout=60)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--scenario", default="all",
                    choices=["all", "decode", "lifecycle", "drain",
-                            "specdec"])
+                            "specdec", "fleet"])
     args = p.parse_args(argv)
 
     failures = []
@@ -388,6 +528,8 @@ def main(argv=None) -> int:
         scenario_specdec(check)
     if args.scenario in ("all", "drain"):
         scenario_drain(check)
+    if args.scenario in ("all", "fleet"):
+        scenario_fleet(check)
 
     if failures:
         print("\n".join(failures))
