@@ -30,7 +30,7 @@ import subprocess
 import sys
 import time
 
-if os.environ.get("DSTPU_BENCH_MODE") == "pipeline" or (
+if os.environ.get("DSTPU_BENCH_MODE") in ("pipeline", "fleet_sweep") or (
         os.environ.get("DSTPU_BENCH_MODE") in ("overlap_sweep", "comm_sweep")
         and os.environ.get("DSTPU_BENCH_FORCE_CPU") == "1"):
     # pipeline bubbles (and the CPU fallback of the overlap sweep) are
@@ -1436,12 +1436,206 @@ def run_comm_sweep(on_tpu: bool) -> None:
           "backend": jax.default_backend(), "n_devices": n_dev})
 
 
+def run_fleet_sweep(on_tpu: bool) -> None:
+    """DSTPU_BENCH_MODE=fleet_sweep — tok/s vs replica count (1/2/3) on
+    the CPU sim over the REAL fleet tier: an in-process ``RouterServer``
+    + ``FleetRouter`` HTTP front over real ``ServingServer`` replicas
+    (tiny model), concurrent blocking clients.  Per point the bench
+    reports aggregate tok/s and the per-segment TTFT-decomposition
+    medians pulled from the new request-trace store (queue_wait /
+    admission / prefill / compile / decode_window …), plus a tracing-
+    overhead measurement: steady-state decode tok/s with the store at
+    default sampling vs tracing off, same warmed engines — the bound the
+    acceptance bar (<2%) is judged against.  CPU-sim numbers measure the
+    SCHEDULING plane (window packing, router fan-out, HTTP), not kernels;
+    scaling linearity is the signal."""
+    import itertools
+    import threading
+    import urllib.request
+
+    import jax.random as jrandom
+
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2,
+        RaggedInferenceEngineConfig,
+    )
+    from deepspeed_tpu.inference.v2.lifecycle import (
+        LifecycleScheduler,
+        ServeRequest,
+    )
+    from deepspeed_tpu.inference.v2.server import ServingServer
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+    from deepspeed_tpu.serving.fleet import FleetRouter, RouterServer
+    from deepspeed_tpu.telemetry.tracing import (
+        RequestTraceStore,
+        install_trace_store,
+    )
+
+    n_requests = int(os.environ.get("DSTPU_BENCH_FLEET_REQUESTS", "24"))
+    max_new = int(os.environ.get("DSTPU_BENCH_FLEET_TOKENS", "24"))
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    params = model.init_params(jrandom.PRNGKey(0))
+
+    def mk_replica():
+        eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            max_tokens=64, max_seqs=8, max_ctx=96, block_size=8,
+            dtype=jnp.float32, attn_impl="gather"))
+        sched = LifecycleScheduler(eng, window_steps=4, max_queue=64)
+        return ServingServer(sched, port=0, bind="127.0.0.1").start()
+
+    def post(port, body, timeout=600):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/generate",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=timeout) as r:
+            return json.loads(r.read())
+
+    prompts = [[3 + i % 7, 5 + i % 5, 7 + i % 3, 11] for i in
+               range(n_requests)]
+    points = []
+    for n_rep in (1, 2, 3):
+        install_trace_store(RequestTraceStore(sample_every=1))
+        replicas = [mk_replica() for _ in range(n_rep)]
+        router = FleetRouter(poll_s=0.2)
+        for i, r in enumerate(replicas):
+            router.add_replica(f"127.0.0.1:{r.port}", name=f"r{i}")
+        rs = RouterServer(router, port=0, bind="127.0.0.1").start()
+        try:
+            def client(results, i):
+                for attempt in (0, 1):      # one retry: a reset under
+                    try:                    # thundering-herd accept is
+                        results[i] = post(  # load, not a bench failure
+                            rs.port, {"prompt": prompts[i],
+                                      "max_new_tokens": max_new})
+                        return
+                    except Exception:  # noqa: BLE001
+                        if attempt:
+                            raise
+
+            def wave():
+                # per-wave result list, captured by this wave's threads:
+                # a client orphaned past the join timeout must write its
+                # late response into ITS wave's list, not a later tally
+                results = [None] * n_requests
+                threads = [threading.Thread(target=client,
+                                            args=(results, i),
+                                            daemon=True)
+                           for i in range(n_requests)]
+                t0 = time.perf_counter()
+                for t in threads:
+                    t.start()
+                for t in threads:
+                    t.join(timeout=600)
+                wall = time.perf_counter() - t0
+                return (wall,
+                        sum(len(r.get("tokens") or [])
+                            for r in results if r),
+                        sum(1 for r in results
+                            if r and r.get("state") == "finished"))
+
+            # warm waves compile every bucket this concurrency pattern
+            # touches — two of them, because balancing shifts the
+            # per-replica concurrency between waves and a replica only
+            # owns all its decode seq-buckets once it has seen a full
+            # set; a FRESH store then isolates the measured waves'
+            # decomposition from warmup compile spans.  Best-of-3 so one
+            # stray bucket compile cannot poison a point.
+            wave()
+            wave()
+            store = RequestTraceStore(sample_every=1)
+            install_trace_store(store)
+            wall, toks, ok = min((wave() for _ in range(3)),
+                                 key=lambda w: w[0])
+            decomp = {k: round((v.get("p50_s") or 0.0) * 1e3, 3)
+                      for k, v in store.segment_summary().items()}
+            point = {"replicas": n_rep, "requests": n_requests,
+                     "finished": ok, "tok_per_s": round(toks / wall, 2),
+                     "wall_s": round(wall, 3),
+                     "ttft_decomp_p50_ms": decomp}
+            points.append(point)
+            log(f"fleet_sweep {n_rep} replica(s): {point['tok_per_s']} "
+                f"tok/s ({ok}/{n_requests} finished) decomp={decomp}")
+        finally:
+            rs.stop()
+            for r in replicas:
+                r.stop()
+            install_trace_store(None)
+
+    # ---- tracing overhead: steady-state decode, store on vs off ------- #
+    n_oh_streams, n_oh_tokens = 8, 192
+    uid_seq = itertools.count(1000)
+
+    def sched_run(eng, store):
+        install_trace_store(store)
+        try:
+            from deepspeed_tpu.telemetry.tracing import TraceContext
+
+            s = LifecycleScheduler(eng, window_steps=8, max_queue=16)
+            uids = [next(uid_seq) for _ in range(n_oh_streams)]
+            for i, uid in enumerate(uids):
+                s.submit(ServeRequest(
+                    uid=uid, prompt=[3 + i, 5, 7],
+                    max_new_tokens=n_oh_tokens,
+                    trace=TraceContext.mint() if store else None))
+            t0 = time.perf_counter()
+            s.run_until_idle()
+            wall = time.perf_counter() - t0
+            toks = sum(len(s.request(u).produced) for u in uids)
+            return toks / wall
+        finally:
+            install_trace_store(None)
+
+    eng_oh = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        max_tokens=64, max_seqs=8, max_ctx=256, block_size=8,
+        dtype=jnp.float32, attn_impl="gather"))
+    sched_run(eng_oh, None)                         # warm the buckets
+    # interleave off/on with the starting order flipped each round, then
+    # compare medians — the per-window span cost is μs against ~ms
+    # windows, far below run-to-run scheduler noise, so ordering bias
+    # must cancel rather than masquerade as (negative) overhead
+    offs, ons = [], []
+    for rnd in range(3):
+        pair = [(offs, None), (ons, RequestTraceStore(sample_every=10))]
+        for sink, store in (pair if rnd % 2 == 0 else pair[::-1]):
+            sink.append(sched_run(eng_oh, store))
+    off = sorted(offs)[len(offs) // 2]
+    on = sorted(ons)[len(ons) // 2]
+    overhead_pct = round((off - on) / off * 100.0, 2) if off > 0 else None
+    log(f"fleet_sweep tracing overhead: off={off:.1f} on={on:.1f} tok/s "
+        f"({overhead_pct}%)")
+
+    # headline = the MEAN over the sweep points — a regression at ANY
+    # replica count must move it (max() would hide a regression at a
+    # non-best point); scaling efficiency stays last-vs-first
+    headline = (sum(p["tok_per_s"] for p in points) / len(points)
+                if points else 0.0)
+    base = points[0]["tok_per_s"] if points else 0.0
+    last = points[-1]["tok_per_s"] if points else 0.0
+    scaling = round(last / base / len(points), 3) if base else 0.0
+    emit("fleet_sweep_tok_per_s", headline, "tokens/s", scaling, {
+        "points": points,
+        "scaling_efficiency_3x": scaling,
+        "tracing_overhead_pct": overhead_pct,
+        "trace_decode_tok_per_s": {"off": round(off, 2),
+                                   "on": round(on, 2)},
+        "requests": n_requests, "max_new_tokens": max_new,
+        "note": "CPU-sim scheduling-plane bench over the real router; "
+                "tok/s measures window packing + HTTP fan-out, not "
+                "kernels",
+    })
+
+
 def main():
     global _ON_TPU
     mode = os.environ.get("DSTPU_BENCH_MODE", "train")
     tpu_ok, reason = False, "forced cpu"
     if mode == "pipeline":
         reason = "pipeline mode measures the CPU-sim schedule"
+    elif mode == "fleet_sweep":
+        reason = "fleet_sweep measures the CPU-sim fleet over the real " \
+                 "router"
     elif os.environ.get("DSTPU_BENCH_FORCE_CPU") != "1":
         timeout = float(os.environ.get("DSTPU_BENCH_PROBE_TIMEOUT", "300"))
         log(f"probing TPU backend (timeout {timeout:.0f}s)")
@@ -1459,6 +1653,7 @@ def main():
         "offload": ("offload_step_ms", "ms/step"),
         "overlap_sweep": ("overlap_step_ms", "ms/step"),
         "comm_sweep": ("comm_sweep_exchange_ms", "ms/step"),
+        "fleet_sweep": ("fleet_sweep_tok_per_s", "tokens/s"),
     }.get(mode, ("zero_train_tokens_per_sec_per_chip", "tokens/s/chip"))
     try:
         backend = jax.default_backend()
@@ -1486,6 +1681,8 @@ def main():
             run_overlap_sweep(on_tpu)
         elif mode == "comm_sweep":
             run_comm_sweep(on_tpu)
+        elif mode == "fleet_sweep":
+            run_fleet_sweep(on_tpu)
         else:
             run_train_bench(on_tpu, reason)
     except Exception as exc:  # noqa: BLE001

@@ -58,6 +58,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ...telemetry.tracing import (FLAG_BY_REASON, get_trace_store,
+                                  record_span, trace_id_of)
 from ...utils.logging import logger
 from .engine_v2 import InferenceEngineV2
 
@@ -111,6 +113,13 @@ class ServeRequest:
     #: prefills locally and the stream continues bit-exactly.
     prefill_only: bool = False
     kv_import: Optional[object] = None
+    #: fleet-wide request-trace context (telemetry/tracing): when set, the
+    #: scheduler appends typed spans (queue_wait, admission, prefill,
+    #: decode_window, preempt/resume, draft/verify, kv_ship_*) under this
+    #: trace id to the process-global store, and ``trace_result`` carries
+    #: the finished local trace for in-band return to the router
+    trace: Optional[object] = None
+    trace_result: Optional[dict] = None
 
     # -- runtime state (scheduler-owned) --
     state: RequestState = RequestState.QUEUED
@@ -128,6 +137,13 @@ class ServeRequest:
     _prefill_pos: int = 0
     _resume_seed: Optional[int] = None       # set while resuming a preempt
     _prefix_counted: bool = False            # hit/miss recorded once
+    #: wall-clock (time.time) marks for span timestamps — kept separate
+    #: from the scheduler's injectable ``clock`` so fake-clock tests still
+    #: produce mergeable cross-process timelines
+    _twall_submit: float = 0.0
+    _twall_queue: float = 0.0                # reset on preemption re-queue
+    _import_s: float = 0.0                   # kv_ship_import wall inside
+    #                                          the last _reserve_for call
 
     @property
     def remaining(self) -> int:
@@ -213,6 +229,10 @@ class LifecycleScheduler:
 
             self.drafter = make_drafter(self.spec)
 
+        #: component label on spans this scheduler records (the serving
+        #: server overwrites it with ``serve:<port>`` at start so fleet
+        #: waterfalls name the replica, even in-process)
+        self.trace_component = "serve"
         self._lock = threading.RLock()
         self._reqs: Dict[int, ServeRequest] = {}
         self._waiting: "collections.deque[int]" = collections.deque()
@@ -229,6 +249,31 @@ class LifecycleScheduler:
         self.last_shed_t: Optional[float] = None
 
     # ------------------------------------------------------------------ #
+    # Request tracing (telemetry/tracing): span + finish helpers.  A
+    # ``None`` store or an un-traced request is the disabled fast path —
+    # one global read + one attribute check per site, no host syncs.
+    # ------------------------------------------------------------------ #
+    def _tspan(self, req: ServeRequest, kind: str, t0: float, dur_s: float,
+               **attrs) -> None:
+        record_span(req.trace, kind, t0=t0, dur_s=dur_s,
+                    component=self.trace_component, uid=req.uid, **attrs)
+
+    def _trace_finish(self, req: ServeRequest,
+                      flag: Optional[str] = None) -> None:
+        store = get_trace_store()
+        if store is None or req.trace is None:
+            return
+        if req.preempt_count > 0:
+            store.flag(req.trace.trace_id, "preempted")
+        req.trace_result = store.finish(
+            req.trace.trace_id, flag=flag,
+            wall_s=max(time.time() - req._twall_submit, 0.0)
+            if req._twall_submit else None)
+
+    def _trace_id(self, req: ServeRequest) -> Optional[str]:
+        return trace_id_of(req.trace)
+
+    # ------------------------------------------------------------------ #
     # Ingress (HTTP handler threads)
     # ------------------------------------------------------------------ #
     def submit(self, req: ServeRequest) -> AdmissionVerdict:
@@ -236,6 +281,7 @@ class LifecycleScheduler:
         with self._lock:
             now = self.clock()
             req.arrival_t = now
+            req._twall_submit = req._twall_queue = time.time()
             if req.deadline_s is not None:
                 req.deadline_t = now + req.deadline_s
             if req.ttft_timeout_s is not None:
@@ -248,13 +294,19 @@ class LifecycleScheduler:
                 req.finish_reason = "empty_prompt"
                 req.finished_t = now
                 self._reqs[req.uid] = req
+                self._trace_finish(req)
                 req._fire("finished")
                 return AdmissionVerdict(True)
             if self.draining:
                 req.state = RequestState.SHED
                 req.finish_reason = "draining"
                 self._count("serving/shed")
-                self._event("serving_shed", uid=req.uid, reason="draining")
+                self._event("serving_shed", uid=req.uid, reason="draining",
+                            trace=self._trace_id(req))
+                self._tspan(req, "admission", t0=req._twall_submit,
+                            dur_s=0.0, shed="draining")
+                self._trace_finish(req,
+                                   flag=FLAG_BY_REASON.get(req.finish_reason))
                 return AdmissionVerdict(False, "draining",
                                         self.predicted_drain_s())
             if len(self._waiting) >= self.max_queue:
@@ -263,7 +315,12 @@ class LifecycleScheduler:
                 self.last_shed_t = now
                 self._count("serving/shed")
                 self._event("serving_shed", uid=req.uid, reason="queue_full",
-                            queue_depth=len(self._waiting))
+                            queue_depth=len(self._waiting),
+                            trace=self._trace_id(req))
+                self._tspan(req, "admission", t0=req._twall_submit,
+                            dur_s=0.0, shed="queue_full")
+                self._trace_finish(req,
+                                   flag=FLAG_BY_REASON.get(req.finish_reason))
                 return AdmissionVerdict(False, "queue_full",
                                         self.retry_after_s())
             self._reqs[req.uid] = req
@@ -351,7 +408,8 @@ class LifecycleScheduler:
         if counter:
             self._count(counter)
         self._event(event, uid=uid, reason=reason,
-                    produced=len(req.produced))
+                    produced=len(req.produced), trace=self._trace_id(req))
+        self._trace_finish(req, flag=FLAG_BY_REASON.get(reason))
         req._fire(event.replace("serving_", ""))
         self._publish_gauges()
 
@@ -421,7 +479,11 @@ class LifecycleScheduler:
         self._count("serving/preempted")
         self._event("serving_preempted", uid=uid, for_uid=head.uid,
                     produced=len(victim.produced),
-                    kv_used=round(self.eng.kv_used_fraction(), 4))
+                    kv_used=round(self.eng.kv_used_fraction(), 4),
+                    trace=self._trace_id(victim))
+        self._tspan(victim, "preempt", t0=time.time(), dur_s=0.0,
+                    for_uid=head.uid, produced=len(victim.produced))
+        victim._twall_queue = time.time()     # the next queue_wait span
         victim._fire("preempted")
         logger.info(f"KV pressure: preempted uid {uid} "
                     f"({len(victim.produced)} tokens spilled) to admit "
@@ -485,8 +547,12 @@ class LifecycleScheduler:
                     return False
                 from .kv_ship import import_kv
 
+                t0w, t0p = time.time(), time.perf_counter()
                 if not import_kv(self.eng, ship, req.uid):
                     return False           # transient exhaustion
+                req._import_s = time.perf_counter() - t0p
+                self._tspan(req, "kv_ship_import", t0=t0w,
+                            dur_s=req._import_s, tokens=ship.n_tokens)
                 req._prefill_pos = ship.n_tokens
             elif self.eng.prefix_cache is not None:
                 matched = self.eng.graft_prefix(req.uid, req.resume_prompt)
@@ -541,7 +607,22 @@ class LifecycleScheduler:
         preempted_this_pass = False
         while self._waiting and budget > 0 and len(picked) < c.max_seqs:
             head = self._reqs[self._waiting[0]]
+            t0w, t0p = time.time(), time.perf_counter()
+            head._import_s = 0.0
             verdict = self._reserve_for(head)
+            if verdict is True:
+                # admission succeeded: close the queue_wait segment
+                # (re-opened by preemption) and record the reservation /
+                # graft work as the admission segment — MINUS the KV
+                # import, which has its own kv_ship_import span (segments
+                # must stay disjoint or the decomposition sums lie)
+                self._tspan(head, "queue_wait", t0=head._twall_queue,
+                            dur_s=max(t0w - head._twall_queue, 0.0))
+                self._tspan(head, "admission", t0=t0w,
+                            dur_s=max(time.perf_counter() - t0p
+                                      - head._import_s, 0.0),
+                            prefix_hit=head._prefill_pos
+                            if head.kv_import is None else 0)
             if verdict is None:
                 self._waiting.popleft()
                 self._retire(head, RequestState.FAILED, "impossible",
@@ -569,11 +650,18 @@ class LifecycleScheduler:
         return picked
 
     def _run_prefill(self, batch: List[Tuple[int, List[int]]]) -> List[int]:
+        t0w, t0p = time.time(), time.perf_counter()
         logits = self.eng.put([u for u, _ in batch], [t for _, t in batch])
+        put_s = time.perf_counter() - t0p
         finished: List[int] = []
         now = self.clock()
         for row, (uid, chunk) in enumerate(batch):
             req = self._reqs[uid]
+            # the whole forward's wall is attributed to every rider: the
+            # request really did spend that time inside this batch
+            self._tspan(req, "prefill", t0=t0w, dur_s=put_s,
+                        tokens=len(chunk), batch=len(batch),
+                        resume=req._resume_seed is not None)
             req._prefill_pos += len(chunk)
             if req._prefill_pos < len(req.resume_prompt):
                 continue                       # mid-prompt; logits unused
@@ -588,8 +676,12 @@ class LifecycleScheduler:
                 # above it is a pure read)
                 from .kv_ship import export_kv
 
+                te_w, te_p = time.time(), time.perf_counter()
                 req.kv_shipment = export_kv(self.eng, uid,
                                             req.resume_prompt)
+                self._tspan(req, "kv_ship_encode", t0=te_w,
+                            dur_s=time.perf_counter() - te_p,
+                            tokens=req.kv_shipment.n_tokens)
                 self._count("serving/completed")
                 self._retire(req, RequestState.FINISHED, "prefill_done",
                              "serving_finished", "serving/prefill_exported")
@@ -603,11 +695,18 @@ class LifecycleScheduler:
                 # (which would re-derive the token it already produced)
                 seed = int(req._resume_seed)
                 req._resume_seed = None
+                self._tspan(req, "resume", t0=time.time(), dur_s=0.0,
+                            produced=len(req.produced))
             else:
                 seed = int(np.argmax(np.asarray(logits[row])))
                 req.produced.append(seed)
                 req.first_token_t = now
                 self._observe("serving/ttft_s", req.ttft_s())
+                store = get_trace_store()
+                if store is not None and req.trace is not None \
+                        and req.ttft_s() is not None:
+                    store.note_exemplar("ttft_s", req.ttft_s(),
+                                        req.trace.trace_id)
                 req._fire("tokens")
                 if self._finished_by(req, seed):
                     self._finish(req)
@@ -637,8 +736,15 @@ class LifecycleScheduler:
         req.finished_t = self.clock()
         self._count("serving/completed")
         self._observe("serving/tpot_s", req.tpot_s())
+        store = get_trace_store()
+        if store is not None and req.trace is not None \
+                and req.tpot_s() is not None:
+            store.note_exemplar("tpot_s", req.tpot_s(),
+                                req.trace.trace_id)
         self._event("serving_finished", uid=req.uid,
-                    produced=len(req.produced), reason=req.finish_reason)
+                    produced=len(req.produced), reason=req.finish_reason,
+                    trace=self._trace_id(req))
+        self._trace_finish(req)
         req._fire("finished")
         self._publish_gauges()
 
@@ -687,12 +793,30 @@ class LifecycleScheduler:
     def _apply_window_results(self, uids: List[int],
                               streams: List[List[int]], poisoned: set,
                               wall_s: Optional[float],
-                              compiled: bool) -> List[int]:
+                              compiled: bool,
+                              span_kind: str = "decode_window",
+                              span_wall_s: Optional[float] = None
+                              ) -> List[int]:
         """Shared tail of fused-decode and verify windows: post-hoc hang
         detection, per-request NaN isolation, eos truncation, finish /
         rotate bookkeeping.  ``streams[i]`` is uid i's newly produced
-        tokens (ignored for poisoned uids)."""
+        tokens (ignored for poisoned uids).  ``span_wall_s`` narrows the
+        recorded span below the hang-check wall when part of the wall is
+        attributed elsewhere (verify windows: drafting has its own
+        span)."""
         finished: List[int] = []
+        # window span per rider — a first-use (compiled) window's wall is
+        # XLA compilation, so it is typed ``compile``, keeping the
+        # decode_window decomposition clean of compile pollution exactly
+        # like the roofline gauges
+        if wall_s is not None:
+            span_s = wall_s if span_wall_s is None else span_wall_s
+            t0w = time.time() - span_s
+            kind = "compile" if compiled else span_kind
+            for uid, stream in zip(uids, streams):
+                self._tspan(self._reqs[uid], kind, t0=t0w, dur_s=span_s,
+                            n_seqs=len(uids), tokens=len(stream),
+                            window=self.eng.decode_windows_dispatched)
         if not compiled and wall_s is not None \
                 and wall_s > self.hang_deadline_s:
             # post-hoc hang detection: the window drained, but took longer
@@ -702,7 +826,15 @@ class LifecycleScheduler:
             self._count("serving/window_hang")
             self._event("serving_window_hang", uids=list(uids),
                         duration_s=round(wall_s, 3),
-                        deadline_s=self.hang_deadline_s)
+                        deadline_s=self.hang_deadline_s,
+                        traces=[self._trace_id(self._reqs[u])
+                                for u in uids])
+            store = get_trace_store()
+            if store is not None:
+                for u in uids:
+                    if self._reqs[u].trace is not None:
+                        store.flag(self._reqs[u].trace.trace_id,
+                                   "window_hang")
 
         if poisoned:
             self.last_incident_t = self.clock()
@@ -771,7 +903,7 @@ class LifecycleScheduler:
         1-token verify is exactly one vanilla decode step).  Greedy
         bit-exactness, watchdog/NaN isolation, eos handling and
         preemption bookkeeping all mirror the fused-decode path."""
-        t_d0 = time.perf_counter()
+        t_d0w, t_d0 = time.time(), time.perf_counter()
         budget = self.eng.config.max_tokens - len(uids)   # draft allowance
         seeds, drafts = [], []
         for u in uids:
@@ -786,6 +918,9 @@ class LifecycleScheduler:
             drafts.append(d)
             seeds.append(self._decodes[u])
         draft_s = time.perf_counter() - t_d0
+        for u, d in zip(uids, drafts):
+            self._tspan(self._reqs[u], "draft", t0=t_d0w, dur_s=draft_s,
+                        k=len(d))
         result = self.eng.verify_decode(uids, seeds, drafts,
                                         draft_wall_s=draft_s)
         self._count("serving/spec_windows")
@@ -795,7 +930,8 @@ class LifecycleScheduler:
             self._count("serving/spec_accepted", result.accepted_draft)
         return self._apply_window_results(
             uids, result.accepted, set(result.nonfinite_uids),
-            wall_s=result.duration_s + draft_s, compiled=result.compiled)
+            wall_s=result.duration_s + draft_s, compiled=result.compiled,
+            span_kind="verify", span_wall_s=result.duration_s)
 
     def step(self) -> List[int]:
         """One scheduler iteration; returns uids that reached a terminal

@@ -39,6 +39,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import urlparse
 
+from ...telemetry.tracing import (
+    TraceContext,
+    get_trace_store,
+    traces_endpoint_payload,
+)
 from ...utils.logging import logger
 from .lifecycle import (
     TERMINAL_STATES,
@@ -99,9 +104,15 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 self._get_metrics()
             elif url.path == "/healthz":
                 self._get_healthz()
+            elif url.path == "/traces":
+                from urllib.parse import parse_qs
+
+                code, body = traces_endpoint_payload(parse_qs(url.query))
+                self._send_json(code, body)
             elif url.path == "/":
                 self._send_json(200, {"endpoints": [
-                    "/v1/generate (POST)", "/metrics", "/healthz"]})
+                    "/v1/generate (POST)", "/metrics", "/healthz",
+                    "/traces"]})
             else:
                 self._send_json(404, {"error": f"unknown path {url.path}"})
         except (BrokenPipeError, ConnectionResetError):
@@ -236,6 +247,12 @@ class _ServingHandler(BaseHTTPRequestHandler):
                 "reason": "no_drafter"})
             return
         stream = bool(payload.get("stream", False))
+        # request-trace context: forwarded header/body field (the router's
+        # fleet trace) or a fresh mint for direct requests — the scheduler
+        # appends typed spans under it and the terminal answer returns
+        # them in-band for the router's fleet-merged view
+        ctx = TraceContext.from_request(self.headers, payload) \
+            if get_trace_store() is not None else None
 
         events: "queue.Queue" = queue.Queue()
         req, verdict = owner.submit_request(
@@ -245,13 +262,14 @@ class _ServingHandler(BaseHTTPRequestHandler):
             deadline_s=payload.get("deadline_s"),
             ttft_timeout_s=payload.get("ttft_timeout_s"),
             spec_mode=spec_mode, spec_k=spec_k,
-            kv_import=kv_import,
+            kv_import=kv_import, trace=ctx,
             sink=events)
         if not verdict.admitted:
             code = 503 if verdict.reason == "draining" else 429
             self._send_json(code, {
                 "error": "overloaded", "reason": verdict.reason,
                 "retry_after_s": verdict.retry_after_s,
+                **self._trace_fields(req),
             }, headers={"Retry-After":
                         str(int(round(verdict.retry_after_s or 1)))})
             return
@@ -287,17 +305,20 @@ class _ServingHandler(BaseHTTPRequestHandler):
             self._send_json(400, {"error": f"bad request body: {e!r}"})
             return
         t0 = time.perf_counter()
+        ctx = TraceContext.from_request(self.headers, payload) \
+            if get_trace_store() is not None else None
         events: "queue.Queue" = queue.Queue()
         req, verdict = owner.submit_request(
             prompt=prompt, max_new_tokens=0,
             priority=int(payload.get("priority", 0)),
             deadline_s=payload.get("deadline_s"),
-            prefill_only=True, sink=events)
+            prefill_only=True, trace=ctx, sink=events)
         if not verdict.admitted:
             code = 503 if verdict.reason == "draining" else 429
             self._send_json(code, {
                 "error": "overloaded", "reason": verdict.reason,
                 "retry_after_s": verdict.retry_after_s,
+                **self._trace_fields(req),
             }, headers={"Retry-After":
                         str(int(round(verdict.retry_after_s or 1)))})
             return
@@ -315,7 +336,7 @@ class _ServingHandler(BaseHTTPRequestHandler):
         if state != RequestState.FINISHED or req.kv_shipment is None:
             self._send_json(_TERMINAL_HTTP.get(state, 500), {
                 "error": "prefill failed", "state": state.value,
-                "finish_reason": reason})
+                "finish_reason": reason, **self._trace_fields(req)})
             return
         from .kv_ship import to_b64
 
@@ -325,7 +346,32 @@ class _ServingHandler(BaseHTTPRequestHandler):
             "wire": wire, "prefix_hit_tokens": req.prefix_hit_tokens,
             "ship_ms": round((time.perf_counter() - t0) * 1e3, 3),
             "kv": frame,
+            **self._trace_fields(req),
         })
+
+    @staticmethod
+    def _trace_fields(req: ServeRequest) -> Dict[str, Any]:
+        """In-band trace payload for a terminal answer: the trace id (the
+        client's ``dstpu-trace --request`` handle) plus this replica's
+        finished spans for the router to merge — never subject to local
+        sampling (``finish`` returns the record either way).  The span
+        dump is attached only when the upstream hop explicitly asked for
+        it (the router stamps RETURN_SPANS_FIELD next to the context);
+        direct clients — including curl users who JOIN a trace with a
+        traceparent of their own — get just the id, not tens of KB of
+        internal spans per response."""
+        if req.trace is None:
+            return {}
+        out: Dict[str, Any] = {"trace_id": req.trace.trace_id}
+        if req.trace.return_spans and req.trace_result is not None:
+            out["trace"] = {
+                "trace": req.trace_result["trace"],
+                "uid": req.trace_result.get("uid"),
+                "spans": req.trace_result.get("spans") or [],
+                "flags": req.trace_result.get("flags") or [],
+                "wall_s": req.trace_result.get("wall_s"),
+            }
+        return out
 
     def _blocking_response(self, owner: "ServingServer", req: ServeRequest,
                            events: "queue.Queue") -> None:
@@ -344,6 +390,7 @@ class _ServingHandler(BaseHTTPRequestHandler):
             "uid": req.uid, "tokens": tokens, "finish_reason": reason,
             "state": state.value, "ttft_s": req.ttft_s(),
             "tpot_s": req.tpot_s(),
+            **self._trace_fields(req),
         })
 
     def _client_gone(self) -> bool:
@@ -390,6 +437,7 @@ class _ServingHandler(BaseHTTPRequestHandler):
                     if state in TERMINAL_STATES:
                         payload["finish_reason"] = reason
                         payload["state"] = state.value
+                        payload.update(self._trace_fields(req))
                     self.wfile.write(
                         f"event: {event}\ndata: "
                         f"{json.dumps(payload)}\n\n".encode())
@@ -444,7 +492,7 @@ class ServingServer:
                        priority: int = 0, deadline_s=None,
                        ttft_timeout_s=None, spec_mode=None, spec_k=None,
                        prefill_only: bool = False, kv_import=None,
-                       sink: "queue.Queue" = None
+                       trace=None, sink: "queue.Queue" = None
                        ) -> "tuple[ServeRequest, AdmissionVerdict]":
         """Build + submit one request; lifecycle events are copied into
         ``sink`` as ``(event, tokens_copy, finish_reason, state)`` tuples
@@ -466,7 +514,7 @@ class ServingServer:
                             if ttft_timeout_s is not None else None),
             spec_mode=spec_mode, spec_k=spec_k,
             prefill_only=prefill_only, kv_import=kv_import,
-            on_event=on_event)
+            trace=trace, on_event=on_event)
         verdict = self.scheduler.submit(req)
         self.kick()
         return req, verdict
@@ -497,6 +545,9 @@ class ServingServer:
         srv.owner = self
         self._server = srv
         self.port = srv.server_address[1]
+        # fleet waterfalls name the replica on every span, even when the
+        # whole fleet shares one process (tests, the chaos harness)
+        self.scheduler.trace_component = f"serve:{self.port}"
         self._http_thread = threading.Thread(
             target=srv.serve_forever, name="dstpu-serve-http",
             kwargs={"poll_interval": 0.2}, daemon=True)
@@ -658,12 +709,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                    help="load draft-model params from a framework training"
                         " checkpoint (params-only resharded handoff)")
     p.add_argument("--telemetry-dir", default="telemetry_serve")
+    from ...telemetry.tracing.store import (
+        add_trace_cli_args,
+        install_trace_store_from_cli,
+    )
+
+    add_trace_cli_args(p)
     args = p.parse_args(argv)
 
     from ...telemetry import Telemetry, set_telemetry
 
     tel = Telemetry(output_dir=args.telemetry_dir)
     set_telemetry(tel)
+    store = install_trace_store_from_cli(args, args.telemetry_dir)
 
     if args.model == "tiny":
         engine = build_tiny_engine(args)
@@ -756,6 +814,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGINT, _term)
     print(f"dstpu-serve listening on http://{args.bind}:{server.port}",
           flush=True)
-    done.wait()
+    # The kernel may deliver a process-directed SIGTERM to a non-main
+    # thread; the Python-level handler only runs once the main thread
+    # re-enters the eval loop, so it must never park in an untimed wait.
+    while not done.wait(0.5):
+        pass
+    if store is not None:
+        store.close()
     tel.close()
     return rc["code"]

@@ -36,6 +36,9 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Set, Tuple
 
+from ...telemetry.tracing import (RETURN_SPANS_FIELD, TRACE_HEADER,
+                                  flag_trace, merge_trace, record_span,
+                                  trace_id_of)
 from ...utils.logging import logger
 from .replica import ReplicaHandle
 
@@ -190,9 +193,33 @@ class FleetRouter:
         return float(min(max(min(preds), 1.0), 120.0)) if preds else 5.0
 
     # ------------------------------------------------------------------ #
+    # Request tracing (telemetry/tracing): the router stamps the context
+    # onto every forwarded body, merges the replica's in-band spans, and
+    # records its own legs (kv_ship_wire, reroute) — so the router's
+    # store owns the fleet-merged per-request view.
+    # ------------------------------------------------------------------ #
+    _trace_id = staticmethod(trace_id_of)
+    _tmerge = staticmethod(merge_trace)
+    _tflag = staticmethod(flag_trace)
+
+    @staticmethod
+    def _tspan(trace, kind: str, t0: float, dur_s: float, **attrs) -> None:
+        record_span(trace, kind, t0=t0, dur_s=dur_s, component="router",
+                    **attrs)
+
+    @staticmethod
+    def _stamp(payload: Dict, trace) -> None:
+        if trace is not None:
+            payload[TRACE_HEADER] = trace.child().header()
+            # the router merges+strips in-band spans, so ask for them —
+            # a client-supplied traceparent alone must NOT trigger the
+            # span dump (no upstream exists to strip it)
+            payload[RETURN_SPANS_FIELD] = True
+
+    # ------------------------------------------------------------------ #
     # Disaggregated prefill
     # ------------------------------------------------------------------ #
-    def _maybe_disagg(self, payload: Dict) -> None:
+    def _maybe_disagg(self, payload: Dict, trace=None) -> None:
         """Prefill long prompts on a prefill-designated replica and attach
         the shipped KV as ``kv_import``.  Mutates ``payload``; every
         failure leaves it untouched (plain routing)."""
@@ -206,8 +233,10 @@ class FleetRouter:
         if h is None:
             return
         t0 = time.perf_counter()
+        t0_wall = time.time()
         pre_body = {"prompt": [int(t) for t in prompt[:-1]],
                     "wire": self.wire}
+        self._stamp(pre_body, trace)
         # the prefill leg inherits the request's deadline/priority — a
         # deadline the client set must bound the REMOTE prefill too, not
         # just the decode half
@@ -223,14 +252,29 @@ class FleetRouter:
                 self._on_lost(h)
             self._count("fleet/prefill_fallback")
             self._event("fleet_prefill_fallback", name=h.name,
-                        error=repr(e))
+                        error=repr(e),
+                        trace=self._trace_id(trace))
+            self._tflag(trace, "prefill_fallback")
             return
         if code != 200 or "kv" not in body:
             self._count("fleet/prefill_fallback")
-            self._event("fleet_prefill_fallback", name=h.name, code=code)
+            self._event("fleet_prefill_fallback", name=h.name, code=code,
+                        trace=self._trace_id(trace))
+            self._tflag(trace, "prefill_fallback")
             return
         payload["kv_import"] = body["kv"]
-        ship_ms = (time.perf_counter() - t0) * 1e3
+        roundtrip_s = time.perf_counter() - t0
+        ship_ms = roundtrip_s * 1e3
+        # the replica's spans (queue/prefill/kv_ship_encode) arrive
+        # in-band; the wire leg is the roundtrip MINUS the replica's own
+        # handler time — what the shipment spent on the network + framing
+        self._tmerge(trace, body)
+        replica_s = float(body.get("ship_ms") or 0.0) / 1e3
+        wire_s = max(roundtrip_s - replica_s, 0.0)
+        self._tspan(trace, "kv_ship_wire",
+                    t0=t0_wall + roundtrip_s - wire_s, dur_s=wire_s,
+                    bytes=len(body["kv"]), replica=h.name,
+                    tokens=body.get("n_tokens", 0), wire=self.wire)
         self._count("fleet/prefill_disagg")
         self._count("fleet/kv_ship_bytes", len(body["kv"]))
         self._gauge("fleet/kv_ship_ms", round(ship_ms, 3))
@@ -239,7 +283,7 @@ class FleetRouter:
     # ------------------------------------------------------------------ #
     # Blocking path
     # ------------------------------------------------------------------ #
-    def generate_blocking(self, payload: Dict
+    def generate_blocking(self, payload: Dict, trace=None
                           ) -> Tuple[int, Dict, Dict[str, str]]:
         """Route one blocking ``/v1/generate``; returns (status, body,
         extra headers).  Nothing has been sent to the client yet, so
@@ -247,16 +291,19 @@ class FleetRouter:
         payload = dict(payload)
         if self.draining:
             ra = self.retry_after_s()
+            self._tflag(trace, "shed")
             return 503, {"error": "router draining",
                          "reason": "draining", "retry_after_s": ra}, \
                 {"Retry-After": str(int(max(ra, 1)))}
-        self._maybe_disagg(payload)
+        self._maybe_disagg(payload, trace)
+        self._stamp(payload, trace)
         tried: Set[str] = set()
         last_shed: Optional[Dict] = None
         while True:
             h = self._pick("decode", tried)
             if h is None:
                 self._count("fleet/shed")
+                self._tflag(trace, "shed")
                 ra = (last_shed or {}).get("retry_after_s") \
                     or self.retry_after_s()
                 body = {"error": "no routable replica",
@@ -273,11 +320,19 @@ class FleetRouter:
                 if h.note_failure():
                     self._on_lost(h)
                 self._count("fleet/rerouted")
-                self._event("fleet_rerouted", name=h.name, error=repr(e))
+                self._event("fleet_rerouted", name=h.name, error=repr(e),
+                            trace=self._trace_id(trace))
+                self._tspan(trace, "reroute", t0=time.time(), dur_s=0.0,
+                            from_replica=h.name, error=repr(e))
+                self._tflag(trace, "rerouted")
                 continue
             if code in (429, 503):
-                # replica-level shed (queue full / draining): rotate on
+                # replica-level shed (queue full / draining): rotate on,
+                # but keep the rejected hop's in-band spans+flags — the
+                # replica force-kept its copy, so the merged view must
+                # show the hop (and stay keep-consistent) too
                 last_shed = body
+                self._tmerge(trace, body)
                 self._count("fleet/replica_shed")
                 continue
             if payload.get("kv_import") and (
@@ -292,19 +347,30 @@ class FleetRouter:
                 tried.discard(h.name)
                 self._count("fleet/prefill_fallback")
                 self._event("fleet_prefill_fallback", name=h.name,
-                            code=code)
+                            code=code,
+                            trace=self._trace_id(trace))
+                self._tflag(trace, "prefill_fallback")
                 continue
             if code >= 500:
                 self._count("fleet/rerouted")
-                self._event("fleet_rerouted", name=h.name, code=code)
+                self._event("fleet_rerouted", name=h.name, code=code,
+                            trace=self._trace_id(trace))
+                self._tspan(trace, "reroute", t0=time.time(), dur_s=0.0,
+                            from_replica=h.name, code=code)
+                self._tflag(trace, "rerouted")
                 continue
             self._count("fleet/routed")
+            self._tmerge(trace, body)
+            # clients get the trace_id handle, not the internal span
+            # dump (the router's store now owns the merged view)
+            body.pop("trace", None)
             return code, body, {}
 
     # ------------------------------------------------------------------ #
     # Streaming path
     # ------------------------------------------------------------------ #
-    def generate_stream(self, payload: Dict, start, send) -> None:
+    def generate_stream(self, payload: Dict, start, send,
+                        trace=None) -> None:
         """Route one SSE ``/v1/generate``.
 
         ``start()`` runs once, right before the first forwarded bytes
@@ -319,8 +385,10 @@ class FleetRouter:
         payload = dict(payload)
         payload["stream"] = True
         if self.draining:
+            self._tflag(trace, "shed")
             raise FleetUnavailable(self.retry_after_s(), "draining")
-        self._maybe_disagg(payload)
+        self._maybe_disagg(payload, trace)
+        self._stamp(payload, trace)
         tried: Set[str] = set()
         last_shed: Optional[Dict] = None
         started = False
@@ -330,6 +398,7 @@ class FleetRouter:
                 ra = (last_shed or {}).get("retry_after_s") \
                     or self.retry_after_s()
                 self._count("fleet/shed")
+                self._tflag(trace, "shed")
                 if not started:
                     raise FleetUnavailable(
                         ra, (last_shed or {}).get("reason",
@@ -356,6 +425,7 @@ class FleetRouter:
                         body = {"error": body_raw.decode(errors="replace")}
                     if resp.status in (429, 503):
                         last_shed = body
+                        self._tmerge(trace, body)
                         self._count("fleet/replica_shed")
                         continue
                     if payload.get("kv_import") and (
@@ -368,7 +438,9 @@ class FleetRouter:
                         tried.discard(h.name)
                         self._count("fleet/prefill_fallback")
                         self._event("fleet_prefill_fallback",
-                                    name=h.name, code=resp.status)
+                                    name=h.name, code=resp.status,
+                                    trace=self._trace_id(trace))
+                        self._tflag(trace, "prefill_fallback")
                         continue
                     if resp.status < 500 and not started:
                         raise ReplicaBadRequest(resp.status, body)
@@ -385,11 +457,16 @@ class FleetRouter:
                         continue
                     raw = b"".join(block)
                     block = []
-                    n_tok, terminal = self._inspect_event(raw)
+                    n_tok, terminal, ev_trace, fwd = \
+                        self._inspect_event(raw)
                     if not started:
                         start()
                         started = True
-                    send(raw)
+                    if ev_trace is not None:
+                        # the terminal event carried the replica's
+                        # spans: merge them into the fleet view
+                        self._tmerge(trace, {"trace": ev_trace})
+                    send(fwd)
                     forwarded += n_tok
                     if terminal:
                         saw_terminal = True
@@ -407,13 +484,20 @@ class FleetRouter:
                     # zero tokens delivered: idempotent-safe, re-route
                     self._count("fleet/rerouted")
                     self._event("fleet_rerouted", name=h.name,
+                                error=repr(e),
+                                trace=self._trace_id(trace))
+                    self._tspan(trace, "reroute", t0=time.time(),
+                                dur_s=0.0, from_replica=h.name,
                                 error=repr(e))
+                    self._tflag(trace, "rerouted")
                     continue
                 # tokens already reached the client: typed in-band error
                 ra = self.retry_after_s()
                 self._count("fleet/mid_stream_error")
                 self._event("fleet_mid_stream_error", name=h.name,
-                            forwarded=forwarded, error=repr(e))
+                            forwarded=forwarded, error=repr(e),
+                            trace=self._trace_id(trace))
+                self._tflag(trace, "mid_stream_error")
                 try:
                     send(self._error_event("replica_lost", forwarded, ra))
                 except OSError:
@@ -427,21 +511,35 @@ class FleetRouter:
                         pass
 
     @staticmethod
-    def _inspect_event(raw: bytes) -> Tuple[int, bool]:
-        """(tokens carried, is_terminal) for one SSE event block."""
-        n_tok, terminal = 0, False
-        for line in raw.splitlines():
+    def _inspect_event(raw: bytes
+                       ) -> Tuple[int, bool, Optional[Dict], bytes]:
+        """(tokens carried, is_terminal, trace payload, forwardable
+        block) for one SSE event block — terminal events from a traced
+        replica carry the replica's span payload for the router's
+        fleet-merged view; the forwarded copy has the internal span dump
+        stripped (clients keep the trace_id handle).  Lines that fail to
+        parse are forwarded untouched."""
+        n_tok, terminal, ev_trace = 0, False, None
+        out: List[bytes] = []
+        for line in raw.splitlines(keepends=True):
             if line.startswith(b"data: "):
                 try:
                     d = json.loads(line[len(b"data: "):])
                 except ValueError:
-                    continue
-                n_tok += len(d.get("tokens") or [])
-                if d.get("finish_reason") is not None or \
-                        d.get("state") in ("finished", "cancelled",
-                                           "expired", "failed", "shed"):
-                    terminal = True
-        return n_tok, terminal
+                    d = None
+                if isinstance(d, dict):
+                    n_tok += len(d.get("tokens") or [])
+                    if d.get("finish_reason") is not None or \
+                            d.get("state") in ("finished", "cancelled",
+                                               "expired", "failed",
+                                               "shed"):
+                        terminal = True
+                        if isinstance(d.get("trace"), dict):
+                            ev_trace = d["trace"]
+                    if d.pop("trace", None) is not None:
+                        line = b"data: " + json.dumps(d).encode() + b"\n"
+            out.append(line)
+        return n_tok, terminal, ev_trace, b"".join(out)
 
     @staticmethod
     def _error_event(reason: str, forwarded: int,

@@ -34,6 +34,11 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional
 from urllib.parse import urlparse
 
+from ...telemetry.tracing import (
+    TraceContext,
+    get_trace_store,
+    traces_endpoint_payload,
+)
 from ...utils.logging import logger
 from .replica import ROLES
 from .router import FleetRouter, FleetUnavailable, ReplicaBadRequest
@@ -86,6 +91,11 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._get_healthz()
             elif url.path == "/metrics":
                 self._get_metrics()
+            elif url.path == "/traces":
+                from urllib.parse import parse_qs
+
+                code, body = traces_endpoint_payload(parse_qs(url.query))
+                self._send_json(code, body)
             elif url.path == "/replicas":
                 self._send_json(200,
                                 {"replicas": self.server.owner
@@ -93,7 +103,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             elif url.path == "/":
                 self._send_json(200, {"endpoints": [
                     "/v1/generate (POST)", "/metrics", "/healthz",
-                    "/replicas (GET/POST)"]})
+                    "/traces", "/replicas (GET/POST)"]})
             else:
                 self._send_json(404, {"error": f"unknown path {url.path}"})
         except (BrokenPipeError, ConnectionResetError):
@@ -174,17 +184,32 @@ class _RouterHandler(BaseHTTPRequestHandler):
         body = self._read_json()
         if body is None:
             return
+        # fleet trace minted AT ROUTER ADMISSION (or adopted from the
+        # client's traceparent); the ``route`` span is the envelope the
+        # per-segment decomposition is judged against
+        store = get_trace_store()
+        ctx = TraceContext.from_request(self.headers, body) \
+            if store is not None else None
+        t0_wall, t0 = time.time(), time.perf_counter()
         owner.inflight_inc()
         try:
             if body.get("stream"):
-                self._proxy_stream(owner, body)
+                self._proxy_stream(owner, body, ctx)
             else:
-                code, out, headers = owner.router.generate_blocking(body)
+                code, out, headers = owner.router.generate_blocking(
+                    body, trace=ctx)
+                if ctx is not None and isinstance(out, dict):
+                    out.setdefault("trace_id", ctx.trace_id)
                 self._send_json(code, out, headers)
         finally:
             owner.inflight_dec()
+            if ctx is not None:
+                wall = time.perf_counter() - t0
+                owner.router._tspan(ctx, "route", t0=t0_wall, dur_s=wall)
+                store.finish(ctx.trace_id, wall_s=wall)
 
-    def _proxy_stream(self, owner: "RouterServer", body: Dict) -> None:
+    def _proxy_stream(self, owner: "RouterServer", body: Dict,
+                      ctx=None) -> None:
         def start():
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
@@ -198,15 +223,19 @@ class _RouterHandler(BaseHTTPRequestHandler):
             self.wfile.flush()
 
         try:
-            owner.router.generate_stream(body, start, send)
+            owner.router.generate_stream(body, start, send, trace=ctx)
         except FleetUnavailable as e:
             self._send_json(503, {
                 "error": "no routable replica", "reason": e.reason,
                 "retry_after_s": e.retry_after_s,
+                **({"trace_id": ctx.trace_id} if ctx else {}),
             }, headers={"Retry-After":
                         str(int(max(e.retry_after_s, 1)))})
         except ReplicaBadRequest as e:
-            self._send_json(e.code, e.body)
+            body = e.body if isinstance(e.body, dict) else {"error": e.body}
+            if ctx is not None:
+                body.setdefault("trace_id", ctx.trace_id)
+            self._send_json(e.code, body)
 
 
 class _RouterHTTPServer(ThreadingHTTPServer):
@@ -333,12 +362,19 @@ def main(argv: Optional[List[str]] = None) -> int:
     p.add_argument("--drain-deadline", type=float, default=30.0)
     p.add_argument("--request-timeout", type=float, default=600.0)
     p.add_argument("--telemetry-dir", default="telemetry_router")
+    from ...telemetry.tracing.store import (
+        add_trace_cli_args,
+        install_trace_store_from_cli,
+    )
+
+    add_trace_cli_args(p)
     args = p.parse_args(argv)
 
     from ...telemetry import Telemetry, set_telemetry
 
     tel = Telemetry(output_dir=args.telemetry_dir)
     set_telemetry(tel)
+    store = install_trace_store_from_cli(args, args.telemetry_dir)
 
     router = FleetRouter(poll_s=args.poll,
                          disagg_threshold=args.disagg_threshold,
@@ -377,6 +413,12 @@ def main(argv: Optional[List[str]] = None) -> int:
     signal.signal(signal.SIGINT, _term)
     print(f"dstpu-router listening on http://{args.bind}:{server.port}",
           flush=True)
-    done.wait()
+    # The kernel may deliver a process-directed SIGTERM to a non-main
+    # thread; the Python-level handler only runs once the main thread
+    # re-enters the eval loop, so it must never park in an untimed wait.
+    while not done.wait(0.5):
+        pass
+    if store is not None:
+        store.close()
     tel.close()
     return rc["code"]

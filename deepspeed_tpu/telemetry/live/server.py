@@ -245,9 +245,15 @@ class _LiveHandler(BaseHTTPRequestHandler):
                 self._get_events(parse_qs(url.query))
             elif url.path == "/summary":
                 self._get_summary(parse_qs(url.query))
+            elif url.path == "/traces":
+                from ..tracing import traces_endpoint_payload
+
+                code, body = traces_endpoint_payload(parse_qs(url.query))
+                self._send_json(code, body)
             elif url.path == "/":
                 self._send_json(200, {"endpoints": [
-                    "/metrics", "/healthz", "/events", "/summary"]})
+                    "/metrics", "/healthz", "/events", "/summary",
+                    "/traces"]})
             else:
                 self._send_json(404, {"error": f"unknown path {url.path}"})
         except (BrokenPipeError, ConnectionResetError):

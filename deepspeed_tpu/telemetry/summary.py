@@ -35,6 +35,10 @@ EVENT_KINDS_INCIDENT = ("fault", "watchdog_timeout", "elastic_worker_failure",
                         "fleet_replica_lost", "fleet_mid_stream_error",
                         "fleet_prefill_fallback")
 
+#: request-tracing counters (telemetry/tracing/store.py mirrors these)
+TRACE_COUNTERS = ("trace/started", "trace/finished", "trace/kept",
+                  "trace/dropped", "trace/upgraded", "trace/flagged")
+
 #: roofline table columns, shared between the section renderer and --help
 ROOFLINE_COLUMNS = (
     ("achieved_tflops", "achieved TFLOP/s per chip (step flops / step time)"),
@@ -325,6 +329,49 @@ def fleet_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+def tracing_summary(metrics: Sequence[Dict[str, Any]],
+                    events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """The request-tracing plane (telemetry/tracing): per-segment
+    TTFT/TPOT decomposition percentiles from the
+    ``serving/trace_segment_s`` histogram (one labelset per span kind),
+    the tail-sampling counters, and the exemplar links from the TTFT/TPOT
+    histogram tails to the trace ids that populated them
+    (``trace_exemplar`` events; the ids resolve via ``dstpu-trace
+    --request`` or ``GET /traces?request=``)."""
+    out: Dict[str, Any] = {}
+    segments: Dict[str, Dict[str, Any]] = {}
+    counters: Dict[str, float] = {}
+    for m in metrics:
+        name = str(m.get("name", ""))
+        if name == "serving/trace_segment_s" and m.get("count"):
+            seg = (m.get("labels") or {}).get("segment", "?")
+            segments[seg] = {k: m.get(k) for k in
+                            ("count", "sum", "mean", "p50", "p95")}
+        elif name in TRACE_COUNTERS:
+            counters[name.split("/", 1)[1]] = m.get("value")
+    # newest exemplar offer per trace id wins; keep the largest few
+    exemplars: Dict[str, Dict[str, float]] = {}
+    for e in events:
+        if e.get("kind") != "trace_exemplar":
+            continue
+        metric, trace = str(e.get("metric")), str(e.get("trace"))
+        try:
+            exemplars.setdefault(metric, {})[trace] = float(e.get("value"))
+        except (TypeError, ValueError):
+            continue
+    if segments:
+        out["segments"] = segments
+    if counters:
+        out["counters"] = counters
+    if exemplars:
+        out["exemplars"] = {
+            m: [{"trace": t, "value": v} for t, v in
+                sorted(vals.items(), key=lambda kv: kv[1],
+                       reverse=True)[:4]]
+            for m, vals in exemplars.items()}
+    return out
+
+
 def memory_summary(metrics: Sequence[Dict[str, Any]],
                    events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
@@ -422,6 +469,7 @@ def summarize_run(events_path: Optional[str],
         "overlap": overlap_summary(run["metrics"]),
         "serving": serving_summary(run["metrics"]),
         "fleet": fleet_summary(run["metrics"]),
+        "tracing": tracing_summary(run["metrics"], run["events"]),
         "profile": profile,
         "xprof": xprof_summary(run["events"], explicit_dir=xprof_dir),
         "memory": memory_summary(run["metrics"], run["events"]),
@@ -612,6 +660,34 @@ def format_summary(s: Dict[str, Any]) -> str:
                      if v]
             if parts:
                 add("lifecycle: " + ", ".join(parts))
+        add("")
+
+    tr = s.get("tracing") or {}
+    if tr:
+        add("--- request tracing (TTFT/TPOT decomposition) ---")
+        segs = tr.get("segments") or {}
+        if segs:
+            from .tracing.cli import segment_table_lines
+
+            rows = [{"segment": seg, "count": row.get("count"),
+                     "total_s": row.get("sum"), "p50_s": row.get("p50"),
+                     "p95_s": row.get("p95")}
+                    for seg, row in segs.items()]
+            rows.sort(key=lambda r: r["total_s"] or 0, reverse=True)
+            for line in segment_table_lines(rows):
+                add(line)
+        tc = tr.get("counters") or {}
+        if tc:
+            add("sampling: " + ", ".join(
+                f"{k}={int(v)}" for k, v in sorted(tc.items())
+                if v is not None))
+        for metric, label in (("ttft_s", "TTFT"), ("tpot_s", "TPOT")):
+            ex = (tr.get("exemplars") or {}).get(metric)
+            if ex:
+                add(f"{label} tail exemplars: " + ", ".join(
+                    f"{e['trace'][:12]}… ({_fmt_ms(e['value'])}ms)"
+                    for e in ex) +
+                    "  [dstpu-trace --request <id> / GET /traces]")
         add("")
 
     fl = s.get("fleet") or {}

@@ -1,0 +1,35 @@
+"""Fleet-wide request tracing: end-to-end span timelines from router
+admission to decode window.
+
+A :class:`TraceContext` (W3C-traceparent wire form) is minted at the first
+hop and propagated through every subsequent one; each process appends
+typed spans to its process-global :class:`RequestTraceStore` and returns
+them in-band with HTTP responses so the router owns the fleet-merged
+view.  Tail-based sampling keeps flagged/slow/exemplar traces and samples
+the steady state; ``bin/dstpu-trace`` renders waterfalls and Chrome-trace
+exports offline, ``GET /traces`` serves the live view.  See the README
+"Request tracing" runbook.
+"""
+from .context import RETURN_SPANS_FIELD, TRACE_HEADER, TraceContext
+from .store import (
+    ALWAYS_KEEP_FLAGS,
+    FLAG_BY_REASON,
+    SPAN_KINDS,
+    RequestTraceStore,
+    flag_trace,
+    get_trace_store,
+    install_trace_store,
+    merge_trace,
+    record_span,
+    span_coverage,
+    trace_id_of,
+    traces_endpoint_payload,
+)
+
+__all__ = [
+    "ALWAYS_KEEP_FLAGS", "FLAG_BY_REASON", "RETURN_SPANS_FIELD",
+    "SPAN_KINDS", "TRACE_HEADER",
+    "RequestTraceStore", "TraceContext", "flag_trace", "get_trace_store",
+    "install_trace_store", "merge_trace", "record_span", "span_coverage",
+    "trace_id_of", "traces_endpoint_payload",
+]

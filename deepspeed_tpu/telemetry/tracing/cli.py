@@ -1,0 +1,263 @@
+"""``bin/dstpu-trace``: per-request waterfalls over a traces.jsonl.
+
+Reads the ``traces.jsonl`` a :class:`~.store.RequestTraceStore` writes
+(rotation-aware, one ``kind: "trace"`` line per kept trace) and renders:
+
+  * default          — store overview: trace counts, the fleet-merged
+    per-segment TTFT/TPOT decomposition (count / total / p50 / p95), and
+    the slowest-traces table;
+  * ``--slowest N``  — the N slowest traces with per-segment sums;
+  * ``--request ID`` — one request's waterfall: every typed span on a
+    shared timeline (offset / duration / component / bar), plus the
+    work-segment coverage of the request wall;
+  * ``--chrome OUT`` — fleet-merged Chrome-trace export through
+    ``telemetry/trace.py``'s exporter (``chrome://tracing`` / Perfetto):
+    components map to threads, span attrs ride ``args``.
+
+``PATH`` is a telemetry output dir (containing ``traces.jsonl``) or a
+traces.jsonl path.  ``--request`` accepts a unique trace-id prefix.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Dict, List, Optional, Sequence
+
+from ..events import read_event_segments
+from ..metrics import _percentile
+from .store import span_coverage
+
+TRACES_FILE = "traces.jsonl"
+
+
+def load_traces(path: str) -> List[Dict[str, Any]]:
+    """All trace records from a dir or jsonl path, de-duplicated by trace
+    id (the newest line wins — a re-finish can re-emit)."""
+    if os.path.isdir(path):
+        path = os.path.join(path, TRACES_FILE)
+    by_id: "Dict[str, Dict[str, Any]]" = {}
+    for rec in read_event_segments(path):
+        if rec.get("kind") != "trace" or not rec.get("trace"):
+            continue
+        by_id[str(rec["trace"])] = rec
+    return list(by_id.values())
+
+
+def find_trace(traces: Sequence[Dict[str, Any]],
+               wanted: str) -> Optional[Dict[str, Any]]:
+    matches = [t for t in traces if str(t["trace"]).startswith(wanted)]
+    if len(matches) > 1:
+        exact = [t for t in matches if str(t["trace"]) == wanted]
+        return exact[0] if exact else None
+    return matches[0] if matches else None
+
+
+# --------------------------------------------------------------------- #
+# Rendering
+# --------------------------------------------------------------------- #
+def segment_table(traces: Sequence[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    durs: Dict[str, List[float]] = {}
+    for t in traces:
+        for s in t.get("spans") or []:
+            durs.setdefault(str(s.get("kind", "?")), []).append(
+                float(s.get("dur_s", 0.0)))
+    rows = []
+    for kind, vals in durs.items():
+        svals = sorted(vals)
+        rows.append({"segment": kind, "count": len(vals),
+                     "total_s": sum(vals),
+                     "p50_s": _percentile(svals, 50),
+                     "p95_s": _percentile(svals, 95)})
+    rows.sort(key=lambda r: r["total_s"], reverse=True)
+    return rows
+
+
+def segment_table_lines(rows: Sequence[Dict[str, Any]]) -> List[str]:
+    """THE per-segment decomposition table — shared by this CLI's
+    overview and dstpu-telemetry's 'request tracing' section so the two
+    renderings cannot drift.  Rows: segment/count/total_s/p50_s/p95_s
+    (seconds), pre-sorted by the caller."""
+    out = [f"{'segment':<18}{'count':>7}{'total(ms)':>12}{'p50(ms)':>10}"
+           f"{'p95(ms)':>10}"]
+    for r in rows:
+        out.append(f"{r['segment']:<18}{int(r['count'] or 0):>7}"
+                   f"{(r['total_s'] or 0) * 1e3:>12.2f}"
+                   f"{(r['p50_s'] or 0) * 1e3:>10.2f}"
+                   f"{(r['p95_s'] or 0) * 1e3:>10.2f}")
+    return out
+
+
+def _slowest_lines(traces: Sequence[Dict[str, Any]], n: int) -> List[str]:
+    done = sorted(traces, key=lambda t: t.get("wall_s") or 0.0,
+                  reverse=True)[:n]
+    out = [f"{'trace':<34}{'uid':>6}{'wall(ms)':>11}  "
+           f"{'flags / top segments'}"]
+    for t in done:
+        by_kind: Dict[str, float] = {}
+        for s in t.get("spans") or []:
+            k = str(s.get("kind", "?"))
+            by_kind[k] = by_kind.get(k, 0.0) + float(s.get("dur_s", 0.0))
+        top = sorted(by_kind.items(), key=lambda kv: kv[1], reverse=True)[:3]
+        desc = " ".join(f"{k}={v * 1e3:.0f}ms" for k, v in top)
+        flags = ",".join(t.get("flags") or [])
+        out.append(f"{str(t['trace']):<34}{str(t.get('uid', '-')):>6}"
+                   f"{(t.get('wall_s') or 0.0) * 1e3:>11.1f}  "
+                   f"{('[' + flags + '] ') if flags else ''}{desc}")
+    return out
+
+
+def waterfall_lines(trace: Dict[str, Any], width: int = 32) -> List[str]:
+    """ASCII span timeline for one request, spans ordered by start."""
+    spans = sorted(trace.get("spans") or [],
+                   key=lambda s: float(s.get("t0", 0.0)))
+    out = []
+    flags = ",".join(trace.get("flags") or [])
+    wall = trace.get("wall_s")
+    head = f"trace {trace['trace']} uid={trace.get('uid')}"
+    if wall is not None:
+        head += f" wall={wall * 1e3:.1f}ms"
+    if flags:
+        head += f" flags=[{flags}]"
+    out.append(head)
+    if not spans:
+        out.append("  (no spans)")
+        return out
+    t_min = min(float(s["t0"]) for s in spans)
+    t_max = max(float(s["t0"]) + float(s.get("dur_s", 0.0)) for s in spans)
+    span_w = max(t_max - t_min, 1e-9)
+    if wall:
+        cov = span_coverage(spans, t_min, min(t_min + wall, t_max))
+        out.append(f"  work-segment coverage: {cov * 100:.1f}% of the "
+                   f"span window (route envelope excluded)")
+    out.append(f"  {'t+ms':>9} {'segment':<16}{'component':<16}"
+               f"{'dur(ms)':>10}  timeline")
+    for s in spans:
+        off = float(s["t0"]) - t_min
+        dur = float(s.get("dur_s", 0.0))
+        lo = int(off / span_w * width)
+        hi = max(int((off + dur) / span_w * width), lo + 1)
+        bar = " " * lo + "█" * min(hi - lo, width - lo)
+        attrs = s.get("attrs") or {}
+        tag = f" {attrs}" if attrs else ""
+        out.append(f"  {off * 1e3:>9.1f} {str(s.get('kind', '?')):<16}"
+                   f"{str(s.get('component', '?')):<16}{dur * 1e3:>10.2f}"
+                   f"  |{bar:<{width}}|{tag}")
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Chrome export (reuses telemetry/trace.py's exporter)
+# --------------------------------------------------------------------- #
+def export_chrome(traces: Sequence[Dict[str, Any]], out_path: str) -> str:
+    """Render the fleet-merged traces through the PR-2 span exporter:
+    request spans become :class:`~..trace.SpanRecord`\\ s on a
+    :class:`~..trace.Tracer` (components → tids), and
+    ``Tracer.to_chrome_trace``/``export_chrome_trace`` do the rest."""
+    from ..trace import SpanRecord, Tracer
+
+    tracer = Tracer(enabled=True, jax_annotations=False,
+                    max_spans=max(sum(len(t.get("spans") or [])
+                                      for t in traces), 1))
+    all_spans = [(t, s) for t in traces for s in t.get("spans") or []]
+    if not all_spans:
+        epoch = 0.0
+    else:
+        epoch = min(float(s.get("t0", 0.0)) for _, s in all_spans)
+    tids: Dict[str, int] = {}
+    for t, s in all_spans:
+        comp = str(s.get("component", "?"))
+        tid = tids.setdefault(comp, len(tids) + 1)
+        attrs = dict(s.get("attrs") or {})
+        attrs.update(trace=t["trace"], component=comp, uid=s.get("uid"))
+        tracer._record(SpanRecord(
+            name=str(s.get("kind", "?")),
+            start_s=float(s.get("t0", 0.0)) - epoch,
+            dur_s=float(s.get("dur_s", 0.0)),
+            depth=0, parent=None, tid=tid, attrs=attrs, error=None))
+    return tracer.export_chrome_trace(out_path)
+
+
+# --------------------------------------------------------------------- #
+# CLI
+# --------------------------------------------------------------------- #
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="dstpu-trace",
+        description="Per-request span timelines from a request-trace "
+                    "store: waterfalls, slowest-trace tables, segment "
+                    "decomposition, Chrome-trace export.")
+    p.add_argument("path", help="telemetry dir (containing traces.jsonl) "
+                                "or a traces.jsonl path")
+    p.add_argument("--request", default=None, metavar="TRACE_ID",
+                   help="render one request's waterfall (unique id "
+                        "prefix accepted)")
+    p.add_argument("--slowest", type=int, default=None, metavar="N",
+                   help="show only the N slowest traces")
+    p.add_argument("--chrome", default=None, metavar="OUT_JSON",
+                   help="export the fleet-merged view as a Chrome trace")
+    p.add_argument("--json", action="store_true", dest="as_json",
+                   help="emit JSON instead of text")
+    args = p.parse_args(argv)
+
+    src = args.path if not os.path.isdir(args.path) \
+        else os.path.join(args.path, TRACES_FILE)
+    from ..events import event_segments
+
+    if not event_segments(src):
+        print(f"dstpu-trace: no {TRACES_FILE}[.N] at {args.path}")
+        return 2
+    traces = load_traces(args.path)
+    if not traces:
+        print(f"dstpu-trace: no trace records in {src}")
+        return 2
+
+    if args.chrome:
+        out = export_chrome(traces, args.chrome)
+        print(f"dstpu-trace: wrote {len(traces)} trace(s) to {out}")
+        return 0
+
+    if args.request:
+        trace = find_trace(traces, args.request)
+        if trace is None:
+            print(f"dstpu-trace: trace {args.request!r} not found "
+                  f"(or the prefix is ambiguous) among {len(traces)} "
+                  f"kept trace(s)")
+            return 1
+        if args.as_json:
+            print(json.dumps(trace, indent=2, sort_keys=True, default=str))
+        else:
+            print("\n".join(waterfall_lines(trace)))
+        return 0
+
+    if args.slowest is not None:
+        if args.as_json:
+            done = sorted(traces, key=lambda t: t.get("wall_s") or 0.0,
+                          reverse=True)[:args.slowest]
+            print(json.dumps(done, indent=2, sort_keys=True, default=str))
+        else:
+            print("\n".join(_slowest_lines(traces, args.slowest)))
+        return 0
+
+    if args.as_json:
+        print(json.dumps({"n_traces": len(traces),
+                          "segments": segment_table(traces)},
+                         indent=2, sort_keys=True, default=str))
+        return 0
+    flagged = sum(1 for t in traces if t.get("flags"))
+    print(f"=== dstpu request traces ({src}) ===")
+    print(f"kept traces: {len(traces)} ({flagged} flagged)")
+    print("")
+    print("--- per-segment decomposition (kept traces) ---")
+    print("\n".join(segment_table_lines(segment_table(traces))))
+    print("")
+    print("--- slowest traces ---")
+    print("\n".join(_slowest_lines(traces, 10)))
+    return 0
+
+
+if __name__ == "__main__":
+    import sys
+
+    sys.exit(main())

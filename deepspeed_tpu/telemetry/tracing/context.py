@@ -1,0 +1,113 @@
+"""W3C-traceparent-style request-trace context.
+
+One :class:`TraceContext` is minted per request at the FIRST hop that sees
+it — router admission (``dstpu-router``) or the serving front end
+(``dstpu-serve``) for direct requests — and propagated through every
+subsequent hop so each process can append typed spans under one fleet-wide
+trace id.  The wire form is exactly the W3C ``traceparent`` header
+(https://www.w3.org/TR/trace-context/):
+
+    00-<32 hex trace id>-<16 hex parent span id>-<2 hex flags>
+
+carried BOTH as an HTTP header (``traceparent``) and as a JSON body field
+of the same name — the router forwards the body field so replicas behind
+any proxy still see it, and curl users can opt a request into an existing
+trace without header plumbing.  Flag bit 0 is the W3C ``sampled`` hint;
+tail-based sampling (store.py) makes the real keep/drop decision at trace
+completion, so the hint only seeds the default.
+"""
+from __future__ import annotations
+
+import dataclasses
+import re
+import uuid
+from typing import Optional
+
+#: HTTP header AND JSON body field carrying the context between hops
+TRACE_HEADER = "traceparent"
+
+#: JSON body marker an upstream MERGING hop (the router) stamps next to
+#: the context: "return your finished spans in-band — I will merge and
+#: strip them".  External clients that merely JOIN a trace (curl with a
+#: traceparent) don't set it and get just the trace id back, never the
+#: internal span dump.
+RETURN_SPANS_FIELD = "trace_return_spans"
+
+_TRACEPARENT_RE = re.compile(
+    r"^00-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    trace_id: str          # 32 lowercase hex chars
+    span_id: str           # 16 lowercase hex chars (this hop's parent id)
+    flags: int = 1         # bit 0 = sampled hint
+    #: True when this context arrived over the wire (header/body) rather
+    #: than being minted locally.  Not part of the wire format and
+    #: excluded from equality.
+    adopted: bool = dataclasses.field(default=False, compare=False)
+    #: True when the sender also stamped RETURN_SPANS_FIELD — an upstream
+    #: MERGING hop (the router) exists that consumes in-band span
+    #: payloads.  Adopted alone is NOT enough: an external client joining
+    #: a trace is adopted too, and must not receive the span dump.
+    return_spans: bool = dataclasses.field(default=False, compare=False)
+
+    @classmethod
+    def mint(cls, sampled: bool = True) -> "TraceContext":
+        return cls(trace_id=uuid.uuid4().hex,
+                   span_id=uuid.uuid4().hex[:16],
+                   flags=1 if sampled else 0)
+
+    @classmethod
+    def parse(cls, header: Optional[str]) -> Optional["TraceContext"]:
+        """Parse a ``traceparent`` value; None on anything malformed (a
+        bad client header must never break admission — the hop just mints
+        a fresh context instead)."""
+        if not header or not isinstance(header, str):
+            return None
+        m = _TRACEPARENT_RE.match(header.strip().lower())
+        if m is None:
+            return None
+        if set(m.group(1)) == {"0"} or set(m.group(2)) == {"0"}:
+            # all-zero ids are INVALID per the W3C spec — the classic
+            # broken-propagation artifact; adopting them would collapse
+            # every such client into one shared trace
+            return None
+        try:
+            flags = int(m.group(3), 16)
+        except ValueError:  # pragma: no cover — regex already guards
+            return None
+        return cls(trace_id=m.group(1), span_id=m.group(2), flags=flags,
+                   adopted=True)
+
+    @classmethod
+    def from_request(cls, headers, payload: Optional[dict] = None
+                     ) -> "TraceContext":
+        """Resolve the context for an incoming HTTP request: the
+        ``traceparent`` header wins, then the JSON body field, else a
+        fresh mint.  ``headers`` is any ``.get``-able mapping (the stdlib
+        ``BaseHTTPRequestHandler.headers`` qualifies)."""
+        ctx = None
+        if headers is not None:
+            ctx = cls.parse(headers.get(TRACE_HEADER))
+        if ctx is None and payload:
+            ctx = cls.parse(payload.get(TRACE_HEADER))
+        if ctx is None:
+            return cls.mint()
+        if payload and payload.get(RETURN_SPANS_FIELD):
+            ctx = dataclasses.replace(ctx, return_spans=True)
+        return ctx
+
+    def header(self) -> str:
+        return f"00-{self.trace_id}-{self.span_id}-{self.flags & 0xff:02x}"
+
+    def child(self) -> "TraceContext":
+        """Same trace, fresh parent span id — the value a hop forwards
+        downstream."""
+        return TraceContext(trace_id=self.trace_id,
+                            span_id=uuid.uuid4().hex[:16],
+                            flags=self.flags)
+
+    @property
+    def sampled(self) -> bool:
+        return bool(self.flags & 1)

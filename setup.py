@@ -23,5 +23,6 @@ setup(
         "dev": ["pytest", "chex"],
     },
     scripts=["bin/dstpu", "bin/ds_report", "bin/dstpu-telemetry",
-             "bin/dstpu-check", "bin/dstpu-serve", "bin/dstpu-router"],
+             "bin/dstpu-check", "bin/dstpu-serve", "bin/dstpu-router",
+             "bin/dstpu-trace"],
 )

@@ -9,7 +9,10 @@ reclaimed, both impls), the real `dstpu-serve` graceful-drain scenario
 completed in-flight response → exit 0), and the FLEET scenario (real
 `dstpu-router` over two `--prefix-cache` replicas: prefix-cached request
 pair answers bit-identically to the cold replica with a counted cache
-hit; SIGTERM-draining one replica loses zero streams and exits 0) — all
+hit; SIGTERM-draining one replica loses zero streams and exits 0), and
+the TRACE scenario (real disaggregated router: one request produces ONE
+merged trace with queue/prefill/kv_ship/decode segments from both
+replicas, resolvable via /traces and rendered by dstpu-trace) — all
 on the CPU sim, same enforcement pattern as the no-bare-print lint, so
 the serving stack cannot rot silently while the TPU relay is down."""
 import os
@@ -29,8 +32,8 @@ class TestServingSmoke:
     def test_smoke_check_passes(self):
         """This IS the CI gate: every scenario (decode parity + roofline,
         lifecycle expiry/reclaim, spec-dec bit-exactness + acceptance,
-        dstpu-serve drain, fleet router + prefix-cache + replica drain)
-        must hold."""
+        dstpu-serve drain, fleet router + prefix-cache + replica drain,
+        disaggregated request tracing) must hold."""
         proc = subprocess.run([sys.executable, CHECK],
                               capture_output=True, text=True, timeout=900)
         assert proc.returncode == 0, \
