@@ -14,7 +14,8 @@ a parseable JSON result instead of a crash.
 
 Env knobs: DSTPU_BENCH_LAYERS / HIDDEN / SEQ / BATCH / STEPS,
 DSTPU_BENCH_MODE (train | flash_sweep | serving | serving_load |
-decode_sweep | overlap_sweep | comm_sweep | ...), DSTPU_BENCH_FORCE_CPU=1,
+decode_sweep | overlap_sweep | comm_sweep | kernel_sweep | ...),
+DSTPU_BENCH_FORCE_CPU=1,
 DSTPU_BENCH_PROBE_TIMEOUT (seconds, default 300); serving modes also read
 DSTPU_BENCH_CTX (context length), DSTPU_BENCH_CHUNK (splitfuse chunk) and
 DSTPU_BENCH_SEQS (decode batch width); decode_sweep reads
@@ -1283,7 +1284,7 @@ def run_comm_sweep(on_tpu: bool) -> None:
     payload = sum(int(x.size) * 4 for x in leaves)
 
     algos = [a for a in os.environ.get(
-        "DSTPU_BENCH_SWEEP_ALGOS", "flat,2hop").split(",") if a]
+        "DSTPU_BENCH_SWEEP_ALGOS", "flat,2hop,fused_gemm").split(",") if a]
     if not (intra and inter):
         algos = [a for a in algos if a != "2hop"]
     wires = [w for w in os.environ.get(
@@ -1340,6 +1341,10 @@ def run_comm_sweep(on_tpu: bool) -> None:
     points = []
     for algo in algos:
         for wire in wires:
+            if algo == "fused_gemm" and wire == "int4_loco":
+                # LoCo residual state rides the flat/2hop wires; the
+                # fused-gemm epilogue schedule carries fp and int8 edges
+                continue
             # the LoCo wire runs per-leaf (residual state per leaf), so
             # bucket size never reaches its program — measure it once and
             # record bucket_bytes=0 (bucket-independent) instead of
@@ -1385,7 +1390,8 @@ def run_comm_sweep(on_tpu: bool) -> None:
 
     sel = hier.CollectiveAlgoSelector.from_topology(
         topo, data_axes, allow_quantized=("int8" in wires),
-        allow_loco=("int4_loco" in wires))
+        allow_loco=("int4_loco" in wires),
+        allow_fused_gemm=("fused_gemm" in algos))
     frac = float(os.environ.get("DSTPU_BENCH_SWEEP_FRAC", "0.5"))
     selections = []
     for bucket in buckets:
@@ -1414,6 +1420,8 @@ def run_comm_sweep(on_tpu: bool) -> None:
     if final is not None:
         algo, wire = final["retuned"].split("/")
         reg.gauge("comm/algo_2hop").set(1.0 if algo == "2hop" else 0.0)
+        reg.gauge("comm/algo_fused_gemm").set(
+            1.0 if algo == "fused_gemm" else 0.0)
         reg.gauge("comm/wire_bits").set(float(hier.WIRE_BITS[wire]))
         reg.gauge("comm/predicted_exchange_ms").set(
             float(sel.predict_ms(final["bucket_bytes"], algo, wire)))
@@ -1434,6 +1442,164 @@ def run_comm_sweep(on_tpu: bool) -> None:
           "comm_gauges": reg.gauge_values(),
           "best_config": f"{best['algo']}/{best['wire']}",
           "backend": jax.default_backend(), "n_devices": n_dev})
+
+
+def run_kernel_sweep(on_tpu: bool) -> None:
+    """DSTPU_BENCH_MODE=kernel_sweep — per-kernel %-of-peak rooflines for
+    the four Pallas kernel families (flash attention, decode paged
+    attention, the PR-9 fused quantized wire, the fused-gemm matmul) on
+    fabricated inputs, so kernel numbers come from ONE enforced table
+    instead of ad-hoc per-mode timings (the earlier flash_sweep relay
+    window was rejected as implausible — BENCH_NOTES).
+
+    Off-TPU the Pallas kernels run in interpreter mode (decode uses its
+    dense bit-compatible lowering), so CPU-sim %-of-peak is a
+    plumbing/structure gate against the CPU fallback peaks, not a speed
+    claim — the on-chip run of the SAME table is the trustworthy number
+    (ROADMAP: next relay window).  Emits the table in ``extra.kernels``
+    plus the published ``kernels/*`` gauges; enforced tier-1 by
+    ``tools/check_kernel_sweep.py``.
+
+    Env: DSTPU_BENCH_KERNELS (comma subset of
+    flash,decode_paged,fused_wire,fused_gemm), DSTPU_BENCH_KERNEL_STEPS.
+    """
+    from deepspeed_tpu.inference.v2.kernels.ragged_ops import (
+        decode_attend_dense, decode_paged_attention)
+    from deepspeed_tpu.kernels.fused_collective_matmul import (
+        matmul_costs, rmsnorm_matmul, shard_major_matmul)
+    from deepspeed_tpu.ops.quantizer.quantizer import (quant_pack_wire,
+                                                       unpack_dequant_wire)
+    from deepspeed_tpu.ops.transformer.flash_attention import flash_attention
+    from deepspeed_tpu.profiling.roofline import (device_spec,
+                                                  format_kernel_table,
+                                                  kernel_roofline_report,
+                                                  publish_kernel_gauges)
+    from deepspeed_tpu.telemetry.metrics import MetricsRegistry
+
+    steps = env_int("DSTPU_BENCH_KERNEL_STEPS", 2)
+    wanted = [k for k in os.environ.get(
+        "DSTPU_BENCH_KERNELS",
+        "flash,decode_paged,fused_wire,fused_gemm").split(",") if k]
+    rng = np.random.default_rng(0)
+    spec = device_spec()
+
+    def fab(shape, dtype=jnp.float32):
+        return jnp.asarray(rng.normal(size=shape), dtype)
+
+    # (name, build) — build returns (jitted_fn, args, flops, bytes); tiny
+    # CPU-sim shapes (the gate budget is ~60 s incl. interpret overhead),
+    # real shapes on TPU
+    if on_tpu:
+        B, S, H, KV, hd = 4, 2048, 16, 8, 128
+        GM, GK, GN = 4096, 4096, 4096
+        wire_elems = 16 << 20
+        dS, dctx, dps, dNB = 16, 1024, 64, 16
+    else:
+        B, S, H, KV, hd = 1, 256, 2, 2, 64
+        GM, GK, GN = 256, 256, 256
+        wire_elems = 1 << 18
+        dS, dctx, dps, dNB = 4, 128, 32, 4
+
+    def build_flash():
+        q = fab((B, S, H, hd))
+        k = fab((B, S, KV, hd))
+        v = fab((B, S, KV, hd))
+        fn = jax.jit(lambda q, k, v: flash_attention(
+            q, k, v, causal=True, block_q=128, block_k=128))
+        flops = 2.0 * B * H * S * S * hd * 2 * 0.5       # QKᵀ+PV, causal
+        bytes_ = 4.0 * (q.size + k.size + v.size + q.size)
+        return fn, (q, k, v), flops, bytes_
+
+    def build_decode():
+        pool = dS * dNB + 1
+        pages = fab((pool, dps, 2 * KV, hd))
+        q = fab((dS, H, hd))
+        lens = jnp.full((dS,), dctx, jnp.int32)
+        table = jnp.arange(1, dS * dNB + 1, dtype=jnp.int32
+                           ).reshape(dS, dNB)
+        kern = decode_paged_attention if on_tpu else decode_attend_dense
+        fn = jax.jit(lambda q, p, ln, t: kern(q, p, ln, t,
+                                              num_kv_heads=KV))
+        flops = 4.0 * H * hd * dctx * dS
+        bytes_ = 4.0 * dS * dctx * 2 * KV * hd           # the page walk
+        return fn, (q, pages, lens, table), flops, bytes_
+
+    def build_wire():
+        x = fab((wire_elems,))
+
+        def roundtrip(x):
+            w, s = quant_pack_wire(x, 8, 256)
+            return unpack_dequant_wire(w, s, 8)
+
+        fn = jax.jit(roundtrip)
+        flops = 4.0 * wire_elems                         # scale+round+mul
+        bytes_ = 4.0 * wire_elems * 2 + wire_elems       # f32 in/out + wire
+        return fn, (x,), flops, bytes_
+
+    def build_gemm():
+        x = fab((GM, GK))
+        w = fab((GK, GN))
+        sc = fab((GK,))
+        # the fused-gemm family: shard-major epilogue matmul + the fused
+        # RMSNorm+matmul — timed kernel-only (the exchange edge is the
+        # comm_sweep's subject; this row answers "is the producing kernel
+        # at peak")
+        fn = jax.jit(lambda x, sc, w: rmsnorm_matmul(x, sc, w, 1e-5,
+                                                     impl="pallas")
+                     + shard_major_matmul(x, w, 4))
+        flops, bytes_ = matmul_costs(GM, GK, GN)
+        return fn, (x, sc, w), 2 * flops, 2 * bytes_
+
+    builders = {"flash": build_flash, "decode_paged": build_decode,
+                "fused_wire": build_wire, "fused_gemm": build_gemm}
+    reg = MetricsRegistry()
+    table = {}
+    reports = []
+    for name in wanted:
+        if name not in builders:
+            log(f"kernel_sweep: unknown kernel {name!r} skipped")
+            continue
+        try:
+            fn, args, flops, bytes_ = builders[name]()
+            out = fn(*args)                  # compile + warmup
+            jax.block_until_ready(out)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                out = fn(*args)
+            jax.block_until_ready(out)
+            dt = (time.perf_counter() - t0) / steps
+        except Exception as exc:  # noqa: BLE001 — record, keep going
+            log(f"kernel_sweep {name}: FAILED {exc!r}")
+            table[name] = {"error": str(exc)[-200:]}
+            continue
+        report = kernel_roofline_report(name, flops, bytes_, dt, spec=spec)
+        report["ms"] = round(dt * 1e3, 3)
+        publish_kernel_gauges(reg, report)
+        reports.append(report)
+        table[name] = {k: (round(v, 4) if isinstance(v, float) else v)
+                       for k, v in report.items()}
+        log(f"kernel_sweep {name}: {dt*1e3:.2f} ms, "
+            f"{report['pct_peak_flops']:.3f}% flops peak, "
+            f"{report['pct_peak_hbm']:.3f}% HBM peak")
+    for line in format_kernel_table(reports):
+        log(line)
+
+    headline = max((r["pct_peak_flops"] for r in reports), default=0.0)
+    # labelled kernels/* gauges (gauge_values() is label-free-only)
+    gauges = sorted({m["name"] for m in reg.snapshot()
+                     if str(m.get("name", "")).startswith("kernels/")})
+    emit("kernel_sweep_pct_peak", round(headline, 3), "%peak",
+         0.0 if not on_tpu else round(headline / 50.0, 4), {
+             "kernels": table,
+             "kernel_gauges": gauges,
+             "device_kind": spec.kind,
+             "interpret_mode": not on_tpu,
+             "steps": steps,
+             "backend": jax.default_backend(),
+             "note": ("CPU-sim: interpreter-mode kernels vs fallback "
+                      "peaks — a structure/plumbing gate, not a speed "
+                      "claim" if not on_tpu else
+                      "on-chip per-kernel %-of-peak")})
 
 
 def run_fleet_sweep(on_tpu: bool) -> None:
@@ -1654,6 +1820,7 @@ def main():
         "overlap_sweep": ("overlap_step_ms", "ms/step"),
         "comm_sweep": ("comm_sweep_exchange_ms", "ms/step"),
         "fleet_sweep": ("fleet_sweep_tok_per_s", "tokens/s"),
+        "kernel_sweep": ("kernel_sweep_pct_peak", "%peak"),
     }.get(mode, ("zero_train_tokens_per_sec_per_chip", "tokens/s/chip"))
     try:
         backend = jax.default_backend()
@@ -1683,6 +1850,8 @@ def main():
             run_comm_sweep(on_tpu)
         elif mode == "fleet_sweep":
             run_fleet_sweep(on_tpu)
+        elif mode == "kernel_sweep":
+            run_kernel_sweep(on_tpu)
         else:
             run_train_bench(on_tpu, reason)
     except Exception as exc:  # noqa: BLE001

@@ -249,6 +249,43 @@ def build_fused_wire_artifact(bits: int = 4) -> Artifact:
 
 
 # --------------------------------------------------------------------- #
+# Fused compute+collective matmul edges (PR 15, T3)
+# --------------------------------------------------------------------- #
+def build_fused_gemm_artifact(wire_bits: int = 0) -> Artifact:
+    """The reduce-scatter epilogue matmul traced under shard_map on the
+    8-device sim, linted with ``expect_fused_gemm``: every epilogue
+    collective operand must chase to the producing pallas_call — the
+    contract the fused-wire-layout pass's gemm extension enforces (the
+    unfused matmul→psum_scatter composition is the fixture negative
+    control)."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..kernels.fused_collective_matmul import matmul_reduce_scatter
+    from ..runtime.topology import (DATA, TopologyConfig, compat_shard_map,
+                                    initialize_mesh)
+
+    topo = initialize_mesh(TopologyConfig(), force=True)
+    n = topo.mesh.shape[DATA]
+
+    def ex(x, w):
+        return matmul_reduce_scatter(x[0], w, (DATA,),
+                                     wire_bits=wire_bits,
+                                     impl="pallas")[None]
+
+    traced = jax.make_jaxpr(compat_shard_map(
+        ex, topo.mesh, (P(DATA), P()), P(DATA), manual_axes={DATA}))(
+            jax.ShapeDtypeStruct((n, 8 * n, 32), jnp.float32),
+            jax.ShapeDtypeStruct((32, 64), jnp.float32))
+    wire = f"int{wire_bits}" if wire_bits else "fp"
+    name = f"fused_gemm_epilogue[{wire}]"
+    return Artifact(name, traced,
+                    PassContext(artifact=name, mesh=topo.mesh,
+                                extra={"expect_fused_gemm": True}))
+
+
+# --------------------------------------------------------------------- #
 # The sweep
 # --------------------------------------------------------------------- #
 _BUILDERS: Dict[str, Callable[[], List[Artifact]]] = {
@@ -258,6 +295,8 @@ _BUILDERS: Dict[str, Callable[[], List[Artifact]]] = {
     "prefetch": lambda: [build_prefetch_artifact()],
     "fused_wire": lambda: [build_fused_wire_artifact(4),
                            build_fused_wire_artifact(8)],
+    "fused_gemm": lambda: [build_fused_gemm_artifact(0),
+                           build_fused_gemm_artifact(8)],
 }
 
 

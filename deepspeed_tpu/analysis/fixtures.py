@@ -137,6 +137,64 @@ def legacy_unfused_int4_wire():
                                mesh=topo.mesh)
 
 
+def unfused_matmul_psum_scatter():
+    """(traced, ctx): the fused-gemm negative control — a plain
+    ``jnp.dot`` whose result feeds ``psum_scatter`` (the unfused
+    matmul→collective composition), linted under the
+    ``expect_fused_gemm`` contract the PR-15 epilogue artifacts carry."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..runtime.topology import (DATA, TopologyConfig, compat_shard_map,
+                                    initialize_mesh)
+
+    topo = initialize_mesh(TopologyConfig(), force=True)
+    n = topo.mesh.shape[DATA]
+
+    def bad(x, w):
+        y = jnp.dot(x[0], w, preferred_element_type=jnp.float32)
+        part = jax.lax.psum_scatter(y, DATA, scatter_dimension=0,
+                                    tiled=True)
+        return (part / n)[None]
+
+    traced = jax.make_jaxpr(compat_shard_map(
+        bad, topo.mesh, (P(DATA), P()), P(DATA), manual_axes={DATA}))(
+            jax.ShapeDtypeStruct((n, 8 * n, 16), jnp.float32),
+            jax.ShapeDtypeStruct((16, 32), jnp.float32))
+    return traced, PassContext(
+        artifact="fixture:unfused_matmul_psum_scatter", mesh=topo.mesh,
+        extra={"expect_fused_gemm": True})
+
+
+def fused_gemm_epilogue():
+    """The FIXED idiom: the reduce-scatter epilogue matmul
+    (``kernels/fused_collective_matmul.matmul_reduce_scatter``) — the
+    collective's operand IS the shard-major Pallas kernel's output — must
+    stay clean under the same expectation."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from ..kernels.fused_collective_matmul import matmul_reduce_scatter
+    from ..runtime.topology import (DATA, TopologyConfig, compat_shard_map,
+                                    initialize_mesh)
+
+    topo = initialize_mesh(TopologyConfig(), force=True)
+    n = topo.mesh.shape[DATA]
+
+    def good(x, w):
+        return matmul_reduce_scatter(x[0], w, (DATA,), impl="pallas")[None]
+
+    traced = jax.make_jaxpr(compat_shard_map(
+        good, topo.mesh, (P(DATA), P()), P(DATA), manual_axes={DATA}))(
+            jax.ShapeDtypeStruct((n, 8 * n, 16), jnp.float32),
+            jax.ShapeDtypeStruct((16, 32), jnp.float32))
+    return traced, PassContext(artifact="fixture:fused_gemm_epilogue",
+                               mesh=topo.mesh,
+                               extra={"expect_fused_gemm": True})
+
+
 def all_gather_in_micro():
     """(traced, ctx): the PR-4 prefetch-invariant violation — a param
     all-gather inside a per-micro program linted with gather_budget=0."""
@@ -215,11 +273,23 @@ def run_source_fixture(pass_name: str, tmp_dir: str):
     return run_source_passes([path], passes=[get_pass(pass_name)])
 
 
-#: graph-pass fixture table: pass name → (firing builder, clean builder)
+#: graph-pass fixture table: key → (firing builder, clean builder).  A key
+#: is a pass name, optionally suffixed ``:variant`` when one pass encodes
+#: several bug classes (``fixture_pass_name`` strips the suffix) — the
+#: fused-wire-layout pass carries both the PR-9 wire contract and the
+#: PR-15 fused-gemm edge contract.
 GRAPH_FIXTURES = {
     "replica-group-gather": (unpinned_sharded_gather,
                              pinned_replicated_gather),
     "masked-nan-propagation": (nan_mask_multiply, select_before_multiply),
     "fused-wire-layout": (legacy_unfused_int4_wire, None),
+    "fused-wire-layout:gemm": (unfused_matmul_psum_scatter,
+                               fused_gemm_epilogue),
     "gather-budget": (all_gather_in_micro, None),
 }
+
+
+def fixture_pass_name(key: str) -> str:
+    """GRAPH_FIXTURES key → registered pass name (strips the ``:variant``
+    suffix)."""
+    return key.split(":", 1)[0]

@@ -268,17 +268,35 @@ class FusedWireLayoutPass(GraphPass):
     bug_class = ("unfused quantize→exchange wire (PR 9: legacy jnp int4 "
                  "pack between quantize and collective)")
 
+    #: collectives checked under the fused-gemm expectation: the epilogue
+    #: exchanges (reduce-scatter family + the quantized a2a wire).  The
+    #: prologue's all_gather is exempt — its operand is the raw weight
+    #: shard, a program input with no producer to fuse.
+    GEMM_COLLECTIVES = ("reduce_scatter", "psum_scatter", "all_to_all")
+
     def run(self, closed, ctx: PassContext) -> List[Finding]:
         import jax.numpy as jnp
 
         graph = _shared_graph(closed, ctx)
         findings: List[Finding] = []
         seen: Dict[tuple, int] = {}
+        # fused-gemm edge contract (PR 15, T3 arXiv:2401.16677): on
+        # artifacts traced with ctx.extra["expect_fused_gemm"], EVERY
+        # epilogue-family collective operand — any dtype, not just the
+        # int8 wire — must chase through layout-only ops to the producing
+        # pallas_call; the unfused matmul→psum_scatter composition is the
+        # tested negative control (fixtures.py)
+        expect_gemm = bool(ctx.extra.get("expect_fused_gemm"))
+        gemm_prims = tuple(ctx.extra.get("fused_gemm_collectives",
+                                         self.GEMM_COLLECTIVES))
         for info in iter_eqns(closed):
             eqn = info.eqn
             name = eqn.primitive.name
             if not any(name.startswith(p) for p in _COLLECTIVE_PRIMS):
                 continue
+            if expect_gemm and eqn.invars and \
+                    any(name.startswith(p) for p in gemm_prims):
+                findings.extend(self._check_gemm_edge(eqn, graph, ctx))
             if eqn.invars:
                 key = (name, id(eqn.invars[0]))
                 seen[key] = seen.get(key, 0) + 1
@@ -296,6 +314,25 @@ class FusedWireLayoutPass(GraphPass):
                 continue
             findings.extend(self._check_wire(eqn, wire, graph, ctx))
         return findings
+
+    def _check_gemm_edge(self, eqn, graph, ctx) -> List[Finding]:
+        """Epilogue collective under the fused-gemm expectation: operand
+        must be the producing Pallas kernel's output (through layout ops).
+        A program-input operand stays clean — there was no producer to
+        fuse (the degenerate leaf-seam edge)."""
+        origin, terminal = chase(eqn.invars[0], graph, _WIRE_LAYOUT)
+        if origin is not None and origin.primitive.name == "pallas_call":
+            return []
+        if origin is None:
+            return []          # program input / literal — nothing unfused
+        f, ln = eqn_site(origin)
+        return [self.finding(
+            f"fused-gemm edge: {eqn.primitive.name} operand produced by "
+            f"{origin.primitive.name!r} instead of the fused matmul "
+            f"pallas_call — the collective fell out of the producing "
+            f"kernel (unfused matmul→collective composition); use "
+            f"kernels/fused_collective_matmul.matmul_reduce_scatter",
+            file=relpath(f), line=ln, eqn=describe_eqn(origin), ctx=ctx)]
 
     def _check_wire(self, eqn, v, graph, ctx) -> List[Finding]:
         origin, _hops = chase(v, graph, _WIRE_LAYOUT)

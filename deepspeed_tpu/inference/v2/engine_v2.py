@@ -872,6 +872,22 @@ class InferenceEngineV2:
         tel = get_telemetry()
         if tel is not None:
             publish_decode_gauges(tel.metrics, report)
+            # per-kernel %-of-peak roofline (kernels/* gauges, the
+            # dstpu-telemetry "kernels" section) for the decode attention
+            # kernel: its analytic page-walk bytes over the window wall,
+            # plus the QK+PV flops (decode is memory-bound — pct_peak_hbm
+            # is the number that matters; flops ride along for the AI)
+            from ...profiling.roofline import (kernel_roofline_report,
+                                               publish_kernel_gauges)
+
+            attn_bytes = bytes_by_kernel.get("decode_attention", 0.0)
+            attn_flops = (4.0 * cfg.num_heads * cfg.head_dim
+                          * window.mean_ctx * window.n_seqs * window.steps
+                          * cfg.num_layers)
+            kname = "decode_paged" if self.config.attn_impl == "paged" \
+                else "decode_dense"
+            publish_kernel_gauges(tel.metrics, kernel_roofline_report(
+                kname, attn_flops, attn_bytes, window.duration_s))
             tel.event("decode_window", tok_per_s=report["decode_tok_per_s"],
                       hbm_pct_peak=report["hbm_pct_peak"],
                       n_seqs=window.n_seqs, steps=window.steps,

@@ -1,0 +1,15 @@
+"""Top-level kernel layer: Pallas kernels whose EDGES are collectives.
+
+``inference/v2/kernels`` holds the serving attention kernels;
+``ops/quantizer`` the wire quantizers; this package holds the T3-style
+compute+collective fusions (arXiv:2401.16677) where a matmul's epilogue or
+prologue IS a collective exchange — see ``fused_collective_matmul``.
+"""
+from .fused_collective_matmul import (  # noqa: F401
+    all_gather_matmul,
+    matmul_reduce_scatter,
+    matmul_reference,
+    rmsnorm_matmul,
+    rmsnorm_matmul_reference,
+    supports_fused_rmsnorm,
+)

@@ -57,6 +57,13 @@ class TransformerConfig:
     #: (bench_logs r3: block_q=256/block_k=512 best on v5e at seq 2048)
     flash_block_q: int = 256
     flash_block_k: int = 512
+    #: fold rms_norm into the consuming projections' Pallas kernels
+    #: (``kernels/fused_collective_matmul.rmsnorm_matmul`` — the norm's
+    #: variance/rsqrt recomputed per output tile, normalized activations
+    #: never round-trip HBM).  "auto" = TPU only, so the CPU sim keeps the
+    #: unfused jaxpr; "on"/"off" force it.  Bitwise vs the unfused
+    #: composition under jit, test-asserted through the interpreter seam.
+    fused_rmsnorm: str = "auto"   # auto | on | off
     # MoE (Mixtral-family): >1 experts replaces the dense MLP with a
     # top-k routed expert MLP on every layer.
     num_experts: int = 1
@@ -198,8 +205,24 @@ def partition_specs(cfg: TransformerConfig) -> Dict:
 # Building blocks
 # --------------------------------------------------------------------- #
 def rms_norm(x, scale, eps):
+    # the fused path (kernels/fused_collective_matmul.rmsnorm_matmul)
+    # folds exactly this composition into the consuming projection's
+    # kernel — any change here must land there too (parity test-asserted)
     var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
     return (x * jax.lax.rsqrt(var + eps).astype(x.dtype)) * scale
+
+
+def _fused_rmsnorm_active(cfg: "TransformerConfig") -> bool:
+    """"on"/"off" force; "auto" enables on TPU Pallas only — the CPU sim's
+    jaxpr (and therefore every tier-1 numeric) is unchanged by default."""
+    mode = getattr(cfg, "fused_rmsnorm", "auto")
+    if mode in ("on", True):
+        return True
+    if mode in ("off", False):
+        return False
+    from ..kernels.fused_collective_matmul import supports_fused_rmsnorm
+
+    return supports_fused_rmsnorm()
 
 
 def rope_tables(seq_len: int, head_dim: int, theta: float, offset=0):
@@ -281,7 +304,7 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
     S = tokens.shape[1]
     cos, sin = rope_tables(S, cfg.head_dim, cfg.rope_theta)
 
-    def mlp_block(h, lp):
+    def mlp_block(h, lp, fused_scale=None):
         if cfg.num_experts > 1:
             # Mixtral-style routed expert MLP (see moe/).  Default dispatch
             # is the sparse scatter/gather path (linear in routing-chunk
@@ -294,12 +317,36 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
                 capacity_factor=cfg.moe_capacity_factor,
                 dispatch_impl=cfg.moe_dispatch)
             return out.reshape(B_, S_, D_), l_aux
-        gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
-        up = h @ lp["up_proj"]["kernel"]
+        if fused_scale is not None:
+            # fused path: h is the UN-normalized residual; the norm is
+            # folded into the gate/up projection kernels (down has no
+            # norm in front and stays a plain matmul)
+            from ..kernels.fused_collective_matmul import rmsnorm_matmul
+
+            gate = jax.nn.silu(rmsnorm_matmul(
+                h, fused_scale, lp["gate_proj"]["kernel"], cfg.norm_eps))
+            up = rmsnorm_matmul(h, fused_scale, lp["up_proj"]["kernel"],
+                                cfg.norm_eps)
+        else:
+            gate = jax.nn.silu(h @ lp["gate_proj"]["kernel"])
+            up = h @ lp["up_proj"]["kernel"]
         return (gate * up) @ lp["down_proj"]["kernel"], jnp.zeros((), jnp.float32)
 
     def proj(h, p, B, n_heads):
         y = h @ p["kernel"]
+        if "bias" in p:
+            y = y + p["bias"]
+        return y.reshape(B, S, n_heads, cfg.head_dim)
+
+    fused_norm = _fused_rmsnorm_active(cfg)
+
+    def norm_proj(x, norm_scale, p, B, n_heads):
+        """rms_norm folded into the projection kernel (the fused path's
+        per-tile recompute of the norm is free VPU work; the normalized
+        activations never hit HBM)."""
+        from ..kernels.fused_collective_matmul import rmsnorm_matmul
+
+        y = rmsnorm_matmul(x, norm_scale, p["kernel"], cfg.norm_eps)
         if "bias" in p:
             y = y + p["bias"]
         return y.reshape(B, S, n_heads, cfg.head_dim)
@@ -310,10 +357,16 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
         x, aux = carry
         B = x.shape[0]
         with jax.named_scope("attention"):
-            h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
-            q = proj(h, lp["q_proj"], B, cfg.num_heads)
-            k = proj(h, lp["k_proj"], B, cfg.num_kv_heads)
-            v = proj(h, lp["v_proj"], B, cfg.num_kv_heads)
+            if fused_norm:
+                ns = lp["attn_norm"]["scale"]
+                q = norm_proj(x, ns, lp["q_proj"], B, cfg.num_heads)
+                k = norm_proj(x, ns, lp["k_proj"], B, cfg.num_kv_heads)
+                v = norm_proj(x, ns, lp["v_proj"], B, cfg.num_kv_heads)
+            else:
+                h = rms_norm(x, lp["attn_norm"]["scale"], cfg.norm_eps)
+                q = proj(h, lp["q_proj"], B, cfg.num_heads)
+                k = proj(h, lp["k_proj"], B, cfg.num_kv_heads)
+                v = proj(h, lp["v_proj"], B, cfg.num_kv_heads)
             q = apply_rope(q, cos, sin)
             k = apply_rope(k, cos, sin)
             o = attention(q, k, v, cfg, causal=True)
@@ -325,8 +378,14 @@ def forward(params: Dict, tokens: jax.Array, cfg: TransformerConfig,
         # data/seq axes — the reference's partition_activations.
         x = checkpoint_name(_constrain(x, _activation_spec()), "attn_residual")
         with jax.named_scope("mlp"):
-            h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
-            mlp_out, l_aux = mlp_block(h, lp)
+            if fused_norm and cfg.num_experts == 1:
+                # norm folded into the gate/up kernels; MoE keeps the
+                # unfused norm (the router needs h itself)
+                mlp_out, l_aux = mlp_block(
+                    x, lp, fused_scale=lp["mlp_norm"]["scale"])
+            else:
+                h = rms_norm(x, lp["mlp_norm"]["scale"], cfg.norm_eps)
+                mlp_out, l_aux = mlp_block(h, lp)
             x = x + mlp_out
         x = checkpoint_name(_constrain(x, _activation_spec()), "mlp_residual")
         return (x, aux + l_aux), None

@@ -141,6 +141,75 @@ def publish_gauges(metrics, report: Dict[str, Any]) -> None:
             metrics.gauge(f"roofline/{key}").set(float(v), device=kind)
 
 
+# --------------------------------------------------------------------- #
+# Per-kernel rooflines (%-of-peak per kernel family — the kernel_sweep
+# bench, the engine's decode-window publication, and the dstpu-telemetry
+# "kernels" section all consume this one report shape)
+# --------------------------------------------------------------------- #
+def kernel_roofline_report(name: str, flops: float, bytes_accessed: float,
+                           seconds: float,
+                           spec: Optional[DeviceSpec] = None
+                           ) -> Dict[str, Any]:
+    """%-of-peak roofline for ONE kernel invocation (or a timed batch of
+    identical invocations — pass summed flops/bytes and total seconds).
+
+    Both peaks are reported: compute-bound kernels (flash, fused-gemm)
+    read ``pct_peak_flops``; bandwidth-bound kernels (decode page walk,
+    the quantized wire) read ``pct_peak_hbm``.  ``bound`` names which side
+    of the ridge the kernel's arithmetic intensity puts it on — the
+    honest denominator for "is this kernel fast".
+    """
+    spec = spec or device_spec()
+    dt = max(float(seconds), 1e-12)
+    ai = flops / max(bytes_accessed, 1.0)
+    tflops = flops / dt / 1e12
+    gbps = bytes_accessed / dt / 1e9
+    return {
+        "kernel": str(name),
+        "device_kind": spec.kind,
+        "tflops": tflops,
+        "hbm_gbps": gbps,
+        "pct_peak_flops": 100.0 * (flops / dt) / spec.peak_flops,
+        "pct_peak_hbm": 100.0 * (bytes_accessed / dt) / spec.hbm_bandwidth,
+        "arithmetic_intensity": ai,
+        "bound": "compute" if ai >= spec.ridge_intensity else "memory",
+        "seconds": float(seconds),
+        "flops": float(flops),
+        "bytes": float(bytes_accessed),
+    }
+
+
+def publish_kernel_gauges(metrics, report: Dict[str, Any]) -> None:
+    """Mirror a per-kernel roofline into ``kernels/*`` gauges (labelled by
+    kernel + device kind) — the same publication pattern as the
+    ``serving/*`` decode gauges, rendered by ``dstpu-telemetry``'s
+    kernels section."""
+    kind = str(report.get("device_kind", "?"))
+    kname = str(report.get("kernel", "?"))
+    for key in ("tflops", "hbm_gbps", "pct_peak_flops", "pct_peak_hbm",
+                "arithmetic_intensity"):
+        v = report.get(key)
+        if isinstance(v, (int, float)):
+            metrics.gauge(f"kernels/{key}").set(float(v), kernel=kname,
+                                                device=kind)
+
+
+def format_kernel_table(reports) -> list:
+    """Human lines for a set of per-kernel roofline reports (the
+    kernel_sweep stderr trace and the telemetry summary share this)."""
+    lines = [f"{'kernel':<24}{'TFLOP/s':>10}{'%flops':>8}{'GB/s':>10}"
+             f"{'%hbm':>8}{'bound':>9}"]
+    for r in reports:
+        lines.append(
+            f"{str(r.get('kernel', '?')):<24}"
+            f"{r.get('tflops', 0.0):>10.3f}"
+            f"{r.get('pct_peak_flops', 0.0):>7.2f}%"
+            f"{r.get('hbm_gbps', 0.0):>10.2f}"
+            f"{r.get('pct_peak_hbm', 0.0):>7.2f}%"
+            f"{str(r.get('bound', '?')):>9}")
+    return lines
+
+
 def format_roofline_line(report: Dict[str, Any]) -> str:
     """One human line: the MFU headline the run summary and the profiler
     report both print."""

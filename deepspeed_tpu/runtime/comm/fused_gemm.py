@@ -1,0 +1,145 @@
+"""fused_gemm — the third collective algorithm (T3, arXiv:2401.16677).
+
+``{flat, 2hop}`` (PR 9) change HOW the exchange crosses the fabric;
+``fused_gemm`` changes WHEN: the collective is an edge of the producing
+matmul kernel (``deepspeed_tpu/kernels/fused_collective_matmul.py``), each
+output shard's tile block entering the exchange as it completes.  This
+module is the runtime glue:
+
+  * :func:`gemm_reduce_scatter` / :func:`gemm_all_gather_matmul` — the
+    call-site wrappers for code that OWNS the producing matmul (TP
+    row-parallel projections, the ZeRO-3 weight gather).  The prologue
+    wrapper takes an optional :class:`~..overlap.prefetch.GatherWindowCache`
+    and rides its invalidation rules: the gathered (wire, scale) payload is
+    reused across an accumulation window exactly like the PR-4 param
+    prefetch, and invalidated on the same events (optimizer step, load).
+  * :func:`fused_gemm_allreduce` — the LEAF-SEAM form consumed by
+    ``hierarchical.exchange_leaves`` when the selector picks
+    ``fused_gemm`` for a bucket.  A materialized gradient leaf has no
+    producer matmul left to fuse into, so this is the DEGENERATE edge: the
+    shard-major reduce-scatter epilogue + all-gather-back schedule over the
+    bucket (fp: ``psum_scatter``+``all_gather``, a reordered mean — same
+    contract as 2-hop's "exact mean, reordered"; int8: exactly the PR-9
+    fused wire).  On TPU the engine's backward GEMMs adopt the true fused
+    epilogue at their call sites; the leaf seam keeps the selector's
+    bucket accounting and wire format honest on every path.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ...kernels.fused_collective_matmul import (
+    all_gather_matmul,
+    matmul_reduce_scatter,
+)
+
+#: algorithm name as it appears in CollectiveAlgoSelector choices,
+#: ``overlap/*`` gauges, and the comm_sweep grid
+FUSED_GEMM = "fused_gemm"
+
+
+def gemm_reduce_scatter(x: jnp.ndarray, w: jnp.ndarray, axes,
+                        wire_bits: int = 0, group_size: int = 256,
+                        impl: str = "auto") -> jnp.ndarray:
+    """Mean reduce-scatter epilogue matmul (see
+    :func:`~...kernels.fused_collective_matmul.matmul_reduce_scatter`) —
+    the replacement for ``psum_scatter(x @ w)`` on TP row-parallel
+    projections and ZeRO grad-producing GEMMs."""
+    return matmul_reduce_scatter(x, w, axes, wire_bits=wire_bits,
+                                 group_size=group_size, impl=impl)
+
+
+def gemm_all_gather_matmul(x: jnp.ndarray, w_shard: jnp.ndarray, axes,
+                           wire_bits: int = 0, group_size: int = 256,
+                           impl: str = "auto",
+                           window_cache=None, gather_fn=None) -> jnp.ndarray:
+    """All-gather prologue matmul for ZeRO-3 / column-parallel weight
+    shards.
+
+    ``window_cache`` is a PR-4 ``GatherWindowCache``, riding its exact
+    invalidation rules: on a warm window the cached full weight (produced
+    once per accumulation window by ``gather_fn``, the caller's jitted
+    gather — qwZ or plain) is consumed directly, so the per-micro program
+    carries **zero** param all-gathers (the gather-budget dstpu-check
+    invariant); the engine's ``invalidate()`` calls at optimizer step /
+    checkpoint load are what end the window.  Without a cache the gather
+    is the fused prologue itself (must then run inside shard_map with
+    ``axes`` manual)."""
+    if window_cache is not None:
+        if gather_fn is None:
+            raise ValueError("window_cache requires gather_fn (the "
+                             "once-per-window jitted gather)")
+        from ...kernels.fused_collective_matmul import (matmul_reference,
+                                                        resolve_impl,
+                                                        shard_major_matmul)
+
+        w_full = window_cache.get(w_shard, gather_fn)
+        if resolve_impl(impl) == "pallas":
+            return shard_major_matmul(x, w_full, 1)
+        return matmul_reference(x, w_full)
+    return all_gather_matmul(x, w_shard, axes, wire_bits=wire_bits,
+                             group_size=group_size, impl=impl)
+
+
+# --------------------------------------------------------------------- #
+# Leaf seam (exchange_leaves' fused_gemm branch)
+# --------------------------------------------------------------------- #
+def fused_gemm_allreduce(grad: jnp.ndarray, axes, wire_bits: int = 0,
+                         group_size: int = 256,
+                         n: Optional[int] = None) -> jnp.ndarray:
+    """Mean-allreduce of one materialized leaf on the fused-gemm schedule:
+    shard-major reduce-scatter epilogue, then all-gather the mean
+    partition back (must run inside shard_map with ``axes`` manual).
+
+    fp: ``all_gather(psum_scatter(g)/n)`` — the exact mean with the
+    reduce-scatter summation order (reordered vs flat ``psum``, same
+    contract as 2-hop).  int8/int4: delegates to the PR-9 fused wire —
+    at the leaf seam the quantized fused-gemm wire IS the fused wire;
+    only the producing-kernel fusion differs when a call site owns the
+    matmul."""
+    if n is None:
+        n = jax.lax.psum(1, axes)
+    if n <= 1:
+        return grad
+    if wire_bits:
+        from .fused_wire import fused_quantized_allreduce
+
+        out, _, _ = fused_quantized_allreduce(grad, axes, bits=wire_bits,
+                                              group_size=group_size)
+        return out
+    flat = grad.reshape(-1).astype(jnp.float32)
+    size = flat.shape[0]
+    pad = (-size) % n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    part = jax.lax.psum_scatter(flat, axes, scatter_dimension=0,
+                                tiled=True) / n
+    full = jax.lax.all_gather(part, axes, axis=0, tiled=True)
+    return full[:size].reshape(grad.shape).astype(grad.dtype)
+
+
+def predict_fused_gemm_bytes(bucket_bytes: int, wire: str,
+                             n: int, group_size: int = 256
+                             ) -> Tuple[dict, float]:
+    """Per-device collective operand bytes of one fused-gemm bucket
+    exchange, by primitive — the comm_sweep's predicted-vs-measured
+    counterpart for the third algorithm (mirrors
+    ``hierarchical.predict_operand_bytes``).  Returns (by-primitive dict,
+    slow-domain wire bytes)."""
+    from .hierarchical import WIRE_BITS, _wire_bytes_per_elem
+
+    bits = WIRE_BITS[wire]
+    elems = bucket_bytes / 4.0
+    out = {}
+    if bits == 0:
+        out["psum_scatter"] = float(bucket_bytes)
+        out["all_gather"] = float(bucket_bytes) / max(n, 1)
+    else:
+        wb = _wire_bytes_per_elem(bits, group_size)
+        out["all_to_all"] = elems * wb
+        out["all_gather"] = elems / max(n, 1) * wb
+    out["total"] = sum(out.values())
+    return out, out["total"]

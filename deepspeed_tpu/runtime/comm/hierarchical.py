@@ -37,7 +37,11 @@ from .fused_wire import fused_quantized_allreduce
 
 #: wire-format names → bits on the wire (0 = full precision)
 WIRE_BITS = {"fp": 0, "int8": 8, "int4_loco": 4}
-ALGOS = ("flat", "2hop")
+#: flat/2hop change HOW the exchange crosses the fabric; fused_gemm (T3,
+#: arXiv:2401.16677 — ``comm/fused_gemm.py`` + ``kernels/
+#: fused_collective_matmul.py``) fuses it INTO the producing matmul as a
+#: reduce-scatter epilogue / all-gather prologue
+ALGOS = ("flat", "2hop", "fused_gemm")
 
 
 def hop_axes(topology, data_axes: Sequence[str]
@@ -131,8 +135,18 @@ def exchange_leaves(leaves: Sequence[jnp.ndarray], axes,
                               "fused_leaves": 0, "max_bucket_bytes": 0,
                               "total_bytes": 0}
     use_2hop = algo == "2hop" and inter_axes and intra_axes
+    use_fused_gemm = algo == "fused_gemm"
 
     def exchange(x):
+        if use_fused_gemm:
+            # the leaf seam is the DEGENERATE fused-gemm edge (a
+            # materialized bucket has no producer matmul left); call
+            # sites that own the GEMM use comm/fused_gemm.py's
+            # gemm_reduce_scatter / gemm_all_gather_matmul directly
+            from .fused_gemm import fused_gemm_allreduce
+
+            return fused_gemm_allreduce(x, axes, wire_bits=wire_bits,
+                                        group_size=group_size, n=n)
         if use_2hop:
             out, _, _ = two_hop_allreduce(x, intra_axes, inter_axes,
                                           wire_bits=wire_bits,
@@ -164,6 +178,13 @@ def predict_operand_bytes(bucket_bytes: int, algo: str, wire: str,
     primitive — the statically checkable counterpart of what
     ``fused_wire.wire_ops`` measures from the traced program, which the
     comm_sweep emits as predicted-vs-measured."""
+    if algo == "fused_gemm":
+        from .fused_gemm import predict_fused_gemm_bytes
+
+        by_prim, _ = predict_fused_gemm_bytes(
+            bucket_bytes, wire, max(n_intra, 1) * max(n_inter, 1),
+            group_size)
+        return by_prim
     bits = WIRE_BITS[wire]
     elems = bucket_bytes / 4.0
     n = max(n_intra, 1) * max(n_inter, 1)
@@ -194,7 +215,7 @@ class CommAlgoChoice:
     """One (algorithm, wire) pick with its evidence — published as the
     ``comm/*`` gauges and logged by the overlap manager."""
 
-    algo: str                      # "flat" | "2hop"
+    algo: str                      # "flat" | "2hop" | "fused_gemm"
     wire: str                      # "fp" | "int8" | "int4_loco"
     predicted_ms: float            # cost-model ms for the chosen config
     predicted_ms_all: Dict[str, float]   # "algo/wire" → ms, every candidate
@@ -234,7 +255,9 @@ class CollectiveAlgoSelector:
     def __init__(self, n_intra: int, n_inter: int, ici_bw: float,
                  dcn_bw: float, hbm_bw: float = 1e12,
                  group_size: int = 256, allow_quantized: bool = True,
-                 allow_loco: bool = False, quant_threshold: float = 0.15):
+                 allow_loco: bool = False, quant_threshold: float = 0.15,
+                 allow_fused_gemm: bool = False,
+                 fused_compute_ms: float = 0.0):
         self.n_intra = max(int(n_intra), 1)
         self.n_inter = max(int(n_inter), 1)
         self.ici_bw = float(ici_bw)
@@ -244,6 +267,16 @@ class CollectiveAlgoSelector:
         self.allow_quantized = bool(allow_quantized)
         self.allow_loco = bool(allow_loco)
         self.quant_threshold = float(quant_threshold)
+        #: offer the fused-gemm epilogue schedule (requires call sites /
+        #: the leaf seam to honor the pick — the overlap manager only
+        #: enables it on the explicit wire)
+        self.allow_fused_gemm = bool(allow_fused_gemm)
+        #: per-bucket producing-GEMM MXU milliseconds available to hide
+        #: the exchange behind (engine roofline estimate / bench
+        #: override).  0 means "no overlap evidence": fused_gemm then
+        #: predicts no cheaper than flat and loses the stable-order
+        #: tie-break, so it is only ever picked on measurement.
+        self.fused_compute_ms = float(fused_compute_ms)
 
     @classmethod
     def from_topology(cls, topology, data_axes: Sequence[str],
@@ -267,21 +300,34 @@ class CollectiveAlgoSelector:
         algos = ["flat"]
         if self.n_inter > 1 and self.n_intra > 1:
             algos.append("2hop")
+        if self.allow_fused_gemm:
+            # fused_gemm composes with any group shape — it is about when
+            # the exchange runs, not how it crosses slices
+            algos.append("fused_gemm")
         wires = ["fp"]
         if self.allow_quantized:
             wires.append("int8")
         if self.allow_loco:
             wires.append("int4_loco")
-        return [(a, w) for a in algos for w in wires]
+        # LoCo residual state rides the flat/2hop wires only: the
+        # fused-gemm edge carries fp and int8 — offering the pair would
+        # silently drop error feedback (the leaf seam delegates to the
+        # residual-less fused wire; the comm_sweep grid skips it too)
+        return [(a, w) for a in algos for w in wires
+                if not (a == "fused_gemm" and w == "int4_loco")]
 
     def _domain_bytes(self, bucket_bytes: float, algo: str, wire: str
                       ) -> Tuple[float, float, float]:
-        """(ici, dcn, hbm) bytes per device for one bucket exchange."""
+        """(ici, dcn, hbm) bytes per device for one bucket exchange.
+
+        fused_gemm moves the same bytes as flat — the epilogue schedule
+        HIDES the transfer behind the producing GEMM's MXU time, it does
+        not shrink it; the hiding is applied in :meth:`predict_ms`."""
         bits = WIRE_BITS[wire]
         n = self.n_intra * self.n_inter
         elems = bucket_bytes / 4.0
         wb = _wire_bytes_per_elem(bits, self.group_size) if bits else 4.0
-        if algo == "flat":
+        if algo in ("flat", "fused_gemm"):
             # the whole ring crosses the slow domain when the group spans it
             ring = 2.0 * (n - 1) / n * elems * wb
             hbm = 2.0 * bucket_bytes + (3.0 * bucket_bytes if bits else 0.0)
@@ -296,8 +342,16 @@ class CollectiveAlgoSelector:
 
     def predict_ms(self, bucket_bytes: float, algo: str, wire: str) -> float:
         ici, dcn, hbm = self._domain_bytes(bucket_bytes, algo, wire)
-        return 1e3 * (ici / self.ici_bw + dcn / self.dcn_bw
-                      + hbm / self.hbm_bw)
+        wire_ms = 1e3 * (ici / self.ici_bw + dcn / self.dcn_bw)
+        if algo == "fused_gemm":
+            # tile-granular epilogue: the exchange overlaps the producing
+            # GEMM's remaining shards — up to ``fused_compute_ms`` of the
+            # wire time hides, but the LAST shard's block has no compute
+            # left to hide behind, so at least 1/n stays exposed
+            n = self.n_intra * self.n_inter
+            wire_ms = max(wire_ms - self.fused_compute_ms,
+                          wire_ms / max(n, 1))
+        return wire_ms + 1e3 * hbm / self.hbm_bw
 
     def predict_wire_bytes(self, bucket_bytes: float, algo: str,
                            wire: str) -> float:

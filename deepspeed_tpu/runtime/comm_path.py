@@ -253,6 +253,10 @@ class _WireContext:
         algo = mgr.comm_algo if (overlap_on and mgr.comm_algo) else "flat"
         self.algo_2hop = bool(algo == "2hop" and self.intra_axes
                               and self.inter_axes)
+        #: fused-gemm epilogue schedule for the plain-grad exchange (the
+        #: leaf seam's degenerate edge; TP/ZeRO-3 call sites that own the
+        #: producing matmul use comm/fused_gemm.py wrappers directly)
+        self.algo_fused_gemm = bool(algo == "fused_gemm")
 
         self.params_t = engine.state.params
         self.stage3 = engine.zero_stage >= 3
@@ -384,12 +388,14 @@ class _WireContext:
         2-hop and quantized wires route through
         ``comm/hierarchical.exchange_leaves`` (the seam the comm_sweep
         bench measures)."""
-        if self.algo_2hop or self.wire_bits:
+        if self.algo_2hop or self.algo_fused_gemm or self.wire_bits:
             from .comm.hierarchical import exchange_leaves
 
+            algo = "2hop" if self.algo_2hop else \
+                ("fused_gemm" if self.algo_fused_gemm else "flat")
             exchanged, stats = exchange_leaves(
                 leaves, self.data_axes, self.intra_axes, self.inter_axes,
-                "2hop" if self.algo_2hop else "flat", self.wire_bits,
+                algo, self.wire_bits,
                 group_size=self.group_size,
                 bucket_bytes=self.bucket_bytes, n=n)
             if self.overlap_mgr is not None and self.bucket_bytes > 0:

@@ -172,6 +172,20 @@ class OverlapConfig(DeepSpeedConfigModel):
     #: auto mode: may the selector pick a QUANTIZED (int8) wire from the
     #: measured exposed-comm fraction?  Only affects the explicit wire
     auto_wire: bool = True
+    #: auto mode: may the selector pick the fused-gemm epilogue schedule
+    #: (``runtime/comm/fused_gemm.py`` — the collective fused into the
+    #: producing matmul, T3 arXiv:2401.16677)?  Only affects the explicit
+    #: wire; analytically admitted only when a producing-GEMM compute
+    #: estimate exists (see ``fused_gemm_compute_ms``)
+    auto_fused_gemm: bool = True
+    #: explicit hint: per-bucket producing-GEMM compute milliseconds the
+    #: fused-gemm epilogue can hide its exchange behind.  0 (default)
+    #: means no analytic credit — the engine's plain-grad exchange runs
+    #: the degenerate leaf-seam edge which delivers no hiding, so
+    #: fused_gemm is then only picked on a measured re-tune.  Set it when
+    #: call sites genuinely route through the comm/fused_gemm.py
+    #: epilogue wrappers (or in tests/benches).
+    fused_gemm_compute_ms: float = 0.0
     #: minimum measured exposed-comm fraction that justifies a lossy wire
     auto_quant_threshold: float = 0.15
     #: override which mesh axes cross a slice (DCN) boundary, comma list

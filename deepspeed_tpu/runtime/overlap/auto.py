@@ -31,8 +31,11 @@ class AutoTuneDecision:
     exposed_comm_fraction: Optional[float]   # None = no trace yet
     reason: str
     #: per-bucket collective algorithm/wire pick
-    #: (runtime/comm/hierarchical.py CommAlgoChoice), present when the
-    #: caller supplied a CollectiveAlgoSelector
+    #: (runtime/comm/hierarchical.py CommAlgoChoice — {flat, 2hop,
+    #: fused_gemm} × {fp, int8, int4_loco}; fused_gemm is the T3-style
+    #: matmul-epilogue schedule, admitted when the selector has a
+    #: producing-GEMM compute estimate to hide the exchange behind),
+    #: present when the caller supplied a CollectiveAlgoSelector
     comm: Optional[Any] = None
 
     def as_event(self) -> Dict[str, Any]:

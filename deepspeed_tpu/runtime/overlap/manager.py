@@ -91,7 +91,26 @@ class OverlapManager:
             engine.topology, axes,
             allow_quantized=allow_quant, allow_loco=loco,
             quant_threshold=float(
-                getattr(self.cfg, "auto_quant_threshold", 0.15)))
+                getattr(self.cfg, "auto_quant_threshold", 0.15)),
+            allow_fused_gemm=bool(
+                getattr(self.cfg, "auto_fused_gemm", True)),
+            fused_compute_ms=self._fused_gemm_compute_ms(engine))
+
+    def _fused_gemm_compute_ms(self, engine) -> float:
+        """Per-bucket producing-GEMM compute milliseconds the fused-gemm
+        epilogue can hide the exchange behind.
+
+        Deliberately the EXPLICIT config hint only
+        (``overlap.fused_gemm_compute_ms``), no auto-derived roofline
+        estimate: the engine's plain-grad exchange runs the leaf seam —
+        the degenerate edge with no producer matmul, which delivers none
+        of the modeled hiding — so crediting it analytically would make
+        the selector pick fused_gemm over schedules (flat/2hop) that are
+        actually faster.  Set the hint when call sites genuinely route
+        through the ``comm/fused_gemm.py`` epilogue wrappers (or in
+        tests/benches); otherwise fused_gemm is only picked on a
+        measured re-tune, where the timing already tells the truth."""
+        return float(getattr(self.cfg, "fused_gemm_compute_ms", 0.0) or 0)
 
     def resolve_comm(self, engine) -> None:
         """Resolve the effective (algorithm, wire) once, before the first
@@ -252,6 +271,8 @@ class OverlapManager:
         if self.comm_algo is not None:
             m.gauge("comm/algo_2hop").set(
                 1.0 if self.comm_algo == "2hop" else 0.0)
+            m.gauge("comm/algo_fused_gemm").set(
+                1.0 if self.comm_algo == "fused_gemm" else 0.0)
             m.gauge("comm/wire_bits").set(float(self.comm_wire_bits))
         if self.comm_choice is not None:
             m.gauge("comm/predicted_exchange_ms").set(

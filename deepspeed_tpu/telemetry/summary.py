@@ -292,6 +292,37 @@ def serving_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     return out
 
 
+def kernels_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """The ``kernels/*`` series: per-kernel %-of-peak rooflines
+    (``profiling/roofline.py publish_kernel_gauges`` — published from the
+    engine per decode window like the ``serving/*`` gauges, and by the
+    ``kernel_sweep`` bench).  One row per kernel label."""
+    out: Dict[str, Dict[str, Any]] = {}
+    for m in metrics:
+        name = str(m.get("name", ""))
+        if not name.startswith("kernels/"):
+            continue
+        key = name.split("/", 1)[1]
+        labels = m.get("labels") or {}
+        kname = labels.get("kernel")
+        if not kname:
+            continue
+        row = out.setdefault(kname, {})
+        row[key] = m.get("value")
+        if labels.get("device"):
+            row["device_kind"] = labels["device"]
+    # "bound" is a string the numeric gauges can't carry — reconstruct it
+    # from the published arithmetic intensity vs the device's ridge
+    for row in out.values():
+        ai = row.get("arithmetic_intensity")
+        if isinstance(ai, (int, float)) and row.get("device_kind"):
+            from ..profiling.roofline import spec_for_kind
+
+            ridge = spec_for_kind(row["device_kind"]).ridge_intensity
+            row["bound"] = "compute" if ai >= ridge else "memory"
+    return out
+
+
 #: fleet-tier counters (dstpu-router) surfaced in the fleet section
 FLEET_COUNTERS = (
     "fleet/routed", "fleet/rerouted", "fleet/shed", "fleet/replica_shed",
@@ -467,6 +498,7 @@ def summarize_run(events_path: Optional[str],
         "step_breakdown": step_breakdown(run["spans"]),
         "comm": comm_table(run["metrics"], device_kind=device_kind),
         "overlap": overlap_summary(run["metrics"]),
+        "kernels": kernels_summary(run["metrics"]),
         "serving": serving_summary(run["metrics"]),
         "fleet": fleet_summary(run["metrics"]),
         "tracing": tracing_summary(run["metrics"], run["events"]),
@@ -603,6 +635,21 @@ def format_summary(s: Dict[str, Any]) -> str:
         for line in format_device_table(xp):
             add(line)
     add("")
+
+    kr = s.get("kernels") or {}
+    if kr:
+        add("--- kernels (%-of-peak rooflines) ---")
+        from ..profiling.roofline import format_kernel_table
+
+        dev = next((row.get("device_kind") for row in kr.values()
+                    if row.get("device_kind")), "?")
+        add(f"device: {dev}")
+        rows = [dict(row, kernel=kname) for kname, row in sorted(
+            kr.items(), key=lambda kv: kv[1].get("pct_peak_flops") or 0,
+            reverse=True)]
+        for line in format_kernel_table(rows):
+            add(line)
+        add("")
 
     srv = s.get("serving") or {}
     if srv:

@@ -58,23 +58,25 @@ class TestGraphFixtures:
     """Each jaxpr detector fires on its re-introduced historical bug and
     stays silent on the fixed idiom — the core acceptance property."""
 
-    @pytest.mark.parametrize("pass_name", sorted(FX.GRAPH_FIXTURES))
-    def test_fixture_fires_at_error(self, pass_name):
-        fire, _clean = FX.GRAPH_FIXTURES[pass_name]
+    @pytest.mark.parametrize("fixture_key", sorted(FX.GRAPH_FIXTURES))
+    def test_fixture_fires_at_error(self, fixture_key):
+        pass_name = FX.fixture_pass_name(fixture_key)
+        fire, _clean = FX.GRAPH_FIXTURES[fixture_key]
         traced, ctx = fire()
         findings = A.run_graph_passes(traced, ctx,
                                       passes=[A.get_pass(pass_name)])
-        assert findings, f"{pass_name} missed its own bug class"
+        assert findings, f"{fixture_key} missed its own bug class"
         assert any(f.severity == A.ERROR for f in findings)
         assert all(f.pass_name == pass_name for f in findings)
 
-    @pytest.mark.parametrize("pass_name", sorted(
+    @pytest.mark.parametrize("fixture_key", sorted(
         n for n, (_f, c) in FX.GRAPH_FIXTURES.items() if c is not None))
-    def test_fixed_idiom_stays_clean(self, pass_name):
-        _fire, clean = FX.GRAPH_FIXTURES[pass_name]
+    def test_fixed_idiom_stays_clean(self, fixture_key):
+        _fire, clean = FX.GRAPH_FIXTURES[fixture_key]
         traced, ctx = clean()
-        assert A.run_graph_passes(traced, ctx,
-                                  passes=[A.get_pass(pass_name)]) == []
+        assert A.run_graph_passes(
+            traced, ctx,
+            passes=[A.get_pass(FX.fixture_pass_name(fixture_key))]) == []
 
     def test_replica_group_seeds_from_arg_shardings(self, mesh8):
         """The engine path: operand sharding arrives via ctx.arg_shardings

@@ -72,11 +72,13 @@ def scenario_fixtures(check):
                                         run_graph_passes)
     from deepspeed_tpu.analysis.fixtures import (GRAPH_FIXTURES,
                                                  SOURCE_FIXTURES,
+                                                 fixture_pass_name,
                                                  run_source_fixture)
 
     for name, (fire, clean) in GRAPH_FIXTURES.items():
+        gate_pass = get_pass(fixture_pass_name(name))
         traced, ctx = fire()
-        findings = run_graph_passes(traced, ctx, passes=[get_pass(name)])
+        findings = run_graph_passes(traced, ctx, passes=[gate_pass])
         check(f"{name}: historical bug fixture fires",
               len(findings) >= 1, f"no findings on {ctx.artifact}")
         check(f"{name}: fires at error severity",
@@ -84,8 +86,7 @@ def scenario_fixtures(check):
               f"severities: {[f.severity for f in findings]}")
         if clean is not None:
             traced, ctx = clean()
-            stayed = run_graph_passes(traced, ctx,
-                                      passes=[get_pass(name)])
+            stayed = run_graph_passes(traced, ctx, passes=[gate_pass])
             check(f"{name}: fixed idiom stays clean", not stayed,
                   "; ".join(f.render() for f in stayed))
 
