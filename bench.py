@@ -1617,6 +1617,7 @@ def run_fleet_sweep(on_tpu: bool) -> None:
     scaling linearity is the signal."""
     import itertools
     import threading
+    import urllib.error
     import urllib.request
 
     import jax.random as jrandom
@@ -1729,6 +1730,153 @@ def run_fleet_sweep(on_tpu: bool) -> None:
                 r.stop()
             install_trace_store(None)
 
+    # ---- autoscale axis: per-tenant QoS + the dstpu-fleet controller -- #
+    # A rate-limited "bulk" tenant floods a QoS router while an unmetered
+    # "interactive" tenant trickles; an in-process FleetController
+    # (identical tick logic to bin/dstpu-fleet, thread-backed spawner)
+    # scales 1→2 under the backlog and back to 1 when idle.  Per-tenant
+    # shed-rate and replica-count gauges flow through dstpu-telemetry
+    # (router._publish_gauges / controller._publish).
+    autoscale = None
+    if os.environ.get("DSTPU_BENCH_FLEET_AUTOSCALE", "1") != "0":
+        from deepspeed_tpu.serving.fleet import (FleetController,
+                                                 QoSAdmission, SLOTarget,
+                                                 TenantClass,
+                                                 view_from_scrape)
+        from deepspeed_tpu.telemetry import Telemetry, set_telemetry
+
+        tel = Telemetry(output_dir=os.environ.get(
+            "DSTPU_TELEMETRY_DIR", "telemetry_bench_fleet"))
+        set_telemetry(tel)
+
+        class _InprocClient:
+            def __init__(self, r):
+                self.r = r
+
+            def scrape(self):
+                return view_from_scrape(self.r.health()[1])
+
+            def register(self, url, role="decode", name=None):
+                self.r.add_replica(url, role=role, name=name)
+                return {}
+
+            def deregister(self, name):
+                self.r.remove_replica(name)
+                return {}
+
+        class _ThreadSpawner:
+            def __init__(self):
+                self.srvs, self.stopped = {}, set()
+
+            def spawn(self, name):
+                srv = mk_replica()
+                self.srvs[name] = srv
+                return f"127.0.0.1:{srv.port}"
+
+            def drain(self, name):
+                srv = self.srvs.get(name)
+                if srv is not None and name not in self.stopped:
+                    self.stopped.add(name)
+                    threading.Thread(target=srv.stop,
+                                     daemon=True).start()
+
+            def alive(self, name):
+                return name in self.srvs and name not in self.stopped
+
+            def forget(self, name):
+                self.srvs.pop(name, None)
+                self.stopped.discard(name)
+
+            def owned(self):
+                return list(self.srvs)
+
+            def stop_all(self):
+                for name, srv in list(self.srvs.items()):
+                    if name not in self.stopped:
+                        srv.stop()
+                self.srvs.clear()
+
+        qos = QoSAdmission(classes=[
+            TenantClass("bulk", priority=-1, rate=60.0, burst=120.0)])
+        seed = mk_replica()
+        router = FleetRouter(poll_s=0.2, qos=qos)
+        router.add_replica(f"127.0.0.1:{seed.port}", name="seed")
+        rs = RouterServer(router, port=0, bind="127.0.0.1").start()
+        spawner = _ThreadSpawner()
+        ctl = FleetController(
+            _InprocClient(router), spawner,
+            slo=SLOTarget(ttft_p95_s=1e9, drain_high_s=0.01,
+                          drain_low_s=10.0, min_replicas=1,
+                          max_replicas=2, hysteresis_up=1,
+                          hysteresis_down=2, cooldown_s=0.5),
+            poll_s=0.2)
+        n_bulk, n_inter = 40, 6
+        sheds = {"bulk": 0, "interactive": 0}
+        replica_counts = []
+        try:
+            def tenant_client(tenant, i):
+                try:
+                    post(rs.port, {"prompt": prompts[i % n_requests],
+                                   "max_new_tokens": 8,
+                                   "tenant": tenant})
+                except urllib.error.HTTPError as e:
+                    if e.code == 429:
+                        sheds[tenant] += 1
+                    e.read()
+                except Exception:  # noqa: BLE001 — load, not the measure
+                    pass
+
+            threads = [threading.Thread(
+                target=tenant_client,
+                args=("bulk" if i < n_bulk else "interactive", i),
+                daemon=True)
+                for i in range(n_bulk + n_inter)]
+            for t in threads:
+                t.start()
+            t_end = time.monotonic() + 20.0
+            while (any(t.is_alive() for t in threads)
+                   and time.monotonic() < t_end):
+                ctl.tick()
+                replica_counts.append(
+                    ctl.last_view.live if ctl.last_view else 0)
+                time.sleep(0.2)
+            for t in threads:
+                t.join(timeout=30)
+            # idle ticks: the controller should now scale back down
+            for _ in range(30):
+                action = ctl.tick()
+                replica_counts.append(
+                    ctl.last_view.live if ctl.last_view else 0)
+                if action == "scale_down" or \
+                        ctl.counters["fleet/controller_scale_downs"]:
+                    break
+                time.sleep(0.2)
+            tenants = router.health()[1].get("tenants") or {}
+            autoscale = {
+                "replica_count_min": min(replica_counts or [0]),
+                "replica_count_max": max(replica_counts or [0]),
+                "scale_ups": int(
+                    ctl.counters["fleet/controller_scale_ups"]),
+                "scale_downs": int(
+                    ctl.counters["fleet/controller_scale_downs"]),
+                "tenant_shed_rate": {
+                    t: row.get("shed_rate")
+                    for t, row in sorted(tenants.items())},
+                "client_429s": dict(sheds),
+            }
+            log(f"fleet_sweep autoscale: replicas "
+                f"{autoscale['replica_count_min']}→"
+                f"{autoscale['replica_count_max']} "
+                f"(ups={autoscale['scale_ups']} "
+                f"downs={autoscale['scale_downs']}) "
+                f"shed_rate={autoscale['tenant_shed_rate']}")
+        finally:
+            rs.stop()
+            spawner.stop_all()
+            seed.stop()
+            tel.close()
+            set_telemetry(None)
+
     # ---- tracing overhead: steady-state decode, store on vs off ------- #
     n_oh_streams, n_oh_tokens = 8, 192
     uid_seq = itertools.count(1000)
@@ -1786,6 +1934,7 @@ def run_fleet_sweep(on_tpu: bool) -> None:
         "tracing_overhead_pct": overhead_pct,
         "trace_decode_tok_per_s": {"off": round(off, 2),
                                    "on": round(on, 2)},
+        "autoscale": autoscale,
         "requests": n_requests, "max_new_tokens": max_new,
         "note": "CPU-sim scheduling-plane bench over the real router; "
                 "tok/s measures window packing + HTTP fan-out, not "

@@ -105,6 +105,10 @@ class ServeRequest:
     #: length for this request only.
     spec_mode: Optional[str] = None
     spec_k: Optional[int] = None
+    #: QoS attribution (serving/fleet/qos): the admission class the
+    #: router charged; stamped so every shed/latency record downstream
+    #: names its tenant.  None = direct traffic, accounted as "default".
+    tenant: Optional[str] = None
     #: disaggregated prefill (serving/fleet): ``prefill_only`` requests
     #: stop at prefill completion and export their KV rows into
     #: ``kv_shipment`` (a kv_ship.KVShipment) instead of decoding;
@@ -302,6 +306,7 @@ class LifecycleScheduler:
                 req.finish_reason = "draining"
                 self._count("serving/shed")
                 self._event("serving_shed", uid=req.uid, reason="draining",
+                            tenant=req.tenant or "default",
                             trace=self._trace_id(req))
                 self._tspan(req, "admission", t0=req._twall_submit,
                             dur_s=0.0, shed="draining")
@@ -315,6 +320,7 @@ class LifecycleScheduler:
                 self.last_shed_t = now
                 self._count("serving/shed")
                 self._event("serving_shed", uid=req.uid, reason="queue_full",
+                            tenant=req.tenant or "default",
                             queue_depth=len(self._waiting),
                             trace=self._trace_id(req))
                 self._tspan(req, "admission", t0=req._twall_submit,

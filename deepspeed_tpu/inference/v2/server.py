@@ -262,12 +262,13 @@ class _ServingHandler(BaseHTTPRequestHandler):
             deadline_s=payload.get("deadline_s"),
             ttft_timeout_s=payload.get("ttft_timeout_s"),
             spec_mode=spec_mode, spec_k=spec_k,
-            kv_import=kv_import, trace=ctx,
+            kv_import=kv_import, tenant=payload.get("tenant"), trace=ctx,
             sink=events)
         if not verdict.admitted:
             code = 503 if verdict.reason == "draining" else 429
             self._send_json(code, {
                 "error": "overloaded", "reason": verdict.reason,
+                "tenant": req.tenant or "default",
                 "retry_after_s": verdict.retry_after_s,
                 **self._trace_fields(req),
             }, headers={"Retry-After":
@@ -312,11 +313,13 @@ class _ServingHandler(BaseHTTPRequestHandler):
             prompt=prompt, max_new_tokens=0,
             priority=int(payload.get("priority", 0)),
             deadline_s=payload.get("deadline_s"),
-            prefill_only=True, trace=ctx, sink=events)
+            prefill_only=True, tenant=payload.get("tenant"),
+            trace=ctx, sink=events)
         if not verdict.admitted:
             code = 503 if verdict.reason == "draining" else 429
             self._send_json(code, {
                 "error": "overloaded", "reason": verdict.reason,
+                "tenant": req.tenant or "default",
                 "retry_after_s": verdict.retry_after_s,
                 **self._trace_fields(req),
             }, headers={"Retry-After":
@@ -492,7 +495,7 @@ class ServingServer:
                        priority: int = 0, deadline_s=None,
                        ttft_timeout_s=None, spec_mode=None, spec_k=None,
                        prefill_only: bool = False, kv_import=None,
-                       trace=None, sink: "queue.Queue" = None
+                       tenant=None, trace=None, sink: "queue.Queue" = None
                        ) -> "tuple[ServeRequest, AdmissionVerdict]":
         """Build + submit one request; lifecycle events are copied into
         ``sink`` as ``(event, tokens_copy, finish_reason, state)`` tuples
@@ -514,6 +517,7 @@ class ServingServer:
                             if ttft_timeout_s is not None else None),
             spec_mode=spec_mode, spec_k=spec_k,
             prefill_only=prefill_only, kv_import=kv_import,
+            tenant=(str(tenant) if tenant else None),
             trace=trace, on_event=on_event)
         verdict = self.scheduler.submit(req)
         self.kick()

@@ -28,7 +28,18 @@ Fields: ``site`` (required), ``kind`` — one of
   * ``exhausted`` raise :class:`InjectedExhausted`; the call site treats
                   the resource as transiently gone (the KV block allocator
                   reports allocation failure so schedulers exercise their
-                  backpressure / preemption paths) —
+                  backpressure / preemption paths),
+  * ``replica_down``  raise :class:`InjectedReplicaDown` (a
+                  ``ConnectionError``): the transport layer must treat the
+                  peer as a dead process — connection refused, reroute /
+                  lost accounting,
+  * ``net_partition``  raise :class:`InjectedNetPartition` (a
+                  ``ConnectionError``): a transient partition the caller's
+                  jittered-backoff retry should absorb before any reroute,
+  * ``controller_crash``  raise :class:`InjectedControllerCrash`; the
+                  ``dstpu-fleet`` control loop must die mid-tick and prove
+                  it rebuilds its fleet model from live ``/healthz``
+                  scrapes alone (no state file) —
 
 plus ``p`` (fire probability, default 1), ``times`` (max fires per process),
 ``steps`` (only fire at these step numbers: ``3`` | ``3-5`` | ``3|7|9``),
@@ -44,6 +55,19 @@ monotonically increasing decode-window index):
   * ``kv_alloc`` (kind ``exhausted``) — fires when the block allocator is
     asked for NEW blocks (no-op allocations never fire), simulating a
     transiently exhausted KV pool.
+
+Fleet sites (wired through ``serving/fleet``):
+
+  * ``fleet_scrape`` (kinds ``slow``/``net_partition``/``replica_down``) —
+    fires inside every router→replica ``/healthz`` probe, under the
+    probe's timeout + jittered-backoff retry;
+  * ``fleet_forward`` (same kinds) — fires on every router→replica
+    forward (``/v1/generate`` proxy legs and the disaggregated-prefill
+    KV-ship socket);
+  * ``controller_scrape`` — the ``dstpu-fleet`` controller's
+    controller→router ``/healthz`` / ``/traces`` calls;
+  * ``controller_tick`` (kind ``controller_crash``) — the top of every
+    controller decision tick.
 
 Stdlib-only and loadable standalone (fault-injection worker scripts).
 """
@@ -75,7 +99,7 @@ except ImportError:  # loaded standalone, outside the package
 
 ENV_VAR = "DSTPU_FAULT_INJECT"
 KINDS = ("io_error", "slow", "truncate", "kill", "shard_missing", "nan",
-         "exhausted")
+         "exhausted", "replica_down", "net_partition", "controller_crash")
 
 
 class InjectedNaN(ArithmeticError):
@@ -86,6 +110,27 @@ class InjectedNaN(ArithmeticError):
 class InjectedExhausted(RuntimeError):
     """Raised by the ``exhausted`` kind: the call site must report its
     resource (KV blocks, queue slots) as transiently unavailable."""
+
+
+class InjectedReplicaDown(ConnectionError):
+    """Raised by the ``replica_down`` kind: the peer process is gone.  A
+    ``ConnectionError`` subclass so the fleet transport paths (scrape /
+    forward) take their real connection-refused handling: failure
+    accounting toward LOST, reroute off the corpse."""
+
+
+class InjectedNetPartition(ConnectionError):
+    """Raised by the ``net_partition`` kind: a transient partition.  Also
+    a ``ConnectionError`` so `runtime.fault.retry` policies treat it as
+    retryable — a one-shot partition must degrade to a jittered-backoff
+    retry, not a lost replica."""
+
+
+class InjectedControllerCrash(RuntimeError):
+    """Raised by the ``controller_crash`` kind: the ``dstpu-fleet``
+    control loop must abandon the tick, drop ALL derived state
+    (hysteresis windows, cooldown clocks), and rebuild its fleet model
+    from the next live ``/healthz`` scrape."""
 
 
 def truncate_file(path: str, nbytes: int = 0) -> None:
@@ -149,6 +194,26 @@ class FaultSpec:
             raise ValueError(f"fault spec needs site=: {text!r}")
         return cls(**kw)
 
+    def manifest(self) -> str:
+        """Re-emit this spec in the ``DSTPU_FAULT_INJECT`` grammar, the
+        round-trip invariant being ``FaultSpec.parse(s.manifest()) == s``
+        — how a programmatic fault plan is handed to a worker subprocess
+        through its environment.  Default-valued fields are elided."""
+        parts = [f"site={self.site}", f"kind={self.kind}"]
+        if self.p != 1.0:
+            parts.append(f"p={self.p}")
+        if self.times is not None:
+            parts.append(f"times={self.times}")
+        if self.steps is not None:
+            parts.append("steps=" + "|".join(str(s)
+                                             for s in sorted(self.steps)))
+        for field, default in (("delay", 0.1), ("truncate_to", 0),
+                               ("exit_code", 1), ("seed", 0)):
+            value = getattr(self, field)
+            if value != default:
+                parts.append(f"{field}={value}")
+        return ",".join(parts)
+
 
 class FaultInjector:
     def __init__(self, specs: Union[str, Sequence[FaultSpec]] = ()):
@@ -156,6 +221,10 @@ class FaultInjector:
             specs = [FaultSpec.parse(s) for s in specs.split(";") if s.strip()]
         self.specs: List[FaultSpec] = list(specs)
         self.fires: "collections.Counter[str]" = collections.Counter()
+
+    def manifest(self) -> str:
+        """The whole plan as one env-var value (``;``-joined specs)."""
+        return ";".join(s.manifest() for s in self.specs)
 
     def inject(self, site: str, step: Optional[int] = None,
                path: Optional[str] = None) -> None:
@@ -210,6 +279,16 @@ class FaultInjector:
         if spec.kind == "exhausted":
             logger.warning(f"fault injection: resource exhausted at {where}")
             raise InjectedExhausted(f"injected exhaustion at {where}")
+        if spec.kind == "replica_down":
+            logger.warning(f"fault injection: replica down at {where}")
+            raise InjectedReplicaDown(f"injected replica death at {where}")
+        if spec.kind == "net_partition":
+            logger.warning(f"fault injection: net partition at {where}")
+            raise InjectedNetPartition(f"injected partition at {where}")
+        if spec.kind == "controller_crash":
+            logger.warning(f"fault injection: controller crash at {where}")
+            raise InjectedControllerCrash(f"injected controller crash at "
+                                          f"{where}")
         if spec.kind == "kill":
             logger.warning(f"fault injection: killing process at {where}")
             os._exit(spec.exit_code)

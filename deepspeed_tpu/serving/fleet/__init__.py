@@ -1,16 +1,33 @@
-"""Multi-replica serving: router, replica registry, disaggregated prefill.
+"""Multi-replica serving: router, replica registry, disaggregated prefill,
+per-tenant QoS, and the SLO autoscaling controller.
 
 Entry points:
   * :class:`~.replica.ReplicaHandle` — one registered ``dstpu-serve``
     process: scraped ``/healthz`` state + the routing score derived from
     its lifecycle drain-rate prediction.
-  * :class:`~.router.FleetRouter` — balancing, reroute-on-death, and the
-    prefill→decode KV handoff.
+  * :class:`~.router.FleetRouter` — balancing, reroute-on-death, the
+    prefill→decode KV handoff, and per-tenant admission (QoS) enforced
+    before replica dispatch.
+  * :class:`~.qos.QoSAdmission` / :class:`~.qos.TenantClass` — the
+    admission table: priority tiers, token-bucket rate quotas, deadline
+    tiers, inflight caps, all keyed on the request ``tenant``.
   * :class:`~.server.RouterServer` / ``bin/dstpu-router`` — the HTTP
     front tier terminating ``POST /v1/generate`` for the whole fleet.
+  * :class:`~.controller.FleetController` / ``bin/dstpu-fleet`` — the
+    SLO autoscaler: scrape /healthz + /traces, spawn or drain replicas
+    to hold TTFT/drain targets, heal below-floor fleets.
 """
+from .controller import (FleetController, ProcessReplicaSpawner,
+                         RouterClient, SLOTarget, view_from_scrape)
+from .qos import DEFAULT_TENANT, QoSAdmission, QoSVerdict, TenantClass
 from .replica import ReplicaHandle
-from .router import FleetRouter
+from .router import FleetRouter, FleetUnavailable, TenantThrottled
 from .server import RouterServer
 
-__all__ = ["ReplicaHandle", "FleetRouter", "RouterServer"]
+__all__ = [
+    "ReplicaHandle", "FleetRouter", "RouterServer",
+    "FleetUnavailable", "TenantThrottled",
+    "QoSAdmission", "QoSVerdict", "TenantClass", "DEFAULT_TENANT",
+    "FleetController", "SLOTarget", "RouterClient",
+    "ProcessReplicaSpawner", "view_from_scrape",
+]

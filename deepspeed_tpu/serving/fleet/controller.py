@@ -1,0 +1,535 @@
+"""``dstpu-fleet``: the SLO autoscaling / self-healing controller.
+
+The controller closes the loop the PR-13 router left open: it scrapes
+the router's structured ``/healthz`` (queue depths, drain-rate
+predictions, lost flags) plus the trace store's segment percentiles
+(``/traces`` → queue_wait/prefill p95, the TTFT decomposition), and
+spawns or drains replica processes to hold the SLO:
+
+  * **scale-up** rides the PR-7 params-only reshard-load: a fresh
+    ``dstpu-serve`` process rebuilds its engine from ``--model/--ckpt``
+    onto whatever chips are visible, then registers itself with the
+    router (``POST /replicas``);
+  * **scale-down** rides the PR-8 SIGTERM drain: the victim flips its
+    ``/healthz`` to draining (the router rotates it out), finishes its
+    in-flight windows, and exits 0 — the controller deregisters it once
+    the process is gone;
+  * **self-healing** bypasses hysteresis: whenever routable capacity
+    falls below ``min_replicas`` (a hard-killed replica, a crashed
+    spawn) a replacement is spawned immediately.
+
+**Hysteresis + cooldown** keep churn from flapping: overload must hold
+for ``hysteresis_up`` consecutive ticks (underload for
+``hysteresis_down``) before a scaling action, and any action opens a
+``cooldown_s`` window during which only healing may act.
+
+**Crash-safe by construction**: the controller keeps NO state file.
+Its entire fleet model is rebuilt every tick from live scrapes, so a
+crash (exercised by the ``controller_crash`` injection kind at the
+``controller_tick`` site) loses only hysteresis history — the restart
+path is "scrape, re-adopt, continue".  Controller→router calls carry
+explicit timeouts + jittered backoff (``runtime/fault/retry``); a
+partitioned router degrades to a skipped tick, never a hang.
+"""
+from __future__ import annotations
+
+import collections
+import dataclasses
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from typing import Dict, List, Optional
+
+from ...runtime.fault.injection import InjectedControllerCrash, inject
+from ...runtime.fault.retry import RetryPolicy, retryable
+from ...utils.logging import logger
+
+#: controller→router transport: a couple of jittered retries per call,
+#: each bounded by the client timeout — the control loop may skip a
+#: tick, it may never wedge on one.
+CONTROLLER_RETRY = RetryPolicy(max_retries=2, base_s=0.05, cap_s=1.0)
+
+#: /traces segment kinds summed (p95) into the TTFT estimate: time
+#: queued plus prompt service — the part of TTFT the fleet's capacity
+#: actually controls.
+TTFT_SEGMENTS = ("queue_wait", "prefill")
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOTarget:
+    """The objective + the knobs that keep the controller from flapping."""
+
+    ttft_p95_s: float = 2.0        # scale up when the TTFT p95 estimate exceeds this
+    drain_high_s: float = 4.0      # ... or any replica's predicted backlog drain does
+    drain_low_s: float = 0.5       # scale down when the FLEET drain estimate sits below
+    min_replicas: int = 1
+    max_replicas: int = 4
+    hysteresis_up: int = 2         # consecutive overloaded ticks before scale-up
+    hysteresis_down: int = 4       # consecutive underloaded ticks before scale-down
+    cooldown_s: float = 10.0       # post-action quiet window (healing exempt)
+
+
+@dataclasses.dataclass
+class FleetView:
+    """One tick's model of the fleet — rebuilt from scratch every scrape,
+    which is the whole crash-safety story."""
+
+    ok: bool
+    state: str = "unknown"
+    registered: int = 0            # names in the router registry (incl. lost)
+    live: int = 0                  # registered minus lost
+    routable: int = 0
+    replicas: List[Dict] = dataclasses.field(default_factory=list)
+    drain_s: float = 0.0           # fleet backlog / fleet drain rate
+    worst_drain_s: float = 0.0     # the most backed-up single replica
+    ttft_p95_s: Optional[float] = None
+
+
+def view_from_scrape(healthz: Dict,
+                     segments: Optional[Dict] = None) -> FleetView:
+    """Build the tick's :class:`FleetView` from a ``/healthz`` body and
+    (optionally) a ``/traces`` segment summary."""
+    reps = list(healthz.get("replicas") or [])
+    live = [r for r in reps if not r.get("lost")]
+    backlog = sum(int(r.get("queue_depth") or 0)
+                  + int(r.get("pending") or 0) for r in live)
+    rate = sum(float(r.get("predicted_tok_per_s") or 0.0) for r in live)
+    worst = max(((int(r.get("queue_depth") or 0)
+                  + int(r.get("pending") or 0))
+                 / max(float(r.get("predicted_tok_per_s") or 0.0), 1e-6)
+                 for r in live), default=0.0)
+    ttft = None
+    if segments:
+        parts = [s.get("p95_s") for k, s in segments.items()
+                 if k in TTFT_SEGMENTS and isinstance(s, dict)
+                 and s.get("p95_s") is not None]
+        if parts:
+            ttft = float(sum(parts))
+    return FleetView(
+        ok=True, state=str(healthz.get("state", "unknown")),
+        registered=len(reps), live=len(live),
+        routable=int(healthz.get("routable") or 0), replicas=reps,
+        drain_s=backlog / max(rate, 1e-6), worst_drain_s=worst,
+        ttft_p95_s=ttft)
+
+
+class RouterClient:
+    """HTTP client for the controller→router control surface."""
+
+    def __init__(self, url: str, timeout_s: float = 5.0,
+                 retry_policy: RetryPolicy = CONTROLLER_RETRY):
+        self.url = url.rstrip("/")
+        if "://" not in self.url:
+            self.url = "http://" + self.url
+        self.timeout_s = float(timeout_s)
+        self.retry_policy = retry_policy     # resolved by @retryable
+
+    @retryable("controller_scrape")
+    def _call(self, method: str, path: str, body=None) -> Dict:
+        inject("controller_scrape")
+        req = urllib.request.Request(
+            self.url + path, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Accept": "application/json",
+                     "Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return json.loads(e.read())       # 503 healthz still carries JSON
+
+    def scrape(self) -> FleetView:
+        healthz = self._call("GET", "/healthz")
+        try:
+            segments = (self._call("GET", "/traces") or {}).get("segments")
+        except Exception:  # noqa: BLE001 — tracing is optional signal
+            segments = None
+        return view_from_scrape(healthz, segments)
+
+    def register(self, url: str, role: str = "decode",
+                 name: Optional[str] = None) -> Dict:
+        return self._call("POST", "/replicas",
+                          {"url": url, "role": role, "name": name})
+
+    def deregister(self, name: str) -> Dict:
+        return self._call("DELETE", f"/replicas?name={name}")
+
+
+class ProcessReplicaSpawner:
+    """Spawn/drain real ``dstpu-serve`` processes.
+
+    ``serve_argv`` is the replica's CLI tail (``--model``/``--ckpt``/
+    engine shape flags); the spawner owns ``--port 0 --bind`` and a
+    per-replica ``--telemetry-dir``.  The URL is read off the
+    ``listening on`` banner; drain is one SIGTERM (the PR-8 path)."""
+
+    def __init__(self, serve_argv: List[str], bind: str = "127.0.0.1",
+                 serve_bin: Optional[str] = None,
+                 telemetry_root: Optional[str] = None,
+                 spawn_timeout_s: float = 120.0):
+        self.serve_argv = list(serve_argv)
+        self.bind = bind
+        self.serve_bin = serve_bin or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.dirname(
+                os.path.dirname(os.path.abspath(__file__))))),
+            "bin", "dstpu-serve")
+        self.telemetry_root = telemetry_root
+        self.spawn_timeout_s = float(spawn_timeout_s)
+        self._procs: Dict[str, subprocess.Popen] = {}
+
+    def spawn(self, name: str) -> Optional[str]:
+        argv = [sys.executable, self.serve_bin,
+                "--port", "0", "--bind", self.bind] + self.serve_argv
+        if self.telemetry_root:
+            argv += ["--telemetry-dir",
+                     os.path.join(self.telemetry_root, name)]
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        url: Optional[str] = None
+        deadline = time.monotonic() + self.spawn_timeout_s
+        while time.monotonic() < deadline:
+            line = proc.stdout.readline()
+            if not line:
+                break                        # died before the banner
+            if "listening on" in line:
+                url = line.rsplit("listening on", 1)[1].strip()
+                break
+        if url is None:
+            logger.error(f"spawn {name}: no banner within "
+                         f"{self.spawn_timeout_s}s, killing")
+            proc.kill()
+            proc.wait(timeout=10)
+            return None
+        # keep the pipe drained so the replica never blocks on stdout
+        threading.Thread(target=self._drain_stdout, args=(proc,),
+                         name=f"spawn-{name}-stdout", daemon=True).start()
+        self._procs[name] = proc
+        return url
+
+    @staticmethod
+    def _drain_stdout(proc: subprocess.Popen) -> None:
+        try:
+            for _ in proc.stdout:
+                pass
+        except (OSError, ValueError):
+            pass
+
+    def drain(self, name: str) -> None:
+        proc = self._procs.get(name)
+        if proc is not None and proc.poll() is None:
+            proc.send_signal(signal.SIGTERM)
+
+    def alive(self, name: str) -> bool:
+        proc = self._procs.get(name)
+        return proc is not None and proc.poll() is None
+
+    def forget(self, name: str) -> None:
+        self._procs.pop(name, None)
+
+    def owned(self) -> List[str]:
+        return list(self._procs)
+
+    def stop_all(self, deadline_s: float = 30.0) -> None:
+        for proc in self._procs.values():
+            if proc.poll() is None:
+                proc.send_signal(signal.SIGTERM)
+        t_end = time.monotonic() + deadline_s
+        for proc in self._procs.values():
+            try:
+                proc.wait(timeout=max(t_end - time.monotonic(), 0.1))
+            except subprocess.TimeoutExpired:
+                proc.kill()
+        self._procs.clear()
+
+
+class FleetController:
+    """The decision loop: scrape → heal/scale → publish.
+
+    ``client`` needs ``scrape()/register()/deregister()``, ``spawner``
+    needs ``spawn()/drain()/alive()/forget()/owned()`` — HTTP + process
+    implementations above; tests drive in-process fakes through the
+    identical tick logic."""
+
+    def __init__(self, client, spawner, slo: SLOTarget = SLOTarget(),
+                 poll_s: float = 1.0, clock=time.monotonic):
+        self.client = client
+        self.spawner = spawner
+        self.slo = slo
+        self.poll_s = float(poll_s)
+        self.clock = clock
+        self.counters: "collections.Counter[str]" = collections.Counter()
+        self.last_view: Optional[FleetView] = None
+        # -- derived state: ALL of it is disposable (crash-safety) --
+        self._over = 0
+        self._under = 0
+        self._last_action_t: Optional[float] = None
+        self._seq = 0
+
+    # ------------------------------------------------------------------ #
+    def run(self, stop: threading.Event) -> None:
+        """The loop.  An injected ``controller_crash`` (or any tick
+        bug) costs the derived state only; the next tick re-adopts the
+        fleet from a fresh scrape."""
+        while not stop.wait(self.poll_s):
+            try:
+                self.tick()
+            except InjectedControllerCrash as e:
+                logger.warning(f"controller crashed mid-tick: {e!r}")
+                self.crash_recover()
+            except Exception as e:  # noqa: BLE001 — the loop must survive
+                logger.warning(f"controller tick failed: {e!r}")
+                self.counters["fleet/controller_tick_errors"] += 1
+
+    def crash_recover(self) -> None:
+        """The restart path, in-process: drop every derived byte and
+        rebuild from live scrapes (process handles re-adopt by name —
+        they were never 'state', the router registry and the OS were)."""
+        self._over = self._under = 0
+        self._last_action_t = None
+        self.counters["fleet/controller_crashes"] += 1
+        self._count("fleet/controller_crashes")
+        self._event("fleet_controller_crash")
+
+    # ------------------------------------------------------------------ #
+    def tick(self) -> str:
+        """One decision pass; returns the action taken (telemetry +
+        tests): scrape_failed | heal | scale_up | scale_down | hold."""
+        inject("controller_tick")
+        try:
+            view = self.client.scrape()
+        except Exception as e:  # noqa: BLE001 — a dark router = skip tick
+            self.counters["fleet/controller_scrape_failures"] += 1
+            self._count("fleet/controller_scrape_failures")
+            logger.warning(f"controller scrape failed: {e!r}")
+            return "scrape_failed"
+        self.last_view = view
+        self._reap(view)
+        self._publish(view)
+
+        # -- self-healing: below the floor, act NOW (no hysteresis) ---- #
+        if view.routable < self.slo.min_replicas \
+                and view.live < self.slo.min_replicas:
+            action = "heal" if self._spawn_one("heal") else "hold"
+            return action
+
+        # -- overload / underload signals ------------------------------ #
+        # The TTFT p95 estimate comes from the router's /traces store — a
+        # since-start aggregate, not a moving window — so a past breach
+        # only counts as overload while there is *current* backlog to
+        # drain; an idle fleet with a bad history must still scale down.
+        over = (view.worst_drain_s > self.slo.drain_high_s
+                or (view.ttft_p95_s is not None
+                    and view.ttft_p95_s > self.slo.ttft_p95_s
+                    and view.drain_s > 0.0))
+        under = (not over and view.drain_s < self.slo.drain_low_s
+                 and view.routable > self.slo.min_replicas)
+        self._over = self._over + 1 if over else 0
+        self._under = self._under + 1 if under else 0
+
+        now = self.clock()
+        cooling = (self._last_action_t is not None
+                   and now - self._last_action_t < self.slo.cooldown_s)
+        if self._over >= self.slo.hysteresis_up and not cooling \
+                and view.live < self.slo.max_replicas:
+            if self._spawn_one("scale_up"):
+                self._over = 0
+                self._last_action_t = now
+                return "scale_up"
+        if self._under >= self.slo.hysteresis_down and not cooling:
+            victim = self._pick_victim(view)
+            if victim is not None:
+                self.spawner.drain(victim)
+                self._under = 0
+                self._last_action_t = now
+                self.counters["fleet/controller_scale_downs"] += 1
+                self._count("fleet/controller_scale_downs")
+                self._event("fleet_scale_down", name=victim,
+                            drain_s=round(view.drain_s, 3))
+                logger.info(f"fleet scale-down: draining {victim}")
+                return "scale_down"
+        return "hold"
+
+    # ------------------------------------------------------------------ #
+    def _spawn_one(self, reason: str) -> bool:
+        name = f"auto{os.getpid() % 10000}-{self._seq}"
+        self._seq += 1
+        url = self.spawner.spawn(name)
+        if url is None:
+            self.counters["fleet/controller_spawn_failures"] += 1
+            self._count("fleet/controller_spawn_failures")
+            return False
+        try:
+            self.client.register(url, role="decode", name=name)
+        except Exception as e:  # noqa: BLE001 — orphan the spawn, drain it
+            logger.error(f"register {name} failed: {e!r}; draining it")
+            self.spawner.drain(name)
+            self.counters["fleet/controller_spawn_failures"] += 1
+            return False
+        key = "fleet/controller_heals" if reason == "heal" \
+            else "fleet/controller_scale_ups"
+        self.counters[key] += 1
+        self._count(key)
+        self._event("fleet_scale_up" if reason == "scale_up"
+                    else "fleet_heal", name=name, url=url)
+        logger.info(f"fleet {reason}: spawned {name} at {url}")
+        return True
+
+    def _pick_victim(self, view: FleetView) -> Optional[str]:
+        """Scale-down only ever drains replicas the controller owns (an
+        operator's hand-registered replicas are not ours to kill) —
+        most recently spawned first."""
+        in_registry = {str(r.get("name")) for r in view.replicas
+                       if not r.get("lost")}
+        owned = [n for n in self.spawner.owned()
+                 if self.spawner.alive(n) and n in in_registry]
+        return owned[-1] if owned else None
+
+    def _reap(self, view: FleetView) -> None:
+        """Deregister owned replicas whose process is gone and whose
+        registry entry went lost (a finished drain, or a crash another
+        tick will heal)."""
+        lost = {str(r.get("name")) for r in view.replicas
+                if r.get("lost")}
+        for name in self.spawner.owned():
+            if not self.spawner.alive(name) and name in lost:
+                try:
+                    self.client.deregister(name)
+                except Exception as e:  # noqa: BLE001 — retried next tick
+                    logger.warning(f"deregister {name} failed: {e!r}")
+                    continue
+                self.spawner.forget(name)
+                self._event("fleet_replica_reaped", name=name)
+
+    # ------------------------------------------------------------------ #
+    def _publish(self, view: FleetView) -> None:
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is None:
+            return
+        m = tel.metrics
+        m.gauge("fleet/controller_replicas").set(view.live)
+        m.gauge("fleet/controller_routable").set(view.routable)
+        m.gauge("fleet/controller_drain_s").set(round(view.drain_s, 4))
+        if view.ttft_p95_s is not None:
+            m.gauge("fleet/controller_ttft_p95_s").set(
+                round(view.ttft_p95_s, 4))
+
+    def _count(self, name: str, n: float = 1) -> None:
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is not None:
+            tel.metrics.counter(name).inc(n)
+
+    def _event(self, kind: str, **fields) -> None:
+        from ...telemetry import get_telemetry
+
+        tel = get_telemetry()
+        if tel is not None:
+            tel.event(kind, **fields)
+
+
+# ------------------------------------------------------------------- #
+# CLI (bin/dstpu-fleet)
+# ------------------------------------------------------------------- #
+def main(argv: Optional[List[str]] = None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="dstpu-fleet",
+        description="SLO autoscaling controller: scrape a dstpu-router's "
+                    "/healthz + /traces, spawn (params-only reshard-load) "
+                    "or SIGTERM-drain dstpu-serve replicas to hold the "
+                    "TTFT/drain target, with hysteresis + cooldown.")
+    p.add_argument("--router", required=True, metavar="URL",
+                   help="the dstpu-router to control")
+    p.add_argument("--poll", type=float, default=1.0,
+                   help="decision tick interval (s)")
+    p.add_argument("--ttft-p95", type=float, default=2.0,
+                   help="SLO: scale up when the queue_wait+prefill p95 "
+                        "estimate (from /traces) exceeds this")
+    p.add_argument("--drain-high", type=float, default=4.0,
+                   help="scale up when any replica's predicted backlog "
+                        "drain exceeds this (s)")
+    p.add_argument("--drain-low", type=float, default=0.5,
+                   help="scale down when the fleet drain estimate sits "
+                        "below this (s)")
+    p.add_argument("--min-replicas", type=int, default=1)
+    p.add_argument("--max-replicas", type=int, default=4)
+    p.add_argument("--hysteresis-up", type=int, default=2,
+                   help="consecutive overloaded ticks before scale-up")
+    p.add_argument("--hysteresis-down", type=int, default=4,
+                   help="consecutive underloaded ticks before scale-down")
+    p.add_argument("--cooldown", type=float, default=10.0,
+                   help="post-action quiet window (s; healing exempt)")
+    p.add_argument("--scrape-timeout", type=float, default=5.0)
+    p.add_argument("--spawn-timeout", type=float, default=120.0)
+    p.add_argument("--bind", default="127.0.0.1",
+                   help="bind address for spawned replicas")
+    p.add_argument("--serve-bin", default=None,
+                   help="dstpu-serve entry point (default: sibling bin/)")
+    p.add_argument("--replica-flag", action="append", default=[],
+                   metavar="FLAG",
+                   help="extra dstpu-serve CLI flag for spawned replicas "
+                        "(repeatable; use --replica-flag=--ckpt=... form "
+                        "for flags with values)")
+    p.add_argument("--on-exit", choices=["drain", "leave"],
+                   default="drain",
+                   help="what happens to controller-spawned replicas on "
+                        "SIGTERM: drain them (default) or leave them "
+                        "running for an operator/restarted controller")
+    p.add_argument("--telemetry-dir", default="telemetry_fleet")
+    args = p.parse_args(argv)
+
+    from ...telemetry import Telemetry, set_telemetry
+
+    tel = Telemetry(output_dir=args.telemetry_dir)
+    set_telemetry(tel)
+
+    serve_argv = []
+    for flag in args.replica_flag:
+        serve_argv.extend(flag.split("=", 1) if flag.startswith("--")
+                          and "=" in flag else [flag])
+    slo = SLOTarget(
+        ttft_p95_s=args.ttft_p95, drain_high_s=args.drain_high,
+        drain_low_s=args.drain_low, min_replicas=args.min_replicas,
+        max_replicas=args.max_replicas, hysteresis_up=args.hysteresis_up,
+        hysteresis_down=args.hysteresis_down, cooldown_s=args.cooldown)
+    controller = FleetController(
+        RouterClient(args.router, timeout_s=args.scrape_timeout),
+        ProcessReplicaSpawner(serve_argv, bind=args.bind,
+                              serve_bin=args.serve_bin,
+                              telemetry_root=args.telemetry_dir,
+                              spawn_timeout_s=args.spawn_timeout),
+        slo=slo, poll_s=args.poll)
+
+    done = threading.Event()
+
+    def _term(signum, frame):
+        logger.info(f"signal {signum}: stopping dstpu-fleet")
+        done.set()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+    loop = threading.Thread(target=controller.run, args=(done,),
+                            name="dstpu-fleet-loop", daemon=True)
+    loop.start()
+    print(f"dstpu-fleet controlling {controller.client.url} "
+          f"(min={slo.min_replicas} max={slo.max_replicas} "
+          f"ttft_p95={slo.ttft_p95_s}s)", flush=True)
+    # Process-directed SIGTERM may land on a non-main thread; the main
+    # thread must never park in an untimed wait (see dstpu-serve/-router).
+    while not done.wait(0.5):
+        pass
+    loop.join(timeout=5.0)
+    if args.on_exit == "drain":
+        controller.spawner.stop_all()
+    tel.close()
+    return 0

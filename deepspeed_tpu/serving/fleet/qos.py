@@ -1,0 +1,197 @@
+"""Per-tenant QoS admission for the fleet router.
+
+Every request names a ``tenant`` (defaulting to :data:`DEFAULT_TENANT`
+so no shed anywhere in the fleet is ever unattributed).  The router
+checks admission BEFORE replica dispatch, so a flooding tenant sheds
+**their** requests — 429 + Retry-After computed from their own bucket's
+refill — while quiet tenants never queue behind the flood:
+
+  * **rate quota** — a token bucket per tenant, metered in *model tokens*
+    (prompt length + requested new tokens): capacity ``burst``, refill
+    ``rate`` tokens/s.  ``rate=0`` is unmetered.
+  * **priority tier** — the class's ``priority`` is stamped onto every
+    admitted request (operator policy, never client-chosen), so replica
+    preemption picks flood victims before interactive ones.
+  * **deadline tier** — a class ``deadline`` becomes the request's
+    default ``deadline_s`` when the client set none.
+  * **inflight cap** — ``inflight`` bounds a tenant's concurrently
+    dispatched requests (0 = unbounded); the router releases the slot
+    when the proxied request finishes.
+
+Classes are keyed by tenant name; tenants without a class of their own
+get a private bucket instantiated from the default-class template, so
+even anonymous traffic is isolated per tenant rather than pooled.
+
+Class spec grammar (CLI ``--tenant-class``)::
+
+    name:priority=2,rate=500,burst=2000,deadline=30,inflight=8
+
+Thread safety: one lock over the bucket table; admission is O(1) and
+never does I/O, so holding it across admit/release is cheap.
+"""
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Dict, Iterable, Optional
+
+#: the attribution fallback: requests that name no tenant are accounted
+#: (and rate-shaped) under this bucket rather than escaping attribution
+DEFAULT_TENANT = "default"
+
+
+@dataclasses.dataclass(frozen=True)
+class TenantClass:
+    """One admission class: the operator policy for a tenant name."""
+
+    name: str
+    priority: int = 0
+    rate: float = 0.0              # bucket refill, model tokens/s (0 = unmetered)
+    burst: float = 0.0             # bucket capacity; defaults to 4x rate
+    deadline: Optional[float] = None   # default deadline_s stamped on admit
+    inflight: int = 0              # concurrent dispatched requests (0 = unbounded)
+
+    def __post_init__(self):
+        if self.rate > 0 and self.burst <= 0:
+            object.__setattr__(self, "burst", 4.0 * self.rate)
+
+    @classmethod
+    def parse(cls, text: str, name: Optional[str] = None) -> "TenantClass":
+        """``name:priority=2,rate=500,...``; with ``name=`` given the
+        text is fields only (the ``--default-tenant-class`` form)."""
+        if name is None:
+            name, _, text = text.partition(":")
+            name = name.strip()
+            if not name:
+                raise ValueError(f"tenant class needs a name: {text!r}")
+        kw: Dict[str, object] = {}
+        for field in text.split(","):
+            if not field.strip():
+                continue
+            k, sep, v = field.partition("=")
+            k, v = k.strip(), v.strip()
+            if not sep:
+                raise ValueError(f"tenant class field needs k=v: {field!r}")
+            if k in ("priority", "inflight"):
+                kw[k] = int(v)
+            elif k in ("rate", "burst", "deadline"):
+                kw[k] = float(v)
+            else:
+                raise ValueError(f"unknown tenant class field {k!r}")
+        return cls(name=name, **kw)
+
+
+@dataclasses.dataclass
+class QoSVerdict:
+    admitted: bool
+    tenant: str
+    tclass: TenantClass
+    reason: Optional[str] = None       # tenant_quota | tenant_inflight
+    retry_after_s: float = 0.0
+
+
+class _Bucket:
+    __slots__ = ("level", "last_t", "inflight", "admitted", "shed",
+                 "tokens_admitted")
+
+    def __init__(self, burst: float):
+        self.level = burst
+        self.last_t: Optional[float] = None
+        self.inflight = 0
+        self.admitted = 0
+        self.shed = 0
+        self.tokens_admitted = 0.0
+
+
+class QoSAdmission:
+    """The router-side admission table: class lookup + per-tenant token
+    buckets + inflight accounting.  ``clock`` is injectable for
+    deterministic tests."""
+
+    def __init__(self, classes: Iterable[TenantClass] = (),
+                 default_class: Optional[TenantClass] = None,
+                 clock=time.monotonic):
+        self.classes: Dict[str, TenantClass] = {c.name: c for c in classes}
+        self.default_class = default_class or \
+            self.classes.get(DEFAULT_TENANT) or TenantClass(DEFAULT_TENANT)
+        self.clock = clock
+        self._lock = threading.Lock()
+        self._buckets: Dict[str, _Bucket] = {}
+
+    def class_of(self, tenant: str) -> TenantClass:
+        return self.classes.get(tenant) or self.default_class
+
+    def _bucket(self, tenant: str, tclass: TenantClass) -> _Bucket:
+        b = self._buckets.get(tenant)
+        if b is None:
+            b = self._buckets[tenant] = _Bucket(tclass.burst)
+        return b
+
+    # ------------------------------------------------------------------ #
+    def admit(self, tenant: str, cost_tokens: float) -> QoSVerdict:
+        """Charge ``cost_tokens`` against ``tenant``'s bucket; a rejection
+        carries the tenant's OWN refill time as Retry-After."""
+        tenant = str(tenant or DEFAULT_TENANT)
+        tclass = self.class_of(tenant)
+        now = self.clock()
+        with self._lock:
+            b = self._bucket(tenant, tclass)
+            if tclass.rate > 0:
+                if b.last_t is not None:
+                    b.level = min(tclass.burst,
+                                  b.level + tclass.rate * (now - b.last_t))
+                b.last_t = now
+                if b.level < cost_tokens:
+                    b.shed += 1
+                    deficit = cost_tokens - b.level
+                    return QoSVerdict(
+                        False, tenant, tclass, reason="tenant_quota",
+                        retry_after_s=max(deficit / tclass.rate, 0.05))
+            if tclass.inflight > 0 and b.inflight >= tclass.inflight:
+                b.shed += 1
+                return QoSVerdict(False, tenant, tclass,
+                                  reason="tenant_inflight",
+                                  retry_after_s=1.0)
+            if tclass.rate > 0:
+                b.level -= cost_tokens
+            b.inflight += 1
+            b.admitted += 1
+            b.tokens_admitted += cost_tokens
+            return QoSVerdict(True, tenant, tclass)
+
+    def release(self, tenant: str) -> None:
+        """The dispatched request finished (any outcome): free the slot."""
+        with self._lock:
+            b = self._buckets.get(str(tenant or DEFAULT_TENANT))
+            if b is not None and b.inflight > 0:
+                b.inflight -= 1
+
+    @staticmethod
+    def stamp(payload: Dict, verdict: QoSVerdict) -> None:
+        """Apply the admitted class's tiers to the forwarded payload: the
+        priority tier is authoritative (operator policy beats whatever the
+        client self-assigned), the deadline tier is a default only."""
+        tclass = verdict.tclass
+        if tclass.priority:
+            payload["priority"] = tclass.priority
+        if tclass.deadline is not None and payload.get("deadline_s") is None:
+            payload["deadline_s"] = tclass.deadline
+        payload["tenant"] = verdict.tenant
+
+    def snapshot(self) -> Dict[str, Dict]:
+        """Per-tenant accounting for ``/healthz`` + gauge publication."""
+        with self._lock:
+            out = {}
+            for tenant, b in self._buckets.items():
+                tclass = self.class_of(tenant)
+                total = b.admitted + b.shed
+                out[tenant] = {
+                    "class": tclass.name, "priority": tclass.priority,
+                    "admitted": b.admitted, "shed": b.shed,
+                    "inflight": b.inflight,
+                    "tokens_admitted": round(b.tokens_admitted, 1),
+                    "shed_rate": round(b.shed / total, 4) if total else 0.0,
+                    "bucket_level": round(b.level, 1),
+                }
+            return out

@@ -16,6 +16,8 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional
 
+from ...runtime.fault.injection import inject
+from ...runtime.fault.retry import RetryPolicy, retryable
 from ...utils.logging import logger
 
 #: /healthz states eligible for new work.  saturated/draining/degraded
@@ -24,11 +26,19 @@ ROUTABLE_STATES = ("healthy",)
 
 ROLES = ("decode", "prefill", "both")
 
+#: scrape transport policy: one quick jittered retry, so a transient
+#: partition degrades to a delayed probe while a dead replica still
+#: fails fast toward LOST accounting.  Every attempt is bounded by the
+#: handle's socket timeout — a wedged replica can no longer stall a
+#: scrape cycle.
+SCRAPE_RETRY = RetryPolicy(max_retries=1, base_s=0.05, cap_s=0.5)
+
 
 class ReplicaHandle:
     def __init__(self, url: str, role: str = "decode",
                  name: Optional[str] = None, lost_after: int = 2,
-                 timeout_s: float = 5.0):
+                 timeout_s: float = 5.0,
+                 retry_policy: RetryPolicy = SCRAPE_RETRY):
         if role not in ROLES:
             raise ValueError(f"role must be one of {ROLES}, got {role!r}")
         self.url = url.rstrip("/")
@@ -38,6 +48,7 @@ class ReplicaHandle:
         self.name = name or self.url.split("://", 1)[1]
         self.lost_after = int(lost_after)
         self.timeout_s = float(timeout_s)
+        self.retry_policy = retry_policy     # resolved by @retryable
         self._lock = threading.Lock()
         # -- scraped state --
         self.status = "unknown"
@@ -52,21 +63,29 @@ class ReplicaHandle:
         self.lost = False
 
     # ------------------------------------------------------------------ #
-    def scrape(self) -> bool:
-        """One ``/healthz`` poll; returns True when the replica answered
-        (any status — a 503 ``draining`` body is a healthy scrape of an
-        unroutable replica).  Connection-level failure counts toward
-        ``lost``."""
+    @retryable("fleet_scrape")
+    def _fetch_healthz(self) -> Dict:
+        """One bounded probe attempt; transport failures (incl. the
+        injected ``net_partition``/``replica_down`` kinds, which are
+        ``ConnectionError``s) get SCRAPE_RETRY's jittered backoff before
+        they count as a failed scrape."""
+        inject("fleet_scrape")
         req = urllib.request.Request(
             f"{self.url}/healthz",
             headers={"Accept": "application/json"})
         try:
-            try:
-                with urllib.request.urlopen(req,
-                                            timeout=self.timeout_s) as r:
-                    body = json.loads(r.read())
-            except urllib.error.HTTPError as e:
-                body = json.loads(e.read())       # 503 still carries JSON
+            with urllib.request.urlopen(req, timeout=self.timeout_s) as r:
+                return json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return json.loads(e.read())           # 503 still carries JSON
+
+    def scrape(self) -> bool:
+        """One ``/healthz`` poll; returns True when the replica answered
+        (any status — a 503 ``draining`` body is a healthy scrape of an
+        unroutable replica).  Connection-level failure (after the retry
+        budget) counts toward ``lost``."""
+        try:
+            body = self._fetch_healthz()
         except Exception as e:  # noqa: BLE001 — any transport failure counts
             with self._lock:
                 self.consecutive_failures += 1

@@ -23,8 +23,19 @@ The router terminates client traffic and forwards it to registered
     fallback``) — disaggregation is an optimization, never a liveness
     dependency.
 
+  * **Per-tenant QoS** — every request is stamped with a ``tenant``
+    (defaulting, so no shed in the fleet is ever unattributed); with a
+    :class:`~.qos.QoSAdmission` table installed, the tenant's token
+    bucket / inflight cap is charged BEFORE replica dispatch, so a
+    flooding tenant sheds THEIR requests (429 + a Retry-After computed
+    from their own bucket refill) while quiet tenants route normally.
+
 Thread safety: registry mutations and counters take the router lock;
 proxied HTTP runs outside it, so slow replicas never serialize the fleet.
+All router→replica sockets carry explicit timeouts plus one
+jittered-backoff retry (``runtime/fault/retry``): a partitioned or slow
+replica degrades to reroute, never to a hung request or a stalled
+scrape cycle.
 """
 from __future__ import annotations
 
@@ -36,20 +47,36 @@ import urllib.error
 import urllib.request
 from typing import Dict, List, Optional, Set, Tuple
 
+from ...runtime.fault.injection import inject
+from ...runtime.fault.retry import RetryPolicy, retryable
 from ...telemetry.tracing import (RETURN_SPANS_FIELD, TRACE_HEADER,
                                   flag_trace, merge_trace, record_span,
                                   trace_id_of)
 from ...utils.logging import logger
+from .qos import DEFAULT_TENANT, QoSAdmission, QoSVerdict
 from .replica import ReplicaHandle
 
 
 class FleetUnavailable(Exception):
     """No routable replica: the fleet-level shed."""
 
-    def __init__(self, retry_after_s: float, reason: str = "no_replica"):
+    def __init__(self, retry_after_s: float, reason: str = "no_replica",
+                 tenant: str = DEFAULT_TENANT):
         super().__init__(reason)
         self.retry_after_s = float(retry_after_s)
         self.reason = reason
+        self.tenant = tenant
+
+
+class TenantThrottled(Exception):
+    """Per-tenant QoS rejection (429): THIS tenant is over quota; the
+    fleet itself may be perfectly healthy."""
+
+    def __init__(self, tenant: str, reason: str, retry_after_s: float):
+        super().__init__(f"tenant {tenant}: {reason}")
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_s = float(retry_after_s)
 
 
 class ReplicaBadRequest(Exception):
@@ -61,26 +88,40 @@ class ReplicaBadRequest(Exception):
         self.body = body
 
 
+#: router→replica transport policy: one jittered-backoff retry before
+#: the failure surfaces to the reroute machinery — a one-shot partition
+#: costs a backoff, a dead replica still reroutes promptly.  Each
+#: attempt is bounded by the call's explicit timeout, so a partitioned
+#: or slow replica degrades to reroute, never to a hung request.
+FORWARD_RETRY = RetryPolicy(max_retries=1, base_s=0.05, cap_s=0.5)
+
+
 def _http_json(method: str, url: str, body=None,
                timeout: float = 300.0) -> Tuple[int, Dict]:
-    req = urllib.request.Request(
-        url, method=method,
-        data=json.dumps(body).encode() if body is not None else None,
-        headers={"Content-Type": "application/json"})
-    try:
-        with urllib.request.urlopen(req, timeout=timeout) as r:
-            return r.status, json.loads(r.read())
-    except urllib.error.HTTPError as e:
+    @retryable("fleet_forward", policy=FORWARD_RETRY)
+    def attempt() -> Tuple[int, Dict]:
+        inject("fleet_forward")
+        req = urllib.request.Request(
+            url, method=method,
+            data=json.dumps(body).encode() if body is not None else None,
+            headers={"Content-Type": "application/json"})
         try:
-            return e.code, json.loads(e.read())
-        except (ValueError, OSError):
-            return e.code, {"error": f"http {e.code}"}
+            with urllib.request.urlopen(req, timeout=timeout) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            try:
+                return e.code, json.loads(e.read())
+            except (ValueError, OSError):
+                return e.code, {"error": f"http {e.code}"}
+
+    return attempt()
 
 
 class FleetRouter:
     def __init__(self, poll_s: float = 0.5, disagg_threshold: int = 0,
                  wire: str = "fp32", request_timeout_s: float = 600.0,
-                 lost_after: int = 2, scrape_timeout_s: float = 5.0):
+                 lost_after: int = 2, scrape_timeout_s: float = 5.0,
+                 qos: Optional[QoSAdmission] = None):
         self.poll_s = float(poll_s)
         #: prompt length at/past which disaggregated prefill kicks in
         #: (0 = disabled; also needs a prefill-capable replica)
@@ -89,6 +130,9 @@ class FleetRouter:
         self.request_timeout_s = float(request_timeout_s)
         self.lost_after = int(lost_after)
         self.scrape_timeout_s = float(scrape_timeout_s)
+        #: per-tenant admission (None = no quotas; tenants are still
+        #: stamped onto payloads so every shed downstream is attributed)
+        self.qos = qos
         self._lock = threading.Lock()
         self._replicas: "collections.OrderedDict[str, ReplicaHandle]" = \
             collections.OrderedDict()
@@ -154,13 +198,29 @@ class FleetRouter:
                 logger.warning(f"fleet scrape pass failed: {e!r}")
 
     def scrape_all(self) -> None:
-        """One health pass over every replica + fleet gauge publication."""
-        for h in self.replicas():
-            was_lost = h.lost
-            h.scrape()
-            if h.lost and not was_lost:
-                self._on_lost(h)
+        """One health pass over every replica + fleet gauge publication.
+        Replicas probe CONCURRENTLY: one wedged replica costs its own
+        timeout + retry budget, never the whole cycle."""
+        reps = self.replicas()
+        if len(reps) > 1:
+            threads = [threading.Thread(target=self._scrape_one, args=(h,),
+                                        name=f"scrape-{h.name}",
+                                        daemon=True) for h in reps]
+            for t in threads:
+                t.start()
+            # bound = per-attempt socket timeout x retry budget + backoff
+            deadline = time.monotonic() + 2 * self.scrape_timeout_s + 2.0
+            for t in threads:
+                t.join(timeout=max(deadline - time.monotonic(), 0.05))
+        elif reps:
+            self._scrape_one(reps[0])
         self._publish_gauges()
+
+    def _scrape_one(self, h: ReplicaHandle) -> None:
+        was_lost = h.lost
+        h.scrape()
+        if h.lost and not was_lost:
+            self._on_lost(h)
 
     def _on_lost(self, h: ReplicaHandle) -> None:
         self._count("fleet/replica_lost")
@@ -217,6 +277,41 @@ class FleetRouter:
             payload[RETURN_SPANS_FIELD] = True
 
     # ------------------------------------------------------------------ #
+    # Per-tenant QoS admission (BEFORE replica dispatch)
+    # ------------------------------------------------------------------ #
+    def _qos_admit(self, payload: Dict,
+                   trace=None) -> Tuple[str, Optional[QoSVerdict]]:
+        """Stamp the tenant onto the payload (every downstream shed stays
+        attributed) and, when QoS is configured, charge the tenant's
+        bucket.  Returns ``(tenant, verdict)``; verdict None means no QoS
+        table is installed."""
+        tenant = str(payload.get("tenant") or DEFAULT_TENANT)
+        payload["tenant"] = tenant
+        if self.qos is None:
+            return tenant, None
+        cost = len(payload.get("prompt") or []) + \
+            int(payload.get("max_new_tokens") or 32)
+        verdict = self.qos.admit(tenant, cost)
+        if verdict.admitted:
+            self.qos.stamp(payload, verdict)
+            return tenant, verdict
+        self._count("fleet/shed")
+        self._count("fleet/tenant_shed")
+        self._event("fleet_tenant_shed", tenant=tenant,
+                    reason=verdict.reason,
+                    retry_after_s=round(verdict.retry_after_s, 3),
+                    trace=self._trace_id(trace))
+        self._tflag(trace, "shed")
+        self._tspan(trace, "admission", t0=time.time(), dur_s=0.0,
+                    shed=verdict.reason, tenant=tenant)
+        return tenant, verdict
+
+    def _qos_release(self, verdict: Optional[QoSVerdict]) -> None:
+        if self.qos is not None and verdict is not None \
+                and verdict.admitted:
+            self.qos.release(verdict.tenant)
+
+    # ------------------------------------------------------------------ #
     # Disaggregated prefill
     # ------------------------------------------------------------------ #
     def _maybe_disagg(self, payload: Dict, trace=None) -> None:
@@ -237,10 +332,11 @@ class FleetRouter:
         pre_body = {"prompt": [int(t) for t in prompt[:-1]],
                     "wire": self.wire}
         self._stamp(pre_body, trace)
-        # the prefill leg inherits the request's deadline/priority — a
+        # the prefill leg inherits the request's deadline/priority (a
         # deadline the client set must bound the REMOTE prefill too, not
-        # just the decode half
-        for key in ("deadline_s", "priority"):
+        # just the decode half) and its tenant, so prefill-side sheds
+        # stay attributed
+        for key in ("deadline_s", "priority", "tenant"):
             if payload.get(key) is not None:
                 pre_body[key] = payload[key]
         try:
@@ -289,12 +385,28 @@ class FleetRouter:
         extra headers).  Nothing has been sent to the client yet, so
         EVERY replica failure is idempotent-safe to retry."""
         payload = dict(payload)
+        tenant = str(payload.get("tenant") or DEFAULT_TENANT)
         if self.draining:
             ra = self.retry_after_s()
             self._tflag(trace, "shed")
             return 503, {"error": "router draining",
-                         "reason": "draining", "retry_after_s": ra}, \
+                         "reason": "draining", "tenant": tenant,
+                         "retry_after_s": ra}, \
                 {"Retry-After": str(int(max(ra, 1)))}
+        tenant, qv = self._qos_admit(payload, trace)
+        if qv is not None and not qv.admitted:
+            ra = qv.retry_after_s
+            return 429, {"error": "tenant over quota",
+                         "reason": qv.reason, "tenant": tenant,
+                         "retry_after_s": ra}, \
+                {"Retry-After": str(int(max(ra, 1)))}
+        try:
+            return self._route_blocking(payload, tenant, trace)
+        finally:
+            self._qos_release(qv)
+
+    def _route_blocking(self, payload: Dict, tenant: str, trace
+                        ) -> Tuple[int, Dict, Dict[str, str]]:
         self._maybe_disagg(payload, trace)
         self._stamp(payload, trace)
         tried: Set[str] = set()
@@ -309,6 +421,7 @@ class FleetRouter:
                 body = {"error": "no routable replica",
                         "reason": (last_shed or {}).get(
                             "reason", "fleet_unavailable"),
+                        "tenant": tenant,
                         "retry_after_s": ra}
                 return 503, body, {"Retry-After": str(int(max(ra, 1)))}
             tried.add(h.name)
@@ -378,15 +491,28 @@ class FleetRouter:
         forwards one complete event block.  Raises
         :class:`FleetUnavailable` / :class:`ReplicaBadRequest` ONLY
         before ``start()`` — once bytes flow, failures surface in-band as
-        a typed ``error`` event."""
+        a typed ``error`` event.  Per-tenant QoS rejections raise
+        :class:`TenantThrottled` (always before ``start()``)."""
+        payload = dict(payload)
+        payload["stream"] = True
+        tenant = str(payload.get("tenant") or DEFAULT_TENANT)
+        if self.draining:
+            self._tflag(trace, "shed")
+            raise FleetUnavailable(self.retry_after_s(), "draining",
+                                   tenant=tenant)
+        tenant, qv = self._qos_admit(payload, trace)
+        if qv is not None and not qv.admitted:
+            raise TenantThrottled(tenant, qv.reason, qv.retry_after_s)
+        try:
+            self._route_stream(payload, tenant, start, send, trace)
+        finally:
+            self._qos_release(qv)
+
+    def _route_stream(self, payload: Dict, tenant: str, start, send,
+                      trace=None) -> None:
         import http.client
         from urllib.parse import urlparse
 
-        payload = dict(payload)
-        payload["stream"] = True
-        if self.draining:
-            self._tflag(trace, "shed")
-            raise FleetUnavailable(self.retry_after_s(), "draining")
         self._maybe_disagg(payload, trace)
         self._stamp(payload, trace)
         tried: Set[str] = set()
@@ -402,7 +528,8 @@ class FleetRouter:
                 if not started:
                     raise FleetUnavailable(
                         ra, (last_shed or {}).get("reason",
-                                                  "fleet_unavailable"))
+                                                  "fleet_unavailable"),
+                        tenant=tenant)
                 send(self._error_event("fleet_unavailable", 0, ra))
                 return
             tried.add(h.name)
@@ -411,6 +538,7 @@ class FleetRouter:
             forwarded = 0
             saw_terminal = False
             try:
+                inject("fleet_forward")
                 conn = http.client.HTTPConnection(
                     u.hostname, u.port, timeout=self.request_timeout_s)
                 conn.request("POST", "/v1/generate",
@@ -566,7 +694,7 @@ class FleetRouter:
             status = "degraded"
         else:
             status = "healthy"
-        return status, {
+        body = {
             "status": status, "state": status,
             "replicas": reps,
             "routable": len(routable), "registered": len(reps),
@@ -574,6 +702,9 @@ class FleetRouter:
             "retry_after_s": self.retry_after_s(),
             "ts": time.time(),
         }
+        if self.qos is not None:
+            body["tenants"] = self.qos.snapshot()
+        return status, body
 
     def _publish_gauges(self) -> None:
         from ...telemetry import get_telemetry
@@ -604,6 +735,16 @@ class FleetRouter:
         m.gauge("fleet/prefix_hit_rate").set(
             round(hits / req, 4) if req else 0.0)
         m.gauge("fleet/replicas_saturated").set(sat)
+        if self.qos is not None:
+            for tenant, row in self.qos.snapshot().items():
+                m.gauge("fleet/tenant_shed_rate").set(
+                    row["shed_rate"], tenant=tenant)
+                m.gauge("fleet/tenant_sheds").set(row["shed"],
+                                                  tenant=tenant)
+                m.gauge("fleet/tenant_admitted").set(row["admitted"],
+                                                     tenant=tenant)
+                m.gauge("fleet/tenant_inflight").set(row["inflight"],
+                                                     tenant=tenant)
 
     def _count(self, name: str, n: float = 1) -> None:
         with self._lock:

@@ -16,8 +16,10 @@ one tier up:
   * ``GET /metrics`` — the router's ``fleet/*`` counters and gauges
     (telemetry registry prometheus text; direct counter rendering
     without a hub).
-  * ``GET /replicas`` / ``POST /replicas`` — registry introspection and
-    live registration (``{"url": ..., "role": "decode|prefill|both"}``).
+  * ``GET /replicas`` / ``POST /replicas`` / ``DELETE /replicas?name=``
+    — registry introspection, live registration (``{"url": ...,
+    "role": "decode|prefill|both"}``), and deregistration (the
+    ``dstpu-fleet`` controller's scale-down bookkeeping).
 
 Graceful drain: SIGTERM flips ``/healthz`` to draining, sheds NEW
 requests with 503 + Retry-After, lets in-flight proxied requests finish
@@ -40,8 +42,10 @@ from ...telemetry.tracing import (
     traces_endpoint_payload,
 )
 from ...utils.logging import logger
+from .qos import QoSAdmission, TenantClass
 from .replica import ROLES
-from .router import FleetRouter, FleetUnavailable, ReplicaBadRequest
+from .router import (FleetRouter, FleetUnavailable, ReplicaBadRequest,
+                     TenantThrottled)
 
 
 class _RouterHandler(BaseHTTPRequestHandler):
@@ -103,7 +107,7 @@ class _RouterHandler(BaseHTTPRequestHandler):
             elif url.path == "/":
                 self._send_json(200, {"endpoints": [
                     "/v1/generate (POST)", "/metrics", "/healthz",
-                    "/traces", "/replicas (GET/POST)"]})
+                    "/traces", "/replicas (GET/POST/DELETE)"]})
             else:
                 self._send_json(404, {"error": f"unknown path {url.path}"})
         except (BrokenPipeError, ConnectionResetError):
@@ -131,6 +135,34 @@ class _RouterHandler(BaseHTTPRequestHandler):
             if self._streaming:
                 self.close_connection = True
                 return
+            try:
+                self._send_json(500, {"error": repr(e)})
+            except (OSError, ValueError):
+                pass
+
+    def do_DELETE(self):  # noqa: N802 — stdlib hook name
+        """``DELETE /replicas?name=X``: deregister a replica (the
+        dstpu-fleet controller's scale-down bookkeeping — the process
+        itself is drained via SIGTERM, not through the router)."""
+        from urllib.parse import parse_qs
+
+        url = urlparse(self.path)
+        try:
+            if url.path != "/replicas":
+                self._send_json(404, {"error": f"unknown path {url.path}"})
+                return
+            name = (parse_qs(url.query).get("name") or [None])[0]
+            if not name:
+                self._send_json(400, {"error": "need ?name="})
+                return
+            if self.server.owner.router.remove_replica(name):
+                self._send_json(200, {"removed": name})
+            else:
+                self._send_json(404, {"error": f"no replica {name!r}"})
+        except (BrokenPipeError, ConnectionResetError):
+            pass
+        except Exception as e:  # noqa: BLE001
+            logger.warning(f"dstpu-router DELETE failed: {e!r}")
             try:
                 self._send_json(500, {"error": repr(e)})
             except (OSError, ValueError):
@@ -192,6 +224,18 @@ class _RouterHandler(BaseHTTPRequestHandler):
             if store is not None else None
         t0_wall, t0 = time.time(), time.perf_counter()
         owner.inflight_inc()
+
+        closed = False
+
+        def _close_trace() -> None:
+            if ctx is None:
+                return
+            wall = time.perf_counter() - t0
+            owner.router._tspan(ctx, "route", t0=t0_wall, dur_s=wall,
+                                tenant=str(body.get("tenant")
+                                           or "default"))
+            store.finish(ctx.trace_id, wall_s=wall)
+
         try:
             if body.get("stream"):
                 self._proxy_stream(owner, body, ctx)
@@ -200,13 +244,17 @@ class _RouterHandler(BaseHTTPRequestHandler):
                     body, trace=ctx)
                 if ctx is not None and isinstance(out, dict):
                     out.setdefault("trace_id", ctx.trace_id)
+                # close the trace BEFORE the response bytes leave: a
+                # client that reads the store right after the 200 must
+                # see the route envelope (the local write it excludes is
+                # microseconds; streams keep post-send timing below)
+                _close_trace()
+                closed = True
                 self._send_json(code, out, headers)
         finally:
             owner.inflight_dec()
-            if ctx is not None:
-                wall = time.perf_counter() - t0
-                owner.router._tspan(ctx, "route", t0=t0_wall, dur_s=wall)
-                store.finish(ctx.trace_id, wall_s=wall)
+            if not closed:
+                _close_trace()
 
     def _proxy_stream(self, owner: "RouterServer", body: Dict,
                       ctx=None) -> None:
@@ -224,10 +272,17 @@ class _RouterHandler(BaseHTTPRequestHandler):
 
         try:
             owner.router.generate_stream(body, start, send, trace=ctx)
+        except TenantThrottled as e:
+            self._send_json(429, {
+                "error": "tenant over quota", "reason": e.reason,
+                "tenant": e.tenant, "retry_after_s": e.retry_after_s,
+                **({"trace_id": ctx.trace_id} if ctx else {}),
+            }, headers={"Retry-After":
+                        str(int(max(e.retry_after_s, 1)))})
         except FleetUnavailable as e:
             self._send_json(503, {
                 "error": "no routable replica", "reason": e.reason,
-                "retry_after_s": e.retry_after_s,
+                "tenant": e.tenant, "retry_after_s": e.retry_after_s,
                 **({"trace_id": ctx.trace_id} if ctx else {}),
             }, headers={"Retry-After":
                         str(int(max(e.retry_after_s, 1)))})
@@ -361,6 +416,19 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "declared lost and rotated out")
     p.add_argument("--drain-deadline", type=float, default=30.0)
     p.add_argument("--request-timeout", type=float, default=600.0)
+    p.add_argument("--tenant-class", action="append", default=[],
+                   metavar="NAME:K=V,...",
+                   help="per-tenant QoS class, e.g. "
+                        "'bulk:priority=0,rate=500,burst=2000,deadline=30"
+                        ",inflight=8' — rate/burst are model tokens "
+                        "(prompt + requested new); over-quota requests "
+                        "shed 429 + Retry-After from the tenant's own "
+                        "bucket refill (repeatable)")
+    p.add_argument("--default-tenant-class", default=None,
+                   metavar="K=V,...",
+                   help="class template for tenants without an explicit "
+                        "--tenant-class (each still gets a private "
+                        "bucket); unset = unmetered")
     p.add_argument("--telemetry-dir", default="telemetry_router")
     from ...telemetry.tracing.store import (
         add_trace_cli_args,
@@ -376,10 +444,18 @@ def main(argv: Optional[List[str]] = None) -> int:
     set_telemetry(tel)
     store = install_trace_store_from_cli(args, args.telemetry_dir)
 
+    qos = None
+    if args.tenant_class or args.default_tenant_class:
+        qos = QoSAdmission(
+            [TenantClass.parse(s) for s in args.tenant_class],
+            default_class=(TenantClass.parse(args.default_tenant_class,
+                                             name="default")
+                           if args.default_tenant_class else None))
     router = FleetRouter(poll_s=args.poll,
                          disagg_threshold=args.disagg_threshold,
                          wire=args.wire, lost_after=args.lost_after,
-                         request_timeout_s=args.request_timeout)
+                         request_timeout_s=args.request_timeout,
+                         qos=qos)
     for url in args.replica:
         router.add_replica(url, role="decode")
     for url in args.prefill_replica:

@@ -33,7 +33,9 @@ EVENT_KINDS_INCIDENT = ("fault", "watchdog_timeout", "elastic_worker_failure",
                         "checkpoint_reshard_fallback",
                         "serving_nan_isolated", "serving_window_hang",
                         "fleet_replica_lost", "fleet_mid_stream_error",
-                        "fleet_prefill_fallback")
+                        "fleet_prefill_fallback", "fleet_tenant_shed",
+                        "fleet_scale_up", "fleet_scale_down", "fleet_heal",
+                        "fleet_controller_crash")
 
 #: request-tracing counters (telemetry/tracing/store.py mirrors these)
 TRACE_COUNTERS = ("trace/started", "trace/finished", "trace/kept",
@@ -328,7 +330,12 @@ FLEET_COUNTERS = (
     "fleet/routed", "fleet/rerouted", "fleet/shed", "fleet/replica_shed",
     "fleet/replica_lost", "fleet/mid_stream_error",
     "fleet/prefill_disagg", "fleet/prefill_fallback",
-    "fleet/kv_ship_bytes")
+    "fleet/kv_ship_bytes",
+    # per-tenant QoS + the autoscaling controller (dstpu-fleet)
+    "fleet/tenant_shed",
+    "fleet/controller_scale_ups", "fleet/controller_scale_downs",
+    "fleet/controller_heals", "fleet/controller_crashes",
+    "fleet/controller_scrape_failures", "fleet/controller_spawn_failures")
 
 
 def fleet_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
@@ -340,13 +347,17 @@ def fleet_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
     counters: Dict[str, float] = {}
     replicas: Dict[str, Dict[str, Any]] = {}
+    tenants: Dict[str, Dict[str, Any]] = {}
     for m in metrics:
         name = str(m.get("name", ""))
         if not name.startswith("fleet/"):
             continue
         key = name.split("/", 1)[1]
         labels = m.get("labels") or {}
-        if name in FLEET_COUNTERS:
+        if labels.get("tenant"):
+            tenants.setdefault(labels["tenant"], {})[
+                key.replace("tenant_", "")] = m.get("value")
+        elif name in FLEET_COUNTERS:
             counters[key] = m.get("value")
         elif labels.get("replica"):
             replicas.setdefault(labels["replica"], {})[
@@ -357,6 +368,8 @@ def fleet_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
         out["counters"] = counters
     if replicas:
         out["replicas"] = replicas
+    if tenants:
+        out["tenants"] = tenants
     return out
 
 
@@ -774,6 +787,28 @@ def format_summary(s: Dict[str, Any]) -> str:
                     f"{int(row.get('pending') or 0):>9}"
                     f"{(row.get('kv_pressure') or 0):>13.3f}"
                     f"{(row.get('predicted_tok_per_s') or 0):>12.1f}")
+        tens = fl.get("tenants") or {}
+        if tens:
+            add(f"{'tenant':<20}{'admitted':>10}{'shed':>8}"
+                f"{'shed rate':>11}{'inflight':>10}")
+            for tname in sorted(tens):
+                row = tens[tname]
+                add(f"{tname:<20}{int(row.get('admitted') or 0):>10}"
+                    f"{int(row.get('sheds') or 0):>8}"
+                    f"{100 * (row.get('shed_rate') or 0):>10.1f}%"
+                    f"{int(row.get('inflight') or 0):>10}")
+        if fl.get("controller_replicas") is not None:
+            line = (f"controller: {int(fl['controller_replicas'])} live"
+                    f" / {int(fl.get('controller_routable') or 0)} routable"
+                    f", drain est {fl.get('controller_drain_s') or 0:.2f}s")
+            if fl.get("controller_ttft_p95_s") is not None:
+                line += f", ttft p95 est {fl['controller_ttft_p95_s']:.2f}s"
+            acts = [f"{k.replace('controller_', '')}={int(v)}"
+                    for k, v in sorted((fl.get('counters') or {}).items())
+                    if k.startswith("controller_") and v]
+            if acts:
+                line += "  [" + ", ".join(acts) + "]"
+            add(line)
         add("")
 
     add("--- memory high-water marks ---")

@@ -24,5 +24,5 @@ setup(
     },
     scripts=["bin/dstpu", "bin/ds_report", "bin/dstpu-telemetry",
              "bin/dstpu-check", "bin/dstpu-serve", "bin/dstpu-router",
-             "bin/dstpu-trace"],
+             "bin/dstpu-trace", "bin/dstpu-fleet"],
 )

@@ -171,3 +171,88 @@ class TestServingKinds:
         doc = injection.__doc__
         for needle in ("decode_window", "kv_alloc", "nan", "exhausted"):
             assert needle in doc
+
+
+class TestFleetKinds:
+    """The fleet-chaos kinds (PR 16): `replica_down` / `net_partition`
+    are ConnectionErrors (so transport handlers and retry policies catch
+    them as one family), `controller_crash` is the controller-loop
+    poison pill."""
+
+    def test_replica_down_raises_typed_connection_error(self):
+        injection.configure("site=fleet_scrape,kind=replica_down,times=1")
+        with pytest.raises(injection.InjectedReplicaDown):
+            injection.inject("fleet_scrape")
+        injection.inject("fleet_scrape")              # times=1 spent
+
+    def test_net_partition_raises_typed_connection_error(self):
+        injection.configure("site=fleet_forward,kind=net_partition,times=2")
+        for _ in range(2):
+            with pytest.raises(injection.InjectedNetPartition):
+                injection.inject("fleet_forward")
+        injection.inject("fleet_forward")
+
+    def test_partition_kinds_are_connection_errors(self):
+        # retry policies key on ConnectionError; a kind that stopped
+        # subclassing it would silently lose its backoff coverage
+        assert issubclass(injection.InjectedReplicaDown, ConnectionError)
+        assert issubclass(injection.InjectedNetPartition, ConnectionError)
+        assert issubclass(injection.InjectedControllerCrash, RuntimeError)
+        assert not issubclass(injection.InjectedControllerCrash,
+                              ConnectionError)
+
+    def test_controller_crash_raises_typed_error(self):
+        injection.configure("site=controller_tick,kind=controller_crash,"
+                            "times=1")
+        with pytest.raises(injection.InjectedControllerCrash):
+            injection.inject("controller_tick")
+        injection.inject("controller_tick")
+
+    def test_fleet_kinds_registered(self):
+        for kind in ("replica_down", "net_partition", "controller_crash"):
+            assert kind in injection.KINDS
+            spec = FaultSpec.parse(f"site=x,kind={kind}")
+            assert spec.kind == kind
+
+    def test_fleet_sites_documented_in_grammar(self):
+        doc = injection.__doc__
+        for needle in ("fleet_scrape", "fleet_forward", "controller_scrape",
+                       "controller_tick", "replica_down", "net_partition",
+                       "controller_crash"):
+            assert needle in doc
+
+
+class TestManifestRoundTrip:
+    """FaultSpec.manifest() emits the grammar back out; parse(manifest)
+    must reproduce the spec for every kind and every non-default knob —
+    the chaos tooling serializes campaign configs through this."""
+
+    @pytest.mark.parametrize("kind", injection.KINDS)
+    def test_every_kind_round_trips(self, kind):
+        spec = FaultSpec.parse(f"site=s1,kind={kind},times=3")
+        assert FaultSpec.parse(spec.manifest()) == spec
+
+    def test_non_default_knobs_round_trip(self):
+        text = ("site=step,kind=slow,p=0.5,times=4,steps=2|5|9,"
+                "delay=0.25,seed=7")
+        spec = FaultSpec.parse(text)
+        again = FaultSpec.parse(spec.manifest())
+        assert again == spec
+        assert again.steps == frozenset({2, 5, 9})
+        assert again.p == pytest.approx(0.5)
+        assert again.delay == pytest.approx(0.25)
+        assert again.seed == 7
+
+    def test_defaults_stay_implicit(self):
+        # a default-valued knob must not leak into the manifest: the
+        # round-trip contract is about semantics, not byte equality,
+        # but noisy manifests make chaos configs unreadable
+        m = FaultSpec.parse("site=a,kind=io_error").manifest()
+        assert m == "site=a,kind=io_error"
+
+    def test_injector_manifest_joins_specs(self):
+        text = ("site=ckpt_save,kind=io_error,times=2;"
+                "site=fleet_scrape,kind=replica_down,times=1")
+        inj = FaultInjector(text)
+        again = FaultInjector(inj.manifest())
+        assert [s for s in again.specs] == [s for s in inj.specs]

@@ -249,6 +249,13 @@ def scenario_specdec(check):
             check(f"{impl}: specdec scenario", False, repr(exc)[-300:])
 
 
+#: every generate-path shed (429/503) body seen by ANY scenario, audited
+#: in main(): since the per-tenant QoS work, EVERY shed anywhere in the
+#: fleet must name the tenant it hit — an unattributed shed means a shed
+#: path escaped the accounting and per-tenant isolation can't be trusted
+SHED_BODIES = []
+
+
 def _http(method, url, body=None, timeout=30):
     req = urllib.request.Request(url, method=method,
                                  data=json.dumps(body).encode()
@@ -257,7 +264,10 @@ def _http(method, url, body=None, timeout=30):
         with urllib.request.urlopen(req, timeout=timeout) as r:
             return r.status, json.loads(r.read())
     except urllib.error.HTTPError as e:
-        return e.code, json.loads(e.read())
+        resp = json.loads(e.read())
+        if e.code in (429, 503) and "/v1/generate" in url:
+            SHED_BODIES.append((url, e.code, resp))
+        return e.code, resp
 
 
 def scenario_drain(check):
@@ -646,6 +656,11 @@ def main(argv=None) -> int:
         scenario_fleet(check)
     if args.scenario in ("all", "trace"):
         scenario_trace(check)
+
+    for url, code, body in SHED_BODIES:
+        check("shed response attributed to a tenant",
+              bool(body.get("tenant")),
+              f"{code} from {url} carried no tenant: {str(body)[:150]}")
 
     if failures:
         print("\n".join(failures))
