@@ -1920,6 +1920,40 @@ def run_fleet_sweep(on_tpu: bool) -> None:
     log(f"fleet_sweep tracing overhead: off={off:.1f} on={on:.1f} tok/s "
         f"({overhead_pct}%)")
 
+    # ---- goodput ledger: same decode, ledger on vs off ---------------- #
+    # identical interleaved-median methodology; the on-rounds also yield
+    # a ledger snapshot (the decode windows land in compute/compile), so
+    # the sweep reports BOTH the accounting overhead and the accounting
+    from deepspeed_tpu.telemetry.goodput import (GoodputLedger,
+                                                 install_goodput_ledger)
+
+    def ledger_run(eng, with_ledger):
+        ledger = GoodputLedger(component="bench") if with_ledger else None
+        install_goodput_ledger(ledger)
+        try:
+            tps = sched_run(eng, None)
+        finally:
+            install_goodput_ledger(None)
+        return tps, (ledger.snapshot() if ledger is not None else None)
+
+    g_offs, g_ons = [], []
+    g_snap = None
+    for rnd in range(3):
+        pair = [(g_offs, False), (g_ons, True)]
+        for sink, with_ledger in (pair if rnd % 2 == 0 else pair[::-1]):
+            tps, snap = ledger_run(eng_oh, with_ledger)
+            sink.append(tps)
+            if snap is not None:
+                g_snap = snap
+    g_off = sorted(g_offs)[len(g_offs) // 2]
+    g_on = sorted(g_ons)[len(g_ons) // 2]
+    goodput_overhead_pct = round((g_off - g_on) / g_off * 100.0, 2) \
+        if g_off > 0 else None
+    log(f"fleet_sweep goodput ledger overhead: off={g_off:.1f} "
+        f"on={g_on:.1f} tok/s ({goodput_overhead_pct}%) "
+        f"goodput_fraction="
+        f"{g_snap['goodput_fraction'] if g_snap else None}")
+
     # headline = the MEAN over the sweep points — a regression at ANY
     # replica count must move it (max() would hide a regression at a
     # non-best point); scaling efficiency stays last-vs-first
@@ -1934,6 +1968,14 @@ def run_fleet_sweep(on_tpu: bool) -> None:
         "tracing_overhead_pct": overhead_pct,
         "trace_decode_tok_per_s": {"off": round(off, 2),
                                    "on": round(on, 2)},
+        "goodput": {
+            "overhead_pct": goodput_overhead_pct,
+            "decode_tok_per_s": {"off": round(g_off, 2),
+                                 "on": round(g_on, 2)},
+            "goodput_fraction": (g_snap or {}).get("goodput_fraction"),
+            "categories": (g_snap or {}).get("categories"),
+            "conserved": (g_snap or {}).get("conserved"),
+        },
         "autoscale": autoscale,
         "requests": n_requests, "max_new_tokens": max_new,
         "note": "CPU-sim scheduling-plane bench over the real router; "

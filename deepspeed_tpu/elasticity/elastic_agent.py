@@ -32,6 +32,7 @@ from typing import Callable, Dict, List, Optional, Sequence
 
 from ..runtime.fault.retry import RetryPolicy, record_fault_event
 from ..telemetry import emit_event
+from ..telemetry.goodput import record_goodput
 from ..utils.logging import logger
 
 
@@ -284,6 +285,10 @@ class DSElasticAgent:
                            restart=self.restart_count,
                            max_restarts=self.max_restarts,
                            world_size=self.world_size)
+                # goodput: everything from here to the respawn — worker
+                # teardown, checkpoint GC, reshape, backoff — is a restart
+                # gap no worker is training through
+                t_restart0 = time.perf_counter()
                 self._terminate(self._procs)
                 if self.restart_count >= self.max_restarts:
                     raise WorkerGroupFailure(
@@ -297,7 +302,10 @@ class DSElasticAgent:
                            backoff_s=round(delay, 3), rc=failed)
                 logger.info(f"elastic agent: restarting worker group in "
                             f"{delay:.2f}s (backoff)")
-                if self._shutdown.wait(delay):
+                interrupted = self._shutdown.wait(delay)
+                record_goodput("restart",
+                               time.perf_counter() - t_restart0)
+                if interrupted:
                     return 0
                 self.restart_count += 1
         finally:

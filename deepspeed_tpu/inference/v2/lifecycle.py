@@ -58,6 +58,8 @@ from typing import Callable, Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ...telemetry.goodput import (get_goodput_ledger, goodput_residual,
+                                  record_goodput)
 from ...telemetry.tracing import (FLAG_BY_REASON, get_trace_store,
                                   record_span, trace_id_of)
 from ...utils.logging import logger
@@ -283,6 +285,7 @@ class LifecycleScheduler:
     def submit(self, req: ServeRequest) -> AdmissionVerdict:
         """Admit to the bounded queue, or shed with a Retry-After."""
         with self._lock:
+            t_shed0 = time.perf_counter()
             now = self.clock()
             req.arrival_t = now
             req._twall_submit = req._twall_queue = time.time()
@@ -309,9 +312,12 @@ class LifecycleScheduler:
                             tenant=req.tenant or "default",
                             trace=self._trace_id(req))
                 self._tspan(req, "admission", t0=req._twall_submit,
-                            dur_s=0.0, shed="draining")
+                            dur_s=0.0, shed="draining",
+                            tenant=req.tenant or "default")
                 self._trace_finish(req,
                                    flag=FLAG_BY_REASON.get(req.finish_reason))
+                record_goodput("shed", time.perf_counter() - t_shed0,
+                               tenant=req.tenant or "default")
                 return AdmissionVerdict(False, "draining",
                                         self.predicted_drain_s())
             if len(self._waiting) >= self.max_queue:
@@ -324,9 +330,12 @@ class LifecycleScheduler:
                             queue_depth=len(self._waiting),
                             trace=self._trace_id(req))
                 self._tspan(req, "admission", t0=req._twall_submit,
-                            dur_s=0.0, shed="queue_full")
+                            dur_s=0.0, shed="queue_full",
+                            tenant=req.tenant or "default")
                 self._trace_finish(req,
                                    flag=FLAG_BY_REASON.get(req.finish_reason))
+                record_goodput("shed", time.perf_counter() - t_shed0,
+                               tenant=req.tenant or "default")
                 return AdmissionVerdict(False, "queue_full",
                                         self.retry_after_s())
             self._reqs[req.uid] = req
@@ -624,11 +633,15 @@ class LifecycleScheduler:
                 # must stay disjoint or the decomposition sums lie)
                 self._tspan(head, "queue_wait", t0=head._twall_queue,
                             dur_s=max(t0w - head._twall_queue, 0.0))
+                # tenant rides the admission span so a recorded
+                # traces.jsonl stays convertible into a replayable
+                # workload even without a router in front
                 self._tspan(head, "admission", t0=t0w,
                             dur_s=max(time.perf_counter() - t0p
                                       - head._import_s, 0.0),
                             prefix_hit=head._prefill_pos
-                            if head.kv_import is None else 0)
+                            if head.kv_import is None else 0,
+                            tenant=head.tenant or "default")
             if verdict is None:
                 self._waiting.popleft()
                 self._retire(head, RequestState.FAILED, "impossible",
@@ -659,6 +672,20 @@ class LifecycleScheduler:
         t0w, t0p = time.time(), time.perf_counter()
         logits = self.eng.put([u for u, _ in batch], [t for _, t in batch])
         put_s = time.perf_counter() - t0p
+        ledger = get_goodput_ledger()
+        if ledger is not None and put_s > 0.0:
+            # the forward's wall splits across riders by chunk size; the
+            # share replaying a preemption victim's already-produced KV is
+            # waste the ledger must see (``preempt_recompute``), the rest
+            # is useful prefill
+            total_toks = sum(len(t) for _, t in batch) or 1
+            redo_toks = sum(len(t) for u, t in batch
+                            if self._reqs[u]._resume_seed is not None)
+            if redo_toks:
+                ledger.add("preempt_recompute",
+                           put_s * redo_toks / total_toks)
+            ledger.add("compute", put_s * (total_toks - redo_toks)
+                       / total_toks)
         finished: List[int] = []
         now = self.clock()
         for row, (uid, chunk) in enumerate(batch):
@@ -811,6 +838,12 @@ class LifecycleScheduler:
         attributed elsewhere (verify windows: drafting has its own
         span)."""
         finished: List[int] = []
+        # goodput: the window wall is attributed ONCE (not per rider) —
+        # first-use windows are XLA compilation, drained windows are
+        # useful decode work (verify windows include their draft host
+        # time: speculative work that produced accepted tokens is compute)
+        if wall_s is not None:
+            record_goodput("compile" if compiled else "compute", wall_s)
         # window span per rider — a first-use (compiled) window's wall is
         # XLA compilation, so it is typed ``compile``, keeping the
         # decode_window decomposition clean of compile pollution exactly
@@ -996,28 +1029,34 @@ class LifecycleScheduler:
         whatever is still live at the deadline is expired and flushed.
         Returns {completed, expired} counts for this drain."""
         self.start_drain()
-        t_end = self.clock() + deadline_s
-        completed = 0
-        while self.pending and self.clock() < t_end:
-            try:
-                finished = self.step()
-            except Exception as e:  # noqa: BLE001 — a raising step must not
-                # wedge the drain: whatever is still live gets expired and
-                # flushed by the mop-up below, and the server still exits
-                logger.error(f"drain step failed: {e!r}")
-                break
-            for uid in finished:
-                if self._reqs[uid].state == RequestState.FINISHED:
-                    completed += 1
-        expired = 0
-        with self._lock:
-            for req in list(self._reqs.values()):
-                if req.state not in TERMINAL_STATES:
-                    self._retire(req, RequestState.EXPIRED, "drain_deadline",
-                                 "serving_expired", "serving/drain_expired")
-                    expired += 1
-            self._event("serving_drain_done", completed=completed,
-                        expired=expired)
+        # goodput: the drain envelope is a residual — the windows it runs
+        # attribute their own walls (compute/compile), only the loop's
+        # remaining wall (scheduling, expiry mop-up) lands in ``drain``
+        with goodput_residual("drain"):
+            t_end = self.clock() + deadline_s
+            completed = 0
+            while self.pending and self.clock() < t_end:
+                try:
+                    finished = self.step()
+                except Exception as e:  # noqa: BLE001 — a raising step
+                    # must not wedge the drain: whatever is still live gets
+                    # expired and flushed by the mop-up below, and the
+                    # server still exits
+                    logger.error(f"drain step failed: {e!r}")
+                    break
+                for uid in finished:
+                    if self._reqs[uid].state == RequestState.FINISHED:
+                        completed += 1
+            expired = 0
+            with self._lock:
+                for req in list(self._reqs.values()):
+                    if req.state not in TERMINAL_STATES:
+                        self._retire(req, RequestState.EXPIRED,
+                                     "drain_deadline", "serving_expired",
+                                     "serving/drain_expired")
+                        expired += 1
+                self._event("serving_drain_done", completed=completed,
+                            expired=expired)
         return {"completed": completed, "expired": expired}
 
     # ------------------------------------------------------------------ #

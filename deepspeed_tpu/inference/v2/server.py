@@ -39,6 +39,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional
 from urllib.parse import urlparse
 
+from ...telemetry.goodput import (
+    GoodputLedger,
+    get_goodput_ledger,
+    install_goodput_ledger,
+    record_goodput,
+)
 from ...telemetry.tracing import (
     TraceContext,
     get_trace_store,
@@ -109,10 +115,17 @@ class _ServingHandler(BaseHTTPRequestHandler):
 
                 code, body = traces_endpoint_payload(parse_qs(url.query))
                 self._send_json(code, body)
+            elif url.path == "/goodput":
+                ledger = get_goodput_ledger()
+                if ledger is None:
+                    self._send_json(404, {"error": "goodput accounting "
+                                                   "not installed"})
+                else:
+                    self._send_json(200, ledger.snapshot())
             elif url.path == "/":
                 self._send_json(200, {"endpoints": [
                     "/v1/generate (POST)", "/metrics", "/healthz",
-                    "/traces"]})
+                    "/traces", "/goodput"]})
             else:
                 self._send_json(404, {"error": f"unknown path {url.path}"})
         except (BrokenPipeError, ConnectionResetError):
@@ -191,6 +204,11 @@ class _ServingHandler(BaseHTTPRequestHandler):
             "counters": dict(sched.counters),
             "ts": time.time(),
         }
+        ledger = get_goodput_ledger()
+        if ledger is not None:
+            # the per-process wall-time books: the fleet router rolls
+            # these up across replicas into its own /healthz
+            body["goodput"] = ledger.snapshot()
         self._send_json(code, body)
 
     # ---------------------------------------------------------------- #
@@ -537,8 +555,13 @@ class ServingServer:
                     logger.error(f"scheduler step failed: {e!r}")
                     time.sleep(self.driver_idle_s)
             else:
+                # goodput: the empty-queue wait is the driver's explicit
+                # idle — recorded so "idle because no traffic" is a
+                # measured category, not just the derived remainder
+                t_idle0 = time.perf_counter()
                 self._work.wait(self.driver_idle_s)
                 self._work.clear()
+                record_goodput("idle", time.perf_counter() - t_idle0)
 
     # ---------------------------------------------------------------- #
     def start(self) -> "ServingServer":
@@ -726,6 +749,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     tel = Telemetry(output_dir=args.telemetry_dir)
     set_telemetry(tel)
     store = install_trace_store_from_cli(args, args.telemetry_dir)
+    ledger = GoodputLedger(component=f"serve:{args.port}")
+    install_goodput_ledger(ledger)
 
     if args.model == "tiny":
         engine = build_tiny_engine(args)
@@ -822,7 +847,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # thread; the Python-level handler only runs once the main thread
     # re-enters the eval loop, so it must never park in an untimed wait.
     while not done.wait(0.5):
-        pass
+        ledger.publish()        # keep the goodput/* gauges live
+    ledger.publish()
     if store is not None:
         store.close()
     tel.close()

@@ -25,6 +25,7 @@ forward entry, grads accumulated in fp32.
 from __future__ import annotations
 
 import os
+import time
 from functools import partial
 from typing import Any, Callable, Dict, Iterable, Optional, Tuple
 
@@ -36,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec
 
 from ..accelerator import get_accelerator
 from ..telemetry import emit_event
+from ..telemetry.goodput import get_goodput_ledger, record_goodput
 from ..telemetry.trace import NULL_SPAN
 from ..utils.logging import log_dist, logger
 from ..utils.timer import SynchronizedWallClockTimer, ThroughputTimer
@@ -934,6 +936,10 @@ class DeepSpeedEngine:
         ctx = jax.profiler.trace(cl.xprof_dir) if trace_now \
             else contextlib.nullcontext()
         self._host_step_calls += 1
+        # goodput: the ledger's step envelope opens here (the tput timer
+        # skips warmup steps, so its last_step_time can't cover step 1 —
+        # the compile step is exactly the one the ledger must not lose)
+        self._goodput_step_t0 = time.perf_counter()
         tel = self.telemetry
         step_span = tel.tracer.step_span(
             self._host_step_calls, name="engine/train_batch") \
@@ -973,6 +979,8 @@ class DeepSpeedEngine:
         return loss
 
     def _post_step_logging(self, loss, batch):
+        t_host0 = time.perf_counter()
+        self._goodput_step_attribution()
         self._write_monitor_events(loss)
         step = self.global_steps
         self._last_logged_step = step   # host mirror for the live plane
@@ -1037,6 +1045,37 @@ class DeepSpeedEngine:
                     output_file=fp.output_file)
             except Exception as e:
                 logger.warning(f"flops profile failed: {e}")
+        # the logging body itself is host bookkeeping the device sat out
+        record_goodput("host_sync", time.perf_counter() - t_host0)
+
+    def _goodput_step_attribution(self) -> None:
+        """Split the step wall just paid into the goodput ledger's books:
+        the FIRST host call traced+compiled ``train_batch`` so its wall is
+        ``compile``; steady-state steps split into ``exposed_comm`` (step
+        wall x the overlap manager's measured exposed fraction) and
+        ``compute`` (the remainder).  No-op when no ledger is installed."""
+        ledger = get_goodput_ledger()
+        if ledger is None:
+            return
+        t0 = getattr(self, "_goodput_step_t0", None)
+        if t0 is None:
+            return
+        self._goodput_step_t0 = None     # one attribution per step
+        dur = time.perf_counter() - t0
+        if dur <= 0.0:
+            return
+        if self._host_step_calls <= 1:
+            ledger.add("compile", dur)
+            return
+        exposed_frac = 0.0
+        dec = getattr(self.overlap, "last_decision", None)
+        if self.overlap.enabled and dec is not None and \
+                dec.exposed_comm_fraction is not None:
+            exposed_frac = min(max(float(dec.exposed_comm_fraction), 0.0),
+                               1.0)
+        if exposed_frac > 0.0:
+            ledger.add("exposed_comm", dur * exposed_frac)
+        ledger.add("compute", dur * (1.0 - exposed_frac))
 
     # ------------------------------------------------------------------ #
     # API-parity helpers
@@ -1245,10 +1284,12 @@ class DeepSpeedEngine:
                        "mesh": {k: int(v)
                                 for k, v in self.topology.dims.items()}},
         }
+        t_ckpt0 = time.perf_counter()
         with self._span("engine/save_checkpoint", tag=str(tag)):
             engine.save(payload, tag)
             if save_latest:
                 engine.commit(tag)
+        record_goodput("checkpoint", time.perf_counter() - t_ckpt0)
         self._heartbeat("idle")
         log_dist(f"saved checkpoint {save_dir}/{tag}", ranks=[0])
         return True

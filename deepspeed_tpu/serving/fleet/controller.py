@@ -48,6 +48,7 @@ from typing import Dict, List, Optional
 
 from ...runtime.fault.injection import InjectedControllerCrash, inject
 from ...runtime.fault.retry import RetryPolicy, retryable
+from ...telemetry.tracing.store import TTFT_SEGMENTS
 from ...utils.logging import logger
 
 #: controller→router transport: a couple of jittered retries per call,
@@ -55,10 +56,9 @@ from ...utils.logging import logger
 #: tick, it may never wedge on one.
 CONTROLLER_RETRY = RetryPolicy(max_retries=2, base_s=0.05, cap_s=1.0)
 
-#: /traces segment kinds summed (p95) into the TTFT estimate: time
-#: queued plus prompt service — the part of TTFT the fleet's capacity
-#: actually controls.
-TTFT_SEGMENTS = ("queue_wait", "prefill")
+#: TTFT_SEGMENTS (imported above): /traces segment kinds summed (p95)
+#: into the TTFT estimate — canonical definition lives next to the
+#: segment aggregates themselves in telemetry/tracing/store.py
 
 
 @dataclasses.dataclass(frozen=True)
@@ -89,6 +89,11 @@ class FleetView:
     drain_s: float = 0.0           # fleet backlog / fleet drain rate
     worst_drain_s: float = 0.0     # the most backed-up single replica
     ttft_p95_s: Optional[float] = None
+    #: True when ttft_p95_s came from the store's ROLLING time window
+    #: (p95_window_s) rather than the count-bounded since-start aggregate
+    #: — a windowed breach is current by construction, so the controller
+    #: may trust it without the current-backlog gate
+    ttft_windowed: bool = False
 
 
 def view_from_scrape(healthz: Dict,
@@ -105,18 +110,29 @@ def view_from_scrape(healthz: Dict,
                  / max(float(r.get("predicted_tok_per_s") or 0.0), 1e-6)
                  for r in live), default=0.0)
     ttft = None
+    windowed = False
     if segments:
-        parts = [s.get("p95_s") for k, s in segments.items()
-                 if k in TTFT_SEGMENTS and isinstance(s, dict)
-                 and s.get("p95_s") is not None]
-        if parts:
-            ttft = float(sum(parts))
+        # prefer the rolling time-window p95 (stale breaches age out);
+        # fall back to the count-bounded aggregate for old stores that
+        # don't publish p95_window_s
+        win_parts = [s.get("p95_window_s") for k, s in segments.items()
+                     if k in TTFT_SEGMENTS and isinstance(s, dict)
+                     and s.get("p95_window_s") is not None]
+        if win_parts:
+            ttft = float(sum(win_parts))
+            windowed = True
+        else:
+            parts = [s.get("p95_s") for k, s in segments.items()
+                     if k in TTFT_SEGMENTS and isinstance(s, dict)
+                     and s.get("p95_s") is not None]
+            if parts:
+                ttft = float(sum(parts))
     return FleetView(
         ok=True, state=str(healthz.get("state", "unknown")),
         registered=len(reps), live=len(live),
         routable=int(healthz.get("routable") or 0), replicas=reps,
         drain_s=backlog / max(rate, 1e-6), worst_drain_s=worst,
-        ttft_p95_s=ttft)
+        ttft_p95_s=ttft, ttft_windowed=windowed)
 
 
 class RouterClient:
@@ -319,14 +335,16 @@ class FleetController:
             return action
 
         # -- overload / underload signals ------------------------------ #
-        # The TTFT p95 estimate comes from the router's /traces store — a
-        # since-start aggregate, not a moving window — so a past breach
-        # only counts as overload while there is *current* backlog to
-        # drain; an idle fleet with a bad history must still scale down.
+        # A ROLLING-window TTFT p95 breach (ttft_windowed) is current by
+        # construction and counts as overload outright.  The legacy
+        # since-start aggregate (old stores without p95_window_s) keeps
+        # the PR-16 guard: a past breach only counts while there is
+        # *current* backlog to drain — an idle fleet with a bad history
+        # must still scale down.
         over = (view.worst_drain_s > self.slo.drain_high_s
                 or (view.ttft_p95_s is not None
                     and view.ttft_p95_s > self.slo.ttft_p95_s
-                    and view.drain_s > 0.0))
+                    and (view.ttft_windowed or view.drain_s > 0.0)))
         under = (not over and view.drain_s < self.slo.drain_low_s
                  and view.routable > self.slo.min_replicas)
         self._over = self._over + 1 if over else 0

@@ -58,6 +58,7 @@ class ReplicaHandle:
         self.predicted_tok_per_s = 1.0
         self.predicted_drain_s = 1.0
         self.counters: Dict[str, float] = {}
+        self.goodput: Optional[Dict] = None    # replica's ledger snapshot
         self.last_scrape_t: Optional[float] = None
         self.consecutive_failures = 0
         self.lost = False
@@ -112,6 +113,8 @@ class ReplicaHandle:
             self.predicted_drain_s = float(body.get("predicted_drain_s",
                                                     1.0))
             self.counters = dict(body.get("counters", {}))
+            gp = body.get("goodput")
+            self.goodput = gp if isinstance(gp, dict) else None
             self.last_scrape_t = time.monotonic()
         if resurrected:
             logger.info(f"replica {self.name} back: {self.status}")
@@ -167,4 +170,5 @@ class ReplicaHandle:
                 "kv_pressure": self.kv_pressure,
                 "predicted_tok_per_s": self.predicted_tok_per_s,
                 "consecutive_failures": self.consecutive_failures,
+                "goodput": self.goodput,
             }

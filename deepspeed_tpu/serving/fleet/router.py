@@ -49,6 +49,8 @@ from typing import Dict, List, Optional, Set, Tuple
 
 from ...runtime.fault.injection import inject
 from ...runtime.fault.retry import RetryPolicy, retryable
+from ...telemetry.goodput import (get_goodput_ledger, record_goodput,
+                                  rollup_goodput)
 from ...telemetry.tracing import (RETURN_SPANS_FIELD, TRACE_HEADER,
                                   flag_trace, merge_trace, record_span,
                                   trace_id_of)
@@ -289,6 +291,7 @@ class FleetRouter:
         payload["tenant"] = tenant
         if self.qos is None:
             return tenant, None
+        t_shed0 = time.perf_counter()
         cost = len(payload.get("prompt") or []) + \
             int(payload.get("max_new_tokens") or 32)
         verdict = self.qos.admit(tenant, cost)
@@ -304,6 +307,11 @@ class FleetRouter:
         self._tflag(trace, "shed")
         self._tspan(trace, "admission", t0=time.time(), dur_s=0.0,
                     shed=verdict.reason, tenant=tenant)
+        # goodput: router time burned rejecting this tenant's request —
+        # tenant-attributed so the fleet rollup shows WHO the shed time
+        # belongs to, not just how much there was
+        record_goodput("shed", time.perf_counter() - t_shed0,
+                       tenant=tenant)
         return tenant, verdict
 
     def _qos_release(self, verdict: Optional[QoSVerdict]) -> None:
@@ -704,6 +712,15 @@ class FleetRouter:
         }
         if self.qos is not None:
             body["tenants"] = self.qos.snapshot()
+        # fleet goodput rollup: every replica's scraped per-process books
+        # + the router's own ledger (QoS shed time) summed into one view
+        snaps = [r.get("goodput") for r in reps]
+        ledger = get_goodput_ledger()
+        if ledger is not None:
+            snaps.append(ledger.snapshot())
+        roll = rollup_goodput(snaps)
+        if roll["processes"]:
+            body["goodput"] = roll
         return status, body
 
     def _publish_gauges(self) -> None:
@@ -745,6 +762,19 @@ class FleetRouter:
                                                      tenant=tenant)
                 m.gauge("fleet/tenant_inflight").set(row["inflight"],
                                                      tenant=tenant)
+        # fleet-level goodput: the router's own books plus every scraped
+        # replica snapshot, collapsed to the one scalar the autotuner
+        # scores configs by
+        snaps = [h.goodput for h in reps]
+        ledger = get_goodput_ledger()
+        if ledger is not None:
+            ledger.publish()
+            snaps.append(ledger.snapshot())
+        roll = rollup_goodput(snaps)
+        if roll["processes"]:
+            m.gauge("fleet/goodput_fraction").set(
+                roll["goodput_fraction"])
+            m.gauge("fleet/goodput_wall_s").set(roll["wall_s"])
 
     def _count(self, name: str, n: float = 1) -> None:
         with self._lock:

@@ -233,7 +233,8 @@ class _RouterHandler(BaseHTTPRequestHandler):
             wall = time.perf_counter() - t0
             owner.router._tspan(ctx, "route", t0=t0_wall, dur_s=wall,
                                 tenant=str(body.get("tenant")
-                                           or "default"))
+                                           or "default"),
+                                stream=bool(body.get("stream", False)))
             store.finish(ctx.trace_id, wall_s=wall)
 
         try:
@@ -443,6 +444,10 @@ def main(argv: Optional[List[str]] = None) -> int:
     tel = Telemetry(output_dir=args.telemetry_dir)
     set_telemetry(tel)
     store = install_trace_store_from_cli(args, args.telemetry_dir)
+    from ...telemetry.goodput import GoodputLedger, install_goodput_ledger
+
+    ledger = GoodputLedger(component=f"router:{args.port}")
+    install_goodput_ledger(ledger)
 
     qos = None
     if args.tenant_class or args.default_tenant_class:
@@ -493,7 +498,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     # thread; the Python-level handler only runs once the main thread
     # re-enters the eval loop, so it must never park in an untimed wait.
     while not done.wait(0.5):
-        pass
+        ledger.publish()        # keep the goodput/* gauges live
+    ledger.publish()
     if store is not None:
         store.close()
     tel.close()

@@ -10,6 +10,9 @@ or watch it live via the ``telemetry.live`` HTTP plane
 (``deepspeed_tpu/telemetry/live/``).
 """
 from .events import EventLog, read_event_segments, read_jsonl
+from .goodput import (GOODPUT_CATEGORIES, GoodputLedger, get_goodput_ledger,
+                      goodput_residual, install_goodput_ledger,
+                      record_goodput, rollup_goodput)
 from .hub import (Telemetry, emit_event, get_telemetry, set_telemetry, span,
                   telemetry_enabled)
 from .memory import MemorySampler
@@ -17,8 +20,11 @@ from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .trace import NULL_SPAN, SpanRecord, Tracer
 
 __all__ = [
-    "Counter", "EventLog", "Gauge", "Histogram", "MemorySampler",
+    "Counter", "EventLog", "GOODPUT_CATEGORIES", "Gauge", "GoodputLedger",
+    "Histogram", "MemorySampler",
     "MetricsRegistry", "NULL_SPAN", "SpanRecord", "Telemetry", "Tracer",
-    "emit_event", "get_telemetry", "read_event_segments", "read_jsonl",
-    "set_telemetry", "span", "telemetry_enabled",
+    "emit_event", "get_goodput_ledger", "get_telemetry", "goodput_residual",
+    "install_goodput_ledger", "read_event_segments", "read_jsonl",
+    "record_goodput", "rollup_goodput", "set_telemetry", "span",
+    "telemetry_enabled",
 ]

@@ -41,6 +41,11 @@ EVENT_KINDS_INCIDENT = ("fault", "watchdog_timeout", "elastic_worker_failure",
 TRACE_COUNTERS = ("trace/started", "trace/finished", "trace/kept",
                   "trace/dropped", "trace/upgraded", "trace/flagged")
 
+#: goodput-ledger category order for the rendered table (telemetry/goodput.py
+#: is canonical; imported lazily in goodput_summary so a partial install of
+#: the telemetry package still summarizes everything else)
+GOODPUT_SCALARS = ("wall_s", "goodput_fraction", "overcommit_s")
+
 #: roofline table columns, shared between the section renderer and --help
 ROOFLINE_COLUMNS = (
     ("achieved_tflops", "achieved TFLOP/s per chip (step flops / step time)"),
@@ -416,6 +421,40 @@ def tracing_summary(metrics: Sequence[Dict[str, Any]],
     return out
 
 
+def goodput_summary(metrics: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
+    """The goodput ledger's ``goodput/*`` gauges (telemetry/goodput.py):
+    ledger wall, per-category seconds + fractions-of-wall, the goodput
+    scalar (compute / wall), the conservation detector (``overcommit_s``
+    — attributed beyond wall means a double-counting seam) and the
+    per-tenant shed attribution."""
+    from .goodput import GOODPUT_CATEGORIES
+
+    out: Dict[str, Any] = {}
+    cats: Dict[str, float] = {}
+    tenants: Dict[str, float] = {}
+    for m in metrics:
+        name = str(m.get("name", ""))
+        if not name.startswith("goodput/"):
+            continue
+        key = name.split("/", 1)[1]
+        labels = m.get("labels") or {}
+        if key == "tenant_shed_s" and labels.get("tenant"):
+            tenants[labels["tenant"]] = m.get("value")
+        elif key.endswith("_s") and key[:-2] in GOODPUT_CATEGORIES:
+            cats[key[:-2]] = m.get("value")
+        elif key in GOODPUT_SCALARS:
+            out[key] = m.get("value")
+    if cats:
+        out["categories"] = cats
+        wall = float(out.get("wall_s") or 0.0)
+        if wall > 0:
+            out["fractions"] = {c: round((v or 0.0) / wall, 6)
+                                for c, v in cats.items()}
+    if tenants:
+        out["tenant_shed_s"] = tenants
+    return out
+
+
 def memory_summary(metrics: Sequence[Dict[str, Any]],
                    events: Sequence[Dict[str, Any]]) -> Dict[str, Any]:
     out: Dict[str, Any] = {}
@@ -514,6 +553,7 @@ def summarize_run(events_path: Optional[str],
         "kernels": kernels_summary(run["metrics"]),
         "serving": serving_summary(run["metrics"]),
         "fleet": fleet_summary(run["metrics"]),
+        "goodput": goodput_summary(run["metrics"]),
         "tracing": tracing_summary(run["metrics"], run["events"]),
         "profile": profile,
         "xprof": xprof_summary(run["events"], explicit_dir=xprof_dir),
@@ -811,6 +851,32 @@ def format_summary(s: Dict[str, Any]) -> str:
             add(line)
         add("")
 
+    gp = s.get("goodput") or {}
+    if gp.get("categories"):
+        add("--- goodput ledger (every wall-second attributed) ---")
+        wall = float(gp.get("wall_s") or 0.0)
+        line = f"wall: {wall:.2f}s"
+        if gp.get("goodput_fraction") is not None:
+            line += f" · goodput {100 * gp['goodput_fraction']:.1f}%"
+        over = float(gp.get("overcommit_s") or 0.0)
+        line += (f" · overcommit {over:.3f}s"
+                 + (" (NOT conserved — double-counted seam?)"
+                    if wall > 0 and over > 0.01 * wall else ""))
+        add(line)
+        cats = gp["categories"]
+        fracs = gp.get("fractions") or {}
+        add(f"{'category':<20}{'seconds':>12}{'% wall':>9}")
+        for cat in sorted(cats, key=lambda c: cats[c] or 0, reverse=True):
+            if not cats[cat]:
+                continue
+            pct = f"{100 * fracs[cat]:.1f}%" if cat in fracs else "-"
+            add(f"{cat:<20}{cats[cat]:>12.3f}{pct:>9}")
+        tens = gp.get("tenant_shed_s") or {}
+        if tens:
+            add("shed by tenant: " + ", ".join(
+                f"{t}={v:.3f}s" for t, v in sorted(tens.items())))
+        add("")
+
     add("--- memory high-water marks ---")
     mem = s["memory"]
     if mem:
@@ -840,6 +906,71 @@ def format_summary(s: Dict[str, Any]) -> str:
             {k: v for k, v in e.items() if k != "thread_stacks"},
             sort_keys=True, default=str))
     return "\n".join(lines)
+
+
+# --------------------------------------------------------------------- #
+# Postmortem bundle (--bundle)
+# --------------------------------------------------------------------- #
+def make_bundle(out_path: str,
+                events_path: Optional[str] = None,
+                trace_path: Optional[str] = None,
+                extra_dir: Optional[str] = None,
+                summary: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
+    """One-file postmortem: every rotation segment of the event log, the
+    request-trace log (``traces.jsonl[.N]`` beside it), the chrome trace,
+    any ``*config*.json`` echo files in the telemetry dir, plus a
+    generated ``summary.json`` (the final metric snapshot, digested) and
+    a ``manifest.json`` — packed into ``out_path`` (tar.gz).  Returns the
+    manifest.  Missing artifacts are skipped, never fatal: a postmortem
+    of a half-dead run is exactly when this gets used."""
+    import tarfile
+    import time as _time
+
+    from .events import event_segments
+
+    files: List[str] = []
+    if events_path:
+        files.extend(event_segments(events_path))
+        # the request-trace log lives beside events.jsonl in the same
+        # telemetry dir (tracing/store.py default wiring)
+        files.extend(event_segments(
+            os.path.join(os.path.dirname(os.path.abspath(events_path)),
+                         "traces.jsonl")))
+    if trace_path and os.path.exists(trace_path):
+        files.append(trace_path)
+    if extra_dir and os.path.isdir(extra_dir):
+        for fn in sorted(os.listdir(extra_dir)):
+            if "config" in fn and fn.endswith(".json"):
+                files.append(os.path.join(extra_dir, fn))
+    seen: set = set()
+    files = [f for f in files
+             if os.path.exists(f) and not (f in seen or seen.add(f))]
+    manifest: Dict[str, Any] = {
+        "created_unix": _time.time(),
+        "sources": {"events": events_path, "trace": trace_path},
+        "files": [{"name": os.path.basename(f),
+                   "bytes": os.path.getsize(f)} for f in files],
+    }
+    with tarfile.open(out_path, "w:gz") as tar:
+        for f in files:
+            tar.add(f, arcname=os.path.join("bundle", os.path.basename(f)))
+
+        def _add_json(name: str, obj: Any) -> None:
+            import io
+
+            data = json.dumps(obj, indent=2, sort_keys=True,
+                              default=str).encode()
+            info = tarfile.TarInfo(os.path.join("bundle", name))
+            info.size = len(data)
+            info.mtime = int(_time.time())
+            tar.addfile(info, io.BytesIO(data))
+
+        if summary is not None:
+            _add_json("summary.json", summary)
+            manifest["files"].append({"name": "summary.json",
+                                      "generated": True})
+        _add_json("manifest.json", manifest)
+    return manifest
 
 
 # --------------------------------------------------------------------- #
@@ -878,6 +1009,11 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "event, if any)")
     parser.add_argument("--json", action="store_true", dest="as_json",
                         help="emit the summary as JSON instead of text")
+    parser.add_argument("--bundle", default=None, metavar="OUT.tar.gz",
+                        help="pack a postmortem bundle: events.jsonl[.N] "
+                             "+ traces.jsonl[.N] + trace.json + config "
+                             "echoes + generated summary.json + manifest, "
+                             "as one tar.gz")
     parser.add_argument("--compare", nargs="?", const=".", default=None,
                         metavar="HISTORY_DIR",
                         help="cross-run regression check: diff this run "
@@ -921,6 +1057,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 2
 
     summary = summarize_run(events_path, trace_path, xprof_dir=args.xprof)
+    if args.bundle:
+        manifest = make_bundle(
+            args.bundle, events_path=events_path, trace_path=trace_path,
+            extra_dir=path if os.path.isdir(path) else
+            os.path.dirname(os.path.abspath(events_path)),
+            summary=summary)
+        print(f"dstpu-telemetry: bundle {args.bundle} "
+              f"({len(manifest['files'])} files)")
+        return 0
     try:
         if args.as_json:
             print(json.dumps(summary, indent=2, sort_keys=True, default=str))

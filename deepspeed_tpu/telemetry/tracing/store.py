@@ -69,6 +69,11 @@ SPAN_KINDS = (
     "route",           # router wrapper: admission → final forwarded byte
 )
 
+#: segment kinds whose p95s sum into the TTFT estimate (time queued plus
+#: prompt service — the part of TTFT fleet capacity actually controls);
+#: canonical here, the fleet controller imports it
+TTFT_SEGMENTS = ("queue_wait", "prefill")
+
 #: flags that force tail-sampling to KEEP a trace.  ``exemplar`` is set
 #: by :meth:`RequestTraceStore.note_exemplar` itself: a flag rides the
 #: in-band payload, so the ROUTER's independently-sampled merged copy is
@@ -110,7 +115,8 @@ class RequestTraceStore:
                  sample_every: int = 10, slow_quantile: float = 0.99,
                  slow_min_samples: int = 32, wall_window: int = 512,
                  exemplar_k: int = 4, segment_window: int = 512,
-                 jsonl_max_mb: float = 64.0):
+                 segment_window_s: float = 60.0,
+                 jsonl_max_mb: float = 64.0, clock=time.monotonic):
         self.sample_every = max(int(sample_every), 1)
         self.slow_quantile = float(slow_quantile)
         self.slow_min_samples = int(slow_min_samples)
@@ -132,6 +138,14 @@ class RequestTraceStore:
             maxlen=int(wall_window))
         self._segments: Dict[str, "collections.deque[float]"] = {}
         self._segment_window = int(segment_window)
+        #: TIME-windowed (ts, dur) pairs per kind: the count-bounded deque
+        #: above keeps stale breaches alive forever under low traffic, so
+        #: the rolling p95 the fleet controller trusts (``p95_window_s``)
+        #: only sees the last ``segment_window_s`` seconds
+        self._seg_recent: Dict[str,
+                               "collections.deque[Tuple[float, float]]"] = {}
+        self.segment_window_s = float(segment_window_s)
+        self.clock = clock
         self._seg_totals: Dict[str, Tuple[int, float]] = {}
         self._exemplars: Dict[str, List[Tuple[float, str]]] = {}
         self._finish_seq = 0
@@ -426,16 +440,42 @@ class RequestTraceStore:
 
         out: Dict[str, Dict[str, Any]] = {}
         with self._lock:
+            now = self.clock()
             for kind, window in self._segments.items():
                 count, total = self._seg_totals.get(kind, (0, 0.0))
                 svals = sorted(window)
+                recent = self._seg_recent.get(kind)
+                rvals = []
+                if recent is not None:
+                    self._expire_recent_locked(recent, now)
+                    rvals = sorted(d for _, d in recent)
                 out[kind] = {
                     "count": count, "total_s": total,
                     "mean_s": total / count if count else 0.0,
                     "p50_s": _percentile(svals, 50) if svals else None,
                     "p95_s": _percentile(svals, 95) if svals else None,
+                    # rolling TIME window (last segment_window_s seconds):
+                    # None once traffic goes quiet — a stale breach must
+                    # age out of the controller's overload signal
+                    "p95_window_s": _percentile(rvals, 95) if rvals
+                    else None,
                 }
         return out
+
+    def ttft_p95_window_s(self) -> Optional[float]:
+        """Rolling-window TTFT p95 estimate: the sum of the time-windowed
+        segment p95s over the TTFT segments (queue_wait + prefill); None
+        when the window holds no recent traffic."""
+        summary = self.segment_summary()
+        parts = [row.get("p95_window_s") for kind, row in summary.items()
+                 if kind in TTFT_SEGMENTS
+                 and row.get("p95_window_s") is not None]
+        return float(sum(parts)) if parts else None
+
+    def _expire_recent_locked(self, recent, now: float) -> None:
+        horizon = now - self.segment_window_s
+        while recent and recent[0][0] < horizon:
+            recent.popleft()
 
     def _observe_segment_locked(self, kind: str, dur_s: float) -> None:
         win = self._segments.get(kind)
@@ -443,6 +483,13 @@ class RequestTraceStore:
             win = self._segments[kind] = collections.deque(
                 maxlen=self._segment_window)
         win.append(dur_s)
+        recent = self._seg_recent.get(kind)
+        if recent is None:
+            recent = self._seg_recent[kind] = collections.deque(
+                maxlen=self._segment_window)
+        now = self.clock()
+        recent.append((now, dur_s))
+        self._expire_recent_locked(recent, now)
         count, total = self._seg_totals.get(kind, (0, 0.0))
         self._seg_totals[kind] = (count + 1, total + dur_s)
         tel = get_telemetry()
@@ -631,6 +678,8 @@ def traces_endpoint_payload(query: Dict[str, Any]
                                     for k, v in sorted(by_kind.items())}})
     return 200, {
         "segments": store.segment_summary(),
+        "ttft_p95_window_s": store.ttft_p95_window_s(),
+        "ttft_window_s": store.segment_window_s,
         "counters": dict(store.counters),
         "exemplars": store.exemplars(),
         "slowest": slow,

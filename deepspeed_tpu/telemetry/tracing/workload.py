@@ -1,0 +1,422 @@
+"""Recorded ``traces.jsonl`` → replayable workload, and the replay driver
+behind ``bin/dstpu-replay``.
+
+The tracing tier already records, per request, everything needed to
+reconstruct the traffic that produced a telemetry run: the trace record's
+``t_start`` gives the arrival time, ``prefill`` spans carry the prompt
+chunk sizes (``resume`` chunks are preempt recompute, not client payload,
+and are excluded), drained ``decode_window``/``verify``/``compile`` spans
+carry the tokens produced, the router's ``route`` span carries the tenant
+and stream flag, and ``draft``/``verify`` spans mark speculative decoding.
+:func:`load_workload` folds a (possibly rotated) ``traces.jsonl`` into a
+list of :class:`WorkloadRequest` with arrival *offsets*, so the same
+traffic shape can be re-fired at any live ``dstpu-serve`` / ``dstpu-router``
+endpoint — in real time or time-scaled — and the run scored from the
+target's own goodput ledger (``/healthz`` → ``goodput`` section).
+
+This is the substrate the autotuning loop needs: record once in
+production, then replay the identical request mix against candidate
+configs and compare ledger-scored verdicts instead of synthetic
+benchmarks.
+
+Replay fidelity contract: request *shape* (count, per-request prompt/output
+lengths, tenants, arrival spacing) is reproduced exactly; prompt *content*
+is synthetic (deterministic token ids of the recorded length — the trace
+intentionally never records payload tokens).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import asdict, dataclass, field
+from typing import Any, Dict, List, Optional
+
+from ..events import read_event_segments
+
+#: span kinds whose ``tokens`` attr counts PROMPT tokens.  ``resume``-flagged
+#: prefill chunks are preempt recompute of already-counted payload.
+PROMPT_SPAN_KINDS = ("prefill",)
+
+#: span kinds whose ``tokens`` attr counts produced OUTPUT tokens.  A
+#: first-use window is retyped ``compile`` but its riders still produced
+#: the recorded tokens, so compile spans count toward output length.
+OUTPUT_SPAN_KINDS = ("decode_window", "verify", "compile")
+
+#: presence of any of these spans marks the request as speculative
+SPEC_SPAN_KINDS = ("draft", "verify")
+
+
+# --------------------------------------------------------------------- #
+# Workload model
+# --------------------------------------------------------------------- #
+@dataclass
+class WorkloadRequest:
+    """One recorded request, ready to re-fire."""
+
+    trace_id: str
+    arrival_s: float            # offset from the workload's first arrival
+    prompt_tokens: int
+    max_new_tokens: int
+    tenant: str = "default"
+    stream: bool = False
+    speculative: bool = False
+    shed: bool = False          # the RECORDED attempt was shed; replayed
+    #                             anyway — offered load is the workload
+
+
+@dataclass
+class Workload:
+    """An ordered (by arrival offset) replayable request list."""
+
+    source: str
+    requests: List[WorkloadRequest] = field(default_factory=list)
+
+    @property
+    def n_requests(self) -> int:
+        return len(self.requests)
+
+    @property
+    def duration_s(self) -> float:
+        return self.requests[-1].arrival_s if self.requests else 0.0
+
+    def tenants(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for r in self.requests:
+            out[r.tenant] = out.get(r.tenant, 0) + 1
+        return out
+
+    def describe(self) -> Dict[str, Any]:
+        reqs = self.requests
+        return {
+            "source": self.source,
+            "n_requests": len(reqs),
+            "duration_s": round(self.duration_s, 6),
+            "tenants": self.tenants(),
+            "shed_recorded": sum(1 for r in reqs if r.shed),
+            "speculative": sum(1 for r in reqs if r.speculative),
+            "stream": sum(1 for r in reqs if r.stream),
+            "prompt_tokens_total": sum(r.prompt_tokens for r in reqs),
+            "output_tokens_total": sum(r.max_new_tokens for r in reqs),
+        }
+
+
+def _span_tokens(spans: List[Dict[str, Any]], kinds) -> int:
+    total = 0
+    for sp in spans:
+        if sp.get("kind") not in kinds:
+            continue
+        attrs = sp.get("attrs") or {}
+        if sp.get("kind") in PROMPT_SPAN_KINDS and attrs.get("resume"):
+            continue
+        try:
+            total += int(attrs.get("tokens") or 0)
+        except (TypeError, ValueError):
+            continue
+    return total
+
+
+def load_workload(path: str,
+                  include_shed: bool = True,
+                  default_prompt_tokens: int = 8,
+                  default_max_new_tokens: int = 16) -> Workload:
+    """Parse a (possibly rotated) ``traces.jsonl`` into a :class:`Workload`.
+
+    A kept trace re-emits on every finish (router after replica on a
+    shared store) — the newest line per trace id wins, exactly like the
+    store's own loader.  Requests that were shed at record time carry
+    ``shed=True`` and default prompt/output lengths (they never reached
+    prefill, so the trace has no token counts for them); they are part of
+    the *offered* load and replayed unless ``include_shed`` is False.
+    """
+    recs: Dict[str, Dict[str, Any]] = {}
+    for row in read_event_segments(path):
+        if row.get("kind") != "trace" or not row.get("trace"):
+            continue
+        recs[str(row["trace"])] = row        # later lines override
+    out: List[WorkloadRequest] = []
+    t_min: Optional[float] = None
+    for rec in recs.values():
+        try:
+            t_start = float(rec["t_start"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        t_min = t_start if t_min is None else min(t_min, t_start)
+    if t_min is None:
+        return Workload(source=path, requests=[])
+    for tid, rec in recs.items():
+        try:
+            t_start = float(rec["t_start"])
+        except (KeyError, TypeError, ValueError):
+            continue
+        spans = rec.get("spans") or []
+        flags = [str(f) for f in (rec.get("flags") or [])]
+        tenant = "default"
+        stream = False
+        shed = any(str(f).startswith("shed") for f in flags)
+        for sp in spans:
+            attrs = sp.get("attrs") or {}
+            if sp.get("kind") == "route":
+                if attrs.get("tenant"):
+                    tenant = str(attrs["tenant"])
+                stream = bool(attrs.get("stream", False))
+            elif sp.get("kind") == "admission":
+                if attrs.get("shed"):
+                    shed = True
+                if attrs.get("tenant"):
+                    tenant = str(attrs["tenant"])
+        if shed and not include_shed:
+            continue
+        prompt = _span_tokens(spans, PROMPT_SPAN_KINDS)
+        output = _span_tokens(spans, OUTPUT_SPAN_KINDS)
+        if prompt:
+            # the prefill's final forward seeds token 1 of the output;
+            # the decode/verify windows carry only the remaining tokens
+            output += 1
+        out.append(WorkloadRequest(
+            trace_id=tid,
+            arrival_s=max(0.0, t_start - t_min),
+            prompt_tokens=prompt or default_prompt_tokens,
+            max_new_tokens=output or default_max_new_tokens,
+            tenant=tenant,
+            stream=stream,
+            speculative=any(sp.get("kind") in SPEC_SPAN_KINDS
+                            for sp in spans),
+            shed=shed,
+        ))
+    out.sort(key=lambda r: (r.arrival_s, r.trace_id))
+    return Workload(source=path, requests=out)
+
+
+def synth_prompt(n_tokens: int, seed: int = 0) -> List[int]:
+    """Deterministic synthetic token ids of the recorded length.  Small
+    ids so any vocab the target model exposes covers them."""
+    return [((seed * 131) + i * 17) % 47 + 1 for i in range(max(1,
+                                                                n_tokens))]
+
+
+# --------------------------------------------------------------------- #
+# Replay driver
+# --------------------------------------------------------------------- #
+def _post_generate(url: str, body: Dict[str, Any],
+                   timeout_s: float) -> Dict[str, Any]:
+    """One blocking (or drained-SSE) request; returns outcome fields."""
+    data = json.dumps(body).encode()
+    req = urllib.request.Request(
+        f"{url}/v1/generate", data=data,
+        headers={"Content-Type": "application/json"})
+    t0 = time.perf_counter()
+    try:
+        with urllib.request.urlopen(req, timeout=timeout_s) as r:
+            if body.get("stream"):
+                # SSE: drain the event stream; tokens arrive as lines
+                while r.readline():
+                    pass
+                payload: Dict[str, Any] = {}
+            else:
+                payload = json.loads(r.read() or b"{}")
+            code = r.status
+    except urllib.error.HTTPError as e:
+        try:
+            payload = json.loads(e.read() or b"{}")
+        except (ValueError, OSError):
+            payload = {}
+        code = e.code
+    except Exception as e:  # noqa: BLE001 — transport failure is an outcome
+        return {"code": 0, "error": repr(e),
+                "wall_s": time.perf_counter() - t0}
+    out = {"code": code, "wall_s": time.perf_counter() - t0}
+    if isinstance(payload, dict):
+        if payload.get("reason"):
+            out["reason"] = payload["reason"]
+        toks = payload.get("tokens")
+        if isinstance(toks, list):
+            out["tokens"] = len(toks)
+    return out
+
+
+def _fetch_goodput(url: str, timeout_s: float = 5.0) \
+        -> Optional[Dict[str, Any]]:
+    """The target's ledger view: ``/healthz`` ``goodput`` section (serve:
+    own snapshot; router: fleet rollup), falling back to ``/goodput``."""
+    for path, key in (("/healthz", "goodput"), ("/goodput", None)):
+        try:
+            with urllib.request.urlopen(f"{url}{path}",
+                                        timeout=timeout_s) as r:
+                body = json.loads(r.read())
+        except Exception:  # noqa: BLE001 — scoring is best-effort
+            continue
+        gp = body.get(key) if key else body
+        if isinstance(gp, dict) and "categories" in gp:
+            return gp
+    return None
+
+
+def _percentile(vals: List[float], q: float) -> Optional[float]:
+    if not vals:
+        return None
+    vs = sorted(vals)
+    idx = min(len(vs) - 1, max(0, int(round(q / 100.0 * (len(vs) - 1)))))
+    return vs[idx]
+
+
+def replay(workload: Workload, url: str,
+           time_scale: float = 1.0,
+           timeout_s: float = 60.0,
+           tenant_override: Optional[str] = None,
+           max_concurrency: int = 64) -> Dict[str, Any]:
+    """Fire the workload at ``url`` honoring (scaled) arrival offsets and
+    return a ledger-scored verdict.
+
+    ``time_scale > 1`` compresses time (2.0 → twice as fast);
+    arrival *order* and relative spacing shape are preserved either way.
+    The verdict carries per-request outcomes, arrival-fidelity stats
+    (scheduled-vs-actual fire lag), and — when the target has a goodput
+    ledger installed — the post-run ledger snapshot plus its
+    ``goodput_fraction`` as the scalar score.
+    """
+    url = url.rstrip("/")
+    if "://" not in url:
+        url = "http://" + url
+    scale = max(time_scale, 1e-6)
+    sem = threading.Semaphore(max(1, int(max_concurrency)))
+    results: List[Optional[Dict[str, Any]]] = [None] * len(workload.requests)
+    epoch = time.perf_counter()
+
+    def _one(i: int, r: WorkloadRequest) -> None:
+        scheduled = r.arrival_s / scale
+        delay = epoch + scheduled - time.perf_counter()
+        if delay > 0:
+            time.sleep(delay)
+        with sem:
+            fired = time.perf_counter() - epoch
+            body: Dict[str, Any] = {
+                "prompt": synth_prompt(r.prompt_tokens, seed=i),
+                "max_new_tokens": int(r.max_new_tokens),
+                "tenant": tenant_override or r.tenant,
+            }
+            if r.stream:
+                body["stream"] = True
+            out = _post_generate(url, body, timeout_s=timeout_s)
+        out.update(trace_id=r.trace_id, scheduled_s=round(scheduled, 6),
+                   fired_s=round(fired, 6),
+                   lag_s=round(fired - scheduled, 6))
+        results[i] = out
+
+    threads = [threading.Thread(target=_one, args=(i, r), daemon=True)
+               for i, r in enumerate(workload.requests)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    wall = time.perf_counter() - epoch
+
+    done = [r for r in results if r is not None]
+    ok = [r for r in done if 200 <= r.get("code", 0) < 300]
+    shed = [r for r in done if r.get("code") in (429, 503)]
+    errors = [r for r in done if r.get("code", 0) == 0
+              or r.get("code", 0) >= 400 and r.get("code") not in (429,
+                                                                   503)]
+    lags = [r["lag_s"] for r in done if "lag_s" in r]
+    goodput = _fetch_goodput(url)
+    verdict: Dict[str, Any] = {
+        "url": url,
+        "source": workload.source,
+        "time_scale": time_scale,
+        "wall_s": round(wall, 6),
+        "n_requests": len(workload.requests),
+        "completed": len(ok),
+        "shed": len(shed),
+        "errors": len(errors),
+        "arrival": {
+            "max_lag_s": round(max(lags), 6) if lags else None,
+            "p95_lag_s": round(_percentile(lags, 95), 6) if lags else None,
+            "mean_lag_s": round(sum(lags) / len(lags), 6) if lags else None,
+        },
+        "goodput": goodput,
+        "score": (goodput or {}).get("goodput_fraction"),
+        "requests": done,
+    }
+    return verdict
+
+
+# --------------------------------------------------------------------- #
+# CLI (bin/dstpu-replay)
+# --------------------------------------------------------------------- #
+def main(argv: Optional[List[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dstpu-replay",
+        description="Replay a recorded traces.jsonl against a live "
+                    "dstpu-serve / dstpu-router endpoint and score the "
+                    "run from the target's goodput ledger.")
+    p.add_argument("traces", help="traces.jsonl (rotated segments found "
+                                  "automatically)")
+    p.add_argument("--url", required=True,
+                   help="target base URL, e.g. http://127.0.0.1:8100")
+    p.add_argument("--time-scale", type=float, default=1.0,
+                   help="arrival-time compression: 2.0 replays twice as "
+                        "fast (default 1.0 = real time)")
+    p.add_argument("--limit", type=int, default=None,
+                   help="replay only the first N requests by arrival")
+    p.add_argument("--skip-shed", action="store_true",
+                   help="drop requests that were shed at record time "
+                        "(default: replay the full offered load)")
+    p.add_argument("--tenant", default=None,
+                   help="override every request's tenant")
+    p.add_argument("--timeout-s", type=float, default=60.0)
+    p.add_argument("--describe", action="store_true",
+                   help="print the parsed workload and exit (no traffic)")
+    p.add_argument("--json", dest="json_out", default=None,
+                   help="write the full verdict JSON here "
+                        "(default: stdout summary only)")
+    args = p.parse_args(argv)
+
+    wl = load_workload(args.traces, include_shed=not args.skip_shed)
+    if args.limit is not None:
+        wl = Workload(source=wl.source, requests=wl.requests[:args.limit])
+    if args.describe:
+        print(json.dumps({"workload": wl.describe(),
+                          "requests": [asdict(r) for r in wl.requests]},
+                         indent=2))
+        return 0
+    if not wl.requests:
+        print(f"dstpu-replay: no replayable traces in {args.traces}",
+              file=sys.stderr)
+        return 1
+
+    d = wl.describe()
+    print(f"dstpu-replay: {d['n_requests']} requests over "
+          f"{d['duration_s']:.2f}s recorded "
+          f"(x{args.time_scale:g} replay) -> {args.url}")
+    verdict = replay(wl, args.url, time_scale=args.time_scale,
+                     timeout_s=args.timeout_s,
+                     tenant_override=args.tenant)
+    verdict["workload"] = d
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(verdict, f, indent=2)
+    arr = verdict["arrival"]
+    score = verdict["score"]
+    print(f"dstpu-replay: completed {verdict['completed']}"
+          f"/{verdict['n_requests']} "
+          f"(shed {verdict['shed']}, errors {verdict['errors']}) "
+          f"in {verdict['wall_s']:.2f}s; "
+          f"arrival p95 lag "
+          f"{arr['p95_lag_s'] if arr['p95_lag_s'] is not None else '?'}s")
+    if score is not None:
+        gp = verdict["goodput"]
+        print(f"dstpu-replay: goodput score {score:.4f} "
+              f"(compute fraction of {gp['wall_s']:.2f}s ledger wall; "
+              f"conserved={gp.get('conserved')})")
+    else:
+        print("dstpu-replay: target has no goodput ledger "
+              "(score unavailable)")
+    return 0 if verdict["errors"] == 0 else 1
+
+
+if __name__ == "__main__":                      # pragma: no cover
+    sys.exit(main())

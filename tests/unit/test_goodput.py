@@ -1,0 +1,448 @@
+"""Goodput ledger + trace-replay harness (marker: goodput).
+
+Covers the accounting core (attribution, derived idle, the overcommit
+detector, residual envelopes, fleet rollup, gauge publication), the
+conservation invariant on a real CPU-sim training run, the
+traces.jsonl -> workload converter behind ``dstpu-replay``, the
+``dstpu-telemetry --bundle`` postmortem tarball, and the rolling-window
+TTFT p95 the fleet controller now prefers over the count-bounded
+aggregate.
+"""
+import json
+import os
+import tarfile
+
+import pytest
+
+from deepspeed_tpu.telemetry.goodput import (
+    CATEGORIES,
+    GoodputLedger,
+    get_goodput_ledger,
+    goodput_residual,
+    install_goodput_ledger,
+    record_goodput,
+    rollup,
+)
+
+pytestmark = pytest.mark.goodput
+
+
+class FakeClock:
+    def __init__(self, t=100.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+# --------------------------------------------------------------------- #
+# Ledger core
+# --------------------------------------------------------------------- #
+class TestLedger:
+    def test_idle_absorbs_remainder_and_fractions_sum(self):
+        clk = FakeClock()
+        led = GoodputLedger(component="t", clock=clk)
+        led.add("compute", 2.0)
+        led.add("exposed_comm", 1.0)
+        clk.advance(5.0)
+        snap = led.snapshot()
+        assert snap["wall_s"] == pytest.approx(5.0)
+        assert snap["categories"]["idle"] == pytest.approx(2.0)
+        assert snap["goodput_fraction"] == pytest.approx(2.0 / 5.0)
+        assert sum(snap["categories"].values()) == pytest.approx(5.0)
+        assert snap["conserved"] and snap["overcommit_s"] == 0.0
+
+    def test_unknown_category_raises(self):
+        led = GoodputLedger(clock=FakeClock())
+        with pytest.raises(ValueError, match="unknown goodput category"):
+            led.add("coffee", 1.0)
+
+    def test_overcommit_breaks_conservation(self):
+        clk = FakeClock()
+        led = GoodputLedger(clock=clk)
+        clk.advance(1.0)
+        led.add("compute", 10.0)        # double-counted seam
+        assert led.overcommit_s() == pytest.approx(9.0)
+        assert not led.conserved()
+        snap = led.snapshot()
+        assert not snap["conserved"]
+        assert snap["overcommit_s"] == pytest.approx(9.0)
+
+    def test_residual_block_subtracts_inner_attributions(self):
+        clk = FakeClock()
+        led = GoodputLedger(clock=clk)
+        with led.residual_block("drain"):
+            led.add("compute", 3.0)     # windows inside the drain loop
+            clk.advance(5.0)
+        assert led.snapshot()["categories"]["drain"] == pytest.approx(2.0)
+        assert led.snapshot()["categories"]["compute"] == pytest.approx(3.0)
+
+    def test_tenant_attributed_shed(self):
+        led = GoodputLedger(clock=FakeClock())
+        led.add("shed", 0.5, tenant="bulk")
+        led.add("shed", 0.25, tenant="bulk")
+        led.add("shed", 0.1, tenant="interactive")
+        assert led.snapshot()["tenant_shed_s"] == {
+            "bulk": pytest.approx(0.75), "interactive": pytest.approx(0.1)}
+
+    def test_rollup_tolerates_malformed(self):
+        clk = FakeClock()
+        a = GoodputLedger(component="a", clock=clk)
+        b = GoodputLedger(component="b", clock=clk)
+        a.add("compute", 4.0)
+        b.add("compute", 1.0)
+        b.add("shed", 1.0, tenant="bulk")
+        clk.advance(10.0)
+        roll = rollup([a.snapshot(), None, "garbage", b.snapshot()])
+        assert roll["processes"] == 2
+        assert roll["wall_s"] == pytest.approx(20.0)
+        assert roll["categories"]["compute"] == pytest.approx(5.0)
+        assert roll["tenant_shed_s"]["bulk"] == pytest.approx(1.0)
+        assert roll["goodput_fraction"] == pytest.approx(5.0 / 20.0)
+        assert roll["conserved"]
+
+    def test_global_install_and_disabled_fast_path(self):
+        assert get_goodput_ledger() is None
+        record_goodput("compute", 1.0)          # no-op, must not raise
+        with goodput_residual("drain"):
+            pass
+        led = GoodputLedger(clock=FakeClock())
+        install_goodput_ledger(led)
+        try:
+            record_goodput("compute", 1.5)
+            assert led.snapshot()["categories"]["compute"] == \
+                pytest.approx(1.5)
+        finally:
+            install_goodput_ledger(None)
+        assert get_goodput_ledger() is None
+
+    def test_publish_mirrors_gauges(self, tmp_path):
+        from deepspeed_tpu.telemetry import Telemetry, set_telemetry
+
+        tel = Telemetry(output_dir=str(tmp_path))
+        set_telemetry(tel)
+        try:
+            clk = FakeClock()
+            led = GoodputLedger(clock=clk)
+            led.add("compute", 2.0)
+            led.add("shed", 0.5, tenant="bulk")
+            clk.advance(4.0)
+            led.publish()
+            m = tel.metrics
+            assert m.gauge("goodput/wall_s").value() == pytest.approx(4.0)
+            assert m.gauge("goodput/compute_s").value() == \
+                pytest.approx(2.0)
+            assert m.gauge("goodput/goodput_fraction").value() == \
+                pytest.approx(0.5)
+            assert m.gauge("goodput/tenant_shed_s").value(
+                tenant="bulk") == pytest.approx(0.5)
+            for cat in CATEGORIES:
+                assert m.gauge(f"goodput/{cat}_s").value() is not None
+        finally:
+            set_telemetry(None)
+            tel.close()
+
+
+# --------------------------------------------------------------------- #
+# Training-run conservation (CPU sim)
+# --------------------------------------------------------------------- #
+def test_training_run_conserves():
+    """Three real ``train_batch`` steps with the ledger installed: step 1
+    lands in compile, later steps in compute, the logging body in
+    host_sync — and the books conserve (no seam double-counts)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    import deepspeed_tpu
+    from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+    from deepspeed_tpu.runtime.topology import TopologyConfig, \
+        initialize_mesh
+
+    topo = initialize_mesh(TopologyConfig(), force=True)
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    params = model.init_params(jax.random.PRNGKey(0))
+    led = GoodputLedger(component="train")
+    install_goodput_ledger(led)
+    try:
+        eng, _, _, _ = deepspeed_tpu.initialize(
+            model=model, model_parameters=params,
+            config={"train_micro_batch_size_per_gpu": 2,
+                    "optimizer": {"type": "AdamW",
+                                  "params": {"lr": 1e-3}},
+                    "zero_optimization": {"stage": 2}},
+            topology=topo)
+        rng = np.random.default_rng(0)
+        batch = {"input_ids": jnp.asarray(
+            rng.integers(0, 64, size=(2, 16)), jnp.int32)}
+        for _ in range(3):
+            eng.train_batch(batch)
+        snap = led.snapshot()
+        cats = snap["categories"]
+        assert cats["compile"] > 0.0, cats       # step 1
+        assert cats["compute"] > 0.0, cats       # steps 2..3
+        assert cats["host_sync"] > 0.0, cats     # _post_step_logging body
+        assert snap["conserved"], \
+            f"overcommit {snap['overcommit_s']}s of {snap['wall_s']}s"
+        assert sum(cats.values()) == pytest.approx(snap["wall_s"],
+                                                   rel=0.01)
+    finally:
+        install_goodput_ledger(None)
+
+
+# --------------------------------------------------------------------- #
+# traces.jsonl -> workload converter
+# --------------------------------------------------------------------- #
+def _trace_row(tid, t_start, spans, flags=(), wall=1.0):
+    return {"kind": "trace", "trace": tid, "uid": None,
+            "t_start": t_start, "spans": spans, "flags": list(flags),
+            "wall_s": wall}
+
+
+def _span(kind, tokens=None, **attrs):
+    sp = {"sid": f"{kind}-{tokens}", "kind": kind, "component": "serve",
+          "uid": 1, "t0": 0.0, "dur_s": 0.01}
+    if tokens is not None:
+        attrs["tokens"] = tokens
+    if attrs:
+        sp["attrs"] = attrs
+    return sp
+
+
+class TestWorkload:
+    def test_load_workload_reconstructs_mix(self, tmp_path):
+        from deepspeed_tpu.telemetry.tracing.workload import load_workload
+
+        path = tmp_path / "traces.jsonl"
+        rows = [
+            # plain request: 2 prefill chunks (5+3), 12 decoded tokens,
+            # router route span carries tenant + stream
+            _trace_row("t-a", 1000.0, [
+                _span("prefill", tokens=5, batch=1, resume=False),
+                _span("prefill", tokens=3, batch=1, resume=False),
+                _span("decode_window", tokens=8, n_seqs=1),
+                _span("decode_window", tokens=4, n_seqs=1),
+                _span("route", tenant="bulk", stream=True),
+            ]),
+            # preempted request: the resume chunk must NOT count toward
+            # the prompt; spec spans mark it speculative
+            _trace_row("t-b", 1002.5, [
+                _span("prefill", tokens=6, batch=1, resume=False),
+                _span("prefill", tokens=6, batch=1, resume=True),
+                _span("compile", tokens=2, n_seqs=1),
+                _span("verify", tokens=5, n_seqs=1),
+                _span("draft"),
+            ]),
+            # shed at admission: no token spans at all -> defaults
+            _trace_row("t-c", 1001.0, [
+                _span("admission", shed="queue_full", tenant="bulk"),
+            ], flags=["shed:queue_full"]),
+        ]
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+            # a re-finish of t-a (newest line per trace id wins)
+            f.write(json.dumps(rows[0]) + "\n")
+
+        wl = load_workload(str(path))
+        assert wl.n_requests == 3
+        by_id = {r.trace_id: r for r in wl.requests}
+        a, b, c = by_id["t-a"], by_id["t-b"], by_id["t-c"]
+        assert [r.trace_id for r in wl.requests] == ["t-a", "t-c", "t-b"]
+        assert a.arrival_s == pytest.approx(0.0)
+        assert c.arrival_s == pytest.approx(1.0)
+        assert b.arrival_s == pytest.approx(2.5)
+        assert (a.prompt_tokens, a.max_new_tokens) == (8, 13)
+        assert a.tenant == "bulk" and a.stream and not a.speculative
+        assert b.prompt_tokens == 6          # resume chunk excluded
+        assert b.max_new_tokens == 8         # seed + compile/verify windows
+        assert b.speculative and not b.shed
+        assert c.shed and c.tenant == "bulk"
+        assert c.prompt_tokens == 8 and c.max_new_tokens == 16  # defaults
+        assert load_workload(str(path),
+                             include_shed=False).n_requests == 2
+        d = wl.describe()
+        assert d["n_requests"] == 3 and d["shed_recorded"] == 1
+        assert d["tenants"] == {"bulk": 2, "default": 1}
+
+    def test_synth_prompt_deterministic_and_sized(self):
+        from deepspeed_tpu.telemetry.tracing.workload import synth_prompt
+
+        assert synth_prompt(5, seed=3) == synth_prompt(5, seed=3)
+        assert synth_prompt(5, seed=3) != synth_prompt(5, seed=4)
+        assert len(synth_prompt(0)) == 1     # never an empty prompt
+        assert all(isinstance(t, int) and t > 0 for t in synth_prompt(64))
+
+    def test_cli_describe(self, tmp_path, capsys):
+        from deepspeed_tpu.telemetry.tracing.workload import main
+
+        path = tmp_path / "traces.jsonl"
+        with open(path, "w") as f:
+            f.write(json.dumps(_trace_row("t-x", 1.0, [
+                _span("prefill", tokens=4, resume=False),
+                _span("decode_window", tokens=2, n_seqs=1)])) + "\n")
+        assert main([str(path), "--url", "http://unused",
+                     "--describe"]) == 0
+        out = json.loads(capsys.readouterr().out)
+        assert out["workload"]["n_requests"] == 1
+        assert out["requests"][0]["prompt_tokens"] == 4
+
+
+# --------------------------------------------------------------------- #
+# dstpu-telemetry --bundle
+# --------------------------------------------------------------------- #
+def test_bundle_packs_logs_and_summary(tmp_path):
+    from deepspeed_tpu.telemetry.summary import make_bundle, summarize_run
+
+    d = tmp_path / "tel"
+    d.mkdir()
+    events = d / "events.jsonl"
+    with open(events, "w") as f:
+        f.write(json.dumps({"kind": "run_start", "pid": 1}) + "\n")
+        f.write(json.dumps({"kind": "metric", "name": "goodput/wall_s",
+                            "labels": {}, "value": 5.0}) + "\n")
+    with open(d / "events.jsonl.1", "w") as f:        # rotated segment
+        f.write(json.dumps({"kind": "fault"}) + "\n")
+    with open(d / "traces.jsonl", "w") as f:
+        f.write(json.dumps(_trace_row("t-a", 1.0, [])) + "\n")
+    with open(d / "trace.json", "w") as f:
+        json.dump({"traceEvents": []}, f)
+    with open(d / "run_config.json", "w") as f:       # config echo
+        json.dump({"zero": 2}, f)
+
+    out = tmp_path / "postmortem.tar.gz"
+    summary = summarize_run(str(events), str(d / "trace.json"))
+    manifest = make_bundle(str(out), events_path=str(events),
+                           trace_path=str(d / "trace.json"),
+                           extra_dir=str(d), summary=summary)
+    assert os.path.exists(out)
+    with tarfile.open(out) as tar:
+        names = {os.path.basename(n) for n in tar.getnames()}
+        assert {"events.jsonl", "events.jsonl.1", "traces.jsonl",
+                "trace.json", "run_config.json", "summary.json",
+                "manifest.json"} <= names
+        with tar.extractfile("bundle/summary.json") as f:
+            packed = json.load(f)
+        assert packed["goodput"]["wall_s"] == 5.0
+    packed_names = {row["name"] for row in manifest["files"]}
+    assert "events.jsonl.1" in packed_names
+
+
+# --------------------------------------------------------------------- #
+# goodput summary section
+# --------------------------------------------------------------------- #
+def test_goodput_summary_section():
+    from deepspeed_tpu.telemetry.summary import goodput_summary
+
+    metrics = [
+        {"kind": "metric", "name": "goodput/wall_s", "value": 10.0},
+        {"kind": "metric", "name": "goodput/compute_s", "value": 6.0},
+        {"kind": "metric", "name": "goodput/shed_s", "value": 1.0},
+        {"kind": "metric", "name": "goodput/goodput_fraction",
+         "value": 0.6},
+        {"kind": "metric", "name": "goodput/overcommit_s", "value": 0.0},
+        {"kind": "metric", "name": "goodput/tenant_shed_s",
+         "labels": {"tenant": "bulk"}, "value": 1.0},
+        {"kind": "metric", "name": "serving/shed", "value": 3.0},
+    ]
+    gp = goodput_summary(metrics)
+    assert gp["wall_s"] == 10.0
+    assert gp["categories"]["compute"] == 6.0
+    assert gp["fractions"]["compute"] == pytest.approx(0.6)
+    assert gp["tenant_shed_s"] == {"bulk": 1.0}
+    assert "serving/shed" not in gp
+
+
+# --------------------------------------------------------------------- #
+# record -> convert -> replay gate (real processes)
+# --------------------------------------------------------------------- #
+def test_goodput_gate_passes():
+    """This IS the CI gate for the record/replay loop: a real dstpu-serve
+    records a tiny traffic mix, the converter reproduces its request
+    count/token/tenant/arrival shape, and bin/dstpu-replay re-fires it at
+    a fresh server emitting a ledger-scored verdict
+    (tools/check_goodput.py, same enforcement pattern as the serving
+    smoke checks)."""
+    import subprocess
+    import sys
+
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    check = os.path.join(repo_root, "tools", "check_goodput.py")
+    proc = subprocess.run([sys.executable, check],
+                          capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, \
+        f"goodput gate failed:\n{proc.stdout}{proc.stderr[-1000:]}"
+
+
+# --------------------------------------------------------------------- #
+# rolling-window TTFT p95 (store + controller preference)
+# --------------------------------------------------------------------- #
+class TestWindowedTTFT:
+    def test_store_expires_stale_breaches(self):
+        from deepspeed_tpu.telemetry.tracing.store import RequestTraceStore
+
+        clk = FakeClock()
+        store = RequestTraceStore(segment_window_s=10.0, clock=clk)
+        store.add_span("t-1", "queue_wait", t0=0.0, dur_s=4.0)
+        store.add_span("t-1", "prefill", t0=0.0, dur_s=2.0)
+        s = store.segment_summary()
+        assert s["queue_wait"]["p95_window_s"] == pytest.approx(4.0)
+        assert store.ttft_p95_window_s() == pytest.approx(6.0)
+        # the breach ages out of the window; the count-bounded aggregate
+        # keeps it (that staleness is exactly what PR-16 tripped over)
+        clk.advance(11.0)
+        store.add_span("t-2", "queue_wait", t0=0.0, dur_s=0.1)
+        store.add_span("t-2", "prefill", t0=0.0, dur_s=0.1)
+        s = store.segment_summary()
+        assert s["queue_wait"]["p95_window_s"] == pytest.approx(0.1)
+        assert s["queue_wait"]["p95_s"] > 3.0   # still remembers the breach
+        assert store.ttft_p95_window_s() == pytest.approx(0.2)
+        # empty window -> None, not 0 (absence of evidence)
+        clk.advance(11.0)
+        assert store.segment_summary()["queue_wait"]["p95_window_s"] \
+            is None
+        assert store.ttft_p95_window_s() is None
+
+    def test_payload_carries_windowed_ttft(self):
+        from deepspeed_tpu.telemetry.tracing.store import (
+            RequestTraceStore,
+            install_trace_store,
+            traces_endpoint_payload,
+        )
+
+        clk = FakeClock()
+        store = RequestTraceStore(segment_window_s=10.0, clock=clk)
+        store.add_span("t-1", "queue_wait", t0=0.0, dur_s=1.0)
+        store.add_span("t-1", "prefill", t0=0.0, dur_s=0.5)
+        install_trace_store(store)
+        try:
+            code, body = traces_endpoint_payload({})
+        finally:
+            install_trace_store(None)
+        assert code == 200
+        assert body["ttft_p95_window_s"] == pytest.approx(1.5)
+        assert body["ttft_window_s"] == 10.0
+
+    def test_controller_prefers_windowed_p95(self):
+        from deepspeed_tpu.serving.fleet.controller import view_from_scrape
+
+        healthz = {"state": "ok", "routable": 1, "replicas": [
+            {"queue_depth": 0, "pending": 0,
+             "predicted_tok_per_s": 10.0}]}
+        segments = {
+            "queue_wait": {"p95_s": 5.0, "p95_window_s": 0.1},
+            "prefill": {"p95_s": 5.0, "p95_window_s": 0.2},
+        }
+        view = view_from_scrape(healthz, segments)
+        assert view.ttft_windowed
+        assert view.ttft_p95_s == pytest.approx(0.3)
+        # old stores without the windowed field fall back, unwindowed
+        legacy = {k: {"p95_s": v["p95_s"]} for k, v in segments.items()}
+        view = view_from_scrape(healthz, legacy)
+        assert not view.ttft_windowed
+        assert view.ttft_p95_s == pytest.approx(10.0)

@@ -1,0 +1,209 @@
+#!/usr/bin/env python
+"""Gate the goodput ledger + trace-replay loop end to end, real processes.
+
+The autotuning loop this PR feeds (record traffic once, replay it against
+candidate configs, score from the ledger) only works if the whole chain
+holds together: a real ``bin/dstpu-serve`` records request traces with
+per-chunk token attrs → ``telemetry/tracing/workload.py`` reconstructs the
+request mix from ``traces.jsonl`` → ``bin/dstpu-replay`` fires it at a
+FRESH server honoring the arrival offsets → the verdict carries the
+target's ledger-scored ``goodput_fraction``.  Any link rotting (a span
+attr renamed, the ledger not installed in serve main, the converter
+misreading rotation) breaks silently without silicon — so this is
+enforced from ``tests/unit/test_goodput.py`` the same way the serving
+smoke checks are.
+
+Checks:
+  * record: N requests with known prompt/output lengths and tenants
+    against a ``--trace-sample 1`` serve process; clean SIGTERM drain.
+  * convert: ``load_workload`` reproduces the request COUNT, per-request
+    prompt/output token counts, tenants, and a monotonic arrival shape
+    spanning real time.
+  * replay: ``bin/dstpu-replay --time-scale`` against a fresh serve
+    process exits 0, completes every request, and emits a verdict whose
+    goodput section came from the target's conserved ledger.
+
+Usage: ``python tools/check_goodput.py``.  Exit status 1 lists what broke.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+#: the recorded mix: (prompt tokens, max_new_tokens, tenant)
+MIX = [
+    ([3, 5, 7, 11, 13], 6, "interactive"),
+    ([4, 6, 8], 4, "bulk"),
+    ([9, 2, 7, 1, 8, 3, 5], 5, "bulk"),
+    ([12, 15], 3, "interactive"),
+]
+
+
+def _spawn_serve(tel_dir, timeout=120):
+    """One dstpu-serve on a kernel-assigned port, banner-parsed (same
+    pattern as tools/check_serving_smoke.py)."""
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO_ROOT, "bin", "dstpu-serve"),
+         "--port", "0", "--bind", "127.0.0.1", "--max-tokens", "32",
+         "--max-seqs", "4", "--max-ctx", "96", "--block-size", "8",
+         "--window-steps", "4", "--trace-sample", "1",
+         "--drain-deadline", "300", "--telemetry-dir", tel_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    found = threading.Event()
+    state = {"port": None}
+    tail = []
+
+    def _pump():
+        for line in proc.stdout:
+            if not found.is_set() and "dstpu-serve listening on" in line:
+                state["port"] = int(line.rsplit(":", 1)[1])
+                found.set()
+            tail.append(line)
+            del tail[:-50]
+        found.set()
+
+    threading.Thread(target=_pump, daemon=True).start()
+    found.wait(timeout)
+    return proc, state["port"], tail
+
+
+def _post(port, body, timeout=300):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    try:
+        return proc.wait(timeout=330)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return -9
+
+
+def main(argv=None) -> int:
+    failures = []
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+
+    rec_tel = "/tmp/dstpu_goodput_gate_rec"
+    play_tel = "/tmp/dstpu_goodput_gate_play"
+    verdict_path = "/tmp/dstpu_goodput_gate_verdict.json"
+    # traces.jsonl appends across runs — a stale log would break every
+    # count assertion below
+    shutil.rmtree(rec_tel, ignore_errors=True)
+    shutil.rmtree(play_tel, ignore_errors=True)
+
+    # ---- record phase ------------------------------------------------ #
+    produced = []
+    proc, port, tail = _spawn_serve(rec_tel)
+    try:
+        check("record: server came up", port is not None,
+              "".join(tail[-10:]))
+        if port is None:
+            return _finish(failures)
+        for prompt, max_new, tenant in MIX:
+            resp = _post(port, {"prompt": prompt,
+                                "max_new_tokens": max_new,
+                                "tenant": tenant})
+            check(f"record: request ({tenant}, {len(prompt)}t) finished",
+                  resp.get("state") == "finished", str(resp)[:200])
+            produced.append(len(resp.get("tokens") or []))
+            time.sleep(0.25)         # real arrival spacing to reproduce
+    finally:
+        rc = _stop(proc)
+    check("record: serve drained clean", rc == 0, f"rc={rc}")
+
+    # ---- convert phase ----------------------------------------------- #
+    from deepspeed_tpu.telemetry.tracing.workload import load_workload
+
+    traces = os.path.join(rec_tel, "traces.jsonl")
+    check("convert: traces.jsonl written", os.path.exists(traces), traces)
+    wl = load_workload(traces)
+    check("convert: request count matches", wl.n_requests == len(MIX),
+          f"{wl.n_requests} != {len(MIX)}")
+    got = sorted((r.prompt_tokens, r.max_new_tokens, r.tenant)
+                 for r in wl.requests)
+    want = sorted((len(p), n, t)
+                  for (p, _m, t), n in zip(MIX, produced))
+    check("convert: prompt/output/tenant mix matches", got == want,
+          f"got={got} want={want}")
+    arrivals = [r.arrival_s for r in wl.requests]
+    check("convert: arrival shape monotonic and spans real time",
+          arrivals == sorted(arrivals) and arrivals[0] == 0.0
+          and arrivals[-1] > 0.2 if arrivals else False,
+          f"arrivals={arrivals}")
+
+    # ---- replay phase ------------------------------------------------ #
+    proc, port, tail = _spawn_serve(play_tel)
+    try:
+        check("replay: fresh server came up", port is not None,
+              "".join(tail[-10:]))
+        if port is not None:
+            cli = subprocess.run(
+                [sys.executable,
+                 os.path.join(REPO_ROOT, "bin", "dstpu-replay"), traces,
+                 "--url", f"http://127.0.0.1:{port}",
+                 "--time-scale", "4", "--timeout-s", "300",
+                 "--json", verdict_path],
+                capture_output=True, text=True, timeout=600)
+            check("replay: dstpu-replay exit 0", cli.returncode == 0,
+                  f"rc={cli.returncode} out={cli.stdout[-300:]} "
+                  f"err={cli.stderr[-200:]}")
+            verdict = {}
+            if os.path.exists(verdict_path):
+                with open(verdict_path) as f:
+                    verdict = json.load(f)
+            check("replay: every request completed",
+                  verdict.get("n_requests") == len(MIX)
+                  and verdict.get("completed") == len(MIX),
+                  f"n={verdict.get('n_requests')} "
+                  f"completed={verdict.get('completed')} "
+                  f"errors={verdict.get('errors')}")
+            gp = verdict.get("goodput") or {}
+            check("replay: verdict scored from the target's ledger",
+                  verdict.get("score") is not None
+                  and gp.get("conserved") is True
+                  and (gp.get("categories") or {}).get("compute", 0) > 0,
+                  f"score={verdict.get('score')} goodput={str(gp)[:200]}")
+            check("replay: arrival fidelity measured",
+                  (verdict.get("arrival") or {}).get("max_lag_s")
+                  is not None, str(verdict.get("arrival")))
+    finally:
+        rc = _stop(proc)
+    check("replay: target drained clean", rc == 0, f"rc={rc}")
+    return _finish(failures)
+
+
+def _finish(failures) -> int:
+    if failures:
+        print("\n".join(failures))
+        print(f"\n{len(failures)} goodput gate check(s) failed "
+              f"(tools/check_goodput.py)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
