@@ -406,6 +406,25 @@ def _stepwise_decode_probe(eng, uids, seed_tokens, steps) -> float:
     return n * steps / (time.perf_counter() - t0)
 
 
+def _kv_point_stats(engines) -> dict:
+    """KV heat columns for a sweep grid point, summed over the point's
+    engine(s): peak live pages, cold fraction at the tightest configured
+    age threshold, and the physical bytes radix prefix sharing saved.
+    Engines built with ``track_page_heat=False`` contribute zeros."""
+    peak = live = cold = saved = 0
+    for eng in engines:
+        snap = eng.memory_snapshot() or {}
+        peak += int(snap.get("peak_live_pages") or 0)
+        live += int(snap.get("live_pages") or 0)
+        saved += int(snap.get("prefix_shared_bytes_saved") or 0)
+        cp = snap.get("cold_pages") or {}
+        if cp:
+            cold += int(cp[min(cp, key=int)])
+    return {"kv_peak_pages": peak,
+            "kv_cold_frac": round(cold / live, 3) if live else 0.0,
+            "prefix_shared_bytes_saved": saved}
+
+
 def run_serving_bench(on_tpu: bool) -> None:
     """Paged vs gather serving attention throughput (VERDICT item 2's
     micro-bench): prefill + decode tokens/s at DSTPU_BENCH_CTX context.
@@ -759,6 +778,7 @@ def run_decode_sweep(on_tpu: bool) -> None:
                     fused_t = time.perf_counter() - t0
                     stepwise = _stepwise_decode_probe(eng, uids, toks[-1],
                                                       probe_steps)
+                    point.update(_kv_point_stats([eng]))
                     eng.flush(uids)
                     point[impl] = {
                         "fused_tok_s":
@@ -1649,7 +1669,7 @@ def run_fleet_sweep(on_tpu: bool) -> None:
             max_tokens=64, max_seqs=8, max_ctx=96, block_size=8,
             dtype=jnp.float32, attn_impl="gather"))
         sched = LifecycleScheduler(eng, window_steps=4, max_queue=64)
-        return ServingServer(sched, port=0, bind="127.0.0.1").start()
+        return ServingServer(sched, port=0, bind="127.0.0.1").start(), eng
 
     def post(port, body, timeout=600):
         req = urllib.request.Request(
@@ -1664,7 +1684,9 @@ def run_fleet_sweep(on_tpu: bool) -> None:
     points = []
     for n_rep in (1, 2, 3):
         install_trace_store(RequestTraceStore(sample_every=1))
-        replicas = [mk_replica() for _ in range(n_rep)]
+        made = [mk_replica() for _ in range(n_rep)]
+        replicas = [srv for srv, _ in made]
+        rep_engines = [eng for _, eng in made]
         router = FleetRouter(poll_s=0.2)
         for i, r in enumerate(replicas):
             router.add_replica(f"127.0.0.1:{r.port}", name=f"r{i}")
@@ -1720,7 +1742,8 @@ def run_fleet_sweep(on_tpu: bool) -> None:
             point = {"replicas": n_rep, "requests": n_requests,
                      "finished": ok, "tok_per_s": round(toks / wall, 2),
                      "wall_s": round(wall, 3),
-                     "ttft_decomp_p50_ms": decomp}
+                     "ttft_decomp_p50_ms": decomp,
+                     **_kv_point_stats(rep_engines)}
             points.append(point)
             log(f"fleet_sweep {n_rep} replica(s): {point['tok_per_s']} "
                 f"tok/s ({ok}/{n_requests} finished) decomp={decomp}")
@@ -1769,7 +1792,7 @@ def run_fleet_sweep(on_tpu: bool) -> None:
                 self.srvs, self.stopped = {}, set()
 
             def spawn(self, name):
-                srv = mk_replica()
+                srv, _ = mk_replica()
                 self.srvs[name] = srv
                 return f"127.0.0.1:{srv.port}"
 
@@ -1798,7 +1821,7 @@ def run_fleet_sweep(on_tpu: bool) -> None:
 
         qos = QoSAdmission(classes=[
             TenantClass("bulk", priority=-1, rate=60.0, burst=120.0)])
-        seed = mk_replica()
+        seed, _ = mk_replica()
         router = FleetRouter(poll_s=0.2, qos=qos)
         router.add_replica(f"127.0.0.1:{seed.port}", name="seed")
         rs = RouterServer(router, port=0, bind="127.0.0.1").start()
@@ -1954,6 +1977,33 @@ def run_fleet_sweep(on_tpu: bool) -> None:
         f"goodput_fraction="
         f"{g_snap['goodput_fraction'] if g_snap else None}")
 
+    # ---- memory plane: same decode, page-heat tracking on vs off ------ #
+    # interleaved-median A-B between two otherwise-identical engines; the
+    # heat tracker is pure host-side bookkeeping so the bound is <1%.
+    # eng_oh already tracks heat (the config default) — it is the ON arm.
+    eng_mem_off = InferenceEngineV2(model, params,
+                                    RaggedInferenceEngineConfig(
+                                        max_tokens=64, max_seqs=8,
+                                        max_ctx=256, block_size=8,
+                                        dtype=jnp.float32,
+                                        attn_impl="gather",
+                                        track_page_heat=False))
+    sched_run(eng_mem_off, None)                    # warm the buckets
+    m_offs, m_ons = [], []
+    for rnd in range(3):
+        pair = [(m_offs, eng_mem_off), (m_ons, eng_oh)]
+        for sink, eng_ in (pair if rnd % 2 == 0 else pair[::-1]):
+            sink.append(sched_run(eng_, None))
+    m_off = sorted(m_offs)[len(m_offs) // 2]
+    m_on = sorted(m_ons)[len(m_ons) // 2]
+    mem_overhead_pct = round((m_off - m_on) / m_off * 100.0, 2) \
+        if m_off > 0 else None
+    m_snap = eng_oh.memory_snapshot() or {}
+    log(f"fleet_sweep memory plane overhead: off={m_off:.1f} "
+        f"on={m_on:.1f} tok/s ({mem_overhead_pct}%) "
+        f"peak_pages={m_snap.get('peak_live_pages')} "
+        f"touches={m_snap.get('touches_total')}")
+
     # headline = the MEAN over the sweep points — a regression at ANY
     # replica count must move it (max() would hide a regression at a
     # non-best point); scaling efficiency stays last-vs-first
@@ -1975,6 +2025,15 @@ def run_fleet_sweep(on_tpu: bool) -> None:
             "goodput_fraction": (g_snap or {}).get("goodput_fraction"),
             "categories": (g_snap or {}).get("categories"),
             "conserved": (g_snap or {}).get("conserved"),
+        },
+        "memory": {
+            "overhead_pct": mem_overhead_pct,
+            "decode_tok_per_s": {"off": round(m_off, 2),
+                                 "on": round(m_on, 2)},
+            "kv_peak_pages": m_snap.get("peak_live_pages"),
+            "kv_touches": m_snap.get("touches_total"),
+            "prefix_shared_bytes_saved":
+                m_snap.get("prefix_shared_bytes_saved"),
         },
         "autoscale": autoscale,
         "requests": n_requests, "max_new_tokens": max_new,

@@ -82,6 +82,15 @@ class RaggedInferenceEngineConfig:
     #: a grafted partial page is copied before the sequence's first append
     #: (copy-on-write), and cold cache pages evict on allocation pressure.
     prefix_cache: bool = False
+    #: host-side KV page-heat tracking (ragged/page_heat.py): per-page
+    #: last-touch window + touch count maintained from the block tables the
+    #: engine already walks — zero device work, no retraces (the
+    #: trace_counts probes are test-asserted unchanged).  Feeds the
+    #: ``mem/*`` cold-set gauges and the dstpu-mem what-if-spill reports.
+    track_page_heat: bool = True
+    #: cold-set age thresholds (windows since last touch) published as
+    #: ``mem/kv_cold_pages{age_windows=K}`` gauges
+    heat_cold_thresholds: Tuple[int, ...] = (4, 16, 64)
 
 
 class InferenceEngineV2:
@@ -110,6 +119,20 @@ class InferenceEngineV2:
             num_layers=self.cfg.num_layers, num_blocks=num_blocks,
             block_size=c.block_size, num_kv_heads=self.cfg.num_kv_heads,
             head_dim=self.cfg.head_dim, dtype=c.dtype))
+        #: page-heat tracker (None = tracking off): observes the allocator
+        #: so its live set mirrors the free list, ticked per forward below
+        self.heat = None
+        #: uid → tenant label for fractional per-tenant KV attribution
+        #: (threaded from the lifecycle scheduler via ``set_tenant``)
+        self._uid_tenants: Dict[int, str] = {}
+        if c.track_page_heat:
+            from .ragged.page_heat import PageHeatTracker
+
+            self.heat = PageHeatTracker(
+                self.state_manager.allocator, block_size=c.block_size,
+                page_bytes=self.kv.mem_bytes() // num_blocks,
+                cold_age_thresholds=c.heat_cold_thresholds)
+            self.state_manager.allocator.heat = self.heat
         # Cast to serving dtype, EXCEPT router kernels: routing must run in
         # f32 so serving picks the same experts as the training forward — a
         # bf16 round-trip flips top-k selection on near-tie tokens.
@@ -361,12 +384,67 @@ class InferenceEngineV2:
         self.kv.update(new_pages)
         for uid in batch.uids:
             self.state_manager.get_sequence(uid).post_forward()
+        self._touch_heat(batch.uids)
         return logits[:batch.n_seqs]
 
     def flush(self, uids: Sequence[int]) -> None:
         self._decode_state = None
         for uid in uids:
             self.state_manager.flush_sequence(uid)
+            self._uid_tenants.pop(uid, None)
+
+    # ------------------------------------------------------------------ #
+    # Memory observability (telemetry/memory.py MemoryLedger plumbing)
+    # ------------------------------------------------------------------ #
+    def set_tenant(self, uid: int, tenant: Optional[str]) -> None:
+        """Label ``uid``'s KV footprint with its tenant (lifecycle
+        admission threads this through); cleared on flush."""
+        if tenant:
+            self._uid_tenants[int(uid)] = str(tenant)
+
+    def _touch_heat(self, uids: Sequence[int]) -> None:
+        """One heat-clock tick + whole-table touch for every sequence a
+        dispatched forward covers (a decode/verify window reads ALL of a
+        sequence's context pages; prefill writes its fresh ones)."""
+        if self.heat is None:
+            return
+        self.heat.tick()
+        blocks: List[int] = []
+        for uid in uids:
+            seq = self.state_manager.get_sequence(uid)
+            if seq is not None:
+                blocks.extend(seq.blocks)
+        self.heat.touch(blocks)
+
+    def memory_snapshot(self):
+        """Heat-tracker snapshot with live holder/tenant attribution, or
+        None when tracking is off."""
+        if self.heat is None:
+            return None
+        holders = {uid: list(seq.blocks)
+                   for uid, seq in self.state_manager._seqs.items()}
+        return self.heat.snapshot(holders=holders,
+                                  tenants=dict(self._uid_tenants))
+
+    def _workspace_bytes(self) -> int:
+        """Device bytes of decode-resume metadata + the sampling key — the
+        ``decode_workspace`` ledger bucket."""
+        n = int(getattr(self._rng, "nbytes", 0) or 0)
+        st = self._decode_state
+        if st is not None:
+            n += int(getattr(st.get("meta"), "nbytes", 0) or 0)
+        return n
+
+    def register_memory_sources(self, ledger) -> None:
+        """Wire this engine's known state trees into a
+        :class:`~....telemetry.memory.MemoryLedger`: params, the KV page
+        pool (the WHOLE preallocated pool — ``jax.live_arrays`` sees it
+        regardless of allocation; used/free/cold lives in the heat
+        section), decode workspace, and the heat snapshot."""
+        ledger.register_source("params", lambda: self._param_bytes)
+        ledger.register_source("kv_pages", lambda: self.kv.mem_bytes())
+        ledger.register_source("decode_workspace", self._workspace_bytes)
+        ledger.attach_kv(self.memory_snapshot)
 
     def kv_used_fraction(self) -> float:
         """Fraction of the KV block pool currently allocated — the
@@ -400,6 +478,10 @@ class InferenceEngineV2:
                            for layer in range(self.cfg.num_layers)])
         dst = src + (dst_block - src_block)
         self.kv.update(self.kv.pages.at[dst].set(self.kv.pages[src]))
+        if self.heat is not None:
+            # the private copy inherits the shared page's heat — same
+            # rows, same access history
+            self.heat.transfer(src_block, dst_block)
 
     def graft_prefix(self, uid: int, tokens: Sequence[int]) -> int:
         """Admission-side prefix reuse: graft the longest cached prefix of
@@ -584,6 +666,7 @@ class InferenceEngineV2:
             # truncate to the accepted length: seed + a matched drafts are
             # real context; rows past them are dead until overwritten
             self.rollback_kv(uid, ctx_before[row] + 1 + a)
+        self._touch_heat(uids)
         emitted = sum(len(t) for t in accepted)
         self.spec_windows += 1
         self.spec_drafted += drafted
@@ -791,6 +874,7 @@ class InferenceEngineV2:
             seq.in_flight_tokens = steps
             seq.post_forward()
             seen[uid] = seq.seen_tokens
+        self._touch_heat(uids)
         # a NaN-poisoned window must NOT leave resumable device state: the
         # advanced meta was computed over poisoned pages, and a follow-up
         # window resuming it would silently keep decoding garbage even if

@@ -574,6 +574,9 @@ class LifecycleScheduler:
                 if matched:
                     req._prefill_pos = matched
         seq = sm.get_or_create_sequence(req.uid)
+        # tenant label rides the reservation so the memory plane can
+        # attribute this uid's KV pages fractionally per tenant
+        self.eng.set_tenant(req.uid, req.tenant or "default")
         if not sm.maybe_allocate_kv(seq, need - seq.seen_tokens):
             # roll back so a shed/preempted retry starts clean: grafted /
             # imported blocks are released (shared pages survive in the

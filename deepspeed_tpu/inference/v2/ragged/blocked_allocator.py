@@ -30,6 +30,11 @@ class BlockedAllocator:
         self._free = num_blocks
         # holders per block: 0 = on the free list
         self._refs = np.zeros(num_blocks, dtype=np.int64)
+        #: optional page-heat observer (ragged/page_heat.PageHeatTracker):
+        #: notified AFTER every holder transition, so its live-page set
+        #: tracks the free list through every path — state manager,
+        #: prefix-cache trie, CoW grafts, preemption flushes
+        self.heat = None
 
     @property
     def free_blocks(self) -> int:
@@ -45,6 +50,11 @@ class BlockedAllocator:
             raise ValueError(f"block id {block} out of range")
         return int(self._refs[block])
 
+    def refcounts(self) -> np.ndarray:
+        """Copy of the per-block holder counts (0 = free) — the heat
+        tracker's fractional-attribution and shared-page input."""
+        return self._refs.copy()
+
     def allocate(self, num_blocks: int) -> np.ndarray:
         if num_blocks > self._free:
             raise ValueError(
@@ -55,26 +65,31 @@ class BlockedAllocator:
             self._head = self._next[self._head]
         self._free -= num_blocks
         self._refs[out] = 1
+        if self.heat is not None:
+            self.heat.note_alloc(out)
         return out
 
     def ref(self, blocks: Union[Iterable[int], np.ndarray]) -> None:
         """Add one holder to each (already-allocated) block — the prefix
         cache's share path.  Refusing free blocks catches the classic
         use-after-free: sharing a page somebody already released."""
-        for b in np.atleast_1d(np.asarray(blocks, dtype=np.int64)):
+        arr = np.atleast_1d(np.asarray(blocks, dtype=np.int64))
+        for b in arr:
             b = int(b)
             if not 0 <= b < self._num_blocks:
                 raise ValueError(f"block id {b} out of range")
             if self._refs[b] <= 0:
                 raise ValueError(f"ref of free block {b}")
             self._refs[b] += 1
+        if self.heat is not None and arr.size:
+            self.heat.note_ref(arr)
 
     def free(self, blocks: Union[Iterable[int], np.ndarray]) -> None:
         """Drop one holder per block; a block returns to the free list only
         when its last holder releases it."""
         blocks = np.atleast_1d(np.asarray(blocks, dtype=np.int64))
         seen = set()
-        released = 0
+        released: List[int] = []
         for b in blocks:
             b = int(b)
             if not 0 <= b < self._num_blocks:
@@ -88,5 +103,7 @@ class BlockedAllocator:
             if self._refs[b] == 0:
                 self._next[b] = self._head
                 self._head = b
-                released += 1
-        self._free += released
+                released.append(b)
+        self._free += len(released)
+        if self.heat is not None and released:
+            self.heat.note_release(released)

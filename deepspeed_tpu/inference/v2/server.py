@@ -45,6 +45,11 @@ from ...telemetry.goodput import (
     install_goodput_ledger,
     record_goodput,
 )
+from ...telemetry.memory import (
+    MemoryLedger,
+    get_memory_ledger,
+    install_memory_ledger,
+)
 from ...telemetry.tracing import (
     TraceContext,
     get_trace_store,
@@ -122,10 +127,17 @@ class _ServingHandler(BaseHTTPRequestHandler):
                                                    "not installed"})
                 else:
                     self._send_json(200, ledger.snapshot())
+            elif url.path == "/memory":
+                ledger = get_memory_ledger()
+                if ledger is None:
+                    self._send_json(404, {"error": "memory ledger "
+                                                   "not installed"})
+                else:
+                    self._send_json(200, ledger.snapshot())
             elif url.path == "/":
                 self._send_json(200, {"endpoints": [
                     "/v1/generate (POST)", "/metrics", "/healthz",
-                    "/traces", "/goodput"]})
+                    "/traces", "/goodput", "/memory"]})
             else:
                 self._send_json(404, {"error": f"unknown path {url.path}"})
         except (BrokenPipeError, ConnectionResetError):
@@ -209,6 +221,11 @@ class _ServingHandler(BaseHTTPRequestHandler):
             # the per-process wall-time books: the fleet router rolls
             # these up across replicas into its own /healthz
             body["goodput"] = ledger.snapshot()
+        mem = get_memory_ledger()
+        if mem is not None:
+            # the per-process byte books ride the same scrape so the
+            # router's fleet memory rollup costs zero extra requests
+            body["memory"] = mem.snapshot()
         self._send_json(code, body)
 
     # ---------------------------------------------------------------- #
@@ -751,6 +768,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     store = install_trace_store_from_cli(args, args.telemetry_dir)
     ledger = GoodputLedger(component=f"serve:{args.port}")
     install_goodput_ledger(ledger)
+    mem_ledger = MemoryLedger(component=f"serve:{args.port}")
+    install_memory_ledger(mem_ledger)
 
     if args.model == "tiny":
         engine = build_tiny_engine(args)
@@ -778,6 +797,13 @@ def main(argv: Optional[List[str]] = None) -> int:
                 args.ckpt, model, engine_config=ecfg)
         else:
             engine = build_hf_engine(args.model, engine_config=ecfg)
+
+    # HBM occupancy books: the engine's state trees become ledger sources,
+    # and everything allocated before this point (runtime constants, the
+    # params themselves are claimed) folds into the baseline so the
+    # conservation invariant judges only what serving does from here on
+    engine.register_memory_sources(mem_ledger)
+    mem_ledger.capture_baseline()
 
     spec = drafter = None
     if args.spec_mode != "off":
@@ -846,9 +872,15 @@ def main(argv: Optional[List[str]] = None) -> int:
     # The kernel may deliver a process-directed SIGTERM to a non-main
     # thread; the Python-level handler only runs once the main thread
     # re-enters the eval loop, so it must never park in an untimed wait.
+    polls = 0
     while not done.wait(0.5):
         ledger.publish()        # keep the goodput/* gauges live
+        # mem/* gauges every poll; a kv_heat trace event (per-page ages —
+        # the what-if-spill estimator's recorded input) every 4th (~2s)
+        mem_ledger.publish(heat_event=polls % 4 == 0)
+        polls += 1
     ledger.publish()
+    mem_ledger.publish(heat_event=True)
     if store is not None:
         store.close()
     tel.close()

@@ -59,6 +59,7 @@ class ReplicaHandle:
         self.predicted_drain_s = 1.0
         self.counters: Dict[str, float] = {}
         self.goodput: Optional[Dict] = None    # replica's ledger snapshot
+        self.memory: Optional[Dict] = None     # replica's memory ledger
         self.last_scrape_t: Optional[float] = None
         self.consecutive_failures = 0
         self.lost = False
@@ -115,6 +116,8 @@ class ReplicaHandle:
             self.counters = dict(body.get("counters", {}))
             gp = body.get("goodput")
             self.goodput = gp if isinstance(gp, dict) else None
+            mem = body.get("memory")
+            self.memory = mem if isinstance(mem, dict) else None
             self.last_scrape_t = time.monotonic()
         if resurrected:
             logger.info(f"replica {self.name} back: {self.status}")
@@ -171,4 +174,5 @@ class ReplicaHandle:
                 "predicted_tok_per_s": self.predicted_tok_per_s,
                 "consecutive_failures": self.consecutive_failures,
                 "goodput": self.goodput,
+                "memory": self.memory,
             }

@@ -51,6 +51,7 @@ from ...runtime.fault.injection import inject
 from ...runtime.fault.retry import RetryPolicy, retryable
 from ...telemetry.goodput import (get_goodput_ledger, record_goodput,
                                   rollup_goodput)
+from ...telemetry.memory import rollup_memory
 from ...telemetry.tracing import (RETURN_SPANS_FIELD, TRACE_HEADER,
                                   flag_trace, merge_trace, record_span,
                                   trace_id_of)
@@ -721,6 +722,11 @@ class FleetRouter:
         roll = rollup_goodput(snaps)
         if roll["processes"]:
             body["goodput"] = roll
+        # fleet memory rollup: replica HBM ledgers summed (the router owns
+        # no engine, so its own process contributes nothing)
+        mem_roll = rollup_memory([r.get("memory") for r in reps])
+        if mem_roll["processes"]:
+            body["memory"] = mem_roll
         return status, body
 
     def _publish_gauges(self) -> None:
@@ -775,6 +781,19 @@ class FleetRouter:
             m.gauge("fleet/goodput_fraction").set(
                 roll["goodput_fraction"])
             m.gauge("fleet/goodput_wall_s").set(roll["wall_s"])
+        mem_roll = rollup_memory([h.memory for h in reps])
+        if mem_roll["processes"]:
+            m.gauge("fleet/mem_live_bytes").set(mem_roll["live_bytes"])
+            m.gauge("fleet/mem_kv_pages_bytes").set(
+                mem_roll["buckets"]["kv_pages"])
+            m.gauge("fleet/mem_unattributed_bytes").set(
+                mem_roll["unattributed_bytes"])
+            kv = mem_roll.get("kv")
+            if kv:
+                m.gauge("fleet/mem_kv_live_pages").set(kv["live_pages"])
+                for thr, n in kv.get("cold_pages", {}).items():
+                    m.gauge("fleet/mem_kv_cold_pages").set(
+                        n, age_windows=str(thr))
 
     def _count(self, name: str, n: float = 1) -> None:
         with self._lock:

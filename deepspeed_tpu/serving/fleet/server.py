@@ -104,10 +104,26 @@ class _RouterHandler(BaseHTTPRequestHandler):
                 self._send_json(200,
                                 {"replicas": self.server.owner
                                  .router.snapshot()})
+            elif url.path == "/memory":
+                # fleet memory rollup: replica HBM ledgers summed — the
+                # same body the router embeds in its /healthz
+                from ...telemetry.memory import rollup_memory
+
+                reps = self.server.owner.router.snapshot()
+                roll = rollup_memory([r.get("memory") for r in reps])
+                if not roll["processes"]:
+                    self._send_json(404, {"error": "no replica has "
+                                                   "reported a memory "
+                                                   "ledger yet"})
+                else:
+                    roll["replicas"] = {
+                        r["name"]: r.get("memory") for r in reps
+                        if isinstance(r.get("memory"), dict)}
+                    self._send_json(200, roll)
             elif url.path == "/":
                 self._send_json(200, {"endpoints": [
                     "/v1/generate (POST)", "/metrics", "/healthz",
-                    "/traces", "/replicas (GET/POST/DELETE)"]})
+                    "/traces", "/replicas (GET/POST/DELETE)", "/memory"]})
             else:
                 self._send_json(404, {"error": f"unknown path {url.path}"})
         except (BrokenPipeError, ConnectionResetError):

@@ -15,16 +15,18 @@ from .goodput import (GOODPUT_CATEGORIES, GoodputLedger, get_goodput_ledger,
                       record_goodput, rollup_goodput)
 from .hub import (Telemetry, emit_event, get_telemetry, set_telemetry, span,
                   telemetry_enabled)
-from .memory import MemorySampler
+from .memory import (MEM_BUCKETS, MemoryLedger, MemorySampler,
+                     get_memory_ledger, install_memory_ledger, rollup_memory)
 from .metrics import Counter, Gauge, Histogram, MetricsRegistry
 from .trace import NULL_SPAN, SpanRecord, Tracer
 
 __all__ = [
     "Counter", "EventLog", "GOODPUT_CATEGORIES", "Gauge", "GoodputLedger",
-    "Histogram", "MemorySampler",
+    "Histogram", "MEM_BUCKETS", "MemoryLedger", "MemorySampler",
     "MetricsRegistry", "NULL_SPAN", "SpanRecord", "Telemetry", "Tracer",
-    "emit_event", "get_goodput_ledger", "get_telemetry", "goodput_residual",
-    "install_goodput_ledger", "read_event_segments", "read_jsonl",
-    "record_goodput", "rollup_goodput", "set_telemetry", "span",
-    "telemetry_enabled",
+    "emit_event", "get_goodput_ledger", "get_memory_ledger", "get_telemetry",
+    "goodput_residual", "install_goodput_ledger", "install_memory_ledger",
+    "read_event_segments", "read_jsonl",
+    "record_goodput", "rollup_goodput", "rollup_memory", "set_telemetry",
+    "span", "telemetry_enabled",
 ]

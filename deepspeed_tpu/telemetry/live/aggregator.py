@@ -36,7 +36,8 @@ _REASON_SAFE = re.compile(r"[^A-Za-z0-9_:. \-]")
 
 #: counters whose totals ride every snapshot (the incident digest)
 INCIDENT_COUNTERS = ("fault/events", "anomaly/events", "straggler/events",
-                     "serving/nan_isolated", "serving/window_hang")
+                     "serving/nan_isolated", "serving/window_hang",
+                     "mem/unattributed")
 
 
 def collect_snapshot(telemetry, host_id: int,

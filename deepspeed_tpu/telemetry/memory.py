@@ -1,25 +1,104 @@
-"""Per-step memory sampler.
+"""Memory observability plane: raw sampling + the HBM occupancy ledger.
 
-What memory "did" during a run is the question HBM-bound training debugging
-always starts with.  Two complementary sources, both polled from the host:
+What memory "did" during a run is the question HBM-bound debugging always
+starts with — and *whose* bytes they were is the question the memory-tiering
+roadmap item (host-offload for optimizer state and cold KV) cannot be
+designed without.  Two layers, one collection path:
+
+**Raw totals** (:func:`collect_raw_totals`, PR-2 :class:`MemorySampler`):
 
   * ``jax.live_arrays()`` — every live jax.Array this process holds a
-    reference to, summed into total bytes + count (catches Python-side leaks:
-    a list someone keeps appending device arrays to);
+    reference to, summed into total bytes + count (catches Python-side
+    leaks: a list someone keeps appending device arrays to);
   * ``device.memory_stats()`` — the runtime allocator's view
-    (``bytes_in_use`` / ``peak_bytes_in_use``) where the backend provides it
-    (TPU does; CPU may return None/{}).
+    (``bytes_in_use`` / ``peak_bytes_in_use``) where the backend provides
+    it (TPU does; CPU may return None/{}).
 
-Samples land in the metrics registry (gauges track the high-water mark
-automatically) and as ``kind: "memory"`` structured events, so the run
-summary can print the peak and when it happened.
+**Occupancy ledger** (:class:`MemoryLedger`): attributes the live bytes to
+a closed, non-overlapping bucket set (:data:`MEM_BUCKETS`) by asking
+registered sources — the serving engine registers its params tree, the
+WHOLE KV page pool (``jax.live_arrays`` sees the preallocated pool
+regardless of allocation; the used/free/cold split lives in the heat
+section), and its decode workspace; training engines register optimizer
+state / gradient accumulators / LoCo residuals.  The conservation contract
+mirrors the PR-17 goodput ledger: bytes the sources do not claim surface
+as ``unattributed_bytes``, and the snapshot is ``conserved`` iff
+``|unattributed| <= eps * live`` (eps = 2%).  Pre-existing process bytes
+(JAX runtime constants, other components' arrays) are folded into
+``other`` once via :meth:`MemoryLedger.capture_baseline`.
+
+A crossing of the conservation bound emits a ``mem_unattributed`` incident
+event (edge-triggered) and bumps the ``mem/unattributed`` counter — both
+registered with the incident machinery (summary ``EVENT_KINDS_INCIDENT``,
+live-aggregator ``INCIDENT_COUNTERS``).
+
+Install pattern and fleet rollup mirror the goodput ledger: process-global
+instance via :func:`install_memory_ledger` / :func:`get_memory_ledger`
+(None IS the disabled fast path), replicas embed :meth:`snapshot` in their
+``/healthz`` body and serve it at ``GET /memory``, and the router's
+:func:`rollup` sums bucket bytes + KV heat across replicas into the fleet
+view ``dstpu-mem`` and the future spill autotuner read.
 """
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+import threading
+from typing import Any, Callable, Dict, Iterable, List, Optional
+
+#: the closed bucket axis — non-overlapping by contract; every registered
+#: source claims bytes in exactly one bucket
+MEM_BUCKETS = ("params", "optimizer_state", "grad_acc", "kv_pages",
+               "decode_workspace", "loco_residuals", "other")
+
+#: conservation bound: unattributed bytes beyond this fraction of live
+#: bytes mean the ledger's sources have drifted from reality
+CONSERVATION_EPS = 0.02
+
+
+def collect_raw_totals() -> Dict[str, Any]:
+    """One poll of both raw sources (live arrays + device allocator
+    stats); keys are absent when a source is unavailable."""
+    import jax
+
+    out: Dict[str, Any] = {}
+    try:
+        live = jax.live_arrays()
+        out["live_array_bytes"] = int(
+            sum(getattr(a, "nbytes", 0) or 0 for a in live))
+        out["live_array_count"] = len(live)
+    except Exception:
+        pass
+
+    per_device = []
+    try:
+        for d in jax.local_devices():
+            stats = None
+            try:
+                stats = d.memory_stats()
+            except Exception:
+                stats = None
+            if not stats:
+                continue
+            per_device.append({
+                "device": str(d.id),
+                "bytes_in_use": int(stats.get("bytes_in_use", 0)),
+                "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
+            })
+    except Exception:
+        pass
+    if per_device:
+        out["device_bytes_in_use"] = sum(
+            d["bytes_in_use"] for d in per_device)
+        out["device_peak_bytes_in_use"] = max(
+            d["peak_bytes_in_use"] for d in per_device)
+    return out
 
 
 class MemorySampler:
+    """Per-step raw-totals sampler (PR-2 API, unchanged): samples land in
+    the metrics registry as ``memory/*`` gauges and as ``kind: "memory"``
+    structured events.  The ledger consumes the SAME collection path
+    (:func:`collect_raw_totals`) — there is no parallel poll."""
+
     def __init__(self, metrics, events=None, interval: int = 1):
         self.metrics = metrics
         self.events = events
@@ -32,40 +111,7 @@ class MemorySampler:
         return self.sample(step=step)
 
     def sample(self, step: Optional[int] = None) -> Dict[str, Any]:
-        import jax
-
-        out: Dict[str, Any] = {}
-        try:
-            live = jax.live_arrays()
-            out["live_array_bytes"] = int(
-                sum(getattr(a, "nbytes", 0) or 0 for a in live))
-            out["live_array_count"] = len(live)
-        except Exception:
-            pass
-
-        per_device = []
-        try:
-            for d in jax.local_devices():
-                stats = None
-                try:
-                    stats = d.memory_stats()
-                except Exception:
-                    stats = None
-                if not stats:
-                    continue
-                per_device.append({
-                    "device": str(d.id),
-                    "bytes_in_use": int(stats.get("bytes_in_use", 0)),
-                    "peak_bytes_in_use": int(stats.get("peak_bytes_in_use", 0)),
-                })
-        except Exception:
-            pass
-        if per_device:
-            out["device_bytes_in_use"] = sum(
-                d["bytes_in_use"] for d in per_device)
-            out["device_peak_bytes_in_use"] = max(
-                d["peak_bytes_in_use"] for d in per_device)
-
+        out = collect_raw_totals()
         if self.metrics is not None:
             if "live_array_bytes" in out:
                 self.metrics.gauge("memory/live_array_bytes").set(
@@ -85,3 +131,244 @@ class MemorySampler:
         if step is not None:
             out["step"] = int(step)
         return out
+
+
+class MemoryLedger:
+    """Bucketed attribution of live device bytes with a conservation
+    invariant.  Sources are zero-arg callables returning current bytes for
+    ONE bucket; they are polled at :meth:`snapshot` time (cheap: the
+    engine's are O(1) attribute reads)."""
+
+    def __init__(self, component: str = "proc",
+                 eps: float = CONSERVATION_EPS):
+        self.component = component
+        self.eps = float(eps)
+        self._lock = threading.Lock()
+        self._sources: Dict[str, List[Callable[[], int]]] = \
+            {b: [] for b in MEM_BUCKETS}
+        self._kv_fn: Optional[Callable[[], Optional[Dict]]] = None
+        self._baseline_other = 0
+        self._was_conserved = True
+        self.unattributed_incidents = 0
+
+    # ------------------------------------------------------------------ #
+    # Wiring
+    # ------------------------------------------------------------------ #
+    def register_source(self, bucket: str, fn: Callable[[], int]) -> None:
+        """Register a byte source for ``bucket``.  Raises on an unknown
+        bucket — a typo'd source must fail loudly, not open an eighth
+        bucket the conservation tests don't know about."""
+        if bucket not in self._sources:
+            raise ValueError(f"unknown memory bucket {bucket!r} "
+                             f"(must be one of {MEM_BUCKETS})")
+        with self._lock:
+            self._sources[bucket].append(fn)
+
+    def attach_kv(self, fn: Callable[[], Optional[Dict]]) -> None:
+        """Attach the engine's heat-snapshot provider (``kv`` section of
+        every snapshot; None while tracking is off)."""
+        self._kv_fn = fn
+
+    def capture_baseline(self) -> int:
+        """Fold bytes that pre-date this ledger's sources (JAX runtime
+        constants, other components' arrays) into ``other`` once, so
+        conservation judges only what changes afterwards."""
+        raw = collect_raw_totals()
+        live = int(raw.get("live_array_bytes", 0) or 0)
+        self._baseline_other = max(0, live - self._attributed_bytes())
+        return self._baseline_other
+
+    def _attributed_bytes(self) -> int:
+        total = 0
+        with self._lock:
+            sources = {b: list(fns) for b, fns in self._sources.items()}
+        for fns in sources.values():
+            for fn in fns:
+                try:
+                    total += int(fn() or 0)
+                except Exception:
+                    continue
+        return total
+
+    # ------------------------------------------------------------------ #
+    # Reading
+    # ------------------------------------------------------------------ #
+    def snapshot(self) -> Dict[str, Any]:
+        raw = collect_raw_totals()
+        with self._lock:
+            sources = {b: list(fns) for b, fns in self._sources.items()}
+            baseline = self._baseline_other
+        buckets: Dict[str, int] = {}
+        for b, fns in sources.items():
+            total = 0
+            for fn in fns:
+                try:
+                    total += int(fn() or 0)
+                except Exception:
+                    continue
+            buckets[b] = total
+        buckets["other"] += baseline
+        live = int(raw.get("live_array_bytes", 0) or 0)
+        attributed = sum(buckets.values())
+        unattributed = live - attributed
+        denom = max(live, 1)
+        snap: Dict[str, Any] = {
+            "component": self.component,
+            "live_bytes": live,
+            "live_array_count": int(raw.get("live_array_count", 0) or 0),
+            "device_bytes_in_use": int(
+                raw.get("device_bytes_in_use", 0) or 0),
+            "device_peak_bytes_in_use": int(
+                raw.get("device_peak_bytes_in_use", 0) or 0),
+            "buckets": buckets,
+            "fractions": {b: round(v / denom, 6)
+                          for b, v in buckets.items()},
+            "unattributed_bytes": unattributed,
+            "unattributed_frac": round(unattributed / denom, 6),
+            "conserved": abs(unattributed) <= self.eps * denom,
+        }
+        if self._kv_fn is not None:
+            try:
+                kv = self._kv_fn()
+            except Exception:
+                kv = None
+            if kv:
+                snap["kv"] = kv
+        return snap
+
+    # ------------------------------------------------------------------ #
+    # Registry surface
+    # ------------------------------------------------------------------ #
+    def publish(self, heat_event: bool = False) -> Dict[str, Any]:
+        """Mirror a fresh snapshot into ``mem/*`` gauges; emit the
+        edge-triggered ``mem_unattributed`` incident on a conservation
+        break; optionally emit a ``kv_heat`` trace event (the recorded
+        input to the dstpu-mem what-if-spill estimator — callers pick the
+        cadence, it carries per-page ages)."""
+        from .hub import get_telemetry
+
+        snap = self.snapshot()
+        tel = get_telemetry()
+        if tel is not None:
+            m = tel.metrics
+            m.gauge("mem/live_bytes").set(snap["live_bytes"])
+            for b, v in snap["buckets"].items():
+                m.gauge(f"mem/{b}_bytes").set(v)
+            m.gauge("mem/unattributed_bytes").set(snap["unattributed_bytes"])
+            m.gauge("mem/unattributed_frac").set(snap["unattributed_frac"])
+            m.gauge("mem/conserved").set(1 if snap["conserved"] else 0)
+            kv = snap.get("kv")
+            if kv:
+                m.gauge("mem/kv_live_pages").set(kv["live_pages"])
+                m.gauge("mem/kv_peak_pages").set(kv["peak_live_pages"])
+                m.gauge("mem/kv_used_bytes").set(kv["used_bytes"])
+                m.gauge("mem/prefix_shared_bytes_saved").set(
+                    kv["prefix_shared_bytes_saved"])
+                for thr, n in kv.get("cold_pages", {}).items():
+                    m.gauge("mem/kv_cold_pages").set(n, age_windows=str(thr))
+                for t, d in kv.get("tenants", {}).items():
+                    m.gauge("mem/tenant_kv_bytes").set(d["bytes"], tenant=t)
+        if not snap["conserved"] and self._was_conserved:
+            self.unattributed_incidents += 1
+            if tel is not None:
+                tel.metrics.counter("mem/unattributed").inc()
+                tel.event("mem_unattributed",
+                          component=self.component,
+                          live_bytes=snap["live_bytes"],
+                          unattributed_bytes=snap["unattributed_bytes"],
+                          unattributed_frac=snap["unattributed_frac"],
+                          buckets=snap["buckets"])
+        self._was_conserved = snap["conserved"]
+        if heat_event and tel is not None and snap.get("kv"):
+            tel.event("kv_heat", component=self.component, **snap["kv"])
+        return snap
+
+
+def rollup(snapshots: Iterable[Optional[Dict[str, Any]]],
+           component: str = "fleet") -> Dict[str, Any]:
+    """Sum per-process ledger snapshots (scraped replica ``/memory`` or
+    ``/healthz`` bodies) into one fleet-level view.  Tolerant of None /
+    malformed entries — a half-scraped replica must degrade the rollup,
+    never kill the endpoint."""
+    live = 0
+    unattr = 0
+    n = 0
+    bad = 0
+    buckets: Dict[str, int] = {b: 0 for b in MEM_BUCKETS}
+    kv_live = kv_peak = kv_used = kv_saved = 0
+    kv_cold: Dict[str, int] = {}
+    tenants: Dict[str, int] = {}
+    kv_seen = False
+    for s in snapshots:
+        if not isinstance(s, dict) or "live_bytes" not in s:
+            continue                  # not a ledger snapshot at all
+        n += 1
+        try:
+            live += int(s.get("live_bytes") or 0)
+            unattr += int(s.get("unattributed_bytes") or 0)
+            if s.get("conserved") is False:
+                bad += 1
+            for b in MEM_BUCKETS:
+                buckets[b] += int((s.get("buckets") or {}).get(b) or 0)
+            kv = s.get("kv")
+            if isinstance(kv, dict):
+                kv_seen = True
+                kv_live += int(kv.get("live_pages") or 0)
+                kv_peak += int(kv.get("peak_live_pages") or 0)
+                kv_used += int(kv.get("used_bytes") or 0)
+                kv_saved += int(kv.get("prefix_shared_bytes_saved") or 0)
+                for thr, c in (kv.get("cold_pages") or {}).items():
+                    kv_cold[str(thr)] = kv_cold.get(str(thr), 0) + int(c)
+                for t, d in (kv.get("tenants") or {}).items():
+                    tenants[str(t)] = tenants.get(str(t), 0) + \
+                        int((d or {}).get("bytes") or 0)
+        except (TypeError, ValueError, AttributeError):
+            continue
+    denom = max(live, 1)
+    out: Dict[str, Any] = {
+        "component": component,
+        "processes": n,
+        "live_bytes": live,
+        "buckets": buckets,
+        "fractions": {b: round(v / denom, 6) for b, v in buckets.items()},
+        "unattributed_bytes": unattr,
+        "unattributed_frac": round(unattr / denom, 6),
+        "nonconserved_processes": bad,
+        "conserved": bad == 0 and abs(unattr) <= CONSERVATION_EPS * denom,
+    }
+    if kv_seen:
+        out["kv"] = {
+            "live_pages": kv_live,
+            "peak_live_pages": kv_peak,
+            "used_bytes": kv_used,
+            "prefix_shared_bytes_saved": kv_saved,
+            "cold_pages": dict(sorted(kv_cold.items(),
+                                      key=lambda kv_: int(kv_[0]))),
+            "tenants": {t: {"bytes": v}
+                        for t, v in sorted(tenants.items())},
+        }
+    return out
+
+
+# --------------------------------------------------------------------- #
+# Process-global instance (goodput-ledger install pattern)
+# --------------------------------------------------------------------- #
+_GLOBAL: Optional[MemoryLedger] = None
+_GLOBAL_LOCK = threading.Lock()
+
+
+def install_memory_ledger(ledger: Optional[MemoryLedger]
+                          ) -> Optional[MemoryLedger]:
+    """Install (or clear, with None) the process-global memory ledger."""
+    global _GLOBAL
+    with _GLOBAL_LOCK:
+        previous, _GLOBAL = _GLOBAL, ledger
+    return previous
+
+
+def get_memory_ledger() -> Optional[MemoryLedger]:
+    return _GLOBAL
+
+
+#: package-level re-export names (``rollup`` is too generic un-prefixed)
+rollup_memory = rollup

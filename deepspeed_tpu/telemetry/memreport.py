@@ -1,0 +1,333 @@
+"""``dstpu-mem`` — render the memory observability plane for humans.
+
+Three reports, all host-side, all from data the serve tier already
+records (no new device work):
+
+  * **occupancy ledger table** — the ``MemoryLedger`` bucket breakdown
+    (params / kv_pages / decode_workspace / ...) with the conservation
+    verdict, scraped live from a running ``dstpu-serve`` or
+    ``dstpu-router`` ``/memory`` endpoint (``--url``);
+  * **KV page-heat report** — a text heatmap of the block pool (one
+    glyph per page, banded by age-since-last-touch), the age histogram,
+    the cold-set sizes at each configured threshold and the per-tenant
+    footprint table (fractional bytes for radix-shared pages);
+  * **what-if-spill table** — from a *recorded* heat trace (the
+    ``kv_heat`` events the serve loop emits into ``events.jsonl``), an
+    offline estimate of what a host-offload tier would buy: for each
+    candidate (age threshold, host budget) pair, the peak spillable cold
+    set, the estimated host hit rate, and the decode tokens whose
+    recompute the tier would avoid.  This is the staging report for the
+    ROADMAP memory-tiering item: it names the cold set *before* anyone
+    builds the spiller.
+
+The estimator is deliberately simple and conservative:
+
+  * a page is *spillable at threshold A* when its age-since-touch is
+    >= A windows; the peak of that count across the trace sizes the
+    host tier (``peak_cold_pages`` / ``peak_cold_mb``);
+  * every *retouch* of a page that had been cold past A (the tracker's
+    cumulative ``retouch_ages`` histogram) is a would-be host hit — had
+    the page been spilled, the host copy would have served it instead
+    of a recompute of ``block_size`` tokens;
+  * the host tier holds ``host_mb`` worth of pages; when the peak cold
+    set exceeds it we scale the hit rate down linearly
+    (``min(1, host_pages / peak_cold_pages)``) — no cleverness about
+    which pages to keep.
+
+Usage::
+
+    dstpu-mem TELEMETRY_DIR [--thresholds 4,16,64] [--host-mb 1,4,16]
+    dstpu-mem --url http://HOST:PORT [--json OUT]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import urllib.request
+from typing import Any, Dict, List, Optional, Sequence
+
+MB = 1024.0 * 1024.0
+
+#: heatmap glyph bands: (min age, glyph).  ``.`` is a free page.
+_HEAT_BANDS = ((64, " "), (16, "-"), (4, "="), (1, "+"), (0, "#"))
+_HEAT_LEGEND = "#=age0  +=1-3  ==4-15  -=16-63  (blank)=64+  .=free"
+_HEAT_COLS = 64
+
+
+def _fmt_bytes(n: float) -> str:
+    n = float(n or 0)
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f}{unit}" if unit != "B" else f"{int(n)}B"
+        n /= 1024.0
+    return f"{n:.1f}GiB"
+
+
+# --------------------------------------------------------------------- #
+# Data sources
+# --------------------------------------------------------------------- #
+def fetch_snapshot(url: str, timeout_s: float = 10.0) -> Dict[str, Any]:
+    """GET ``/memory`` from a live dstpu-serve or dstpu-router."""
+    url = url.rstrip("/")
+    if "://" not in url:
+        url = "http://" + url
+    with urllib.request.urlopen(f"{url}/memory", timeout=timeout_s) as r:
+        return json.loads(r.read())
+
+
+def read_heat_trace(telemetry_dir: str) -> List[Dict[str, Any]]:
+    """All ``kv_heat`` events from a recorded telemetry dir (rotation
+    aware)."""
+    import os
+
+    from .events import read_event_segments
+    from .hub import EVENTS_FILE
+
+    path = os.path.join(telemetry_dir, EVENTS_FILE)
+    return [e for e in read_event_segments(path)
+            if e.get("kind") == "kv_heat"]
+
+
+# --------------------------------------------------------------------- #
+# Renderers (each returns a list of lines)
+# --------------------------------------------------------------------- #
+def render_ledger(snap: Dict[str, Any]) -> List[str]:
+    """The occupancy-ledger bucket table from a ``/memory`` snapshot
+    (single replica) or a fleet rollup."""
+    buckets = snap.get("buckets") or {}
+    if not buckets:
+        return []
+    live = float(snap.get("live_bytes") or 0.0)
+    lines = ["--- HBM occupancy ledger ---"]
+    who = snap.get("component") or ""
+    procs = snap.get("processes")
+    head = f"live {_fmt_bytes(live)}"
+    if who:
+        head = f"{who}: " + head
+    if procs:
+        head += f" across {procs} process(es)"
+    una = float(snap.get("unattributed_bytes") or 0.0)
+    conserved = snap.get("conserved")
+    if conserved is None and "nonconserved_processes" in snap:
+        conserved = not snap.get("nonconserved_processes")
+    head += f" · unattributed {_fmt_bytes(abs(una))}"
+    if conserved is not None:
+        head += " (conserved)" if conserved else " (NOT CONSERVED)"
+    lines.append(head)
+    lines.append(f"{'bucket':<20}{'bytes':>12}{'% live':>9}")
+    for b in sorted(buckets, key=lambda b: buckets[b] or 0, reverse=True):
+        v = float(buckets[b] or 0.0)
+        pct = f"{100 * v / live:.1f}%" if live > 0 else "-"
+        lines.append(f"{b:<20}{_fmt_bytes(v):>12}{pct:>9}")
+    return lines
+
+
+def render_heat(kv: Dict[str, Any]) -> List[str]:
+    """Heatmap + histogram + tenant table from one kv snapshot (either a
+    live ``/memory`` body's ``kv`` section or one ``kv_heat`` event)."""
+    if not kv:
+        return []
+    lines = ["--- KV page heat ---"]
+    total = int(kv.get("total_pages") or 0)
+    lines.append(
+        f"window {int(kv.get('window') or 0)} · live "
+        f"{int(kv.get('live_pages') or 0)}/{total} pages "
+        f"(peak {int(kv.get('peak_live_pages') or 0)}) · used "
+        f"{_fmt_bytes(kv.get('used_bytes') or 0)} · "
+        f"{int(kv.get('touches_total') or 0)} touches")
+    shared = int(kv.get("shared_pages") or 0)
+    saved = float(kv.get("prefix_shared_bytes_saved") or 0.0)
+    if shared:
+        lines.append(f"prefix sharing: {shared} shared pages save "
+                     f"{_fmt_bytes(saved)}")
+    ages = kv.get("page_ages")
+    if ages:
+        lines.append(f"heatmap ({_HEAT_LEGEND}):")
+        row = []
+        for i, a in enumerate(ages):
+            if a is None or a < 0:
+                row.append(".")
+            else:
+                row.append(next(g for lo, g in _HEAT_BANDS if a >= lo))
+            if len(row) == _HEAT_COLS or i == len(ages) - 1:
+                lines.append(f"  [{i - len(row) + 1:>5}] " + "".join(row))
+                row = []
+    hist = kv.get("age_histogram") or {}
+    if hist:
+        lines.append("age histogram (windows-since-touch: pages): " +
+                     ", ".join(f"{k}:{v}" for k, v in
+                               sorted(hist.items(),
+                                      key=lambda kv_: int(kv_[0]))))
+    cold = kv.get("cold_pages") or {}
+    page_bytes = float(kv.get("page_bytes") or 0.0)
+    for thr in sorted(cold, key=int):
+        n = int(cold[thr] or 0)
+        lines.append(f"cold set at age>={thr}: {n} pages "
+                     f"({_fmt_bytes(n * page_bytes)})")
+    tenants = kv.get("tenants") or {}
+    if tenants:
+        lines.append(f"{'tenant':<20}{'pages':>10}{'bytes':>12}")
+        for t in sorted(tenants,
+                        key=lambda t: tenants[t].get("bytes", 0),
+                        reverse=True):
+            row = tenants[t]
+            lines.append(f"{t:<20}{row.get('pages', 0):>10.2f}"
+                         f"{_fmt_bytes(row.get('bytes', 0)):>12}")
+    return lines
+
+
+def what_if_spill(events: Sequence[Dict[str, Any]],
+                  thresholds: Optional[Sequence[int]] = None,
+                  host_mb: Optional[Sequence[float]] = None,
+                  ) -> List[Dict[str, Any]]:
+    """The what-if-spill estimate; rows of plain dicts so tests and the
+    gate can assert on the numbers directly."""
+    evs = [e for e in events if e.get("page_bytes")]
+    if not evs:
+        return []
+    final = evs[-1]
+    page_bytes = float(final["page_bytes"])
+    block_size = int(final.get("block_size") or 0)
+    retouch = {int(k): int(v)
+               for k, v in (final.get("retouch_ages") or {}).items()}
+    if not thresholds:
+        thresholds = sorted(int(k)
+                            for k in (final.get("cold_pages") or {}))
+        thresholds = [t for t in thresholds if t > 0] or [4, 16, 64]
+    # Peak spillable set per threshold, across the whole trace.  Use the
+    # raw per-page ages when the recorder kept them (pool small enough),
+    # else the precomputed cold counts at the configured thresholds.
+    peak_cold: Dict[int, int] = {}
+    for thr in thresholds:
+        peak = 0
+        for e in evs:
+            ages = e.get("page_ages")
+            if ages is not None:
+                n = sum(1 for a in ages if a is not None and a >= thr)
+            else:
+                n = int((e.get("cold_pages") or {}).get(str(thr), 0))
+            peak = max(peak, n)
+        peak_cold[thr] = peak
+    if not host_mb:
+        base = max(peak_cold.values()) * page_bytes / MB
+        host_mb = sorted({round(max(base * f, 0.25), 2)
+                          for f in (0.25, 0.5, 1.0)})
+    rows: List[Dict[str, Any]] = []
+    for thr in thresholds:
+        retouches = sum(v for a, v in retouch.items() if a >= thr)
+        for h in host_mb:
+            host_pages = int(h * MB // page_bytes) if page_bytes else 0
+            if peak_cold[thr] > 0:
+                hit = min(1.0, host_pages / peak_cold[thr])
+            else:
+                hit = 1.0
+            rows.append({
+                "age_threshold": int(thr),
+                "host_mb": float(h),
+                "peak_cold_pages": peak_cold[thr],
+                "peak_cold_mb": round(peak_cold[thr] * page_bytes / MB,
+                                      3),
+                "host_pages": host_pages,
+                "est_hit_rate": round(hit, 3),
+                "cold_retouches": retouches,
+                "avoided_recompute_tokens":
+                    int(retouches * block_size * hit),
+            })
+    return rows
+
+
+def render_what_if(rows: Sequence[Dict[str, Any]]) -> List[str]:
+    if not rows:
+        return []
+    lines = ["--- what-if host-offload spill (offline, from heat "
+             "trace) ---"]
+    lines.append(f"{'age>=':>6}{'host MB':>9}{'cold pages':>12}"
+                 f"{'cold MB':>9}{'hit rate':>10}{'retouches':>11}"
+                 f"{'avoided tok':>13}")
+    for r in rows:
+        lines.append(
+            f"{r['age_threshold']:>6}{r['host_mb']:>9.2f}"
+            f"{r['peak_cold_pages']:>12}{r['peak_cold_mb']:>9.3f}"
+            f"{r['est_hit_rate']:>10.2f}{r['cold_retouches']:>11}"
+            f"{r['avoided_recompute_tokens']:>13}")
+    # Name the concrete staging target: the biggest spillable set.
+    best = max(rows, key=lambda r: r["peak_cold_mb"])
+    lines.append(
+        f"spillable cold set: {best['peak_cold_pages']} pages "
+        f"({best['peak_cold_mb']:.3f} MB) at age>="
+        f"{best['age_threshold']} windows")
+    return lines
+
+
+# --------------------------------------------------------------------- #
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    p = argparse.ArgumentParser(
+        prog="dstpu-mem",
+        description="Memory observability reports: HBM occupancy "
+                    "ledger, KV page heat, what-if-spill staging.")
+    p.add_argument("telemetry_dir", nargs="?",
+                   help="recorded telemetry dir (reads kv_heat events "
+                        "from events.jsonl)")
+    p.add_argument("--url", help="live dstpu-serve/dstpu-router base "
+                                 "URL; GETs /memory")
+    p.add_argument("--thresholds",
+                   help="comma-separated cold-age thresholds (windows) "
+                        "for the what-if table")
+    p.add_argument("--host-mb",
+                   help="comma-separated candidate host-tier sizes (MB)")
+    p.add_argument("--json", dest="json_out",
+                   help="also write the machine-readable report here")
+    args = p.parse_args(argv)
+    if not args.telemetry_dir and not args.url:
+        p.error("need a TELEMETRY_DIR and/or --url")
+
+    thresholds = ([int(x) for x in args.thresholds.split(",") if x]
+                  if args.thresholds else None)
+    host_mb = ([float(x) for x in args.host_mb.split(",") if x]
+               if args.host_mb else None)
+
+    lines: List[str] = []
+    report: Dict[str, Any] = {}
+    if args.url:
+        try:
+            snap = fetch_snapshot(args.url)
+        except Exception as e:  # noqa: BLE001 — CLI surface
+            print(f"dstpu-mem: cannot fetch {args.url}/memory: {e!r}",
+                  file=sys.stderr)
+            return 1
+        report["snapshot"] = snap
+        lines += render_ledger(snap)
+        kv = snap.get("kv") or {}
+        if kv:
+            lines.append("")
+            lines += render_heat(kv)
+    if args.telemetry_dir:
+        events = read_heat_trace(args.telemetry_dir)
+        if not events:
+            print(f"dstpu-mem: no kv_heat events under "
+                  f"{args.telemetry_dir}", file=sys.stderr)
+            if not args.url:
+                return 1
+        else:
+            if lines:
+                lines.append("")
+            lines += [f"heat trace: {len(events)} kv_heat events from "
+                      f"{args.telemetry_dir}"]
+            lines += render_heat(events[-1])
+            rows = what_if_spill(events, thresholds=thresholds,
+                                 host_mb=host_mb)
+            report["what_if"] = rows
+            report["trace_events"] = len(events)
+            if rows:
+                lines.append("")
+                lines += render_what_if(rows)
+    print("\n".join(lines))
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+    return 0
+
+
+if __name__ == "__main__":  # pragma: no cover
+    sys.exit(main())

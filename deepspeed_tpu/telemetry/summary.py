@@ -35,7 +35,7 @@ EVENT_KINDS_INCIDENT = ("fault", "watchdog_timeout", "elastic_worker_failure",
                         "fleet_replica_lost", "fleet_mid_stream_error",
                         "fleet_prefill_fallback", "fleet_tenant_shed",
                         "fleet_scale_up", "fleet_scale_down", "fleet_heal",
-                        "fleet_controller_crash")
+                        "fleet_controller_crash", "mem_unattributed")
 
 #: request-tracing counters (telemetry/tracing/store.py mirrors these)
 TRACE_COUNTERS = ("trace/started", "trace/finished", "trace/kept",
@@ -474,6 +474,40 @@ def memory_summary(metrics: Sequence[Dict[str, Any]],
             peak, peak_step = float(v), e.get("step")
     if peak_step is not None:
         out["live_array_bytes_peak_step"] = peak_step
+    # HBM occupancy ledger (``mem/*`` gauges, telemetry/memory.py): bucket
+    # bytes, the conservation detector and the KV heat cold-set view
+    from .memory import MEM_BUCKETS
+
+    buckets: Dict[str, Any] = {}
+    kv: Dict[str, Any] = {}
+    cold: Dict[str, Any] = {}
+    tenants: Dict[str, Any] = {}
+    for m in metrics:
+        name = str(m.get("name", ""))
+        if not name.startswith("mem/"):
+            continue
+        key = name.split("/", 1)[1]
+        labels = m.get("labels") or {}
+        if key.endswith("_bytes") and key[:-6] in MEM_BUCKETS:
+            buckets[key[:-6]] = m.get("value")
+        elif key == "kv_cold_pages" and labels.get("age_windows"):
+            cold[labels["age_windows"]] = m.get("value")
+        elif key == "tenant_kv_bytes" and labels.get("tenant"):
+            tenants[labels["tenant"]] = m.get("value")
+        elif key in ("live_bytes", "unattributed_bytes",
+                     "unattributed_frac", "conserved"):
+            out[key] = m.get("value")
+        elif key in ("kv_live_pages", "kv_peak_pages", "kv_used_bytes",
+                     "prefix_shared_bytes_saved"):
+            kv[key] = m.get("value")
+    if buckets:
+        out["buckets"] = buckets
+    if cold:
+        kv["cold_pages"] = cold
+    if tenants:
+        kv["tenants"] = tenants
+    if kv:
+        out["kv"] = kv
     return out
 
 
@@ -890,6 +924,44 @@ def format_summary(s: Dict[str, Any]) -> str:
             add(f"device allocator peak: "
                 f"{_fmt_bytes(mem['device_peak_bytes_in_use_max'])} "
                 f"(in_use max {_fmt_bytes(mem.get('device_bytes_in_use_max') or 0)})")
+        buckets = mem.get("buckets") or {}
+        if buckets:
+            live = float(mem.get("live_bytes") or 0.0)
+            line = f"occupancy ledger: live {_fmt_bytes(live)}"
+            if mem.get("conserved") is not None:
+                ok = bool(mem["conserved"])
+                una = float(mem.get("unattributed_bytes") or 0.0)
+                line += (f" · unattributed {_fmt_bytes(abs(una))}"
+                         + ("" if ok else " (NOT conserved)"))
+            add(line)
+            add(f"{'bucket':<20}{'bytes':>12}{'% live':>9}")
+            for b in sorted(buckets, key=lambda b: buckets[b] or 0,
+                            reverse=True):
+                v = float(buckets[b] or 0.0)
+                if not v:
+                    continue
+                pct = f"{100 * v / live:.1f}%" if live > 0 else "-"
+                add(f"{b:<20}{_fmt_bytes(v):>12}{pct:>9}")
+        kv = mem.get("kv") or {}
+        if kv:
+            line = (f"kv heat: live pages "
+                    f"{int(kv.get('kv_live_pages') or 0)} "
+                    f"(peak {int(kv.get('kv_peak_pages') or 0)}), used "
+                    f"{_fmt_bytes(kv.get('kv_used_bytes') or 0)}")
+            saved = float(kv.get("prefix_shared_bytes_saved") or 0.0)
+            if saved:
+                line += f", prefix sharing saves {_fmt_bytes(saved)}"
+            add(line)
+            cold = kv.get("cold_pages") or {}
+            if cold:
+                add("cold pages by age: " + ", ".join(
+                    f">{thr}w={int(n)}" for thr, n in
+                    sorted(cold.items(), key=lambda kv_: int(kv_[0]))))
+            tens = kv.get("tenants") or {}
+            if tens:
+                add("kv by tenant: " + ", ".join(
+                    f"{t}={_fmt_bytes(v)}"
+                    for t, v in sorted(tens.items())))
     else:
         add("(no memory samples)")
     add("")

@@ -24,5 +24,6 @@ setup(
     },
     scripts=["bin/dstpu", "bin/ds_report", "bin/dstpu-telemetry",
              "bin/dstpu-check", "bin/dstpu-serve", "bin/dstpu-router",
-             "bin/dstpu-trace", "bin/dstpu-fleet"],
+             "bin/dstpu-trace", "bin/dstpu-fleet", "bin/dstpu-replay",
+             "bin/dstpu-mem"],
 )

@@ -1,0 +1,452 @@
+"""Memory observability plane (marker: mem): HBM occupancy ledger
+conservation + baseline folding + edge-triggered incident, fleet rollup,
+``mem/*`` gauge parsing in the summarizer, KV page-heat tracker
+invariants (allocator-observer live set, retouch histogram, CoW heat
+transfer), radix prefix-cache accounting (shared pages counted once
+physically / fractionally per tenant), the what-if-spill estimator
+math behind ``dstpu-mem``, retrace-neutrality of tracking, and heat/
+allocator/free-list consistency across a chaos scenario (preempt +
+NaN-isolate + flush, PR-8 harness shape)."""
+import gc
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from deepspeed_tpu.inference.v2.engine_v2 import (
+    InferenceEngineV2,
+    RaggedInferenceEngineConfig,
+)
+from deepspeed_tpu.inference.v2.lifecycle import (
+    LifecycleScheduler,
+    RequestState,
+    ServeRequest,
+)
+from deepspeed_tpu.inference.v2.ragged.blocked_allocator import (
+    BlockedAllocator,
+)
+from deepspeed_tpu.inference.v2.ragged.page_heat import PageHeatTracker
+from deepspeed_tpu.models.transformer import CausalLM, TransformerConfig
+from deepspeed_tpu.runtime.fault import injection
+from deepspeed_tpu.telemetry import Telemetry, set_telemetry
+from deepspeed_tpu.telemetry.memory import (
+    MEM_BUCKETS,
+    MemoryLedger,
+    rollup_memory,
+)
+
+pytestmark = pytest.mark.mem
+
+BS = 8
+SYS = [7, 3, 9, 4, 11, 6, 2, 8, 13, 5, 1, 12, 15, 10, 14, 16]  # 2 pages
+
+
+@pytest.fixture(scope="module")
+def tiny_lm():
+    cfg = TransformerConfig.tiny(use_flash=False)
+    model = CausalLM(cfg)
+    return model, model.init_params(jax.random.PRNGKey(0))
+
+
+def mk_engine(tiny_lm, prefix_cache=True, track=True, num_blocks=24,
+              impl="gather"):
+    model, params = tiny_lm
+    return InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+        max_tokens=32, max_seqs=8, max_ctx=64, block_size=BS,
+        num_blocks=num_blocks, dtype=jnp.float32, attn_impl=impl,
+        prefix_cache=prefix_cache, track_page_heat=track))
+
+
+def alloc_live_set(al):
+    return {i for i, r in enumerate(al.refcounts()) if r > 0}
+
+
+# --------------------------------------------------------------------- #
+# PageHeatTracker core (allocator only, no engine)
+# --------------------------------------------------------------------- #
+class TestHeatTracker:
+    def mk(self, n=8, page_bytes=100):
+        al = BlockedAllocator(n)
+        heat = PageHeatTracker(al, block_size=4, page_bytes=page_bytes)
+        al.heat = heat
+        return al, heat
+
+    def test_live_set_tracks_allocator(self):
+        al, heat = self.mk()
+        blocks = [int(b) for b in al.allocate(3)]
+        assert heat.live_pages() == alloc_live_set(al) == set(blocks)
+        al.free(blocks[:1])
+        assert heat.live_pages() == alloc_live_set(al)
+        al.free(blocks[1:])
+        assert heat.live_pages() == set() == alloc_live_set(al)
+
+    def test_aging_cold_sets_and_retouch_histogram(self):
+        al, heat = self.mk()
+        blocks = [int(b) for b in al.allocate(3)]
+        for _ in range(5):
+            heat.tick()
+        assert heat.cold_pages(4) == len(blocks)       # age 5 everywhere
+        heat.touch(blocks[:1])                         # would-be host hit
+        assert heat.retouch_ages == {5: 1}
+        assert heat.cold_pages(4) == len(blocks) - 1
+        snap = heat.snapshot()
+        assert snap["cold_pages"]["4"] == 2
+        assert snap["retouch_ages"] == {"5": 1}
+        assert snap["used_bytes"] == 3 * 100
+
+    def test_touch_of_free_page_raises(self):
+        al, heat = self.mk()
+        b = [int(x) for x in al.allocate(1)]
+        al.free(b)
+        with pytest.raises(ValueError, match="non-live"):
+            heat.touch(b)
+
+    def test_transfer_inherits_heat(self):
+        al, heat = self.mk()
+        src, dst = (int(b) for b in al.allocate(2))
+        heat.tick()
+        heat.tick()
+        heat.touch([src])                   # src hot, dst 2 windows old
+        heat.transfer(src, dst)
+        ages = heat.snapshot()["page_ages"]
+        assert ages[dst] == ages[src] == 0
+        assert heat.transfers == 1
+
+    def test_shared_page_counted_once_and_fractionally(self):
+        al, heat = self.mk()
+        a, b = (int(x) for x in al.allocate(2))
+        al.ref([a])                          # second holder of page a
+        snap = heat.snapshot(holders={1: [a, b], 2: [a]},
+                             tenants={1: "alice", 2: "bob"})
+        # physically: 2 live pages, the shared one counted ONCE
+        assert snap["live_pages"] == 2 and snap["used_bytes"] == 200
+        assert snap["shared_pages"] == 1
+        assert snap["prefix_shared_bytes_saved"] == 100
+        # fractionally: alice = a/2 + b, bob = a/2; sum == physical
+        assert snap["tenants"]["alice"]["pages"] == pytest.approx(1.5)
+        assert snap["tenants"]["bob"]["pages"] == pytest.approx(0.5)
+        assert (snap["tenants"]["alice"]["bytes"]
+                + snap["tenants"]["bob"]["bytes"]
+                == pytest.approx(snap["used_bytes"]))
+
+
+# --------------------------------------------------------------------- #
+# MemoryLedger: buckets, baseline, conservation, incident, rollup
+# --------------------------------------------------------------------- #
+class TestLedger:
+    def test_unknown_bucket_raises(self):
+        led = MemoryLedger(component="t")
+        with pytest.raises(ValueError, match="unknown memory bucket"):
+            led.register_source("coffee", lambda: 1)
+
+    def test_baseline_folds_preexisting_live_into_other(self):
+        gc.collect()
+        led = MemoryLedger(component="t")
+        led.capture_baseline()          # whatever the process holds now
+        snap = led.snapshot()
+        assert snap["conserved"], snap
+        assert snap["buckets"]["other"] >= 0
+        assert set(snap["buckets"]) == set(MEM_BUCKETS)
+
+    def test_overattribution_breaks_conservation_edge_triggered(
+            self, tmp_path):
+        gc.collect()
+        led = MemoryLedger(component="t")
+        led.capture_baseline()
+        tel = Telemetry(output_dir=str(tmp_path / "tel"),
+                        chrome_trace=False)
+        set_telemetry(tel)
+        try:
+            assert led.publish()["conserved"]
+            assert led.unattributed_incidents == 0
+            # a phantom terabyte: attributed >> live
+            led.register_source("grad_acc", lambda: 10 ** 12)
+            snap = led.publish()
+            assert not snap["conserved"]
+            assert snap["unattributed_bytes"] < 0
+            assert led.unattributed_incidents == 1
+            led.publish()               # still broken: NO second incident
+            assert led.unattributed_incidents == 1
+        finally:
+            set_telemetry(None)
+            tel.close()
+
+    def test_rollup_sums_processes_and_kv(self):
+        def snap(live, cold, tenant_bytes):
+            return {
+                "component": "r", "live_bytes": live,
+                "unattributed_bytes": 10, "conserved": True,
+                "buckets": {"params": live - 100, "kv_pages": 100},
+                "kv": {"live_pages": 4, "peak_live_pages": 6,
+                       "used_bytes": 80, "prefix_shared_bytes_saved": 7,
+                       "cold_pages": {"4": cold},
+                       "tenants": {"a": {"pages": 1.0,
+                                         "bytes": tenant_bytes}}},
+            }
+
+        roll = rollup_memory([snap(1000, 2, 30), snap(500, 1, 10),
+                              None, {"garbage": True}])
+        assert roll["processes"] == 2
+        assert roll["live_bytes"] == 1500
+        assert roll["buckets"]["kv_pages"] == 200
+        assert roll["nonconserved_processes"] == 0
+        assert roll["kv"]["live_pages"] == 8
+        assert roll["kv"]["cold_pages"]["4"] == 3
+        assert roll["kv"]["tenants"]["a"] == {"bytes": 40}
+
+    def test_memory_summary_parses_ledger_gauges(self):
+        from deepspeed_tpu.telemetry.summary import memory_summary
+
+        metrics = [
+            {"name": "mem/live_bytes", "value": 1000.0},
+            {"name": "mem/params_bytes", "value": 800.0},
+            {"name": "mem/kv_pages_bytes", "value": 200.0},
+            {"name": "mem/unattributed_bytes", "value": 0.0},
+            {"name": "mem/conserved", "value": 1.0},
+            {"name": "mem/kv_live_pages", "value": 5.0},
+            {"name": "mem/kv_cold_pages", "value": 3.0,
+             "labels": {"age_windows": "4"}},
+            {"name": "mem/tenant_kv_bytes", "value": 50.0,
+             "labels": {"tenant": "alice"}},
+            {"name": "goodput/wall_s", "value": 9.0},   # not ours
+        ]
+        out = memory_summary(metrics, [])
+        assert out["buckets"] == {"params": 800.0, "kv_pages": 200.0}
+        assert out["live_bytes"] == 1000.0 and out["conserved"] == 1.0
+        assert out["kv"]["cold_pages"] == {"4": 3.0}
+        assert out["kv"]["tenants"] == {"alice": 50.0}
+
+    def test_mem_unattributed_is_an_incident_kind(self):
+        from deepspeed_tpu.telemetry.live.aggregator import (
+            INCIDENT_COUNTERS,
+        )
+        from deepspeed_tpu.telemetry.summary import EVENT_KINDS_INCIDENT
+
+        assert "mem_unattributed" in EVENT_KINDS_INCIDENT
+        assert "mem/unattributed" in INCIDENT_COUNTERS
+
+
+# --------------------------------------------------------------------- #
+# Prefix-cache accounting through the real engine
+# --------------------------------------------------------------------- #
+class TestPrefixAccounting:
+    def _seed_trie(self, eng, tail):
+        """Prefill SYS+tail once and retire it, leaving SYS's full pages
+        committed to (and held only by) the radix trie."""
+        toks = SYS + tail
+        eng.put([90], [toks])
+        eng.commit_prefix(90, toks, allow_partial=True)
+        eng.flush([90])
+
+    def test_shared_graft_counted_once_physical_fractional_tenant(
+            self, tiny_lm):
+        eng = mk_engine(tiny_lm)
+        self._seed_trie(eng, [21])
+        al = eng.state_manager.allocator
+        # two tenants graft the same 2-page system prefix
+        for uid, tenant in ((1, "alice"), (2, "bob")):
+            matched = eng.graft_prefix(uid, SYS + [30 + uid])
+            assert matched >= len(SYS)
+            eng.set_tenant(uid, tenant)
+            eng.put([uid], [(SYS + [30 + uid])[matched:]])
+        snap = eng.memory_snapshot()
+        pb = snap["page_bytes"]
+        # heat map == allocator at the settle point
+        assert set(eng.heat.live_pages()) == alloc_live_set(al)
+        # the 2 SYS pages are shared 3 ways (trie + alice + bob) but
+        # physically counted once; saved = (refs-1) * page_bytes
+        assert snap["shared_pages"] >= 2
+        assert snap["prefix_shared_bytes_saved"] >= 2 * 2 * pb
+        tens = snap["tenants"]
+        assert set(tens) == {"alice", "bob"}
+        assert tens["alice"]["pages"] == pytest.approx(
+            tens["bob"]["pages"])
+        # fractional shares never double-count the physical pool
+        assert (tens["alice"]["bytes"] + tens["bob"]["bytes"]
+                <= snap["used_bytes"] + 1e-6)
+        eng.flush([1, 2])
+        assert set(eng.heat.live_pages()) == alloc_live_set(al)
+
+    def test_cow_graft_transfers_heat(self, tiny_lm):
+        eng = mk_engine(tiny_lm)
+        base = SYS[:11]                        # 1 full page + 3-tok tail
+        self._seed_trie(eng, list(base[len(SYS):]) or [44])
+        # seed again with the partial-page prompt committed wholesale
+        toks = base + [44]
+        eng.put([91], [toks])
+        eng.commit_prefix(91, toks, allow_partial=True)
+        eng.flush([91])
+        before = eng.heat.transfers
+        matched = eng.graft_prefix(5, base + [44, 45, 46])
+        assert matched > 0
+        # the partial tail page was CoW-copied and inherited its heat
+        assert eng.heat.transfers == before + 1
+        assert set(eng.heat.live_pages()) == alloc_live_set(
+            eng.state_manager.allocator)
+
+    def test_rollback_and_flush_leave_heat_consistent(self, tiny_lm):
+        eng = mk_engine(tiny_lm, prefix_cache=False)
+        al = eng.state_manager.allocator
+        eng.put([7], [[3, 5, 7, 11, 13, 17, 19, 23, 29, 31]])
+        assert set(eng.heat.live_pages()) == alloc_live_set(al)
+        eng.rollback_kv(7, 4)                  # spec-dec rejection path
+        # rollback never frees pages — the reservation survives
+        assert set(eng.heat.live_pages()) == alloc_live_set(al)
+        eng.flush([7])
+        assert set(eng.heat.live_pages()) == alloc_live_set(al) == set()
+
+    def test_tracking_off_is_inert(self, tiny_lm):
+        eng = mk_engine(tiny_lm, track=False)
+        eng.put([1], [[3, 5, 7]])
+        assert eng.heat is None
+        assert eng.memory_snapshot() is None
+        assert eng.state_manager.allocator.heat is None
+        eng.flush([1])
+
+    def test_tracking_does_not_change_trace_counts(self, tiny_lm):
+        def run(track):
+            eng = mk_engine(tiny_lm, prefix_cache=False, track=track)
+            eng.put([1, 2], [[3, 5, 7], [4, 6]])
+            toks = eng.decode_batch([1, 2], [9, 11], 6)
+            eng.flush([1, 2])
+            return dict(eng.trace_counts), toks
+
+        tc_off, toks_off = run(False)
+        tc_on, toks_on = run(True)
+        assert tc_on == tc_off          # zero retraces from tracking
+        assert (jnp.asarray(toks_on) == jnp.asarray(toks_off)).all()
+
+
+# --------------------------------------------------------------------- #
+# what-if-spill estimator math (the table dstpu-mem renders)
+# --------------------------------------------------------------------- #
+class TestWhatIfSpill:
+    def mk_events(self):
+        # 10-page pool, page_bytes chosen so 4 pages == 1 MiB
+        pb = 256 * 1024
+        ev = lambda ages, retouch: {  # noqa: E731 — table literal
+            "page_bytes": pb, "block_size": 8,
+            "page_ages": ages, "retouch_ages": retouch,
+            "cold_pages": {"4": sum(1 for a in ages if a >= 4)},
+        }
+        return [
+            ev([0, 0, 1, 2, -1, -1, -1, -1, -1, -1], {}),
+            ev([5, 6, 7, 8, 0, 0, -1, -1, -1, -1], {}),   # peak: 4 cold
+            ev([0, 0, 9, 9, 1, 1, -1, -1, -1, -1],
+               {"1": 10, "5": 2, "6": 1}),                 # final
+        ]
+
+    def test_candidate_rows(self):
+        from deepspeed_tpu.telemetry.memreport import what_if_spill
+
+        rows = what_if_spill(self.mk_events(), thresholds=[4],
+                             host_mb=[0.5, 1.0])
+        assert len(rows) == 2
+        small, big = rows
+        # peak spillable set: 4 pages = 1 MiB, at event 2
+        assert small["peak_cold_pages"] == 4
+        assert small["peak_cold_mb"] == pytest.approx(1.0)
+        # 3 retouches happened past age 4 (ages 5, 6 from the histogram)
+        assert small["cold_retouches"] == 3
+        # 0.5 MB host holds 2 of the 4 cold pages -> 50% hit rate
+        assert small["est_hit_rate"] == pytest.approx(0.5)
+        assert small["avoided_recompute_tokens"] == int(3 * 8 * 0.5)
+        # 1 MB host holds the whole cold set
+        assert big["est_hit_rate"] == pytest.approx(1.0)
+        assert big["avoided_recompute_tokens"] == 3 * 8
+
+    def test_render_names_the_cold_set(self):
+        from deepspeed_tpu.telemetry.memreport import (
+            render_what_if,
+            what_if_spill,
+        )
+
+        rows = what_if_spill(self.mk_events(), thresholds=[4],
+                             host_mb=[1.0])
+        text = "\n".join(render_what_if(rows))
+        assert "spillable cold set: 4 pages (1.000 MB) at age>=4" in text
+
+
+# --------------------------------------------------------------------- #
+# Chaos: heat map vs allocator vs free list under preempt+NaN+flush
+# --------------------------------------------------------------------- #
+class FakeClock:
+    def __init__(self):
+        self.t = 1000.0
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def test_chaos_heat_and_ledger_consistent(tiny_lm, tmp_path):
+    """PR-8 harness shape: a tight pool forces preemption, one decode
+    window is NaN-poisoned (victim isolated + flushed), and everything
+    drains.  At EVERY settle point the heat map's live page set must
+    equal the allocator's, and the occupancy ledger must stay conserved
+    (|unattributed| <= 2% of live)."""
+    model, params = tiny_lm
+    injection.clear()
+    gc.collect()
+    tel = Telemetry(output_dir=str(tmp_path / "tel"), chrome_trace=False)
+    set_telemetry(tel)
+    try:
+        clock = FakeClock()
+        eng = InferenceEngineV2(model, params,
+                                RaggedInferenceEngineConfig(
+                                    max_tokens=32, max_seqs=8,
+                                    max_ctx=64, block_size=BS,
+                                    num_blocks=24, dtype=jnp.float32,
+                                    attn_impl="paged"))
+        sched = LifecycleScheduler(eng, max_queue=64, window_steps=4,
+                                   kv_high_watermark=0.5, clock=clock)
+        led = MemoryLedger(component="chaos")
+        eng.register_memory_sources(led)
+        led.capture_baseline()
+        free0 = eng.state_manager.free_blocks
+        al = eng.state_manager.allocator
+
+        def settle_check(where):
+            assert set(eng.heat.live_pages()) == alloc_live_set(al), \
+                f"heat/allocator drift at {where}"
+            snap = led.publish()
+            assert snap["conserved"], \
+                f"ledger not conserved at {where}: " \
+                f"{snap['unattributed_frac']}"
+
+        def prompt(uid):
+            if uid == 11:            # the preemption forcer (big prompt)
+                return [(uid * 7 + i) % 250 + 1 for i in range(40)]
+            return [(uid * 13 + i) % 250 + 1 for i in range(uid % 5 + 2)]
+
+        for start in (0, 6):
+            for uid in range(start, start + 6):
+                sched.submit(ServeRequest(uid=uid, prompt=prompt(uid),
+                                          max_new_tokens=4 + uid % 6))
+            sched.step()
+            clock.advance(1.0)
+            settle_check(f"wave@{start}")
+        injection.configure("site=decode_window,kind=nan,times=1")
+        sched.step()
+        clock.advance(0.5)
+        settle_check("post-nan")
+        sched.run_until_idle()
+        injection.clear()
+        settle_check("drained")
+        states = {u: sched.request(u).state for u in range(12)}
+        assert sum(1 for s in states.values()
+                   if s == RequestState.FAILED) == 1
+        assert sched.counters["serving/preempted"] >= 1
+        # every block reclaimed AND the heat map agrees the pool is empty
+        assert eng.state_manager.free_blocks == free0 == 24
+        assert eng.heat.live_pages() == set()
+        # the scenario's heat telemetry round-trips through a snapshot
+        snap = led.snapshot()
+        assert snap["kv"]["peak_live_pages"] > 0
+        assert snap["kv"]["touches_total"] > 0
+    finally:
+        injection.clear()
+        set_telemetry(None)
+        tel.close()

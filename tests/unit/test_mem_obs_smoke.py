@@ -1,0 +1,26 @@
+"""Memory observability end-to-end gate (marker: mem): real processes.
+
+Runs ``tools/check_mem_obs.py`` — a real ``bin/dstpu-serve`` serving a
+CONSERVED ``/memory`` ledger mid-decode, the router rollup summing two
+replicas' ledgers, ``bin/dstpu-mem`` rendering the live ledger and, from
+a recorded 32k-context prefix-cache heat trace, the what-if-spill table
+that names a concrete spillable cold set.  Same enforcement pattern as
+test_goodput.py's record/replay gate."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.mem
+
+
+def test_mem_obs_gate_passes():
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    check = os.path.join(repo_root, "tools", "check_mem_obs.py")
+    proc = subprocess.run([sys.executable, check],
+                          capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, \
+        f"memory observability gate failed:\n" \
+        f"{proc.stdout}{proc.stderr[-1000:]}"
