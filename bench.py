@@ -812,10 +812,27 @@ def run_decode_sweep(on_tpu: bool) -> None:
                     point["spec"] = {"error": str(exc)[-200:]}
                     log(f"seqs={n_seqs} ctx={ctx} spec: FAILED "
                         f"{str(exc)[:160]}")
+            # ---- host-tier axis: swap on/off at an undersized pool (once
+            # per ctx — the scenario's pool is sized by ctx, not n_seqs)
+            if n_seqs == seqs_grid[0]:
+                try:
+                    point["swap"] = _decode_sweep_swap_point(
+                        model, params, ctx, base_impl)
+                except Exception as exc:  # noqa: BLE001
+                    point["swap"] = {"error": str(exc)[-200:]}
+                    log(f"ctx={ctx} swap: FAILED {str(exc)[:160]}")
             table.append(point)
             log(f"seqs={n_seqs} ctx={ctx}: paged {pf} vs gather {gf} "
                 f"fused tok/s (x{point.get('paged_vs_gather', '?')}), "
                 f"fused/stepwise x{point.get('fused_vs_stepwise', '?')}")
+            sw = point.get("swap") or {}
+            if "swap_on" in sw:
+                log(f"  host tier ctx={ctx}: off "
+                    f"{sw['swap_off']['tok_s']} vs on "
+                    f"{sw['swap_on']['tok_s']} tok/s, hit_rate "
+                    f"{sw['swap_on']['swap_hit_rate']}, avoided "
+                    f"{sw['swap_on']['avoided_recompute_tokens']} tokens, "
+                    f"streams_equal={sw['streams_equal']}")
             for kk, sp in sorted((point.get("spec") or {}).items()):
                 if isinstance(sp, dict) and "acceptance_rate" in sp:
                     log(f"  spec ngram k={sp['k']}: acceptance "
@@ -823,6 +840,26 @@ def run_decode_sweep(on_tpu: bool) -> None:
                         f"{sp['effective_tok_s']} tok/s "
                         f"(x{sp['effective_vs_vanilla']} vs vanilla fused)")
 
+    swap_pts = [p["swap"] for p in table
+                if isinstance(p.get("swap"), dict) and "swap_on" in p["swap"]]
+    swap_summary = {}
+    if swap_pts:
+        hits = [sp["swap_on"]["swap_hit_rate"] for sp in swap_pts
+                if sp["swap_on"].get("swap_hit_rate") is not None]
+        swap_summary = {
+            "swap_points": len(swap_pts),
+            "swap_min_hit_rate": round(min(hits), 4) if hits else None,
+            "swap_avoided_recompute_tokens": sum(
+                int(sp["swap_on"].get("avoided_recompute_tokens") or 0)
+                for sp in swap_pts),
+            "swap_streams_equal_everywhere": all(
+                sp.get("streams_equal") for sp in swap_pts),
+        }
+        log(f"host tier: {swap_summary['swap_points']} A/B points, min "
+            f"hit_rate {swap_summary['swap_min_hit_rate']}, avoided "
+            f"{swap_summary['swap_avoided_recompute_tokens']} recompute "
+            f"tokens, streams_equal_everywhere="
+            f"{swap_summary['swap_streams_equal_everywhere']}")
     ratios = [p["paged_vs_gather"] for p in table if "paged_vs_gather" in p]
     overhead = [p["fused_vs_stepwise"] for p in table
                 if "fused_vs_stepwise" in p]
@@ -857,8 +894,75 @@ def run_decode_sweep(on_tpu: bool) -> None:
           "min_paged_vs_gather": round(min(ratios), 3) if ratios else None,
           "min_fused_vs_stepwise":
               round(min(overhead), 2) if overhead else None,
-          "spec_ks": spec_ks, **spec_summary,
+          "spec_ks": spec_ks, **spec_summary, **swap_summary,
           "backend": jax.default_backend()})
+
+
+def _decode_sweep_swap_point(model, params, ctx, impl):
+    """Host-tier A/B at one grid point (decode_sweep helper).
+
+    An undersized KV pool forces the lifecycle scheduler to preempt a
+    low-priority stream under a higher-priority burst; with the tier OFF
+    the resume is a prefill recompute, with the tier ON it is a
+    swap-out/swap-in (H2D copy + page-table patch).  Streams must match
+    bit-exactly between the arms; the swap columns report what the tier
+    bought (hit rate, recompute tokens avoided) and what it cost (A/B
+    wall-clock tok/s)."""
+    from deepspeed_tpu.inference.v2.engine_v2 import (
+        InferenceEngineV2,
+        RaggedInferenceEngineConfig,
+    )
+    from deepspeed_tpu.inference.v2.lifecycle import (LifecycleScheduler,
+                                                      ServeRequest)
+
+    bs = 8
+    vic_prompt = min(max(ctx // 16, 24), 48)
+    vic_new = 16
+    comp_prompt, comp_new = vic_prompt // 2, 12
+    vic_blocks = -(-(vic_prompt + vic_new) // bs)
+    comp_blocks = -(-(comp_prompt + comp_new) // bs)
+    # the victim plus four competitors fit, the fifth forces a preemption
+    pool = vic_blocks + 4 * comp_blocks + 1
+
+    def run(tier_mb):
+        eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            max_tokens=32, max_seqs=8,
+            max_ctx=vic_prompt + vic_new + bs, block_size=bs,
+            num_blocks=pool, dtype=jnp.float32, attn_impl=impl,
+            host_tier_mb=tier_mb))
+        sched = LifecycleScheduler(eng, max_queue=64, window_steps=4,
+                                   kv_high_watermark=0.5)
+        t0 = time.perf_counter()
+        sched.submit(ServeRequest(
+            uid=0, prompt=[(7 * i) % 250 + 1 for i in range(vic_prompt)],
+            max_new_tokens=vic_new, priority=0))
+        sched.step()
+        sched.step()
+        for uid in range(1, 6):
+            sched.submit(ServeRequest(
+                uid=uid,
+                prompt=[(uid * 13 + i) % 250 + 1 for i in range(comp_prompt)],
+                max_new_tokens=comp_new, priority=1))
+        sched.run_until_idle()
+        wall = time.perf_counter() - t0
+        streams = {u: list(sched.request(u).produced) for u in range(6)}
+        toks = sum(len(v) for v in streams.values())
+        stats = eng.kv_swap.stats() if eng.kv_swap is not None else {}
+        return {
+            "tok_s": round(toks / wall, 2),
+            "preempted": sched.counters.get("serving/preempted", 0),
+            "swap_out": sched.counters.get("serving/swap_out", 0),
+            "swap_in": sched.counters.get("serving/swap_in", 0),
+            "swap_hit_rate": stats.get("hit_rate"),
+            "avoided_recompute_tokens":
+                stats.get("avoided_recompute_tokens", 0),
+        }, streams
+
+    off, off_streams = run(0.0)
+    on, on_streams = run(8.0)
+    return {"swap_off": off, "swap_on": on,
+            "streams_equal": off_streams == on_streams,
+            "pool_blocks": pool}
 
 
 def _decode_sweep_spec_point(model, n_seqs, ctx, steps, spec_ks,
@@ -2004,6 +2108,31 @@ def run_fleet_sweep(on_tpu: bool) -> None:
         f"peak_pages={m_snap.get('peak_live_pages')} "
         f"touches={m_snap.get('touches_total')}")
 
+    # ---- host tier off: unchanged-behavior check ---------------------- #
+    # an engine with host_tier_mb=0 (the default) must build no tier, no
+    # swap manager, and produce the SAME streams as the default-config
+    # engine — the tier must cost nothing when it is off
+    eng_tier_off = InferenceEngineV2(model, params,
+                                     RaggedInferenceEngineConfig(
+                                         max_tokens=64, max_seqs=8,
+                                         max_ctx=256, block_size=8,
+                                         dtype=jnp.float32,
+                                         attn_impl="gather",
+                                         host_tier_mb=0.0))
+
+    def stream_probe(eng):
+        s = LifecycleScheduler(eng, window_steps=8, max_queue=16)
+        for i in range(4):
+            s.submit(ServeRequest(uid=5000 + i, prompt=[3 + i, 5, 7],
+                                  max_new_tokens=32))
+        s.run_until_idle()
+        return [list(s.request(5000 + i).produced) for i in range(4)]
+
+    tier_off_unchanged = (
+        eng_tier_off.host_tier is None and eng_tier_off.kv_swap is None
+        and stream_probe(eng_tier_off) == stream_probe(eng_oh))
+    log(f"fleet_sweep host tier off unchanged: {tier_off_unchanged}")
+
     # headline = the MEAN over the sweep points — a regression at ANY
     # replica count must move it (max() would hide a regression at a
     # non-best point); scaling efficiency stays last-vs-first
@@ -2035,6 +2164,7 @@ def run_fleet_sweep(on_tpu: bool) -> None:
             "prefix_shared_bytes_saved":
                 m_snap.get("prefix_shared_bytes_saved"),
         },
+        "host_tier_off_unchanged": tier_off_unchanged,
         "autoscale": autoscale,
         "requests": n_requests, "max_new_tokens": max_new,
         "note": "CPU-sim scheduling-plane bench over the real router; "

@@ -91,6 +91,14 @@ class RaggedInferenceEngineConfig:
     #: cold-set age thresholds (windows since last touch) published as
     #: ``mem/kv_cold_pages{age_windows=K}`` gauges
     heat_cold_thresholds: Tuple[int, ...] = (4, 16, 64)
+    #: host-DRAM page tier capacity in MB (0 = tier off).  When on,
+    #: KV-pressure preemption *swaps*: the victim's coldest contiguous
+    #: page-prefix (ranked by heat age) is exported in kv_ship canonical
+    #: rows to host memory, and resume grafts it back (H2D + page-table
+    #: patch) instead of recomputing the prefill; prefix-cache evictions
+    #: likewise spill shared full pages host-side.  Sized from the
+    #: dstpu-mem what-if-spill tables (ragged/kv_swap.py).
+    host_tier_mb: float = 0.0
 
 
 class InferenceEngineV2:
@@ -133,6 +141,18 @@ class InferenceEngineV2:
                 page_bytes=self.kv.mem_bytes() // num_blocks,
                 cold_age_thresholds=c.heat_cold_thresholds)
             self.state_manager.allocator.heat = self.heat
+        #: host-DRAM page tier + swap coordinator (None = tier off)
+        self.host_tier = None
+        self.kv_swap = None
+        if c.host_tier_mb > 0:
+            from ...runtime.swap_tensor.host_tier import HostPageTier
+            from .ragged.kv_swap import KVSwapManager
+
+            self.host_tier = HostPageTier(int(c.host_tier_mb * 1e6))
+            self.kv_swap = KVSwapManager(self, self.host_tier)
+            if self.state_manager.prefix_cache is not None:
+                self.state_manager.prefix_cache.spill_fn = \
+                    self.kv_swap.spill_prefix_node
         # Cast to serving dtype, EXCEPT router kernels: routing must run in
         # f32 so serving picks the same experts as the training forward — a
         # bf16 round-trip flips top-k selection on near-tie tokens.
@@ -444,7 +464,12 @@ class InferenceEngineV2:
         ledger.register_source("params", lambda: self._param_bytes)
         ledger.register_source("kv_pages", lambda: self.kv.mem_bytes())
         ledger.register_source("decode_workspace", self._workspace_bytes)
+        ledger.register_source(
+            "host_kv",
+            lambda: self.host_tier.used_bytes if self.host_tier else 0)
         ledger.attach_kv(self.memory_snapshot)
+        if self.kv_swap is not None:
+            ledger.attach_swap(self.kv_swap.stats)
 
     def kv_used_fraction(self) -> float:
         """Fraction of the KV block pool currently allocated — the
@@ -483,6 +508,15 @@ class InferenceEngineV2:
             # rows, same access history
             self.heat.transfer(src_block, dst_block)
 
+    def _write_page_rows(self, block: int, rows) -> None:
+        """H2D-write one logical page's canonical rows ``[L, block_size,
+        2*KV, HD]`` into every layer's physical slot — the restore leg of
+        a host-tier prefix spill."""
+        phys = jnp.asarray([block + layer * self._num_blocks
+                            for layer in range(self.cfg.num_layers)])
+        self.kv.update(self.kv.pages.at[phys].set(
+            jnp.asarray(rows, self.kv.pages.dtype)))
+
     def graft_prefix(self, uid: int, tokens: Sequence[int]) -> int:
         """Admission-side prefix reuse: graft the longest cached prefix of
         ``tokens`` into a fresh sequence and return how many tokens it
@@ -502,6 +536,30 @@ class InferenceEngineV2:
         assert seq is None or (not seq.blocks and seq.seen_tokens == 0), \
             f"prefix graft into a non-fresh sequence uid={uid}"
         matched, blocks, partial = cache.match(list(tokens))
+        if self.kv_swap is not None and not partial:
+            # extend the device-trie match through host-spilled full pages:
+            # each one is re-materialized into a fresh block, re-committed
+            # to the trie (which takes the owning ref), and then shared
+            # with the sequence like any other matched page
+            alloc = self.state_manager.allocator
+            bs = self.config.block_size
+            while matched + bs <= len(tokens) - 1:
+                path = tuple(int(t) for t in tokens[:matched + bs])
+                rows = self.kv_swap.peek_prefix(path)
+                if rows is None:
+                    break
+                if alloc.free_blocks < 1:
+                    cache.evict(1)
+                if alloc.free_blocks < 1:
+                    break
+                blk = int(alloc.allocate(1)[0])
+                self._write_page_rows(blk, rows)
+                cache.commit(list(tokens), blocks + [blk],
+                             upto=matched + bs)
+                alloc.free([blk])       # the trie's ref now owns the page
+                self.kv_swap.confirm_prefix(path)
+                blocks.append(blk)
+                matched += bs
         if not matched:
             return 0
         # create the descriptor FIRST: get_or_create can raise on the

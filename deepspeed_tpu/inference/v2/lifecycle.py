@@ -417,6 +417,9 @@ class LifecycleScheduler:
             self.eng.flush([uid])
         if self.drafter is not None:
             self.drafter.flush(uid)
+        ksw = getattr(self.eng, "kv_swap", None)
+        if ksw is not None:
+            ksw.drop(uid)       # parked rows die with the request
         req.state = state
         req.finish_reason = reason
         req.finished_t = self.clock()
@@ -484,6 +487,15 @@ class LifecycleScheduler:
         # (least work thrown away for FIFO arrival orders)
         victim = min(victims, key=lambda r: (r.priority, -r._admit_order))
         uid = victim.uid
+        # host tier on: park the victim's coldest contiguous page-prefix
+        # BEFORE the flush (the export is a pure read of still-live pages)
+        # so resume is a swap-in instead of a prefill recompute; 0 tokens
+        # spilled degrades to the pre-tier evict+recompute path
+        swapped = 0
+        ksw = getattr(self.eng, "kv_swap", None)
+        if ksw is not None and victim.produced:
+            swapped = ksw.spill(
+                uid, victim.prompt + victim.produced[:-1])
         del self._decodes[uid]
         self.eng.flush([uid])                 # spill: produced stays host-side
         victim.state = RequestState.QUEUED
@@ -492,8 +504,11 @@ class LifecycleScheduler:
         victim._prefill_pos = 0
         self._waiting.append(uid)             # re-admitted behind the head
         self._count("serving/preempted")
+        if swapped:
+            self._count("serving/swap_out")
+            self._count("serving/swap_out_tokens", swapped)
         self._event("serving_preempted", uid=uid, for_uid=head.uid,
-                    produced=len(victim.produced),
+                    produced=len(victim.produced), swapped=swapped,
                     kv_used=round(self.eng.kv_used_fraction(), 4),
                     trace=self._trace_id(victim))
         self._tspan(victim, "preempt", t0=time.time(), dur_s=0.0,
@@ -539,9 +554,41 @@ class LifecycleScheduler:
             # now instead of wedging the queue head
             return None
         sm = self.eng.state_manager
+        ksw = getattr(self.eng, "kv_swap", None)
+        swapped_in = False
         if sm.get_sequence(req.uid) is None:
             req._prefill_pos = 0
-            if req.kv_import is not None:
+            if (ksw is not None and req._resume_seed is not None
+                    and ksw.entry(req.uid) is not None):
+                # swap-in resume: the preempt path parked this uid's rows
+                # host-side, and they cover MORE than any original
+                # kv_import shipment (prompt + produced so far), so this
+                # branch wins.  Same cheap feasibility gate as kv_import:
+                # evict cache slack, then bail before touching the device.
+                if need_blocks > sm.allocator.free_blocks and \
+                        sm.prefix_cache is not None:
+                    sm.prefix_cache.evict(
+                        need_blocks - sm.allocator.free_blocks)
+                if need_blocks > sm.allocator.free_blocks:
+                    return False
+                t0w, t0p = time.time(), time.perf_counter()
+                n = ksw.restore(req.uid, req.resume_prompt)
+                if n:
+                    req._import_s = time.perf_counter() - t0p
+                    self._tspan(req, "kv_swap_in", t0=t0w,
+                                dur_s=req._import_s, tokens=n)
+                    req._prefill_pos = n
+                    swapped_in = True
+                    self._count("serving/swap_in")
+                    self._count("serving/swap_in_tokens", n)
+                elif ksw.entry(req.uid) is not None:
+                    return False    # transient exhaustion: rows stay
+                                    # parked, the queue head retries
+                else:
+                    # rows were LRU-evicted / failed re-attestation /
+                    # fault-injected away: recompute (bit-exact, slower)
+                    self._count("serving/swap_miss")
+            elif req.kv_import is not None:
                 ship = req.kv_import
                 attested = [int(t) for t in
                             req.resume_prompt[:ship.n_tokens]]
@@ -592,7 +639,9 @@ class LifecycleScheduler:
         # retries would inflate the hit stats (cache.note_hit/note_miss
         # exist for the same reason — match() itself is a pure lookup)
         cache = self.eng.prefix_cache
-        if req.kv_import is not None and req._prefill_pos:
+        if swapped_in:
+            pass    # swap-in counters were recorded in the branch above
+        elif req.kv_import is not None and req._prefill_pos:
             self._count("serving/kv_import")
             self._count("serving/kv_import_tokens", req._prefill_pos)
         elif cache is not None and req.prefix_hit_tokens == 0 \
@@ -765,6 +814,9 @@ class LifecycleScheduler:
         self.eng.flush([req.uid])
         if self.drafter is not None:
             self.drafter.flush(req.uid)
+        ksw = getattr(self.eng, "kv_swap", None)
+        if ksw is not None:
+            ksw.drop(req.uid)
         req.state = RequestState.FINISHED
         req.finish_reason = "eos" if (
             self.eos_token_id is not None and req.produced

@@ -160,6 +160,17 @@ class PageHeatTracker:
         this equals the allocator's non-free set at every settle point."""
         return set(int(b) for b in np.nonzero(self._live)[0])
 
+    def page_ages_for(self, blocks) -> np.ndarray:
+        """Ages (windows since last touch) for ``blocks``; -1 for free
+        pages.  The host-tier spiller ranks a victim's pages with this
+        (coldest first) before exporting."""
+        b = np.asarray(list(blocks), dtype=np.int64)
+        ages = np.full(b.size, -1, dtype=np.int64)
+        if b.size:
+            live = self._live[b]
+            ages[live] = self.window - self._last[b[live]]
+        return ages
+
     def cold_pages(self, age_threshold: int) -> int:
         idx = np.nonzero(self._live)[0]
         if idx.size == 0:

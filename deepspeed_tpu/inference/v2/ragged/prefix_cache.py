@@ -75,6 +75,11 @@ class RadixPrefixCache:
         self._root = _Node(tokens=(), block=-1, claim=0, parent=None)
         self._clock = itertools.count(1)
         self._nodes = 0
+        #: optional spill hook (KVSwapManager.spill_prefix_node when a host
+        #: tier is configured): called with the node just before its page
+        #: is freed, so eviction parks shared prefixes host-side instead of
+        #: dropping them
+        self.spill_fn = None
         # cumulative stats (mirrored into serving/* counters by the
         # lifecycle scheduler; read directly by tests)
         self.hits = 0
@@ -285,6 +290,12 @@ class RadixPrefixCache:
 
     def _drop(self, node: _Node) -> None:
         assert not node.children, "evicting an interior node"
+        if self.spill_fn is not None:
+            try:
+                self.spill_fn(node)       # reads the page while it's live
+            except Exception as e:        # spill is best-effort: eviction
+                logger.warning(           # must proceed regardless
+                    f"prefix cache: host spill failed ({e}); dropping")
         del node.parent.children[node.tokens]
         self.allocator.free([node.block])
         self._nodes -= 1

@@ -676,7 +676,8 @@ def tiny_engine_config(args):
         max_ctx=args.max_ctx, block_size=args.block_size,
         num_blocks=args.num_blocks, dtype=jnp.float32,
         attn_impl=args.attn_impl,
-        prefix_cache=getattr(args, "prefix_cache", False))
+        prefix_cache=getattr(args, "prefix_cache", False),
+        host_tier_mb=getattr(args, "host_tier_mb", 0.0))
 
 
 def build_tiny_engine(args):
@@ -719,6 +720,10 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "shared across requests (refcounts + copy-on-write;"
                         " multi-tenant traffic with a common system prompt "
                         "skips its prefill)")
+    p.add_argument("--host-tier-mb", type=float, default=0.0,
+                   help="host-DRAM page tier capacity in MB (0 = off); "
+                        "KV-pressure preemption then swaps cold pages out "
+                        "instead of evicting, and resume is an H2D copy")
     p.add_argument("--queue-cap", type=int, default=64,
                    help="admission queue bound; beyond it requests are "
                         "shed with 429 + Retry-After")

@@ -34,6 +34,11 @@ class DeviceSpec:
     #: magnitude below ICI on every generation — which is exactly why the
     #: CollectiveAlgoSelector quantizes the inter-slice hop.
     dcn_bandwidth: float = 0.0
+    #: approximate host<->device (PCIe) bytes/s per chip, one direction —
+    #: the denominator for the host memory tier: optimizer-offload
+    #: prefetch time, KV swap-in/out cost, and the ``overlap:"auto"``
+    #: decision of what can live host-side without exposing transfer time
+    host_bandwidth: float = 0.0
 
     @property
     def ridge_intensity(self) -> float:
@@ -43,18 +48,18 @@ class DeviceSpec:
 
 #: ordered: first substring match against device_kind wins
 DEVICE_SPECS = (
-    DeviceSpec("TPU v6 lite", 918e12, 1640e9, 448e9, 25e9),   # Trillium
-    DeviceSpec("TPU v6", 918e12, 1640e9, 448e9, 25e9),
-    DeviceSpec("TPU v5p", 459e12, 2765e9, 600e9, 25e9),
-    DeviceSpec("TPU v5 lite", 197e12, 819e9, 200e9, 12.5e9),  # v5e → "v5 lite"
-    DeviceSpec("TPU v5e", 197e12, 819e9, 200e9, 12.5e9),
-    DeviceSpec("TPU v4", 275e12, 1228e9, 300e9, 12.5e9),
-    DeviceSpec("TPU v3", 123e12, 900e9, 82e9, 6e9),
+    DeviceSpec("TPU v6 lite", 918e12, 1640e9, 448e9, 25e9, 64e9),  # Trillium
+    DeviceSpec("TPU v6", 918e12, 1640e9, 448e9, 25e9, 64e9),
+    DeviceSpec("TPU v5p", 459e12, 2765e9, 600e9, 25e9, 32e9),
+    DeviceSpec("TPU v5 lite", 197e12, 819e9, 200e9, 12.5e9, 32e9),
+    DeviceSpec("TPU v5e", 197e12, 819e9, 200e9, 12.5e9, 32e9),
+    DeviceSpec("TPU v4", 275e12, 1228e9, 300e9, 12.5e9, 16e9),
+    DeviceSpec("TPU v3", 123e12, 900e9, 82e9, 6e9, 16e9),
 )
 
 #: conservative stand-in so CPU smoke runs produce finite (clearly labelled)
 #: utilization numbers instead of dividing by zero
-CPU_FALLBACK = DeviceSpec("cpu", 1e12, 100e9, 10e9, 1e9)
+CPU_FALLBACK = DeviceSpec("cpu", 1e12, 100e9, 10e9, 1e9, 10e9)
 
 
 def spec_for_kind(kind: str) -> DeviceSpec:
@@ -73,6 +78,15 @@ def interconnect_peak(kind: str) -> float:
     return spec_for_kind(kind).ici_bandwidth
 
 
+def host_transfer_seconds(nbytes: float,
+                          spec: Optional[DeviceSpec] = None) -> float:
+    """Predicted one-direction host<->device transfer time for ``nbytes``
+    over PCIe — the swap-cost model: what a KV swap-in adds to a resume,
+    and what an offload prefetch must hide under a step."""
+    spec = spec or device_spec()
+    return float(nbytes) / max(spec.host_bandwidth, 1.0)
+
+
 def device_spec(device: Any = None) -> DeviceSpec:
     """Spec for ``device`` (default: first visible device).  Unknown TPU
     kinds get the v5e numbers (the most common fleet chip) with a warning;
@@ -88,7 +102,7 @@ def device_spec(device: Any = None) -> DeviceSpec:
     if getattr(device, "platform", "cpu") == "tpu":
         logger.warning(f"no roofline spec for device kind {kind!r}; "
                        f"assuming TPU v5e peaks")
-        return DeviceSpec(kind, 197e12, 819e9, 200e9)
+        return DeviceSpec(kind, 197e12, 819e9, 200e9, 12.5e9, 32e9)
     return dataclasses.replace(CPU_FALLBACK, kind=kind)
 
 

@@ -191,6 +191,7 @@ class DeepSpeedEngine:
         # fraction stays in HBM, the trailing ratio streams from pinned
         # host at step time (zero/twin_flow.py).
         self._twin_flow_bytes = None
+        self._offload_prefetcher = None
         if config.zero_config.offload_optimizer_device() == "cpu":
             ratio = float(config.zero_config.offload_optimizer.ratio)
             if 0.0 < ratio < 1.0:
@@ -202,6 +203,17 @@ class DeepSpeedEngine:
             else:
                 opt_shardings = jax.tree.map(self._to_host_memory,
                                              opt_shardings)
+            # offload_optimizer.pipeline_read: double-buffer the host
+            # partition toward the device between steps (ZeRO-Infinity's
+            # pipelined swap-in) so the H2D leg hides under fwd/bwd instead
+            # of serializing before the sharded update.  A no-op on CPU sim
+            # (bitwise-identity — the offload-vs-resident loss equality
+            # test rides that).
+            if config.zero_config.offload_optimizer is not None and \
+                    config.zero_config.offload_optimizer.pipeline_read:
+                from .swap_tensor.host_tier import HostOffloadPrefetcher
+
+                self._offload_prefetcher = HostOffloadPrefetcher()
         opt_state = jax.jit(self.optimizer.init, out_shardings=opt_shardings)(params)
 
         gas = config.gradient_accumulation_steps
@@ -922,6 +934,14 @@ class DeepSpeedEngine:
         injector = fault_injection.get_injector()
         if injector is not None:   # don't pay the global_steps sync otherwise
             injector.inject("step", step=self.global_steps)
+        # offload pipeline_read: issue the async H2D stage of the host
+        # optimizer partition BEFORE dispatch so it lands under fwd/bwd;
+        # identity on CPU sim / injected offload fault (update then reads
+        # the host partition directly — correct, just unoverlapped)
+        if self._offload_prefetcher is not None:
+            staged = self._offload_prefetcher.arm(self.state.opt_state)
+            if staged is not self.state.opt_state:
+                self.state = self.state.replace(opt_state=staged)
         # Device-time attribution (reference: CUDA-event comms timing;
         # comms_logger.xprof_step): wrap ONE step in an xprof trace — per-op
         # device durations, collectives included.  A wrapper, not a separate
@@ -1362,6 +1382,37 @@ class DeepSpeedEngine:
         self._heartbeat("idle")
         log_dist(f"loaded checkpoint {load_dir}/{tag}", ranks=[0])
         return os.path.join(load_dir, str(tag)), payload.get("client_state", {})
+
+    # ------------------------------------------------------------------ #
+    # Memory observability (telemetry/memory.py MemoryLedger plumbing)
+    # ------------------------------------------------------------------ #
+    def register_memory_sources(self, ledger) -> None:
+        """Attribute this engine's bytes to the
+        :class:`~..telemetry.memory.MemoryLedger` buckets (training-side
+        mirror of ``InferenceEngineV2.register_memory_sources``): params,
+        the optimizer partition split into its device-resident
+        (``optimizer_state``) and host-staged (``host_optimizer``) halves
+        per the Twin-Flow byte split, and the deferred-reduction gradient
+        accumulation buffer."""
+        def _tree_bytes(tree) -> int:
+            return int(sum(int(getattr(x, "nbytes", 0) or 0)
+                           for x in jax.tree_util.tree_leaves(tree)))
+
+        def _opt_split():
+            total = _tree_bytes(self.state.opt_state)
+            if self._twin_flow_bytes is not None:
+                dev_b, host_b = self._twin_flow_bytes()
+                return int(dev_b), int(host_b)
+            if self.config.zero_config.offload_optimizer_device() == "cpu":
+                return 0, total   # full offload: everything host-side
+            return total, 0
+
+        ledger.register_source(
+            "params", lambda: _tree_bytes(self.state.params))
+        ledger.register_source("optimizer_state", lambda: _opt_split()[0])
+        ledger.register_source("host_optimizer", lambda: _opt_split()[1])
+        ledger.register_source(
+            "grad_acc", lambda: _tree_bytes(self.state.grad_acc))
 
     # ------------------------------------------------------------------ #
     # State offload (reference: engine.offload_states :3844 / reload_states

@@ -39,7 +39,14 @@ Fields: ``site`` (required), ``kind`` — one of
   * ``controller_crash``  raise :class:`InjectedControllerCrash`; the
                   ``dstpu-fleet`` control loop must die mid-tick and prove
                   it rebuilds its fleet model from live ``/healthz``
-                  scrapes alone (no state file) —
+                  scrapes alone (no state file),
+  * ``kv_swap``   raise :class:`InjectedSwapFailure`; the host-tier KV
+                  swap path (spill or restore) must fall back to the
+                  pre-tier behavior — evict + prefill recompute — with the
+                  stream still bit-exact,
+  * ``offload``   raise :class:`InjectedOffloadFailure`; the optimizer
+                  host-offload prefetcher must skip the staged transfer
+                  and let the update consume the host partition directly —
 
 plus ``p`` (fire probability, default 1), ``times`` (max fires per process),
 ``steps`` (only fire at these step numbers: ``3`` | ``3-5`` | ``3|7|9``),
@@ -55,6 +62,20 @@ monotonically increasing decode-window index):
   * ``kv_alloc`` (kind ``exhausted``) — fires when the block allocator is
     asked for NEW blocks (no-op allocations never fire), simulating a
     transiently exhausted KV pool.
+
+Host-tier sites (wired through ``runtime/swap_tensor`` +
+``inference/v2/ragged/kv_swap``):
+
+  * ``host_alloc`` (kind ``exhausted``) — fires when the host page tier
+    allocates a staging buffer for an incoming spill: the put is rejected
+    and the caller takes the evict path;
+  * ``kv_swap_out`` (kinds ``kv_swap``/``io_error``/``slow``) — fires at
+    D2H issue, when a victim's pages are exported toward the host tier;
+  * ``kv_swap_in`` (kinds ``kv_swap``/``io_error``/``slow``) — fires at
+    H2D resume, before spilled rows are grafted back into fresh pages;
+  * ``offload_prefetch`` (kinds ``offload``/``slow``) — fires when the
+    optimizer host-offload prefetcher stages the pinned-host partition
+    toward the device ahead of the sharded update.
 
 Fleet sites (wired through ``serving/fleet``):
 
@@ -99,7 +120,8 @@ except ImportError:  # loaded standalone, outside the package
 
 ENV_VAR = "DSTPU_FAULT_INJECT"
 KINDS = ("io_error", "slow", "truncate", "kill", "shard_missing", "nan",
-         "exhausted", "replica_down", "net_partition", "controller_crash")
+         "exhausted", "replica_down", "net_partition", "controller_crash",
+         "kv_swap", "offload")
 
 
 class InjectedNaN(ArithmeticError):
@@ -131,6 +153,21 @@ class InjectedControllerCrash(RuntimeError):
     control loop must abandon the tick, drop ALL derived state
     (hysteresis windows, cooldown clocks), and rebuild its fleet model
     from the next live ``/healthz`` scrape."""
+
+
+class InjectedSwapFailure(RuntimeError):
+    """Raised by the ``kv_swap`` kind at the ``kv_swap_out``/``kv_swap_in``
+    sites: the host-tier transfer failed mid-flight.  The swap machinery
+    must fall back to the pre-tier semantics — spill becomes a plain evict,
+    restore becomes a prefill recompute — and the resumed greedy stream
+    must stay bit-exact either way."""
+
+
+class InjectedOffloadFailure(RuntimeError):
+    """Raised by the ``offload`` kind at the ``offload_prefetch`` site:
+    the staged H2D transfer of the host optimizer partition failed.  The
+    prefetcher must skip the stage and let the compiled update read the
+    pinned-host partition directly (correct, just unoverlapped)."""
 
 
 def truncate_file(path: str, nbytes: int = 0) -> None:
@@ -289,6 +326,14 @@ class FaultInjector:
             logger.warning(f"fault injection: controller crash at {where}")
             raise InjectedControllerCrash(f"injected controller crash at "
                                           f"{where}")
+        if spec.kind == "kv_swap":
+            logger.warning(f"fault injection: KV swap failure at {where}")
+            raise InjectedSwapFailure(f"injected KV swap failure at {where}")
+        if spec.kind == "offload":
+            logger.warning(f"fault injection: offload prefetch failure at "
+                           f"{where}")
+            raise InjectedOffloadFailure(f"injected offload failure at "
+                                         f"{where}")
         if spec.kind == "kill":
             logger.warning(f"fault injection: killing process at {where}")
             os._exit(spec.exit_code)

@@ -37,6 +37,10 @@ class AutoTuneDecision:
     #: producing-GEMM compute estimate to hide the exchange behind),
     #: present when the caller supplied a CollectiveAlgoSelector
     comm: Optional[Any] = None
+    #: host-offload placement plan (``plan_host_offload``), present when
+    #: the caller supplied optimizer-state geometry + a DeviceSpec with
+    #: ``host_bandwidth``
+    offload: Optional["HostOffloadPlan"] = None
 
     def as_event(self) -> Dict[str, Any]:
         out = {
@@ -47,7 +51,67 @@ class AutoTuneDecision:
         }
         if self.comm is not None:
             out["comm"] = self.comm.as_event()
+        if self.offload is not None:
+            out["offload"] = self.offload.as_event()
         return out
+
+
+@dataclasses.dataclass(frozen=True)
+class HostOffloadPlan:
+    """What should live host-side: the ``offload_optimizer`` ratio the
+    roofline's PCIe model says the step can hide."""
+
+    ratio: float                # fraction of optimizer bytes host-side
+    host_bytes: int
+    transfer_s: float           # predicted one-way PCIe time per step
+    hidden: bool                # transfer fits under the compute step
+    reason: str
+
+    def as_event(self) -> Dict[str, Any]:
+        return {"ratio": round(self.ratio, 4),
+                "host_bytes": int(self.host_bytes),
+                "transfer_s": round(self.transfer_s, 6),
+                "hidden": self.hidden, "reason": self.reason}
+
+
+def plan_host_offload(spec: Any, opt_bytes: float, hbm_budget_bytes: float,
+                      step_seconds: float,
+                      hide_fraction: float = 0.5) -> HostOffloadPlan:
+    """Decide how much optimizer state can live in host DRAM.
+
+    ``spec`` is a :class:`~...profiling.roofline.DeviceSpec` (its
+    ``host_bandwidth`` is the PCIe model); ``opt_bytes`` the full
+    optimizer-state footprint; ``hbm_budget_bytes`` what HBM can spare for
+    resident optimizer state; ``step_seconds`` the measured (or predicted)
+    compute step the prefetch must hide under.  The plan offloads at least
+    what HBM cannot hold, then grows the host share while the per-step
+    PCIe transfer stays under ``hide_fraction`` of the step — past that
+    the transfer would expose and ``offload_optimizer.ratio`` should stop.
+    """
+    opt_bytes = max(float(opt_bytes), 0.0)
+    if opt_bytes <= 0:
+        return HostOffloadPlan(0.0, 0, 0.0, True, "no optimizer state")
+    bw = max(float(getattr(spec, "host_bandwidth", 0.0)), 1.0)
+    forced = max(0.0, opt_bytes - max(float(hbm_budget_bytes), 0.0))
+    # bytes/step the PCIe leg can move without exposing transfer time
+    hideable = bw * max(float(step_seconds), 0.0) * float(hide_fraction)
+    host_bytes = min(opt_bytes, max(forced, hideable))
+    ratio = host_bytes / opt_bytes
+    transfer_s = host_bytes / bw
+    hidden = transfer_s <= max(float(step_seconds), 0.0) * hide_fraction \
+        + 1e-12
+    if forced > hideable:
+        reason = (f"HBM forces {forced / 1e6:.1f}MB host-side; predicted "
+                  f"{transfer_s * 1e3:.2f}ms/step PCIe "
+                  f"{'hides' if hidden else 'EXPOSES'} under the "
+                  f"{step_seconds * 1e3:.2f}ms step")
+    else:
+        reason = (f"PCIe can hide {hideable / 1e6:.1f}MB/step at "
+                  f"{bw / 1e9:.0f}GB/s: offloading "
+                  f"{host_bytes / 1e6:.1f}MB ({ratio:.0%})")
+    return HostOffloadPlan(ratio=ratio, host_bytes=int(host_bytes),
+                           transfer_s=transfer_s, hidden=hidden,
+                           reason=reason)
 
 
 def exposed_comm_fraction(xprof_report: Dict[str, Any]) -> Optional[float]:
@@ -76,10 +140,16 @@ def autotune(xprof_report: Optional[Dict[str, Any]],
              grad_bytes: float,
              comm_threshold: float = 0.05,
              target_buckets: int = 8,
-             comm_selector: Optional[Any] = None) -> AutoTuneDecision:
+             comm_selector: Optional[Any] = None,
+             offload_spec: Optional[Any] = None,
+             opt_bytes: float = 0.0,
+             hbm_budget_bytes: float = 0.0,
+             step_seconds: float = 0.0) -> AutoTuneDecision:
     """Pick deferred-reduction and bucket-size settings (and, when a
     :class:`~..comm.hierarchical.CollectiveAlgoSelector` is supplied, the
-    per-bucket collective algorithm + wire format).
+    per-bucket collective algorithm + wire format; and, when
+    ``offload_spec`` + optimizer geometry are supplied, the host-offload
+    placement plan).
 
     ``xprof_report``: device-time attribution of one captured step (or
     None before any capture).  ``grad_bytes``: fp32 gradient wire volume
@@ -90,19 +160,22 @@ def autotune(xprof_report: Optional[Dict[str, Any]],
     frac = exposed_comm_fraction(xprof_report) if xprof_report else None
     comm = comm_selector.select(bucket, exposed_comm_fraction=frac) \
         if comm_selector is not None else None
+    offload = plan_host_offload(offload_spec, opt_bytes, hbm_budget_bytes,
+                                step_seconds) \
+        if offload_spec is not None and opt_bytes > 0 else None
     if frac is None:
         return AutoTuneDecision(
             deferred=True, bucket_bytes=bucket, exposed_comm_fraction=None,
             reason="no xprof capture yet: size heuristic only, deferred on",
-            comm=comm)
+            comm=comm, offload=offload)
     if frac < comm_threshold:
         return AutoTuneDecision(
             deferred=False, bucket_bytes=bucket, exposed_comm_fraction=frac,
             reason=f"comm fraction {frac:.3f} < threshold {comm_threshold}: "
                    f"not worth the deferred gradient buffer",
-            comm=comm)
+            comm=comm, offload=offload)
     return AutoTuneDecision(
         deferred=True, bucket_bytes=bucket, exposed_comm_fraction=frac,
         reason=f"comm fraction {frac:.3f} >= threshold {comm_threshold}: "
                f"deferring reduction, {target_buckets}-launch buckets",
-        comm=comm)
+        comm=comm, offload=offload)

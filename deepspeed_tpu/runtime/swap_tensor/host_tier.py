@@ -1,0 +1,225 @@
+"""Host-DRAM page tier: the staging layer between HBM and (later) NVMe.
+
+This is the TPU analogue of DeepSpeed's ``swap_tensor`` host buffers
+(``AsyncPartitionedParameterSwapper``'s pinned buffer pool): a bounded,
+LRU-evicting dictionary of canonical-row page payloads living in host
+memory, fed by double-buffered D2H transfers and drained by H2D copies at
+resume time.  Two consumers share it:
+
+* serving — :class:`~deepspeed_tpu.inference.v2.ragged.kv_swap.KVSwapManager`
+  parks preempted sequences' cold KV pages (and spilled radix-prefix pages)
+  here so resume is an H2D copy + page-table patch instead of a prefill
+  recompute;
+* training — :class:`HostOffloadPrefetcher` stages the pinned-host
+  optimizer partition toward the device ahead of the sharded update
+  (``zero_optimization.offload_optimizer.pipeline_read``).
+
+Double buffering rides the PR-4 ``GatherWindowCache`` pattern
+(:mod:`deepspeed_tpu.runtime.overlap.prefetch`): a ``put`` issues the
+device→host copy asynchronously (``copy_to_host_async`` when the payload
+is still a jax array) and parks it in a one-slot pending buffer; the NEXT
+``put`` (or an explicit :meth:`HostPageTier.sync`) materializes the
+previous transfer, by which point the DMA has progressed under compute.
+On the CPU simulator every copy is synchronous and the tier degrades to a
+plain bounded dict — bit-exactness tests run there.
+
+Fault sites (see :mod:`deepspeed_tpu.runtime.fault.injection`):
+``host_alloc`` at buffer admission, ``kv_swap_out`` at D2H issue,
+``offload_prefetch`` at the prefetcher's H2D arm.
+"""
+from __future__ import annotations
+
+from collections import OrderedDict
+from typing import Any, Dict, Hashable, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ...utils.logging import logger
+from ..fault import injection
+
+
+class HostPageTier:
+    """Bounded host-memory store of canonical-row page payloads.
+
+    Keys are arbitrary hashables (the KV swap manager uses
+    ``("kv", uid)`` / ``("prefix", token_path)``); values are float32
+    numpy arrays in the ``kv_ship`` canonical row layout.  Capacity is
+    enforced in bytes with LRU eviction; a payload larger than the whole
+    tier is rejected outright.
+    """
+
+    def __init__(self, capacity_bytes: int, name: str = "host_kv"):
+        self.capacity_bytes = int(capacity_bytes)
+        self.name = name
+        self._store: "OrderedDict[Hashable, np.ndarray]" = OrderedDict()
+        self._pending: Optional[Tuple[Hashable, Any]] = None
+        self.used_bytes = 0
+        self.puts = 0
+        self.evictions = 0
+        self.rejects = 0
+        self.swap_out_bytes = 0
+
+    # -- internal -----------------------------------------------------
+
+    def _materialize(self, key: Hashable, payload: Any) -> None:
+        """Drain a pending D2H transfer into the store (second buffer
+        slot).  ``np.asarray`` blocks until the async copy has landed."""
+        rows = np.asarray(payload, dtype=np.float32)
+        self._store[key] = rows
+        self._store.move_to_end(key)
+        self.used_bytes += rows.nbytes
+        self.swap_out_bytes += rows.nbytes
+
+    def _evict_until(self, need: int) -> None:
+        while self._store and self.capacity_bytes - self.used_bytes < need:
+            old_key, old_rows = self._store.popitem(last=False)
+            self.used_bytes -= old_rows.nbytes
+            self.evictions += 1
+            logger.info("host tier %s: evicted %s (%d bytes) for incoming "
+                        "spill", self.name, old_key, old_rows.nbytes)
+
+    # -- public -------------------------------------------------------
+
+    @property
+    def free_bytes(self) -> int:
+        return max(0, self.capacity_bytes - self.used_bytes)
+
+    def put(self, key: Hashable, rows: Any) -> bool:
+        """Admit ``rows`` under ``key``.  Returns False when the payload
+        cannot fit (too large, or host_alloc fault injected); raises the
+        ``kv_swap_out`` site's fault (InjectedSwapFailure / OSError) so
+        the caller can take the evict+recompute fallback."""
+        try:
+            injection.inject("host_alloc")
+        except injection.InjectedExhausted:
+            self.rejects += 1
+            logger.warning("host tier %s: injected host_alloc exhaustion, "
+                           "rejecting %s", self.name, key)
+            return False
+        injection.inject("kv_swap_out")
+
+        nbytes = int(rows.nbytes if hasattr(rows, "nbytes")
+                     else np.asarray(rows).nbytes)
+        if nbytes > self.capacity_bytes:
+            self.rejects += 1
+            return False
+        # Drain the previous pending transfer first (its DMA has had a full
+        # put-interval to progress), then issue this one asynchronously.
+        self.sync()
+        self.discard(key)
+        self._evict_until(nbytes)
+        if hasattr(rows, "copy_to_host_async"):
+            try:
+                rows.copy_to_host_async()
+            except Exception:  # CPU backend / already-host arrays
+                pass
+        self._pending = (key, rows)
+        self.puts += 1
+        return True
+
+    def sync(self) -> None:
+        """Drain the in-flight D2H transfer, if any."""
+        if self._pending is not None:
+            key, payload = self._pending
+            self._pending = None
+            self._materialize(key, payload)
+
+    def get(self, key: Hashable) -> Optional[np.ndarray]:
+        """Pure lookup (no hit/miss accounting — the caller confirms the
+        use, mirroring the prefix cache's note_hit idiom)."""
+        self.sync()
+        rows = self._store.get(key)
+        if rows is not None:
+            self._store.move_to_end(key)
+        return rows
+
+    def pop(self, key: Hashable) -> Optional[np.ndarray]:
+        self.sync()
+        rows = self._store.pop(key, None)
+        if rows is not None:
+            self.used_bytes -= rows.nbytes
+        return rows
+
+    def discard(self, key: Hashable) -> None:
+        if self._pending is not None and self._pending[0] == key:
+            self._pending = None
+            return
+        rows = self._store.pop(key, None)
+        if rows is not None:
+            self.used_bytes -= rows.nbytes
+
+    def __contains__(self, key: Hashable) -> bool:
+        self.sync()
+        return key in self._store
+
+    def __len__(self) -> int:
+        self.sync()
+        return len(self._store)
+
+    def stats(self) -> Dict[str, int]:
+        return {
+            "capacity_bytes": self.capacity_bytes,
+            "used_bytes": self.used_bytes,
+            "entries": len(self._store) + (1 if self._pending else 0),
+            "puts": self.puts,
+            "evictions": self.evictions,
+            "rejects": self.rejects,
+            "swap_out_bytes": self.swap_out_bytes,
+        }
+
+
+class HostOffloadPrefetcher:
+    """Stages the host-resident optimizer partition toward the device
+    ahead of the sharded update (``offload_optimizer.pipeline_read``).
+
+    On TPU the arm is a real async H2D ``jax.device_put`` into device
+    memory kind, issued between steps so the transfer hides under the
+    forward/backward; on the CPU simulator placement is a no-op and the
+    staged tree is the SAME tree (bitwise identity — the offload-vs-
+    resident loss equality test runs there).  An injected ``offload``
+    fault skips the stage: the update then reads the pinned-host
+    partition directly — correct, just unoverlapped.
+    """
+
+    def __init__(self) -> None:
+        self.arms = 0
+        self.failures = 0
+        self.bytes_staged = 0
+        self._is_tpu = jax.default_backend() == "tpu"
+
+    def arm(self, tree: Any) -> Any:
+        """Issue the H2D stage for ``tree``; returns the staged tree (the
+        input tree unchanged on CPU or on injected failure)."""
+        try:
+            injection.inject("offload_prefetch")
+        except injection.InjectedOffloadFailure:
+            self.failures += 1
+            logger.warning("offload prefetch: injected failure, update will "
+                           "read the host partition unstaged")
+            return tree
+        self.arms += 1
+
+        def _nbytes(leaf: Any) -> int:
+            return int(getattr(leaf, "nbytes", 0) or 0)
+
+        self.bytes_staged += sum(
+            _nbytes(x) for x in jax.tree_util.tree_leaves(tree))
+        if not self._is_tpu:
+            return tree
+
+        def _stage(leaf: Any) -> Any:
+            sharding = getattr(leaf, "sharding", None)
+            if sharding is None or getattr(leaf, "ndim", 0) == 0:
+                return leaf
+            try:
+                return jax.device_put(
+                    leaf, sharding.with_memory_kind("device"))
+            except Exception:
+                return leaf
+
+        return jax.tree_util.tree_map(_stage, tree)
+
+    def stats(self) -> Dict[str, int]:
+        return {"arms": self.arms, "failures": self.failures,
+                "bytes_staged": self.bytes_staged}

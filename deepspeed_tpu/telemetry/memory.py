@@ -47,7 +47,15 @@ from typing import Any, Callable, Dict, Iterable, List, Optional
 #: the closed bucket axis — non-overlapping by contract; every registered
 #: source claims bytes in exactly one bucket
 MEM_BUCKETS = ("params", "optimizer_state", "grad_acc", "kv_pages",
-               "decode_workspace", "loco_residuals", "other")
+               "decode_workspace", "loco_residuals",
+               "host_kv", "host_optimizer", "other")
+
+#: buckets whose bytes live OUTSIDE ``jax.live_arrays`` (host-tier numpy
+#: buffers) — reported and gauged like any bucket, but excluded from the
+#: conservation sum, which judges device-side attribution only.  The
+#: ``host_optimizer`` bucket stays IN conservation: twin-flow host halves
+#: are jax arrays (pinned_host memory kind) on every backend.
+NON_DEVICE_BUCKETS = ("host_kv",)
 
 #: conservation bound: unattributed bytes beyond this fraction of live
 #: bytes mean the ledger's sources have drifted from reality
@@ -147,6 +155,7 @@ class MemoryLedger:
         self._sources: Dict[str, List[Callable[[], int]]] = \
             {b: [] for b in MEM_BUCKETS}
         self._kv_fn: Optional[Callable[[], Optional[Dict]]] = None
+        self._swap_fn: Optional[Callable[[], Optional[Dict]]] = None
         self._baseline_other = 0
         self._was_conserved = True
         self.unattributed_incidents = 0
@@ -169,6 +178,13 @@ class MemoryLedger:
         every snapshot; None while tracking is off)."""
         self._kv_fn = fn
 
+    def attach_swap(self, fn: Callable[[], Optional[Dict]]) -> None:
+        """Attach the KV swap manager's stats provider (``swap`` section:
+        hit rate, swap in/out bytes, avoided recompute tokens — the live
+        numbers ``dstpu-mem --validate`` checks against the what-if
+        prediction)."""
+        self._swap_fn = fn
+
     def capture_baseline(self) -> int:
         """Fold bytes that pre-date this ledger's sources (JAX runtime
         constants, other components' arrays) into ``other`` once, so
@@ -182,7 +198,9 @@ class MemoryLedger:
         total = 0
         with self._lock:
             sources = {b: list(fns) for b, fns in self._sources.items()}
-        for fns in sources.values():
+        for b, fns in sources.items():
+            if b in NON_DEVICE_BUCKETS:
+                continue
             for fn in fns:
                 try:
                     total += int(fn() or 0)
@@ -209,7 +227,8 @@ class MemoryLedger:
             buckets[b] = total
         buckets["other"] += baseline
         live = int(raw.get("live_array_bytes", 0) or 0)
-        attributed = sum(buckets.values())
+        attributed = sum(v for b, v in buckets.items()
+                         if b not in NON_DEVICE_BUCKETS)
         unattributed = live - attributed
         denom = max(live, 1)
         snap: Dict[str, Any] = {
@@ -234,6 +253,13 @@ class MemoryLedger:
                 kv = None
             if kv:
                 snap["kv"] = kv
+        if self._swap_fn is not None:
+            try:
+                swap = self._swap_fn()
+            except Exception:
+                swap = None
+            if swap:
+                snap["swap"] = swap
         return snap
 
     # ------------------------------------------------------------------ #
@@ -268,6 +294,12 @@ class MemoryLedger:
                     m.gauge("mem/kv_cold_pages").set(n, age_windows=str(thr))
                 for t, d in kv.get("tenants", {}).items():
                     m.gauge("mem/tenant_kv_bytes").set(d["bytes"], tenant=t)
+            swap = snap.get("swap")
+            if swap:
+                m.gauge("mem/swap_in_bytes").set(swap["swap_in_bytes"])
+                m.gauge("mem/swap_out_bytes").set(swap["swap_out_bytes"])
+                m.gauge("mem/swap_hit_rate").set(
+                    round(float(swap["hit_rate"]), 6))
         if not snap["conserved"] and self._was_conserved:
             self.unattributed_incidents += 1
             if tel is not None:
@@ -299,6 +331,13 @@ def rollup(snapshots: Iterable[Optional[Dict[str, Any]]],
     kv_cold: Dict[str, int] = {}
     tenants: Dict[str, int] = {}
     kv_seen = False
+    swap_seen = False
+    swap_sum: Dict[str, float] = {"swapped_out": 0, "swapped_in": 0,
+                                  "misses": 0, "swap_in_bytes": 0,
+                                  "swap_out_bytes": 0,
+                                  "avoided_recompute_tokens": 0,
+                                  "host_used_bytes": 0,
+                                  "host_capacity_bytes": 0}
     for s in snapshots:
         if not isinstance(s, dict) or "live_bytes" not in s:
             continue                  # not a ledger snapshot at all
@@ -322,6 +361,11 @@ def rollup(snapshots: Iterable[Optional[Dict[str, Any]]],
                 for t, d in (kv.get("tenants") or {}).items():
                     tenants[str(t)] = tenants.get(str(t), 0) + \
                         int((d or {}).get("bytes") or 0)
+            swap = s.get("swap")
+            if isinstance(swap, dict):
+                swap_seen = True
+                for k in swap_sum:
+                    swap_sum[k] += int(swap.get(k) or 0)
         except (TypeError, ValueError, AttributeError):
             continue
     denom = max(live, 1)
@@ -347,6 +391,11 @@ def rollup(snapshots: Iterable[Optional[Dict[str, Any]]],
             "tenants": {t: {"bytes": v}
                         for t, v in sorted(tenants.items())},
         }
+    if swap_seen:
+        hits = swap_sum["swapped_in"]
+        total = hits + swap_sum["misses"]
+        out["swap"] = {**{k: int(v) for k, v in swap_sum.items()},
+                       "hit_rate": hits / max(1, total) if total else 1.0}
     return out
 
 

@@ -237,6 +237,44 @@ def what_if_spill(events: Sequence[Dict[str, Any]],
     return rows
 
 
+def validate_swap(snap: Dict[str, Any],
+                  events: Sequence[Dict[str, Any]],
+                  thresholds: Optional[Sequence[int]] = None,
+                  factor: float = 1.5) -> Dict[str, Any]:
+    """``--validate``: judge the LIVE spiller's measured hit rate against
+    the what-if prediction computed from the same heat trace at the
+    tier's actual capacity.  Passes when the ratio measured/predicted is
+    within ``[1/factor, factor]`` — the estimator earned its keep if the
+    spiller it sized lands near its forecast.  Returns a verdict dict
+    (``ok``/``measured``/``predicted``/``ratio``/``reason``)."""
+    swap = snap.get("swap")
+    if not isinstance(swap, dict):
+        return {"ok": False, "reason": "no swap section in /memory "
+                                       "snapshot (host tier off?)"}
+    measured = float(swap.get("hit_rate") or 0.0)
+    cap_mb = float(swap.get("host_capacity_bytes") or 0) / MB
+    rows = what_if_spill(events, thresholds=thresholds,
+                         host_mb=[max(cap_mb, 0.01)])
+    if not rows:
+        return {"ok": False, "reason": "no usable kv_heat events in the "
+                                       "trace (nothing to predict from)"}
+    # smallest threshold = largest cold set = the conservative forecast
+    row = min(rows, key=lambda r: r["age_threshold"])
+    predicted = max(float(row["est_hit_rate"]), 1e-6)
+    ratio = measured / predicted
+    ok = (1.0 / factor) <= ratio <= factor
+    return {"ok": ok, "measured": round(measured, 4),
+            "predicted": round(predicted, 4), "ratio": round(ratio, 4),
+            "factor": float(factor), "host_mb": round(cap_mb, 3),
+            "age_threshold": row["age_threshold"],
+            "swapped_in": int(swap.get("swapped_in") or 0),
+            "misses": int(swap.get("misses") or 0),
+            "reason": "measured within factor of prediction" if ok else
+                      f"measured {measured:.3f} vs predicted "
+                      f"{predicted:.3f} (ratio {ratio:.2f} outside "
+                      f"[{1 / factor:.2f}, {factor:.2f}])"}
+
+
 def render_what_if(rows: Sequence[Dict[str, Any]]) -> List[str]:
     if not rows:
         return []
@@ -278,9 +316,20 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                    help="comma-separated candidate host-tier sizes (MB)")
     p.add_argument("--json", dest="json_out",
                    help="also write the machine-readable report here")
+    p.add_argument("--validate", action="store_true",
+                   help="compare the live spiller's measured swap hit "
+                        "rate (--url /memory swap section) against the "
+                        "what-if prediction from TELEMETRY_DIR's heat "
+                        "trace; exit 1 when outside --validate-factor")
+    p.add_argument("--validate-factor", type=float, default=1.5,
+                   help="accepted measured/predicted ratio band "
+                        "[1/F, F] (default 1.5)")
     args = p.parse_args(argv)
     if not args.telemetry_dir and not args.url:
         p.error("need a TELEMETRY_DIR and/or --url")
+    if args.validate and not (args.telemetry_dir and args.url):
+        p.error("--validate needs BOTH a TELEMETRY_DIR (the recorded "
+                "heat trace) and --url (the live spiller)")
 
     thresholds = ([int(x) for x in args.thresholds.split(",") if x]
                   if args.thresholds else None)
@@ -322,11 +371,29 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             if rows:
                 lines.append("")
                 lines += render_what_if(rows)
+    rc = 0
+    if args.validate:
+        verdict = validate_swap(report.get("snapshot") or {},
+                                read_heat_trace(args.telemetry_dir),
+                                thresholds=thresholds,
+                                factor=args.validate_factor)
+        report["validate"] = verdict
+        lines.append("")
+        lines.append("--- swap hit-rate validation ---")
+        if "measured" in verdict:
+            lines.append(
+                f"measured {verdict['measured']:.3f} vs predicted "
+                f"{verdict['predicted']:.3f} at {verdict['host_mb']:.2f}"
+                f" MB (age>={verdict['age_threshold']}, ratio "
+                f"{verdict['ratio']:.2f}, band ±{verdict['factor']}x)")
+        lines.append(("PASS: " if verdict["ok"] else "FAIL: ")
+                     + verdict["reason"])
+        rc = 0 if verdict["ok"] else 1
     print("\n".join(lines))
     if args.json_out:
         with open(args.json_out, "w") as f:
             json.dump(report, f, indent=2, sort_keys=True)
-    return 0
+    return rc
 
 
 if __name__ == "__main__":  # pragma: no cover

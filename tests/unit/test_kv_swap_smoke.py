@@ -1,0 +1,27 @@
+"""Host memory tier end-to-end gate (marker: swap): real processes.
+
+Runs ``tools/check_kv_swap.py`` — a real ``bin/dstpu-serve`` under a
+deliberately small KV pool with the host tier on, where a priority burst
+forces the low-priority stream through swap-out/swap-in (counters
+asserted over /metrics), the resumed stream matches an ample-pool
+tier-off replica bit-exactly, and ``bin/dstpu-mem --validate`` judges
+the live spiller's measured hit rate against the what-if forecast from
+the same heat trace.  Same enforcement pattern as test_mem_obs_smoke.py.
+"""
+import os
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.swap
+
+
+def test_kv_swap_gate_passes():
+    repo_root = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    check = os.path.join(repo_root, "tools", "check_kv_swap.py")
+    proc = subprocess.run([sys.executable, check],
+                          capture_output=True, text=True, timeout=900)
+    assert proc.returncode == 0, \
+        f"KV swap gate failed:\n{proc.stdout}{proc.stderr[-1000:]}"

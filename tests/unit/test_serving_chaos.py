@@ -201,6 +201,80 @@ def test_chaos_traffic_mix_survivors_bit_identical(tiny_lm, tmp_path):
         tel.close()
 
 
+def test_chaos_swap_under_fault_survivors_bit_identical(tiny_lm):
+    """Swap-under-fault scenario (host memory tier): a tight pool plus a
+    priority burst forces the low-priority victim through KV-pressure
+    preemption with the host tier ON; one injected ``kv_swap`` fault
+    downgrades a spill to the plain-evict fallback, then one NaN-poisoned
+    decode window kills exactly one stream.  Survivors must stay
+    bit-identical to an unperturbed ample-pool tier-off run and every
+    block must come back to the pool."""
+    model, params = tiny_lm
+
+    def mk(num_blocks, host_tier_mb):
+        eng = InferenceEngineV2(model, params, RaggedInferenceEngineConfig(
+            max_tokens=32, max_seqs=8, max_ctx=64, block_size=8,
+            num_blocks=num_blocks, dtype=jnp.float32, attn_impl="paged",
+            host_tier_mb=host_tier_mb))
+        return eng, LifecycleScheduler(eng, max_queue=64, window_steps=4,
+                                       kv_high_watermark=0.5)
+
+    def submit_mix(sched):
+        # big low-priority decoder first, then a high-priority burst the
+        # pool cannot hold alongside it
+        sched.submit(ServeRequest(
+            uid=0, prompt=[(7 * i) % 250 + 1 for i in range(30)],
+            max_new_tokens=20, priority=0))
+        sched.step()
+        sched.step()
+        for uid in range(1, 6):
+            sched.submit(ServeRequest(
+                uid=uid, prompt=[(uid * 13 + i) % 250 + 1 for i in range(16)],
+                max_new_tokens=16, priority=1))
+
+    # reference: ample pool, tier off, no faults — uninterrupted streams
+    injection.clear()
+    _, sched_ref = mk(num_blocks=64, host_tier_mb=0.0)
+    submit_mix(sched_ref)
+    sched_ref.run_until_idle()
+    refs = {u: list(sched_ref.request(u).produced) for u in range(6)}
+
+    eng, sched = mk(num_blocks=POOL_BLOCKS, host_tier_mb=8.0)
+    free0 = eng.state_manager.free_blocks
+    try:
+        # first spill hits an injected transfer failure → must degrade to
+        # the pre-tier evict+recompute path, still bit-exact
+        injection.configure("site=kv_swap_out,kind=kv_swap,times=1")
+        submit_mix(sched)
+        for _ in range(20):
+            sched.step()
+            if sched.counters.get("serving/preempted", 0) >= 1:
+                break
+        assert sched.counters["serving/preempted"] >= 1
+        assert eng.kv_swap.stats()["spill_failures"] >= 1, \
+            "injected kv_swap fault never downgraded a spill"
+        # one poisoned decode window mid-mix
+        injection.configure("site=decode_window,kind=nan,times=1")
+        sched.step()
+        injection.clear()
+        sched.run_until_idle()
+    finally:
+        injection.clear()
+
+    states = {u: sched.request(u).state for u in range(6)}
+    nan_victims = [u for u in range(6) if states[u] == RequestState.FAILED]
+    assert len(nan_victims) == 1, f"NaN victims: {nan_victims}"
+    assert sched.request(nan_victims[0]).finish_reason == "nan"
+    survivors = [u for u in range(6) if states[u] == RequestState.FINISHED]
+    assert len(survivors) == 5, states
+    for u in survivors:
+        assert list(sched.request(u).produced) == refs[u], \
+            f"uid {u} diverged"
+    # pool conservation: host-tier entries dropped with their requests,
+    # every device block reclaimed
+    assert eng.state_manager.free_blocks == free0 == POOL_BLOCKS
+
+
 def test_chaos_goodput_ledger_conserves(tiny_lm):
     """The goodput ledger under the full chaos mix (preemption, NaN
     isolation, shedding, drain): every category the scenario exercises is

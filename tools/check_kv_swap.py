@@ -1,0 +1,253 @@
+#!/usr/bin/env python
+"""Gate the host memory tier end to end, real processes.
+
+A real ``bin/dstpu-serve`` runs under a deliberately small KV pool with
+the host tier ON; a low-priority stream is forced off the device by a
+higher-priority burst, so KV-pressure preemption must take the SWAP path
+(cold pages parked in host DRAM, resume = H2D copy + page-table patch
+instead of a prefill recompute).  A second serve with an ample pool and
+the tier OFF decodes the same prompts — every stream must match
+bit-exactly, preemption or not.  Finally ``bin/dstpu-mem --validate``
+judges the live spiller's measured hit rate against the PR-18 what-if
+prediction computed from the same recorded heat trace.
+
+Checks:
+  * serve: both replicas come up and drain clean on SIGTERM.
+  * swap: the small-pool replica preempted at least once AND the
+    preemption took the swap path (``serving_swap_out`` /
+    ``serving_swap_in`` counters over /metrics).
+  * bit-exact: victim + burst streams identical to the ample-pool
+    tier-off replica's streams.
+  * ledger: /memory carries a swap section with the tier's accounting.
+  * validate: ``dstpu-mem <trace> --url ... --validate`` exits 0 —
+    measured hit rate within 1.5x of the what-if forecast at the tier's
+    actual capacity.
+
+Usage: ``python tools/check_kv_swap.py``.  Exit status 1 lists what
+broke.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if REPO_ROOT not in sys.path:
+    sys.path.insert(0, REPO_ROOT)
+
+VICTIM_PROMPT = [(7 * i) % 250 + 1 for i in range(30)]
+VICTIM_NEW = 48
+BURST_PROMPTS = {u: [(u * 13 + i) % 250 + 1 for i in range(16)]
+                 for u in range(1, 6)}
+BURST_NEW = 16
+
+
+def _spawn_serve(tel_dir, num_blocks, host_tier_mb, timeout=120):
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO_ROOT, "bin", "dstpu-serve"),
+         "--port", "0", "--bind", "127.0.0.1", "--max-tokens", "32",
+         "--max-seqs", "8", "--max-ctx", "96", "--block-size", "8",
+         "--num-blocks", str(num_blocks),
+         "--host-tier-mb", str(host_tier_mb),
+         "--window-steps", "4", "--kv-watermark", "0.5",
+         "--drain-deadline", "300", "--telemetry-dir", tel_dir],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env)
+    found = threading.Event()
+    state = {"port": None}
+    tail = []
+
+    def _pump():
+        for line in proc.stdout:
+            if not found.is_set() and "dstpu-serve listening on" in line:
+                state["port"] = int(line.rsplit(":", 1)[1])
+                found.set()
+            tail.append(line)
+            del tail[:-50]
+        found.set()
+
+    threading.Thread(target=_pump, daemon=True).start()
+    found.wait(timeout)
+    return proc, state["port"], tail
+
+
+def _get(port, path, timeout=30, raw=False):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        body = r.read()
+    return body.decode() if raw else json.loads(body)
+
+
+def _post(port, body, timeout=600):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/generate",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"})
+    with urllib.request.urlopen(req, timeout=timeout) as r:
+        return json.loads(r.read())
+
+
+def _stop(proc):
+    if proc.poll() is None:
+        proc.send_signal(signal.SIGTERM)
+    try:
+        return proc.wait(timeout=330)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        return -9
+
+
+def _counter(metrics_text, name):
+    """Sum a prometheus counter across label sets."""
+    total = 0.0
+    for m in re.finditer(
+            rf"^{re.escape(name)}(?:\{{[^}}]*\}})? ([0-9.e+-]+)$",
+            metrics_text, re.M):
+        total += float(m.group(1))
+    return total
+
+
+def _run_traffic(port):
+    """The forcing scenario: victim decodes under priority 0, then a
+    priority-1 burst starves the pool.  Returns {label: tokens}."""
+    results = {}
+
+    def post(label, prompt, max_new, priority):
+        try:
+            results[label] = _post(port, {
+                "prompt": prompt, "max_new_tokens": max_new,
+                "priority": priority, "tenant": "gate"})
+        except Exception as e:  # noqa: BLE001 — checked by caller
+            results[label] = {"error": repr(e)}
+
+    t_vic = threading.Thread(
+        target=post, args=("victim", VICTIM_PROMPT, VICTIM_NEW, 0),
+        daemon=True)
+    t_vic.start()
+    # wait until the victim is actually holding KV (prefill landed) so
+    # the burst arrives mid-decode, not mid-queue
+    deadline = time.monotonic() + 120
+    while time.monotonic() < deadline:
+        try:
+            snap = _get(port, "/memory", timeout=10)
+        except Exception:  # noqa: BLE001 — server still warming
+            time.sleep(0.1)
+            continue
+        if ((snap.get("kv") or {}).get("live_pages") or 0) >= 4:
+            break
+        time.sleep(0.05)
+    burst = []
+    for u, p in BURST_PROMPTS.items():
+        t = threading.Thread(target=post,
+                             args=(f"burst{u}", p, BURST_NEW, 1),
+                             daemon=True)
+        t.start()
+        burst.append(t)
+    t_vic.join(timeout=600)
+    for t in burst:
+        t.join(timeout=600)
+    return results
+
+
+def main(argv=None) -> int:
+    failures = []
+
+    def check(name, ok, detail=""):
+        if not ok:
+            failures.append(f"{name}: {detail}")
+
+    tel_swap = "/tmp/dstpu_kv_swap_gate"
+    tel_ref = "/tmp/dstpu_kv_swap_gate_ref"
+    for d in (tel_swap, tel_ref):
+        shutil.rmtree(d, ignore_errors=True)
+
+    # swap arm: pool too small for victim + burst, host tier ON
+    proc_s, port_s, tail_s = _spawn_serve(tel_swap, num_blocks=24,
+                                          host_tier_mb=8.0)
+    # reference arm: ample pool, tier OFF — the uninterrupted streams
+    proc_r, port_r, tail_r = _spawn_serve(tel_ref, num_blocks=64,
+                                          host_tier_mb=0.0)
+    snap = {}
+    try:
+        check("serve: swap replica came up", port_s is not None,
+              "".join(tail_s[-10:]))
+        check("serve: reference replica came up", port_r is not None,
+              "".join(tail_r[-10:]))
+        if port_s is None or port_r is None:
+            return _finish(failures)
+
+        got = _run_traffic(port_s)
+        ref = _run_traffic(port_r)
+        for label in ["victim"] + [f"burst{u}" for u in BURST_PROMPTS]:
+            check(f"traffic: {label} finished on the swap replica",
+                  got.get(label, {}).get("state") == "finished",
+                  str(got.get(label))[:200])
+            check(f"traffic: {label} finished on the reference replica",
+                  ref.get(label, {}).get("state") == "finished",
+                  str(ref.get(label))[:200])
+            check(f"bit-exact: {label} stream identical to the "
+                  f"uninterrupted run",
+                  got.get(label, {}).get("tokens")
+                  == ref.get(label, {}).get("tokens"),
+                  f"swap={got.get(label, {}).get('tokens')} "
+                  f"ref={ref.get(label, {}).get('tokens')}")
+
+        metrics = _get(port_s, "/metrics", raw=True)
+        check("swap: preemption was forced",
+              _counter(metrics, "serving_preempted") >= 1, metrics[-400:])
+        check("swap: preemption took the swap-out path",
+              _counter(metrics, "serving_swap_out") >= 1, metrics[-400:])
+        check("swap: resume took the swap-in path",
+              _counter(metrics, "serving_swap_in") >= 1, metrics[-400:])
+
+        snap = _get(port_s, "/memory")
+        swap = snap.get("swap") or {}
+        check("ledger: /memory carries the swap section",
+              swap.get("swapped_out", 0) >= 1
+              and swap.get("host_capacity_bytes", 0) > 0,
+              str(swap)[:300])
+
+        # validate: measured hit rate vs the what-if forecast from the
+        # SAME heat trace, through the real CLI
+        cli = subprocess.run(
+            [sys.executable, os.path.join(REPO_ROOT, "bin", "dstpu-mem"),
+             tel_swap, "--url", f"http://127.0.0.1:{port_s}",
+             "--validate", "--validate-factor", "1.5"],
+            capture_output=True, text=True, timeout=120)
+        check("validate: dstpu-mem --validate exit 0 (measured within "
+              "1.5x of what-if prediction)", cli.returncode == 0,
+              f"rc={cli.returncode} out={cli.stdout[-400:]} "
+              f"err={cli.stderr[-200:]}")
+        check("validate: verdict rendered",
+              "swap hit-rate validation" in cli.stdout,
+              cli.stdout[-300:])
+    finally:
+        rc_s = _stop(proc_s)
+        rc_r = _stop(proc_r)
+    check("serve: swap replica drained clean", rc_s == 0, f"rc={rc_s}")
+    check("serve: reference replica drained clean", rc_r == 0,
+          f"rc={rc_r}")
+    return _finish(failures)
+
+
+def _finish(failures) -> int:
+    if failures:
+        print("\n".join(failures))
+        print(f"\n{len(failures)} KV swap gate check(s) failed "
+              f"(tools/check_kv_swap.py)")
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
